@@ -1,0 +1,66 @@
+"""In-process fake kubelet for end-to-end plugin tests.
+
+Implements the kubelet side of the device-plugin contract — a Registration
+gRPC server on ``kubelet.sock`` plus DevicePlugin client stubs — which the
+reference entirely lacks (its NVML/server code is only exercised on real
+hardware; SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import grpc
+
+from tpu_device_plugin.api import pb, rpc
+
+
+class FakeKubelet(rpc.RegistrationServicer):
+    """Registration server + plugin-client factory rooted at ``plugin_dir``."""
+
+    def __init__(self, plugin_dir: str):
+        self.plugin_dir = plugin_dir
+        self.socket_path = os.path.join(plugin_dir, "kubelet.sock")
+        self.registrations: list = []
+        self.registered = threading.Event()
+        self._server: grpc.Server | None = None
+        self._channels: list[grpc.Channel] = []
+
+    def Register(self, request, context):  # noqa: N802
+        self.registrations.append(request)
+        self.registered.set()
+        return pb.Empty()
+
+    def start(self) -> None:
+        os.makedirs(self.plugin_dir, exist_ok=True)
+        try:
+            os.remove(self.socket_path)
+        except FileNotFoundError:
+            pass
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=4))
+        rpc.add_registration_servicer(self, self._server)
+        assert self._server.add_insecure_port(f"unix:{self.socket_path}") != 0
+        self._server.start()
+
+    def stop(self) -> None:
+        for ch in self._channels:
+            ch.close()
+        self._channels.clear()
+        if self._server is not None:
+            self._server.stop(grace=0.2).wait(timeout=5)
+            self._server = None
+
+    def plugin_client(self, endpoint: str) -> rpc.DevicePluginStub:
+        """DevicePlugin stub for a plugin socket registered as ``endpoint``."""
+        channel = grpc.insecure_channel(
+            f"unix:{os.path.join(self.plugin_dir, endpoint)}"
+        )
+        grpc.channel_ready_future(channel).result(timeout=5)
+        self._channels.append(channel)
+        return rpc.DevicePluginStub(channel)
+
+    def wait_for_registration(self, timeout: float = 5.0):
+        assert self.registered.wait(timeout), "plugin never registered"
+        return self.registrations[-1]

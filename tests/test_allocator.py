@@ -1,0 +1,160 @@
+"""Preferred-allocation policies: simple, ICI best-effort, static slices."""
+
+import pytest
+
+from tpu_device_plugin.allocator import PolicyError, SimplePolicy
+from tpu_device_plugin.allocator.besteffort import BestEffortPolicy
+from tpu_device_plugin.allocator.static_slices import (
+    StaticSlicePolicy,
+    multi_host_slice_policy,
+    tray_aligned_policy,
+)
+from tpu_device_plugin.topology import Topology, build_fake_topology
+from tpu_device_plugin.device import Chip
+
+
+def ids(n, prefix="tpu"):
+    return [f"{prefix}-{i}" for i in range(n)]
+
+
+class TestSimplePolicy:
+    def test_sorted_prefix(self):
+        got = SimplePolicy().allocate(["tpu-2", "tpu-0", "tpu-1"], [], 2)
+        assert got == ["tpu-0", "tpu-1"]
+
+    def test_required_first(self):
+        got = SimplePolicy().allocate(["tpu-2", "tpu-0", "tpu-1"], ["tpu-2"], 2)
+        assert got == ["tpu-0", "tpu-2"]
+
+    @pytest.mark.parametrize(
+        "available, required, size",
+        [
+            (["a"], [], 2),          # size > available
+            (["a", "b"], ["c"], 2),  # required not available
+            (["a", "b"], ["a", "b"], 1),  # required > size
+            (["a"], [], -1),
+        ],
+    )
+    def test_invalid_requests(self, available, required, size):
+        with pytest.raises(PolicyError):
+            SimplePolicy().allocate(available, required, size)
+
+
+class TestBestEffortPolicy:
+    def test_prefers_same_tray(self):
+        topo = build_fake_topology(8, 4)  # trays {0..3}, {4..7}
+        policy = BestEffortPolicy(topo)
+        got = policy.allocate(ids(8), [], 4)
+        assert got == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+
+    def test_packs_around_required(self):
+        topo = build_fake_topology(8, 4)
+        policy = BestEffortPolicy(topo)
+        got = policy.allocate(ids(8), ["tpu-5"], 2)
+        # Best partner for tpu-5 is a same-tray neighbour.
+        assert "tpu-5" in got and set(got) <= {"tpu-4", "tpu-5", "tpu-6", "tpu-7"}
+
+    def test_leaves_remainder_compact(self):
+        # 2 trays of 2: picking one whole tray keeps the other intact.
+        topo = build_fake_topology(4, 2)
+        policy = BestEffortPolicy(topo)
+        got = policy.allocate(["tpu-0", "tpu-1", "tpu-2", "tpu-3"], [], 2)
+        assert got in (["tpu-0", "tpu-1"], ["tpu-2", "tpu-3"])
+
+    def test_deterministic_tie_break(self):
+        topo = build_fake_topology(4, 4)
+        policy = BestEffortPolicy(topo)
+        assert policy.allocate(ids(4), [], 1) == policy.allocate(ids(4), [], 1)
+
+    def test_greedy_path_on_large_pools(self, monkeypatch):
+        import tpu_device_plugin.allocator.besteffort as be
+
+        monkeypatch.setattr(be, "MAX_EXHAUSTIVE_WORK", 1)
+        topo = build_fake_topology(8, 4)
+        policy = BestEffortPolicy(topo)
+        got = policy.allocate(ids(8), [], 4)
+        assert got == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+
+    def test_greedy_tie_break_prefers_lexicographically_smallest(self, monkeypatch):
+        import tpu_device_plugin.allocator.besteffort as be
+
+        monkeypatch.setattr(be, "MAX_EXHAUSTIVE_WORK", 1)
+        # All pair scores equal (single tray), IDs where one is a prefix of
+        # another: 'c-1' must beat 'c-10'.
+        from tpu_device_plugin.device import Chip
+        from tpu_device_plugin.topology import Topology
+
+        topo = Topology(torus_shape=(12, 1, 1))
+        for i in range(12):
+            cid = f"c-{i}"
+            topo.chips_by_id[cid] = Chip(id=cid, index=i, coords=(0, 0, 0), tray=0)
+        policy = BestEffortPolicy(topo)
+        got = policy.allocate([f"c-{i}" for i in range(12)], [], 1)
+        assert got == ["c-0"]
+        got = policy.allocate(["c-10", "c-1", "c-11", "c-12"], [], 1)
+        assert got == ["c-1"]
+
+    def test_admission_path_latency_budget(self):
+        # GetPreferredAllocation runs inside a synchronous kubelet RPC; the
+        # v5p-16-host worst case must stay far below the dial timeout.
+        import time
+
+        topo = build_fake_topology(16, 4)
+        policy = BestEffortPolicy(topo)
+        t0 = time.monotonic()
+        got = policy.allocate(sorted(topo.chips_by_id), [], 8)
+        elapsed = time.monotonic() - t0
+        assert len(got) == 8
+        assert elapsed < 0.5, f"preferred allocation took {elapsed:.2f}s"
+
+
+class TestStaticSlicePolicy:
+    def test_tray_aligned_whole_tray_first(self):
+        topo = build_fake_topology(8, 4)
+        policy = tray_aligned_policy(topo)
+        got = policy.allocate(ids(8), [], 4)
+        assert got == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+        # With tray 0 partly taken, the intact tray 1 wins.
+        got = policy.allocate(["tpu-1", "tpu-2", "tpu-3", "tpu-4", "tpu-5", "tpu-6", "tpu-7"], [], 4)
+        assert got == ["tpu-4", "tpu-5", "tpu-6", "tpu-7"]
+
+    def test_fallback_to_besteffort_for_odd_sizes(self):
+        topo = build_fake_topology(8, 4)
+        policy = tray_aligned_policy(topo)
+        got = policy.allocate(ids(8), [], 3)  # no static set of size 3
+        assert len(got) == 3 and len(set(got)) == 3
+
+    def test_multi_host_v5p16_packing(self):
+        # v5p-16 slice: 4 hosts x 4 chips; the policy packs whole hosts and
+        # then ICI-adjacent host groups (BASELINE configs[4]).
+        topo = Topology(accelerator_type="v5p", torus_shape=(4, 4, 1), wraparound=False)
+        hosts = {}
+        for h in range(4):
+            chip_ids = []
+            for i in range(4):
+                cid = f"host{h}-chip{i}"
+                coords = (i, h, 0)
+                if h == 0:
+                    topo.chips_by_id[cid] = Chip(id=cid, index=i, coords=coords, tray=h)
+                else:
+                    topo.remote_coords[cid] = coords
+                    topo.remote_trays[cid] = h
+                chip_ids.append(cid)
+            hosts[f"host{h}"] = chip_ids
+        policy = multi_host_slice_policy(topo, hosts)
+        all_ids = [c for ids_ in hosts.values() for c in ids_]
+        got = policy.allocate(all_ids, [], 4)
+        assert got == sorted(hosts["host0"])
+        got8 = policy.allocate(all_ids, [], 8)
+        assert got8 == sorted(hosts["host0"] + hosts["host1"])
+        # host0 busy -> next adjacent pair.
+        remaining = [c for h in ("host1", "host2", "host3") for c in hosts[h]]
+        got8b = policy.allocate(remaining, [], 8)
+        assert got8b == sorted(hosts["host1"] + hosts["host2"])
+
+    def test_static_respects_required_and_availability(self):
+        topo = build_fake_topology(8, 4)
+        policy = StaticSlicePolicy(
+            topo, {2: [["tpu-0", "tpu-1"], ["tpu-2", "tpu-3"]]}
+        )
+        assert policy.allocate(ids(8), ["tpu-2"], 2) == ["tpu-2", "tpu-3"]

@@ -1,0 +1,58 @@
+"""Fake backend: lifecycle, discovery, scriptable health events."""
+
+import queue
+import threading
+
+import pytest
+
+from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+from tpu_device_plugin.backend import BackendInitError
+from tpu_device_plugin.backend.fake import FakeChipManager
+
+
+def test_lifecycle_and_devices():
+    mgr = FakeChipManager(n_chips=4, chips_per_tray=4)
+    with pytest.raises(BackendInitError):
+        mgr.devices()
+    mgr.init()
+    devs = mgr.devices()
+    assert [d.id for d in devs] == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+    assert all(d.health == HEALTHY for d in devs)
+    # Snapshots are copies; mutating them does not corrupt the backend.
+    devs[0].health = UNHEALTHY
+    assert mgr.devices()[0].health == HEALTHY
+    mgr.shutdown()
+
+
+def test_fail_init():
+    mgr = FakeChipManager(fail_init=True)
+    with pytest.raises(BackendInitError):
+        mgr.init()
+
+
+def test_health_event_forwarding_and_filtering():
+    mgr = FakeChipManager(n_chips=2)
+    mgr.init()
+    chips = mgr.devices()
+    stop = threading.Event()
+    events: queue.Queue = queue.Queue()
+    t = threading.Thread(
+        target=mgr.check_health, args=(stop, events, chips[:1]), daemon=True
+    )
+    t.start()
+    try:
+        mgr.inject("tpu-1", UNHEALTHY)  # not watched by this plugin
+        mgr.inject("tpu-0", UNHEALTHY)
+        ev = events.get(timeout=2)
+        assert ev.chip_id == "tpu-0" and ev.health == UNHEALTHY
+        mgr.inject("tpu-0", HEALTHY)  # recovery events are supported
+        ev = events.get(timeout=2)
+        assert ev.health == HEALTHY
+        mgr.inject("", UNHEALTHY)  # unattributed event reaches every watcher
+        ev = events.get(timeout=2)
+        assert ev.all_chips
+        assert events.empty()
+    finally:
+        stop.set()
+        t.join(timeout=2)
+    assert not t.is_alive()

@@ -1,0 +1,113 @@
+"""Spec for the versioned config API: precedence CLI > env > file > default
+(reference: api/config/v1/config.go:111-144)."""
+
+import pytest
+
+from tpu_device_plugin import config as cfg
+
+
+def test_defaults():
+    c = cfg.load(cli_values={}, env={})
+    assert c.version == "v1"
+    assert c.flags.topology_strategy == "chip"
+    assert c.flags.fail_on_init_error is True
+    assert c.flags.pass_device_specs is True
+    assert c.flags.device_list_strategy == "envvar"
+    assert c.flags.device_id_strategy == "uuid"
+    assert c.flags.backend == "tpu"
+
+
+def test_env_overrides_default():
+    c = cfg.load(cli_values={}, env={"TOPOLOGY_STRATEGY": "tray", "FAIL_ON_INIT_ERROR": "false"})
+    assert c.flags.topology_strategy == "tray"
+    assert c.flags.fail_on_init_error is False
+
+
+def test_cli_overrides_env():
+    c = cfg.load(
+        cli_values={"topology_strategy": "mixed"},
+        env={"TOPOLOGY_STRATEGY": "tray"},
+    )
+    assert c.flags.topology_strategy == "mixed"
+
+
+def test_file_lowest_precedence(tmp_path):
+    f = tmp_path / "config.yaml"
+    f.write_text(
+        "version: v1\n"
+        "flags:\n"
+        "  topologyStrategy: tray\n"
+        "  deviceIdStrategy: index\n"
+        "  resourceConfig: tpu:shared:4\n"
+    )
+    c = cfg.load(cli_values={"config_file": str(f)}, env={"TOPOLOGY_STRATEGY": "chip"})
+    assert c.flags.topology_strategy == "chip"  # env beats file
+    assert c.flags.device_id_strategy == "index"  # file beats default
+    assert c.flags.resource_config == "tpu:shared:4"
+
+
+def test_file_json_and_env_located_file(tmp_path):
+    f = tmp_path / "config.json"
+    f.write_text('{"version": "v1", "flags": {"backend": "fake"}}')
+    c = cfg.load(cli_values={}, env={"CONFIG_FILE": str(f)})
+    assert c.flags.backend == "fake"
+
+
+def test_file_missing_version(tmp_path):
+    f = tmp_path / "config.yaml"
+    f.write_text("flags: {}\n")
+    with pytest.raises(cfg.ConfigError, match="version"):
+        cfg.load(cli_values={"config_file": str(f)}, env={})
+
+
+def test_file_bad_version(tmp_path):
+    f = tmp_path / "config.yaml"
+    f.write_text("version: v2\nflags: {}\n")
+    with pytest.raises(cfg.ConfigError, match="unknown version"):
+        cfg.load(cli_values={"config_file": str(f)}, env={})
+
+
+def test_file_unknown_flag(tmp_path):
+    f = tmp_path / "config.yaml"
+    f.write_text("version: v1\nflags: {bogus: 1}\n")
+    with pytest.raises(cfg.ConfigError, match="unknown flag"):
+        cfg.load(cli_values={"config_file": str(f)}, env={})
+
+
+def test_strategy_aliases():
+    # Reference-compatible names none/single/mixed map onto chip/tray/mixed.
+    assert cfg.load(cli_values={"topology_strategy": "none"}, env={}).flags.topology_strategy == "chip"
+    assert cfg.load(cli_values={"topology_strategy": "single"}, env={}).flags.topology_strategy == "tray"
+
+
+@pytest.mark.parametrize(
+    "cli",
+    [
+        {"topology_strategy": "bogus"},
+        {"device_list_strategy": "bogus"},
+        {"device_id_strategy": "bogus"},
+        {"backend": "bogus"},
+        {"resource_config": "tpu:bad"},
+        {"backend": "fake", "fake_topology": "nope"},
+    ],
+)
+def test_validation_errors(cli):
+    with pytest.raises(cfg.ConfigError):
+        cfg.load(cli_values=cli, env={})
+
+
+def test_bool_env_parsing():
+    for text, want in [("1", True), ("true", True), ("0", False), ("no", False)]:
+        c = cfg.load(cli_values={}, env={"PASS_DEVICE_SPECS": text})
+        assert c.flags.pass_device_specs is want
+    with pytest.raises(cfg.ConfigError):
+        cfg.load(cli_values={}, env={"PASS_DEVICE_SPECS": "maybe"})
+
+
+def test_to_json_roundtrip():
+    import json
+
+    c = cfg.load(cli_values={}, env={})
+    doc = json.loads(c.to_json())
+    assert doc["version"] == "v1"
+    assert doc["flags"]["topology_strategy"] == "chip"

@@ -1,0 +1,138 @@
+"""Daemon lifecycle: smoke config, restart orchestration, signal handling.
+
+Covers BASELINE configs[0] (chip-less node, failOnInitError=false, daemon
+blocks quietly) and the reference's restart paths (SIGHUP, kubelet-socket
+recreation, terminal signals — main.go:286-324)."""
+
+import os
+import queue
+import signal
+import threading
+import time
+
+import pytest
+
+from tpu_device_plugin.backend.fake import FakeChipManager
+from tpu_device_plugin.config import Config, Flags
+from tpu_device_plugin.main import Daemon, FatalEvent, make_backend
+from tpu_device_plugin.watchers import KubeletSocketWatcher, SignalEvent, SocketEvent
+
+from .fake_kubelet import FakeKubelet
+
+
+def run_daemon_async(daemon):
+    result = {}
+
+    def target():
+        result["code"] = daemon.run()
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    return t, result
+
+
+def make_daemon(tmp_path, kubelet, flags=None, backend=None):
+    flags = flags or Flags(backend="fake", fake_topology="4x4")
+    flags.device_plugin_path = kubelet.plugin_dir
+    cfg = Config(flags=flags)
+    backend = backend or FakeChipManager(n_chips=4, chips_per_tray=4)
+    return Daemon(cfg, backend=backend, lease_dir=str(tmp_path / "leases"))
+
+
+@pytest.fixture
+def kubelet(tmp_path):
+    k = FakeKubelet(str(tmp_path / "device-plugins"))
+    k.start()
+    yield k
+    k.stop()
+
+
+def test_smoke_cpu_only_node_blocks_quietly(tmp_path):
+    """BASELINE configs[0]: no TPU stack, failOnInitError=false ⇒ no exit,
+    no devices, clean shutdown on SIGTERM."""
+    flags = Flags(backend="fake", fail_on_init_error=False,
+                  device_plugin_path=str(tmp_path / "dp"))
+    daemon = Daemon(
+        Config(flags=flags),
+        backend=FakeChipManager(fail_init=True),
+        lease_dir=str(tmp_path / "leases"),
+    )
+    t, result = run_daemon_async(daemon)
+    time.sleep(0.3)
+    assert t.is_alive()  # blocked, not crashed
+    daemon.request_stop()
+    t.join(timeout=5)
+    assert result["code"] == 0
+
+
+def test_fail_on_init_error_exits_nonzero(tmp_path):
+    flags = Flags(backend="fake", fail_on_init_error=True,
+                  device_plugin_path=str(tmp_path / "dp"))
+    daemon = Daemon(
+        Config(flags=flags),
+        backend=FakeChipManager(fail_init=True),
+        lease_dir=str(tmp_path / "leases"),
+    )
+    assert daemon.run() == 1
+
+
+def test_serve_register_and_terminal_signal(tmp_path, kubelet):
+    daemon = make_daemon(tmp_path, kubelet)
+    t, result = run_daemon_async(daemon)
+    reg = kubelet.wait_for_registration()
+    assert reg.resource_name == "google.com/tpu"
+    assert daemon.started.wait(5)
+    daemon.events.put(SignalEvent(signum=signal.SIGTERM))
+    t.join(timeout=10)
+    assert result["code"] == 0
+    assert not os.path.exists(os.path.join(kubelet.plugin_dir, "tpu-tpu.sock"))
+
+
+def test_sighup_restarts_and_reregisters(tmp_path, kubelet):
+    daemon = make_daemon(tmp_path, kubelet)
+    t, result = run_daemon_async(daemon)
+    kubelet.wait_for_registration()
+    assert daemon.started.wait(5)
+    n_before = len(kubelet.registrations)
+
+    daemon.events.put(SignalEvent(signum=signal.SIGHUP))
+    deadline = time.monotonic() + 10
+    while len(kubelet.registrations) <= n_before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert len(kubelet.registrations) > n_before  # re-registered after restart
+
+    daemon.events.put(SignalEvent(signum=signal.SIGTERM))
+    t.join(timeout=10)
+    assert result["code"] == 0
+
+
+def test_fatal_event_exits_nonzero(tmp_path, kubelet):
+    daemon = make_daemon(tmp_path, kubelet)
+    t, result = run_daemon_async(daemon)
+    kubelet.wait_for_registration()
+    assert daemon.started.wait(5)
+    daemon.events.put(FatalEvent(message="crash budget exceeded"))
+    t.join(timeout=10)
+    assert result["code"] == 1
+
+
+def test_kubelet_socket_watcher_detects_recreation(tmp_path):
+    sock = tmp_path / "kubelet.sock"
+    sock.write_text("")
+    events: queue.Queue = queue.Queue()
+    watcher = KubeletSocketWatcher(str(sock), events, poll_secs=0.05)
+    watcher.start()
+    try:
+        time.sleep(0.15)  # baseline inode observed
+        os.remove(sock)
+        sock.write_text("")  # recreated -> new inode
+        event = events.get(timeout=5)
+        assert isinstance(event, SocketEvent)
+    finally:
+        watcher.stop()
+
+
+def test_make_backend_selects_fake_topology():
+    backend = make_backend(Flags(backend="fake", fake_topology="8x4"))
+    backend.init()
+    assert len(backend.devices()) == 8

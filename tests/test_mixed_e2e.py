@@ -1,0 +1,165 @@
+"""Mixed-strategy end-to-end: overlapping chip/tray views, shared health
+fan-out, claim reconciliation with TTL recovery (BASELINE configs[3])."""
+
+import time
+
+import pytest
+
+from tpu_device_plugin.api import pb
+from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+from tpu_device_plugin.backend.fake import FakeChipManager
+from tpu_device_plugin.config import Config, Flags
+from tpu_device_plugin.resource_config import ResourceConfig
+from tpu_device_plugin.strategy import new_topology_strategy
+
+from .fake_kubelet import FakeKubelet
+
+
+@pytest.fixture
+def stack(tmp_path):
+    kubelet = FakeKubelet(str(tmp_path / "device-plugins"))
+    kubelet.start()
+    mgr = FakeChipManager(n_chips=4, chips_per_tray=4)
+    mgr.init()
+    cfg = Config(
+        flags=Flags(
+            backend="fake",
+            topology_strategy="mixed",
+            mixed_claim_ttl_secs=1.0,
+            device_plugin_path=kubelet.plugin_dir,
+        )
+    )
+    strategy = new_topology_strategy(
+        cfg,
+        ResourceConfig(),
+        mgr,
+        plugin_dir=kubelet.plugin_dir,
+        kubelet_socket=kubelet.socket_path,
+        lease_dir=str(tmp_path / "leases"),
+    )
+    plugins = strategy.get_plugins()
+    for p in plugins:
+        p.start()
+    yield kubelet, mgr, plugins
+    for p in plugins:
+        p.stop()
+    kubelet.stop()
+
+
+def stub_for(kubelet, plugins, resource):
+    plugin = next(p for p in plugins if p.resource_name == resource)
+    import os
+
+    return kubelet.plugin_client(os.path.basename(plugin.socket_path))
+
+
+def test_health_event_reaches_both_views(stack):
+    kubelet, mgr, plugins = stack
+    chip_stub = stub_for(kubelet, plugins, "google.com/tpu")
+    tray_stub = stub_for(kubelet, plugins, "google.com/tpu-tray")
+
+    chip_stream = iter(chip_stub.ListAndWatch(pb.Empty()))
+    tray_stream = iter(tray_stub.ListAndWatch(pb.Empty()))
+    assert all(d.health == HEALTHY for d in next(chip_stream).devices)
+    assert all(d.health == HEALTHY for d in next(tray_stream).devices)
+
+    mgr.inject("tpu-1", UNHEALTHY)
+    chip_update = {d.ID: d.health for d in next(chip_stream).devices}
+    tray_update = {d.ID: d.health for d in next(tray_stream).devices}
+    # Both plugins observed the same event (single watcher, fanned out).
+    assert chip_update["tpu-1"] == UNHEALTHY
+    assert chip_update["tpu-0"] == HEALTHY
+    assert tray_update["tray-0"] == UNHEALTHY  # tray contains the dead chip
+
+
+def test_tray_allocation_claims_chips_and_ttl_recovers(stack):
+    kubelet, mgr, plugins = stack
+    chip_stub = stub_for(kubelet, plugins, "google.com/tpu")
+    tray_stub = stub_for(kubelet, plugins, "google.com/tpu-tray")
+
+    chip_stream = iter(chip_stub.ListAndWatch(pb.Empty()))
+    next(chip_stream)  # initial, all healthy
+
+    tray_stub.Allocate(
+        pb.AllocateRequest(
+            container_requests=[pb.ContainerAllocateRequest(devicesIDs=["tray-0"])]
+        )
+    )
+    update = {d.ID: d.health for d in next(chip_stream).devices}
+    assert all(h == UNHEALTHY for h in update.values())  # all 4 chips claimed
+
+    # After the claim TTL, the chip view recovers via the lazy sweep.
+    deadline = time.monotonic() + 5
+    recovered = {}
+    while time.monotonic() < deadline:
+        recovered = {d.ID: d.health for d in next(chip_stream).devices}
+        if all(h == HEALTHY for h in recovered.values()):
+            break
+    assert all(h == HEALTHY for h in recovered.values())
+
+
+def test_invalid_multi_container_allocate_leaves_no_orphan_claims(stack):
+    import grpc
+
+    kubelet, mgr, plugins = stack
+    chip_stub = stub_for(kubelet, plugins, "google.com/tpu")
+    tray_stub = stub_for(kubelet, plugins, "google.com/tpu-tray")
+
+    with pytest.raises(grpc.RpcError):
+        tray_stub.Allocate(
+            pb.AllocateRequest(
+                container_requests=[
+                    pb.ContainerAllocateRequest(devicesIDs=["tray-0"]),  # valid
+                    pb.ContainerAllocateRequest(devicesIDs=["bogus"]),  # invalid
+                ]
+            )
+        )
+    # The failed request must not have claimed tray-0's chips.
+    resp = next(iter(chip_stub.ListAndWatch(pb.Empty())))
+    assert all(d.health == HEALTHY for d in resp.devices)
+
+
+def test_late_subscriber_sees_prior_health_state(tmp_path):
+    """A plugin that starts after a chip already failed must still advertise
+    it Unhealthy (fan-out replays latched state on subscribe)."""
+    import queue
+
+    from tpu_device_plugin.health import HealthFanout
+
+    mgr = FakeChipManager(n_chips=2)
+    mgr.init()
+    fanout = HealthFanout(mgr)
+    q1 = fanout.subscribe()
+    mgr.inject("tpu-0", UNHEALTHY)
+    ev = q1.get(timeout=5)
+    assert ev.chip_id == "tpu-0"
+
+    q2 = fanout.subscribe()  # late joiner
+    ev = q2.get(timeout=5)
+    assert ev.chip_id == "tpu-0" and ev.health == UNHEALTHY
+    # Recovery reaches both, and a third subscriber sees nothing stale.
+    mgr.inject("tpu-0", HEALTHY)
+    assert q1.get(timeout=5).health == HEALTHY
+    assert q2.get(timeout=5).health == HEALTHY
+    q3 = fanout.subscribe()
+    with pytest.raises(queue.Empty):
+        q3.get(timeout=0.3)
+    for q in (q1, q2, q3):
+        fanout.unsubscribe(q)
+
+
+def test_chip_allocation_marks_tray_unhealthy(stack):
+    kubelet, mgr, plugins = stack
+    chip_stub = stub_for(kubelet, plugins, "google.com/tpu")
+    tray_stub = stub_for(kubelet, plugins, "google.com/tpu-tray")
+
+    tray_stream = iter(tray_stub.ListAndWatch(pb.Empty()))
+    next(tray_stream)
+
+    chip_stub.Allocate(
+        pb.AllocateRequest(
+            container_requests=[pb.ContainerAllocateRequest(devicesIDs=["tpu-2"])]
+        )
+    )
+    update = {d.ID: d.health for d in next(tray_stream).devices}
+    assert update["tray-0"] == UNHEALTHY

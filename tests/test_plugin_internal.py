@@ -1,0 +1,117 @@
+"""Unit tests for plugin-internal machinery: crash budget, claim ledger,
+sharing env composition."""
+
+from tpu_device_plugin.device import Chip
+from tpu_device_plugin.plugin import ClaimLedger, CrashBudget
+from tpu_device_plugin.sharing import container_env, process_bounds
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, secs):
+        self.now += secs
+
+
+class TestCrashBudget:
+    def test_allows_up_to_max_rapid_crashes(self):
+        clock = FakeClock()
+        budget = CrashBudget(max_crashes=5, window_secs=3600, clock=clock)
+        for _ in range(5):
+            clock.advance(1)
+            assert budget.record_crash()
+        clock.advance(1)
+        assert not budget.record_crash()  # 6th rapid crash exceeds the budget
+
+    def test_quiet_hour_resets_count(self):
+        clock = FakeClock()
+        budget = CrashBudget(max_crashes=5, window_secs=3600, clock=clock)
+        for _ in range(5):
+            clock.advance(1)
+            assert budget.record_crash()
+        clock.advance(4000)  # more than the window since the last crash
+        assert budget.record_crash()
+
+
+class TestClaimLedger:
+    def test_claims_visible_to_other_resources_only(self):
+        ledger = ClaimLedger()
+        ledger.claim("google.com/tpu-tray", ["tpu-0", "tpu-1"])
+        assert ledger.claimed_by_other("google.com/tpu") == {"tpu-0", "tpu-1"}
+        assert ledger.claimed_by_other("google.com/tpu-tray") == set()
+
+    def test_release(self):
+        ledger = ClaimLedger()
+        ledger.claim("a", ["c0", "c1"])
+        ledger.release(["c0"])
+        assert ledger.claimed_by_other("b") == {"c1"}
+
+    def test_ttl_expiry(self):
+        clock = FakeClock()
+        ledger = ClaimLedger(ttl_secs=60, clock=clock)
+        ledger.claim("a", ["c0"])
+        clock.advance(61)
+        assert ledger.claimed_by_other("b") == set()
+
+    def test_listeners_fire_on_claim_and_release(self):
+        ledger = ClaimLedger()
+        calls = []
+        ledger.subscribe(lambda: calls.append(1))
+        ledger.claim("a", ["c0"])
+        ledger.release(["c0"])
+        assert len(calls) == 2
+
+    def test_sweep_notifies_all_listeners(self):
+        # Regression: whichever plugin sweeps first must wake its siblings —
+        # the sweeper is usually the plugin whose own view was never blocked.
+        clock = FakeClock()
+        ledger = ClaimLedger(ttl_secs=60, clock=clock)
+        calls = {"a": 0, "b": 0}
+        ledger.subscribe(lambda: calls.__setitem__("a", calls["a"] + 1))
+        ledger.subscribe(lambda: calls.__setitem__("b", calls["b"] + 1))
+        ledger.claim("tray", ["c0"])
+        assert calls == {"a": 1, "b": 1}
+        clock.advance(61)
+        assert ledger.sweep() is True
+        assert calls == {"a": 2, "b": 2}
+        assert ledger.sweep() is False  # second sweeper: nothing left
+        assert calls == {"a": 2, "b": 2}
+
+
+class TestSharingEnv:
+    def chips(self, coords_list):
+        return [
+            Chip(id=f"tpu-{i}", index=i, coords=c) for i, c in enumerate(coords_list)
+        ]
+
+    def test_process_bounds_bounding_box(self):
+        chips = self.chips([(0, 0, 0), (1, 0, 0), (0, 1, 0), (1, 1, 0)])
+        assert process_bounds(chips) == ("2,2,1", "1,1,1")
+        assert process_bounds([]) == ("1,1,1", "1,1,1")
+
+    def test_process_bounds_non_contiguous_omitted(self):
+        # Chips not filling their bounding box (fragmented hand-out): no
+        # bounds are emitted rather than a grid inconsistent with
+        # TPU_VISIBLE_DEVICES.
+        chips = self.chips([(0, 0, 0), (3, 0, 0)])
+        assert process_bounds(chips) is None
+        env = container_env(chips, shared=False)
+        assert env["TPU_VISIBLE_DEVICES"] == "0,1"
+        assert "TPU_CHIPS_PER_PROCESS_BOUNDS" not in env
+        assert "TPU_PROCESS_BOUNDS" not in env
+
+    def test_exclusive_env_has_no_sharing_knobs(self):
+        env = container_env(self.chips([(0, 0, 0)]), shared=False)
+        assert env["TPU_VISIBLE_DEVICES"] == "0"
+        assert "TPU_ALLOW_MULTIPLE_LIBTPU_LOAD" not in env
+
+    def test_shared_env(self):
+        env = container_env(self.chips([(0, 0, 0), (1, 0, 0)]), shared=True, lease_dir="/x")
+        assert env["TPU_VISIBLE_DEVICES"] == "0,1"
+        assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,1,1"
+        assert env["TPU_ALLOW_MULTIPLE_LIBTPU_LOAD"] == "1"
+        assert env["TPU_SHARED_LEASE_DIR"] == "/x"

@@ -1,0 +1,104 @@
+"""Behavioural spec of the time-slice replica allocator.
+
+The tables mirror the reference's sharing spec
+(cmd/nvidia-device-plugin/replica_test.go:25-131) so the TPU allocator is
+behaviour-identical: deterministic, unique-chip-preferring,
+least-shared-first.
+"""
+
+import pytest
+
+from tpu_device_plugin.replica import (
+    AllocationError,
+    Prioritized,
+    prioritize_devices,
+    replica_id,
+    strip_replica,
+    strip_replicas,
+)
+
+
+@pytest.mark.parametrize(
+    "name, available, must_include, size, want, want_unique",
+    [
+        ("basic",
+         ["a-replica-0", "a-replica-1", "b-replica-1"], [], 1,
+         ["a-replica-0"], True),
+        ("multiple unique",
+         ["a-replica-0", "a-replica-1", "b-replica-1"], [], 2,
+         ["a-replica-0", "b-replica-1"], True),
+        ("non-unique",
+         ["a-replica-0", "a-replica-1", "a-replica-2", "b-replica-1"], [], 3,
+         ["a-replica-0", "a-replica-1", "b-replica-1"], False),
+        ("must include greater utilized",
+         ["a-replica-0", "a-replica-1", "b-replica-1"], ["b-replica-1"], 1,
+         ["b-replica-1"], True),
+        ("must include least utilized",
+         ["a-replica-0", "a-replica-1", "b-replica-1"], ["a-replica-1"], 1,
+         ["a-replica-1"], True),
+        ("must include two",
+         ["a-replica-0", "a-replica-1", "b-replica-1"], ["a-replica-1"], 2,
+         ["a-replica-1", "b-replica-1"], True),
+        ("non-unique must include",
+         ["a-replica-0", "a-replica-1", "a-replica-2", "b-replica-2", "b-replica-1"],
+         ["a-replica-2"], 3,
+         ["a-replica-0", "a-replica-2", "b-replica-1"], False),
+        ("must include",
+         ["a-replica-0", "a-replica-1", "a-replica-2", "b-replica-1", "c-replica-0"],
+         ["a-replica-2"], 3,
+         ["a-replica-2", "b-replica-1", "c-replica-0"], True),
+        ("must include entire allocation",
+         ["a-replica-0", "a-replica-1", "a-replica-2", "b-replica-1"],
+         ["a-replica-2", "b-replica-1", "a-replica-1"], 3,
+         ["a-replica-1", "a-replica-2", "b-replica-1"], False),
+        ("deterministic",
+         ["a-replica-1", "b-replica-1", "c-replica-1", "d-replica-1",
+          "e-replica-1", "f-replica-1", "g-replica-1", "h-replica-1"], [], 1,
+         ["a-replica-1"], True),
+        ("undersized", ["a-replica-0", "a-replica-1", "a-replica-2", "b-replica-1"],
+         [], 0, [], True),
+    ],
+)
+def test_prioritize_devices(name, available, must_include, size, want, want_unique):
+    got = prioritize_devices(available, must_include, size)
+    assert got == Prioritized(devices=want, unique=want_unique), name
+
+
+@pytest.mark.parametrize(
+    "name, available, must_include, size, message",
+    [
+        ("oversized request",
+         ["a-replica-0", "a-replica-1", "a-replica-2", "b-replica-1"], [], 5,
+         "no devices left to allocate"),
+        ("none available", [], [], 1, "no devices left to allocate"),
+        ("must-include replica not available",
+         ["a-replica-0", "a-replica-1"], ["a-replica-2"], 1,
+         "device 'a-replica-2' in mustIncludeDeviceIDs is missing from availableDeviceIDs"),
+        ("must-include chip not available",
+         ["a-replica-0", "a-replica-1"], ["b-replica-2"], 1,
+         "device 'b-replica-2' in mustIncludeDeviceIDs is missing from availableDeviceIDs"),
+    ],
+)
+def test_prioritize_devices_errors(name, available, must_include, size, message):
+    with pytest.raises(AllocationError, match=message):
+        prioritize_devices(available, must_include, size)
+
+
+@pytest.mark.parametrize(
+    "replica_ids, want",
+    [
+        (["b-replica-5", "a-replica-1", "a-replica-0"], ["a", "b"]),
+        (["b-replica-0", "a-replica-1", "a-replica-2", "c-replica-2"], ["a", "b", "c"]),
+        ([], []),
+        # Bare chip IDs (unshared resources) pass through unchanged.
+        (["tpu-1", "tpu-0"], ["tpu-0", "tpu-1"]),
+    ],
+)
+def test_strip_replicas(replica_ids, want):
+    assert strip_replicas(replica_ids) == want
+
+
+def test_replica_id_roundtrip():
+    rid = replica_id("tpu-3", 7)
+    assert rid == "tpu-3-replica-7"
+    assert strip_replica(rid) == "tpu-3"

@@ -1,0 +1,46 @@
+"""Spec for --resource-config parsing (reference: main.go:171-203)."""
+
+import pytest
+
+from tpu_device_plugin.resource_config import Variant, parse_resource_config
+
+
+def test_basic_entry():
+    rc = parse_resource_config("tpu:shared-tpu:4")
+    assert rc.get("tpu") == Variant(name="shared-tpu", replicas=4, auto_replicas=False)
+    assert rc.get("tpu").shared
+
+
+def test_multiple_entries_and_whitespace():
+    rc = parse_resource_config(" tpu:shared-tpu:4 , tpu-tray:tray:2 ,")
+    assert rc.get("tpu").name == "shared-tpu"
+    assert rc.get("tpu-tray") == Variant(name="tray", replicas=2)
+
+
+def test_auto_replicas():
+    rc = parse_resource_config("tpu:tpu-mem-gb:-1")
+    v = rc.get("tpu")
+    assert v.auto_replicas and v.replicas == 1 and v.name == "tpu-mem-gb"
+    assert v.shared
+
+
+def test_unconfigured_resource_identity_fallback():
+    rc = parse_resource_config("tpu:shared:2")
+    assert rc.get("other") == Variant(name="other", replicas=0, auto_replicas=False)
+    assert not rc.get("other").shared
+
+
+def test_empty_string():
+    assert parse_resource_config("") == {}
+
+
+@pytest.mark.parametrize("bad", ["tpu:x", "tpu:x:y:z", "tpu:x:notanint", "tpu:x:-2"])
+def test_malformed_entries(bad):
+    with pytest.raises(ValueError):
+        parse_resource_config(bad)
+
+
+def test_rename_without_sharing():
+    rc = parse_resource_config("tpu:renamed:1")
+    v = rc.get("tpu")
+    assert v.name == "renamed" and not v.shared

@@ -1,0 +1,119 @@
+"""Topology strategy factory: chip/tray/mixed plugin construction."""
+
+import pytest
+
+from tpu_device_plugin.backend.fake import FakeChipManager
+from tpu_device_plugin.config import Config, Flags
+from tpu_device_plugin.resource_config import ResourceConfig, parse_resource_config
+from tpu_device_plugin.strategy import (
+    ChipStrategy,
+    MixedStrategy,
+    TrayStrategy,
+    chip_units,
+    new_topology_strategy,
+    tray_units,
+)
+
+
+def make_strategy(strategy_name, mgr, rc_text="", plugin_dir="/tmp/dp"):
+    cfg = Config(flags=Flags(topology_strategy=strategy_name, backend="fake"))
+    rc = parse_resource_config(rc_text) if rc_text else ResourceConfig()
+    return new_topology_strategy(
+        cfg, rc, mgr, plugin_dir=plugin_dir, kubelet_socket="/tmp/dp/kubelet.sock"
+    )
+
+
+@pytest.fixture
+def v5e4():
+    mgr = FakeChipManager(n_chips=4, chips_per_tray=4)
+    mgr.init()
+    return mgr
+
+
+@pytest.fixture
+def two_trays():
+    mgr = FakeChipManager(n_chips=8, chips_per_tray=4)
+    mgr.init()
+    return mgr
+
+
+def test_unit_builders(v5e4):
+    assert [u.id for u in chip_units(v5e4)] == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+    trays = tray_units(v5e4)
+    assert [u.id for u in trays] == ["tray-0"]
+    assert trays[0].chip_ids == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+    assert trays[0].hbm_bytes == 4 * (16 << 30)
+
+
+def test_chip_strategy(v5e4):
+    strategy = make_strategy("chip", v5e4)
+    assert isinstance(strategy, ChipStrategy)
+    (plugin,) = strategy.get_plugins()
+    assert plugin.resource_name == "google.com/tpu"
+    assert plugin.socket_path == "/tmp/dp/tpu-tpu.sock"
+    assert plugin._policy is not None  # ICI best-effort for exclusive chips
+    assert not plugin.shared
+
+
+def test_chip_strategy_with_sharing_rename(v5e4):
+    strategy = make_strategy("chip", v5e4, rc_text="tpu:shared-tpu:4")
+    (plugin,) = strategy.get_plugins()
+    assert plugin.resource_name == "google.com/shared-tpu"
+    assert plugin.replicas == 4 and plugin.shared
+    # Sharing and topology policy are mutually exclusive (server.go:269-270).
+    assert plugin._policy is None
+
+
+def test_chip_strategy_auto_replicas(v5e4):
+    strategy = make_strategy("chip", v5e4, rc_text="tpu:tpu-mem-gb:-1")
+    (plugin,) = strategy.get_plugins()
+    assert plugin.auto_replicas and plugin.shared
+
+
+def test_tray_strategy(two_trays):
+    strategy = make_strategy("tray", two_trays)
+    assert isinstance(strategy, TrayStrategy)
+    (plugin,) = strategy.get_plugins()
+    assert plugin.resource_name == "google.com/tpu"
+    plugin.initialize()
+    assert {a.id for a in plugin._advertised} == {"tray-0", "tray-1"}
+
+
+def test_tray_strategy_falls_back_to_chips():
+    mgr = FakeChipManager(n_chips=4, chips_per_tray=1)
+    mgr.init()
+    strategy = make_strategy("tray", mgr)
+    (plugin,) = strategy.get_plugins()
+    plugin.initialize()
+    assert {a.id for a in plugin._advertised} == {"tpu-0", "tpu-1", "tpu-2", "tpu-3"}
+
+
+def test_mixed_strategy_both_views_share_ledger(v5e4):
+    strategy = make_strategy("mixed", v5e4)
+    assert isinstance(strategy, MixedStrategy)
+    plugins = strategy.get_plugins()
+    names = {p.resource_name for p in plugins}
+    assert names == {"google.com/tpu", "google.com/tpu-tray"}
+    chip_plugin = next(p for p in plugins if p.resource_name == "google.com/tpu")
+    tray_plugin = next(p for p in plugins if p.resource_name == "google.com/tpu-tray")
+    assert chip_plugin._claims is tray_plugin._claims is not None
+    assert chip_plugin.socket_path != tray_plugin.socket_path
+    chip_plugin.initialize()
+    tray_plugin.initialize()
+    assert len(chip_plugin._advertised) == 4  # 4x1-chip
+    assert len(tray_plugin._advertised) == 1  # 1x4-chip (BASELINE configs[3])
+
+
+def test_mixed_strategy_trayless_host_has_single_plugin():
+    mgr = FakeChipManager(n_chips=2, chips_per_tray=1)
+    mgr.init()
+    plugins = make_strategy("mixed", mgr).get_plugins()
+    assert [p.resource_name for p in plugins] == ["google.com/tpu"]
+
+
+def test_mixed_sharing_via_resource_config(v5e4):
+    strategy = make_strategy("mixed", v5e4, rc_text="tpu:shared-tpu:4,tpu-tray:tray:2")
+    plugins = strategy.get_plugins()
+    by_name = {p.resource_name: p for p in plugins}
+    assert by_name["google.com/shared-tpu"].replicas == 4
+    assert by_name["google.com/tray"].replicas == 2

@@ -1,0 +1,74 @@
+"""Topology model: ICI distance, tray grouping, pair scoring."""
+
+from tpu_device_plugin.topology import (
+    SCORE_DCN,
+    SCORE_SAME_TRAY,
+    Topology,
+    build_fake_topology,
+    grid_coords,
+)
+
+
+def test_grid_coords_row_major():
+    assert grid_coords(4, (2, 2, 1)) == [(0, 0, 0), (1, 0, 0), (0, 1, 0), (1, 1, 0)]
+
+
+def test_fake_topology_v5e4():
+    topo = build_fake_topology(4, 4)
+    assert len(topo.chips_by_id) == 4
+    assert topo.torus_shape == (4, 1, 1)
+    trays = topo.trays()
+    assert list(trays) == [0]
+    assert [c.index for c in trays[0]] == [0, 1, 2, 3]
+    assert topo.chips_by_id["tpu-0"].device_paths == ["/dev/accel0"]
+    assert topo.chips_by_id["tpu-0"].hbm_gib == 16
+
+
+def test_fake_topology_two_trays():
+    topo = build_fake_topology(8, 4)
+    trays = topo.trays()
+    assert sorted(trays) == [0, 1]
+    assert [c.id for c in trays[1]] == ["tpu-4", "tpu-5", "tpu-6", "tpu-7"]
+
+
+def test_ici_distance_mesh():
+    topo = build_fake_topology(8, 4)  # 4x2 mesh
+    assert topo.ici_distance("tpu-0", "tpu-1") == 1
+    assert topo.ici_distance("tpu-0", "tpu-3") == 3
+    assert topo.ici_distance("tpu-0", "tpu-4") == 1  # vertically adjacent
+    assert topo.ici_distance("tpu-0", "tpu-7") == 4
+    assert topo.ici_distance("tpu-0", "nope") is None
+
+
+def test_ici_distance_torus_wraparound():
+    topo = build_fake_topology(8, 4)
+    topo.wraparound = True
+    # 4-wide ring: 0 -> 3 is one hop backwards.
+    assert topo.ici_distance("tpu-0", "tpu-3") == 1
+
+
+def test_pair_scores_ordering():
+    topo = build_fake_topology(8, 4)
+    same_tray = topo.pair_score("tpu-0", "tpu-1")
+    cross_tray = topo.pair_score("tpu-0", "tpu-4")
+    assert same_tray == SCORE_SAME_TRAY
+    assert same_tray > cross_tray > SCORE_DCN
+
+
+def test_remote_chips_scored_via_ici():
+    topo = build_fake_topology(4, 4)
+    topo.torus_shape = (4, 2, 1)
+    topo.remote_coords["remote-0"] = (0, 1, 0)
+    topo.remote_trays["remote-0"] = 4
+    assert not topo.is_local("remote-0")
+    assert topo.ici_distance("tpu-0", "remote-0") == 1
+    # Remote-but-ICI-connected beats unknown/DCN-only.
+    assert topo.pair_score("tpu-0", "remote-0") > SCORE_DCN
+    assert topo.pair_score("tpu-0", "unknown-chip") == SCORE_DCN
+
+
+def test_set_score_prefers_compact_sets():
+    topo = build_fake_topology(8, 4)
+    compact = topo.set_score(["tpu-0", "tpu-1"])
+    spread = topo.set_score(["tpu-0", "tpu-7"])
+    assert compact > spread

@@ -1,0 +1,13 @@
+"""tpu-device-plugin: a TPU-native Kubernetes device-plugin framework.
+
+A per-node daemon that discovers TPU chips over /dev/accel* (native C++
+libtpuinfo layer), advertises them to the kubelet via the device-plugin gRPC
+API v1beta1, health-checks them, maps tray/ICI-slice topology onto the
+chip/tray/mixed strategies, and time-slices chips across oversubscribed JAX
+pods via replica sharing.
+
+Built to the capability surface of iktos/k8s-gpu-sharing-plugin (a fractional
+GPU-sharing fork of NVIDIA/k8s-device-plugin v0.11.0); see SURVEY.md.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,68 @@
+"""Preferred-allocation policies.
+
+The ``Policy`` contract mirrors the reference's gpuallocator policy interface
+(vendor/.../gpuallocator/allocator.go:24-32): given the device IDs still
+available, the IDs that must be included, and the requested size, return the
+best set.  Policies here score candidate sets by ICI adjacency from the
+topology snapshot instead of probing NVLink pairs per call.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Sequence
+
+from ..topology import Topology
+
+
+class PolicyError(ValueError):
+    """The request cannot be satisfied (bad size, unknown required IDs...)."""
+
+
+class Policy(ABC):
+    @abstractmethod
+    def allocate(
+        self,
+        available: Sequence[str],
+        required: Sequence[str],
+        size: int,
+    ) -> list[str]:
+        """Pick ``size`` device IDs from ``available`` ⊇ ``required``."""
+
+
+def validate_request(
+    available: Sequence[str], required: Sequence[str], size: int
+) -> None:
+    if size < 0:
+        raise PolicyError(f"invalid allocation size {size}")
+    if size > len(available):
+        raise PolicyError(
+            f"allocation size {size} exceeds {len(available)} available devices"
+        )
+    if len(required) > size:
+        raise PolicyError(
+            f"{len(required)} required devices exceed allocation size {size}"
+        )
+    missing = set(required) - set(available)
+    if missing:
+        raise PolicyError(f"required devices not available: {sorted(missing)}")
+
+
+from .simple import SimplePolicy  # noqa: E402
+from .besteffort import BestEffortPolicy  # noqa: E402
+from .static_slices import StaticSlicePolicy  # noqa: E402
+
+
+def new_best_effort_policy(topology: Topology) -> Policy:
+    return BestEffortPolicy(topology)
+
+
+__all__ = [
+    "Policy",
+    "PolicyError",
+    "SimplePolicy",
+    "BestEffortPolicy",
+    "StaticSlicePolicy",
+    "new_best_effort_policy",
+    "validate_request",
+]

@@ -1,0 +1,25 @@
+"""Trivial policy: required devices first, then the lowest-sorted available.
+
+Equivalent of the reference's simple policy
+(vendor/.../gpuallocator/simple_policy.go:13-35).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from . import Policy, validate_request
+
+
+class SimplePolicy(Policy):
+    def allocate(
+        self, available: Sequence[str], required: Sequence[str], size: int
+    ) -> list[str]:
+        validate_request(available, required, size)
+        picked = list(required)
+        for dev in sorted(available):
+            if len(picked) == size:
+                break
+            if dev not in picked:
+                picked.append(dev)
+        return sorted(picked)

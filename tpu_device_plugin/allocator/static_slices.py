@@ -1,0 +1,100 @@
+"""Static preferred-allocation policy for known slice shapes.
+
+Equivalent of the reference's hard-coded DGX policies
+(vendor/.../gpuallocator/staticdgx_policies.go:37-107): for well-known
+machine shapes the valid chip sets are written down instead of searched.
+On TPU the natural valid sets are whole trays and ICI-contiguous tray
+groups — e.g. a v5e-4 host prefers the whole 4-chip tray, and a v5p-16
+slice (4 hosts x 4 chips) prefers host-local trays first, then pairs of
+ICI-adjacent trays across hosts (BASELINE configs[4]).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..topology import Topology
+from . import Policy, PolicyError, validate_request
+from .besteffort import BestEffortPolicy
+
+
+class StaticSlicePolicy(Policy):
+    """Pick the first listed valid set that fits; fall back to best-effort.
+
+    ``valid_sets`` maps an allocation size to the ordered list of preferred
+    chip-ID sets for that size.
+    """
+
+    def __init__(self, topology: Topology, valid_sets: dict[int, list[list[str]]]):
+        self._valid_sets = valid_sets
+        self._fallback = BestEffortPolicy(topology)
+
+    def allocate(
+        self, available: Sequence[str], required: Sequence[str], size: int
+    ) -> list[str]:
+        validate_request(available, required, size)
+        avail, req = set(available), set(required)
+        for candidate in self._valid_sets.get(size, []):
+            cset = set(candidate)
+            if cset <= avail and req <= cset:
+                return sorted(candidate)
+        return self._fallback.allocate(available, required, size)
+
+
+def tray_aligned_policy(topology: Topology) -> StaticSlicePolicy:
+    """Build the static sets for the host's tray layout: whole trays, then
+    ICI-contiguous runs of trays for larger sizes."""
+    trays = topology.trays()
+    tray_ids = [[c.id for c in chips] for _, chips in sorted(trays.items())]
+    valid: dict[int, list[list[str]]] = {}
+    if not tray_ids:
+        return StaticSlicePolicy(topology, valid)
+    tray_size = len(tray_ids[0])
+    if any(len(t) != tray_size for t in tray_ids):
+        # Irregular trays: no static sets, always best-effort.
+        return StaticSlicePolicy(topology, valid)
+    # Runs of 1..len consecutive trays, e.g. v5p-16 host group: sizes 4, 8,
+    # 12, 16 map to 1-4 contiguous trays.
+    for run in range(1, len(tray_ids) + 1):
+        size = run * tray_size
+        sets = []
+        for start in range(0, len(tray_ids) - run + 1):
+            merged: list[str] = []
+            for t in tray_ids[start : start + run]:
+                merged.extend(t)
+            sets.append(merged)
+        valid[size] = sets
+    return StaticSlicePolicy(topology, valid)
+
+
+def multi_host_slice_policy(
+    topology: Topology, hosts: dict[str, list[str]]
+) -> StaticSlicePolicy:
+    """Static sets for a multi-host slice (e.g. v5p-16 = 4 hosts x 4 chips).
+
+    ``hosts`` maps a host name to its chip IDs in ICI order.  Preferred sets:
+    single hosts for size = host width, consecutive host pairs/groups for
+    multiples — packing allocations onto ICI-adjacent hosts
+    (BASELINE configs[4]).
+    """
+    host_chips = [chips for _, chips in sorted(hosts.items())]
+    if not host_chips:
+        raise PolicyError("multi_host_slice_policy needs at least one host")
+    widths = {len(h) for h in host_chips}
+    if len(widths) != 1:
+        # Mixed widths would register undersized sets for the same size key
+        # and let allocate() return fewer devices than requested.
+        raise PolicyError(
+            f"multi_host_slice_policy requires uniform host widths, got {sorted(widths)}"
+        )
+    width = len(host_chips[0])
+    valid: dict[int, list[list[str]]] = {}
+    for run in range(1, len(host_chips) + 1):
+        sets = []
+        for start in range(0, len(host_chips) - run + 1):
+            merged: list[str] = []
+            for h in host_chips[start : start + run]:
+                merged.extend(h)
+            sets.append(merged)
+        valid[run * width] = sets
+    return StaticSlicePolicy(topology, valid)

@@ -1,0 +1,23 @@
+"""Kubelet device-plugin API v1beta1: messages, constants and gRPC bindings."""
+
+from . import constants
+from . import deviceplugin_pb2 as pb
+from .rpc import (
+    DevicePluginServicer,
+    DevicePluginStub,
+    RegistrationServicer,
+    RegistrationStub,
+    add_device_plugin_servicer,
+    add_registration_servicer,
+)
+
+__all__ = [
+    "constants",
+    "pb",
+    "DevicePluginServicer",
+    "DevicePluginStub",
+    "RegistrationServicer",
+    "RegistrationStub",
+    "add_device_plugin_servicer",
+    "add_registration_servicer",
+]

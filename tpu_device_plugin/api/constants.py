@@ -1,0 +1,21 @@
+"""Constants of the kubelet device-plugin API v1beta1.
+
+Mirrors the upstream Kubernetes constants (reference:
+vendor/k8s.io/kubelet/pkg/apis/deviceplugin/v1beta1/constants.go:19-37) —
+these values are fixed by the kubelet and must not change.
+"""
+
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+VERSION = "v1beta1"
+
+# Directory where the kubelet watches for plugin sockets; only privileged
+# pods can reach it.
+DEVICE_PLUGIN_PATH = "/var/lib/kubelet/device-plugins/"
+KUBELET_SOCKET = DEVICE_PLUGIN_PATH + "kubelet.sock"
+
+# Timeout (seconds) the kubelet applies to PreStartContainer RPCs.
+KUBELET_PRESTART_CONTAINER_RPC_TIMEOUT_SECS = 30
+
+SUPPORTED_VERSIONS = ("v1beta1",)

@@ -1,0 +1,138 @@
+"""Hand-written gRPC service bindings for the device-plugin API v1beta1.
+
+The environment has no grpcio-tools protoc plugin, so instead of generated
+``*_pb2_grpc.py`` stubs these bindings are written directly against the
+grpcio generic-handler API.  The method paths (``/v1beta1.DevicePlugin/...``,
+``/v1beta1.Registration/Register``) are fixed by the upstream Kubernetes API
+(reference: vendor/k8s.io/kubelet/pkg/apis/deviceplugin/v1beta1/api.proto:23-79)
+and give byte-identical wire behaviour to the kubelet's own stubs.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import deviceplugin_pb2 as pb
+
+DEVICE_PLUGIN_SERVICE = "v1beta1.DevicePlugin"
+REGISTRATION_SERVICE = "v1beta1.Registration"
+
+
+class DevicePluginServicer:
+    """Service interface implemented by a device plugin.
+
+    Subclass and override; each method receives (request, context) like any
+    grpcio servicer.  Reference semantics: cmd/nvidia-device-plugin/
+    server.go:243-358.
+    """
+
+    def GetDevicePluginOptions(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def ListAndWatch(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def GetPreferredAllocation(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def Allocate(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def PreStartContainer(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+
+def add_device_plugin_servicer(servicer: DevicePluginServicer, server: grpc.Server) -> None:
+    handlers = {
+        "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+            servicer.GetDevicePluginOptions,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.DevicePluginOptions.SerializeToString,
+        ),
+        "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+            servicer.ListAndWatch,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.ListAndWatchResponse.SerializeToString,
+        ),
+        "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPreferredAllocation,
+            request_deserializer=pb.PreferredAllocationRequest.FromString,
+            response_serializer=pb.PreferredAllocationResponse.SerializeToString,
+        ),
+        "Allocate": grpc.unary_unary_rpc_method_handler(
+            servicer.Allocate,
+            request_deserializer=pb.AllocateRequest.FromString,
+            response_serializer=pb.AllocateResponse.SerializeToString,
+        ),
+        "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+            servicer.PreStartContainer,
+            request_deserializer=pb.PreStartContainerRequest.FromString,
+            response_serializer=pb.PreStartContainerResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(DEVICE_PLUGIN_SERVICE, handlers),)
+    )
+
+
+class DevicePluginStub:
+    """Client stub for the DevicePlugin service (used by the fake kubelet
+    test harness and the benchmark driver)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.GetDevicePluginOptions = channel.unary_unary(
+            f"/{DEVICE_PLUGIN_SERVICE}/GetDevicePluginOptions",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.DevicePluginOptions.FromString,
+        )
+        self.ListAndWatch = channel.unary_stream(
+            f"/{DEVICE_PLUGIN_SERVICE}/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )
+        self.GetPreferredAllocation = channel.unary_unary(
+            f"/{DEVICE_PLUGIN_SERVICE}/GetPreferredAllocation",
+            request_serializer=pb.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=pb.PreferredAllocationResponse.FromString,
+        )
+        self.Allocate = channel.unary_unary(
+            f"/{DEVICE_PLUGIN_SERVICE}/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString,
+        )
+        self.PreStartContainer = channel.unary_unary(
+            f"/{DEVICE_PLUGIN_SERVICE}/PreStartContainer",
+            request_serializer=pb.PreStartContainerRequest.SerializeToString,
+            response_deserializer=pb.PreStartContainerResponse.FromString,
+        )
+
+
+class RegistrationServicer:
+    """Service interface implemented by the kubelet (or the fake kubelet)."""
+
+    def Register(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+
+def add_registration_servicer(servicer: RegistrationServicer, server: grpc.Server) -> None:
+    handlers = {
+        "Register": grpc.unary_unary_rpc_method_handler(
+            servicer.Register,
+            request_deserializer=pb.RegisterRequest.FromString,
+            response_serializer=pb.Empty.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(REGISTRATION_SERVICE, handlers),)
+    )
+
+
+class RegistrationStub:
+    """Client stub the plugin uses to register with the kubelet."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.Register = channel.unary_unary(
+            f"/{REGISTRATION_SERVICE}/Register",
+            request_serializer=pb.RegisterRequest.SerializeToString,
+            response_deserializer=pb.Empty.FromString,
+        )

@@ -1,0 +1,60 @@
+"""Chip discovery/health backends.
+
+``ChipManager`` is the contract between the plugin layers and whatever knows
+about hardware — the equivalent of the reference's ``ResourceManager``
+interface (cmd/nvidia-device-plugin/nvidia.go:49-52) widened with explicit
+lifecycle and a cached topology snapshot.
+
+Two implementations:
+  * ``fake``  — deterministic, scriptable; powers tests, the CPU-only smoke
+    config and the benchmark harness.
+  * ``tpu``   — real chips via the native C++ ``libtpuinfo`` library over
+    /dev/accel* (dlopen-tolerant, so the daemon runs on chip-less nodes).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from abc import ABC, abstractmethod
+
+from ..device import Chip, HealthEvent
+from ..topology import Topology
+
+
+class BackendInitError(RuntimeError):
+    """Chip discovery failed (no driver / no chips).  Per failOnInitError the
+    daemon either exits or blocks quietly (reference: main.go:219-231)."""
+
+
+class ChipManager(ABC):
+    """Discovery + health contract implemented by each backend."""
+
+    @abstractmethod
+    def init(self) -> None:
+        """Initialise the backend; raises BackendInitError when the node has
+        no usable TPU stack."""
+
+    @abstractmethod
+    def shutdown(self) -> None:
+        """Release backend resources."""
+
+    @abstractmethod
+    def devices(self) -> list[Chip]:
+        """Snapshot of all local chips."""
+
+    @abstractmethod
+    def topology(self) -> Topology:
+        """Topology snapshot, computed once at discovery time (the reference
+        re-probes per RPC; see SURVEY.md §3.4 — we deliberately don't)."""
+
+    @abstractmethod
+    def check_health(
+        self,
+        stop: threading.Event,
+        events: "queue.Queue[HealthEvent]",
+        chips: list[Chip],
+    ) -> None:
+        """Blocking health loop: watch ``chips`` and push HealthEvents until
+        ``stop`` is set.  Runs on a dedicated thread per plugin (reference:
+        checkHealth, nvidia.go:181-269)."""

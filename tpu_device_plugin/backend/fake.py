@@ -1,0 +1,100 @@
+"""Deterministic fake chip backend.
+
+The reference has no hardware-free backend at all (its NVML-touching code is
+only exercised on real GPUs — SURVEY.md §4); this fake is what makes the TPU
+build's plugin server, strategies and end-to-end tests runnable anywhere,
+including the CPU-only smoke config (BASELINE configs[0]).
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+
+from ..api.constants import HEALTHY, UNHEALTHY
+from ..device import Chip, HealthEvent
+from ..topology import Topology, build_fake_topology
+from . import BackendInitError, ChipManager
+
+
+class FakeChipManager(ChipManager):
+    """N fake chips with a configurable tray layout and scriptable health.
+
+    ``fail_init=True`` simulates a node without a TPU stack (exercises the
+    failOnInitError paths).  Tests inject health transitions with
+    :meth:`inject` and the health loop forwards them like a real event wait
+    primitive would.
+    """
+
+    def __init__(
+        self,
+        n_chips: int = 4,
+        chips_per_tray: int = 4,
+        hbm_gib: int = 16,
+        accelerator_type: str = "v5e",
+        fail_init: bool = False,
+        id_prefix: str = "tpu",
+    ):
+        self._n_chips = n_chips
+        self._chips_per_tray = chips_per_tray
+        self._hbm_gib = hbm_gib
+        self._accelerator_type = accelerator_type
+        self._fail_init = fail_init
+        self._id_prefix = id_prefix
+        self._topology: Topology | None = None
+        self._injected: "queue.Queue[HealthEvent]" = queue.Queue()
+        self.initialized = False
+
+    # -- ChipManager contract -------------------------------------------------
+
+    def init(self) -> None:
+        if self._fail_init:
+            raise BackendInitError(
+                "fake backend configured to fail init (no TPU stack on this node)"
+            )
+        self._topology = build_fake_topology(
+            self._n_chips,
+            self._chips_per_tray,
+            accelerator_type=self._accelerator_type,
+            hbm_gib=self._hbm_gib,
+            id_prefix=self._id_prefix,
+        )
+        self.initialized = True
+
+    def shutdown(self) -> None:
+        self.initialized = False
+
+    def devices(self) -> list[Chip]:
+        self._require_init()
+        return [copy.deepcopy(c) for c in sorted(self._topology.chips_by_id.values(), key=lambda c: c.index)]
+
+    def topology(self) -> Topology:
+        self._require_init()
+        return self._topology
+
+    def check_health(
+        self,
+        stop: threading.Event,
+        events: "queue.Queue[HealthEvent]",
+        chips: list[Chip],
+    ) -> None:
+        watched = {c.id for c in chips}
+        while not stop.is_set():
+            try:
+                event = self._injected.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if event.all_chips or event.chip_id in watched:
+                events.put(event)
+
+    # -- test/bench controls --------------------------------------------------
+
+    def inject(self, chip_id: str, health: str = UNHEALTHY) -> None:
+        """Script a health transition; '' = all chips."""
+        assert health in (HEALTHY, UNHEALTHY)
+        self._injected.put(HealthEvent(chip_id=chip_id, health=health))
+
+    def _require_init(self) -> None:
+        if not self.initialized or self._topology is None:
+            raise BackendInitError("fake backend not initialised")

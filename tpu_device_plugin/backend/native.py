@@ -1,0 +1,172 @@
+"""ctypes bindings to the native C++ ``libtpuinfo`` chip library.
+
+The three-sub-layer structure of the reference's NVML boundary (C header /
+low-level bindings / high-level device model — SURVEY.md component 12) maps
+here to: native/tpuinfo.h (API surface), this module's ctypes declarations
+(low-level), and the Chip/Topology construction below (high-level).  Like
+the reference's dlopen of libnvidia-ml (nvml_dl.go:29-36), the library is
+located and loaded at runtime — a missing library raises
+NativeUnavailableError instead of breaking the daemon on chip-less nodes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+from ..api.constants import HEALTHY, UNHEALTHY
+from ..device import Chip, HealthEvent
+from ..topology import Topology
+
+ENV_LIBRARY = "TPUINFO_LIBRARY"
+_ID_LEN = 64
+_PATH_LEN = 128
+_TYPE_LEN = 16
+_MAX_CHIPS = 256
+_MAX_EVENTS = 64
+
+
+class NativeUnavailableError(RuntimeError):
+    """libtpuinfo.so could not be located or loaded."""
+
+
+class _ChipStruct(ctypes.Structure):
+    _fields_ = [
+        ("id", ctypes.c_char * _ID_LEN),
+        ("index", ctypes.c_int32),
+        ("device_path", ctypes.c_char * _PATH_LEN),
+        ("hbm_bytes", ctypes.c_int64),
+        ("x", ctypes.c_int32),
+        ("y", ctypes.c_int32),
+        ("z", ctypes.c_int32),
+        ("tray", ctypes.c_int32),
+        ("numa_node", ctypes.c_int32),
+    ]
+
+
+class _TopologyStruct(ctypes.Structure):
+    _fields_ = [
+        ("accelerator_type", ctypes.c_char * _TYPE_LEN),
+        ("torus_x", ctypes.c_int32),
+        ("torus_y", ctypes.c_int32),
+        ("torus_z", ctypes.c_int32),
+        ("wraparound", ctypes.c_int32),
+    ]
+
+
+class _HealthEventStruct(ctypes.Structure):
+    _fields_ = [
+        ("chip_id", ctypes.c_char * _ID_LEN),
+        ("healthy", ctypes.c_int32),
+    ]
+
+
+def _candidate_paths(lib_path: str | None) -> list[str]:
+    candidates = []
+    if lib_path:
+        candidates.append(lib_path)
+    env = os.environ.get(ENV_LIBRARY)
+    if env:
+        candidates.append(env)
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    candidates.append(os.path.join(here, "native", "libtpuinfo.so"))
+    candidates.append("libtpuinfo.so")
+    return candidates
+
+
+class NativeTpuInfo:
+    """Loaded libtpuinfo library instance."""
+
+    def __init__(self, lib_path: str | None = None):
+        last_error: Exception | None = None
+        self._lib = None
+        for path in _candidate_paths(lib_path):
+            try:
+                self._lib = ctypes.CDLL(path)
+                break
+            except OSError as e:
+                last_error = e
+        if self._lib is None:
+            raise NativeUnavailableError(str(last_error) or "no candidate paths")
+        self._declare()
+
+    def _declare(self) -> None:
+        lib = self._lib
+        lib.tpuinfo_init.argtypes = [ctypes.c_char_p]
+        lib.tpuinfo_init.restype = ctypes.c_int
+        lib.tpuinfo_shutdown.argtypes = []
+        lib.tpuinfo_shutdown.restype = None
+        lib.tpuinfo_chip_count.argtypes = []
+        lib.tpuinfo_chip_count.restype = ctypes.c_int
+        lib.tpuinfo_get_chips.argtypes = [ctypes.POINTER(_ChipStruct), ctypes.c_int]
+        lib.tpuinfo_get_chips.restype = ctypes.c_int
+        lib.tpuinfo_get_topology.argtypes = [ctypes.POINTER(_TopologyStruct)]
+        lib.tpuinfo_get_topology.restype = ctypes.c_int
+        lib.tpuinfo_wait_health_events.argtypes = [
+            ctypes.POINTER(_HealthEventStruct),
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.tpuinfo_wait_health_events.restype = ctypes.c_int
+        lib.tpuinfo_version.argtypes = []
+        lib.tpuinfo_version.restype = ctypes.c_char_p
+
+    # ------------------------------------------------------------------ calls
+
+    def version(self) -> str:
+        return self._lib.tpuinfo_version().decode()
+
+    def init(self, driver_root: str) -> int:
+        """Returns the number of chips discovered, or a negative error."""
+        return self._lib.tpuinfo_init(driver_root.encode())
+
+    def shutdown(self) -> None:
+        self._lib.tpuinfo_shutdown()
+
+    def chips(self) -> list[Chip]:
+        buf = (_ChipStruct * _MAX_CHIPS)()
+        n = self._lib.tpuinfo_get_chips(buf, _MAX_CHIPS)
+        if n < 0:
+            raise RuntimeError(f"tpuinfo_get_chips failed with {n}")
+        out = []
+        for i in range(n):
+            c = buf[i]
+            out.append(
+                Chip(
+                    id=c.id.decode(),
+                    index=c.index,
+                    device_paths=[c.device_path.decode()],
+                    hbm_bytes=c.hbm_bytes,
+                    coords=(c.x, c.y, c.z),
+                    tray=c.tray,
+                    numa_node=None if c.numa_node < 0 else c.numa_node,
+                )
+            )
+        return out
+
+    def topology(self) -> Topology:
+        t = _TopologyStruct()
+        rc = self._lib.tpuinfo_get_topology(ctypes.byref(t))
+        if rc != 0:
+            raise RuntimeError(f"tpuinfo_get_topology failed with {rc}")
+        topo = Topology(
+            accelerator_type=t.accelerator_type.decode(),
+            torus_shape=(t.torus_x, t.torus_y, t.torus_z),
+            wraparound=bool(t.wraparound),
+        )
+        for chip in self.chips():
+            topo.chips_by_id[chip.id] = chip
+        return topo
+
+    def wait_health_events(self, timeout_ms: int = 1000) -> list[HealthEvent]:
+        buf = (_HealthEventStruct * _MAX_EVENTS)()
+        n = self._lib.tpuinfo_wait_health_events(buf, _MAX_EVENTS, timeout_ms)
+        if n < 0:
+            raise RuntimeError(f"tpuinfo_wait_health_events failed with {n}")
+        return [
+            HealthEvent(
+                chip_id=buf[i].chip_id.decode(),
+                health=HEALTHY if buf[i].healthy else UNHEALTHY,
+            )
+            for i in range(n)
+        ]

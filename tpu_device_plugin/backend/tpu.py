@@ -1,0 +1,94 @@
+"""Real TPU chip backend over the native libtpuinfo C++ library.
+
+The native boundary of the framework (the role NVML/CGo plays in the
+reference, vendor/.../nvml/bindings.go + nvml_dl.go:29-36): chip enumeration
+from /dev/accel*, HBM/topology metadata from sysfs, and a blocking
+health-wait primitive.  The library is dlopen'd at runtime so the daemon
+binary runs unchanged on chip-less nodes — init simply fails and the
+failOnInitError policy decides what happens next.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+
+from ..device import Chip, HealthEvent
+from ..topology import Topology
+from . import BackendInitError, ChipManager
+from .native import NativeTpuInfo, NativeUnavailableError
+
+
+class TpuChipManager(ChipManager):
+    """ChipManager backed by the native libtpuinfo library."""
+
+    def __init__(self, driver_root: str = "/", lib_path: str | None = None):
+        self._driver_root = driver_root
+        self._lib_path = lib_path
+        self._native: NativeTpuInfo | None = None
+        self._topology: Topology | None = None
+
+    def init(self) -> None:
+        try:
+            self._native = NativeTpuInfo(lib_path=self._lib_path)
+        except NativeUnavailableError as e:
+            raise BackendInitError(f"libtpuinfo unavailable: {e}") from e
+        count = self._native.init(self._driver_root)
+        if count < 0:
+            raise BackendInitError(
+                f"libtpuinfo init failed (code {count}) under root {self._driver_root!r}"
+            )
+        if count == 0:
+            raise BackendInitError(
+                f"no TPU chips found under {self._driver_root!r}/dev"
+            )
+        self._topology = self._native.topology()
+
+    def shutdown(self) -> None:
+        if self._native is not None:
+            self._native.shutdown()
+            self._native = None
+        self._topology = None
+
+    def devices(self) -> list[Chip]:
+        self._require_init()
+        return self._native.chips()
+
+    def topology(self) -> Topology:
+        self._require_init()
+        return self._topology
+
+    def check_health(
+        self,
+        stop: threading.Event,
+        events: "queue.Queue[HealthEvent]",
+        chips: list[Chip],
+    ) -> None:
+        """Blocking health loop over the native wait primitive.
+
+        TPUs have no XID-style event stream (SURVEY.md §7 hard part #2);
+        libtpuinfo synthesises health from device-node liveness, reporting
+        both failures and recoveries.
+        """
+        self._require_init()
+        watched = {c.id for c in chips}
+        while not stop.is_set():
+            try:
+                batch = self._native.wait_health_events(timeout_ms=1000)
+            except RuntimeError as e:
+                # A transient native failure (e.g. mid-driver-reset) must not
+                # kill the watcher for the life of the daemon — log, back
+                # off, retry.
+                logging.getLogger(__name__).warning(
+                    "health wait failed (%s); retrying", e
+                )
+                stop.wait(1.0)
+                continue
+            for event in batch:
+                if event.all_chips or event.chip_id in watched:
+                    events.put(event)
+
+    def _require_init(self) -> None:
+        if self._native is None:
+            raise BackendInitError("tpu backend not initialised")

@@ -1,0 +1,265 @@
+"""Versioned daemon configuration with CLI > env > file precedence.
+
+Equivalent of the reference's config API (api/config/v1/config.go:34-144):
+a ``Config{version, flags}`` document loadable from YAML or JSON, merged with
+environment variables and command-line flags so that explicit CLI values win
+over env vars, which win over the config file, which wins over defaults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+import yaml
+
+VERSION = "v1"
+
+# Topology strategies (the TPU mapping of the reference's MIG strategies,
+# cmd/nvidia-device-plugin/mig-strategy.go:30-34).  "chip" advertises every
+# chip individually; "tray" advertises whole ICI-connected trays; "mixed"
+# advertises both views simultaneously with cross-resource reconciliation.
+STRATEGY_CHIP = "chip"
+STRATEGY_TRAY = "tray"
+STRATEGY_MIXED = "mixed"
+STRATEGIES = (STRATEGY_CHIP, STRATEGY_TRAY, STRATEGY_MIXED)
+# Reference-compatible aliases (none/single/mixed).
+STRATEGY_ALIASES = {"none": STRATEGY_CHIP, "single": STRATEGY_TRAY, "mixed": STRATEGY_MIXED}
+
+DEVICE_LIST_STRATEGY_ENVVAR = "envvar"
+DEVICE_LIST_STRATEGY_VOLUME_MOUNTS = "volume-mounts"
+DEVICE_LIST_STRATEGIES = (DEVICE_LIST_STRATEGY_ENVVAR, DEVICE_LIST_STRATEGY_VOLUME_MOUNTS)
+
+DEVICE_ID_STRATEGY_UUID = "uuid"
+DEVICE_ID_STRATEGY_INDEX = "index"
+DEVICE_ID_STRATEGIES = (DEVICE_ID_STRATEGY_UUID, DEVICE_ID_STRATEGY_INDEX)
+
+BACKEND_TPU = "tpu"
+BACKEND_FAKE = "fake"
+BACKENDS = (BACKEND_TPU, BACKEND_FAKE)
+
+
+@dataclass
+class Flags:
+    """All daemon flags.  Field name ↔ flag ↔ env-var mapping lives in
+    FLAG_DEFS below (reference flag set: cmd/nvidia-device-plugin/main.go:62-130)."""
+
+    topology_strategy: str = STRATEGY_CHIP
+    fail_on_init_error: bool = True
+    # On TPU, passing /dev/accel* device nodes is the primary mechanism for
+    # exposing chips to containers (there is no nvidia-container-runtime
+    # equivalent injecting them from an env var), so this defaults on.
+    pass_device_specs: bool = True
+    device_list_strategy: str = DEVICE_LIST_STRATEGY_ENVVAR
+    device_id_strategy: str = DEVICE_ID_STRATEGY_UUID
+    # Root under which /dev and /sys are found; tests point this at a fake
+    # device tree.
+    driver_root: str = "/"
+    config_file: str = ""
+    resource_config: str = ""
+    backend: str = BACKEND_TPU
+    # Fake-backend shape "<chips>x<chips-per-tray>", e.g. "4x4" = one v5e-4
+    # tray.  Ignored by the tpu backend, which discovers real topology.
+    fake_topology: str = "4x4"
+    # Where plugin sockets are created and kubelet.sock is found; overridable
+    # for tests and benchmarks.
+    device_plugin_path: str = ""
+    # Mixed strategy: seconds before a cross-view chip claim expires and the
+    # overlapping resource becomes schedulable again (the device-plugin API
+    # has no deallocate signal).  0 disables expiry.
+    mixed_claim_ttl_secs: float = 300.0
+
+
+@dataclass
+class FlagDef:
+    attr: str
+    flag: str
+    env: str
+    type: type
+    help: str
+    choices: tuple[str, ...] | None = None
+
+
+FLAG_DEFS: list[FlagDef] = [
+    FlagDef("topology_strategy", "--topology-strategy", "TOPOLOGY_STRATEGY", str,
+            "how chips are grouped into advertised resources (aliases: none=chip, single=tray)",
+            STRATEGIES + tuple(a for a in STRATEGY_ALIASES if a not in STRATEGIES)),
+    FlagDef("fail_on_init_error", "--fail-on-init-error", "FAIL_ON_INIT_ERROR", bool,
+            "fail the daemon when chip discovery fails; if false, block quietly (non-TPU nodes)"),
+    FlagDef("pass_device_specs", "--pass-device-specs", "PASS_DEVICE_SPECS", bool,
+            "pass /dev/accel* DeviceSpecs in Allocate responses"),
+    FlagDef("device_list_strategy", "--device-list-strategy", "DEVICE_LIST_STRATEGY", str,
+            "how the chip list reaches the container", DEVICE_LIST_STRATEGIES),
+    FlagDef("device_id_strategy", "--device-id-strategy", "DEVICE_ID_STRATEGY", str,
+            "expose chip ids or chip indices to containers", DEVICE_ID_STRATEGIES),
+    FlagDef("driver_root", "--driver-root", "TPU_DRIVER_ROOT", str,
+            "root under which /dev and /sys are mounted"),
+    FlagDef("config_file", "--config-file", "CONFIG_FILE", str,
+            "versioned YAML/JSON config file"),
+    FlagDef("resource_config", "--resource-config", "RESOURCE_CONFIG", str,
+            "sharing config: <orig>:<new>:<replicas>[,...]; replicas=-1 means one per GiB HBM"),
+    FlagDef("backend", "--backend", "TPU_BACKEND", str,
+            "chip discovery backend", BACKENDS),
+    FlagDef("fake_topology", "--fake-topology", "FAKE_TOPOLOGY", str,
+            "fake backend shape <chips>x<chips-per-tray>"),
+    FlagDef("device_plugin_path", "--device-plugin-path", "DEVICE_PLUGIN_PATH", str,
+            "kubelet device-plugin socket directory (default: the kubelet standard path)"),
+    FlagDef("mixed_claim_ttl_secs", "--mixed-claim-ttl-secs", "MIXED_CLAIM_TTL_SECS", float,
+            "mixed strategy: seconds before a cross-view chip claim expires (0 = never)"),
+]
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class Config:
+    version: str = VERSION
+    flags: Flags = field(default_factory=Flags)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"version": self.version, "flags": dataclasses.asdict(self.flags)}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+
+def _coerce_bool(value: Any) -> bool:
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, str):
+        low = value.strip().lower()
+        if low in ("1", "true", "yes", "on"):
+            return True
+        if low in ("0", "false", "no", "off"):
+            return False
+    raise ConfigError(f"expected a boolean, got {value!r}")
+
+
+def _parse_config_file(path: str) -> dict[str, Any]:
+    """Load and version-check a YAML or JSON config document
+    (reference: api/config/v1/config.go:70-94)."""
+    with open(path) as f:
+        raw = yaml.safe_load(f)  # YAML is a superset of JSON
+    if raw is None:
+        raw = {}
+    if not isinstance(raw, dict):
+        raise ConfigError(f"config file {path}: expected a mapping at top level")
+    version = raw.get("version", "")
+    if not version:
+        raise ConfigError(f"config file {path}: missing required field 'version'")
+    if version != VERSION:
+        raise ConfigError(
+            f"config file {path}: unknown version {version!r} (supported: {VERSION})"
+        )
+    flags = raw.get("flags", {})
+    if not isinstance(flags, dict):
+        raise ConfigError(f"config file {path}: 'flags' must be a mapping")
+    return flags
+
+
+def _normalize_file_key(key: str) -> str:
+    # Accept both camelCase (helm-style) and snake_case keys in config files.
+    out = []
+    for ch in key:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out).replace("-", "_")
+
+
+def load(
+    cli_values: Mapping[str, Any] | None = None,
+    env: Mapping[str, str] | None = None,
+) -> Config:
+    """Build the effective Config with precedence CLI > env > file > default.
+
+    ``cli_values`` holds only flags the user explicitly set on the command
+    line (attr name → value).  The config file itself is located via that
+    same precedence chain.
+    """
+    cli_values = dict(cli_values or {})
+    env = os.environ if env is None else env
+
+    flags = Flags()
+    by_attr = {d.attr: d for d in FLAG_DEFS}
+
+    def apply(attr: str, value: Any, source: str) -> None:
+        d = by_attr[attr]
+        if d.type is bool:
+            value = _coerce_bool(value)
+        elif d.type is float:
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                raise ConfigError(f"{source}: expected a number for {d.flag}, got {value!r}")
+        else:
+            value = str(value)
+        if attr == "topology_strategy":
+            value = STRATEGY_ALIASES.get(value, value)
+        if d.choices and value not in d.choices:
+            raise ConfigError(
+                f"{source}: invalid value {value!r} for {d.flag} (choices: {', '.join(d.choices)})"
+            )
+        setattr(flags, attr, value)
+
+    # Locate the config file first (CLI > env).
+    config_file = cli_values.get("config_file") or env.get("CONFIG_FILE", "")
+
+    # file < env < CLI
+    if config_file:
+        for key, value in _parse_config_file(config_file).items():
+            attr = _normalize_file_key(key)
+            if attr not in by_attr:
+                raise ConfigError(f"config file {config_file}: unknown flag {key!r}")
+            apply(attr, value, f"config file {config_file}")
+    for d in FLAG_DEFS:
+        if d.env in env:
+            apply(d.attr, env[d.env], f"env {d.env}")
+    for attr, value in cli_values.items():
+        if attr not in by_attr:
+            raise ConfigError(f"unknown flag attribute {attr!r}")
+        apply(attr, value, "command line")
+
+    validate(flags)
+    return Config(version=VERSION, flags=flags)
+
+
+def validate(flags: Flags) -> None:
+    """Cross-field validation (reference: main.go:140-157)."""
+    if flags.topology_strategy not in STRATEGIES:
+        raise ConfigError(f"invalid topology strategy {flags.topology_strategy!r}")
+    if flags.device_list_strategy not in DEVICE_LIST_STRATEGIES:
+        raise ConfigError(f"invalid device list strategy {flags.device_list_strategy!r}")
+    if flags.device_id_strategy not in DEVICE_ID_STRATEGIES:
+        raise ConfigError(f"invalid device id strategy {flags.device_id_strategy!r}")
+    if flags.backend not in BACKENDS:
+        raise ConfigError(f"invalid backend {flags.backend!r}")
+    if flags.resource_config:
+        from .resource_config import parse_resource_config
+
+        try:
+            parse_resource_config(flags.resource_config)
+        except ValueError as e:
+            raise ConfigError(str(e)) from None
+    if flags.backend == BACKEND_FAKE:
+        _parse_fake_topology(flags.fake_topology)
+
+
+def _parse_fake_topology(text: str) -> tuple[int, int]:
+    try:
+        chips_text, per_tray_text = text.lower().split("x")
+        chips, per_tray = int(chips_text), int(per_tray_text)
+    except ValueError:
+        raise ConfigError(
+            f"invalid fake topology {text!r}: expected <chips>x<chips-per-tray>"
+        ) from None
+    if chips < 0 or per_tray < 1:
+        raise ConfigError(f"invalid fake topology {text!r}")
+    return chips, per_tray

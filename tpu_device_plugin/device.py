@@ -1,0 +1,95 @@
+"""The node-local TPU chip model.
+
+Equivalent of the reference's ``Device`` struct
+(cmd/nvidia-device-plugin/nvidia.go:41-46): everything the plugin layers need
+to know about one physical chip — identity, device nodes, memory, NUMA
+affinity — plus the TPU-specific ICI coordinates and tray membership that
+replace the reference's NVLink/P2P link matrix as the topology signal.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .api.constants import HEALTHY
+
+
+@dataclass
+class Chip:
+    """One physical TPU chip on this host."""
+
+    # Stable identity, e.g. "tpu-v5e-0000:05:00.0" (PCI) or "tpu-3" (fake).
+    id: str
+    # Host-local accel index: /dev/accel<index>.
+    index: int
+    # Device nodes a container needs to drive this chip.
+    device_paths: list[str] = field(default_factory=list)
+    # HBM capacity in bytes (drives replicas=-1 auto-sharing: 1 replica/GiB).
+    hbm_bytes: int = 0
+    # Chip coordinates inside the ICI mesh of the local slice (x, y, z).
+    coords: tuple[int, int, int] = (0, 0, 0)
+    # Tray index on this host; chips on one tray share the fastest ICI hops.
+    tray: int = 0
+    # Host NUMA node, surfaced to the kubelet TopologyManager; None = unknown.
+    numa_node: int | None = None
+    health: str = HEALTHY
+
+    @property
+    def hbm_gib(self) -> int:
+        return self.hbm_bytes // (1 << 30)
+
+
+@dataclass
+class Unit:
+    """One schedulable unit as advertised to the kubelet.
+
+    Depending on the topology strategy a unit is a single chip (``chip``
+    strategy) or a whole ICI-connected tray of chips (``tray`` strategy) —
+    the TPU analog of the reference advertising whole GPUs vs MIG profiles
+    as distinct resources (cmd/nvidia-device-plugin/mig-strategy.go:206-282).
+    """
+
+    id: str
+    chips: list[Chip]
+
+    @property
+    def device_paths(self) -> list[str]:
+        paths: list[str] = []
+        for chip in self.chips:
+            paths.extend(chip.device_paths)
+        return paths
+
+    @property
+    def hbm_bytes(self) -> int:
+        return sum(c.hbm_bytes for c in self.chips)
+
+    @property
+    def numa_node(self) -> int | None:
+        nodes = {c.numa_node for c in self.chips if c.numa_node is not None}
+        if len(nodes) == 1:
+            return nodes.pop()
+        return None  # spans NUMA nodes or unknown
+
+    @property
+    def chip_ids(self) -> list[str]:
+        return [c.id for c in self.chips]
+
+    @property
+    def chip_indices(self) -> list[int]:
+        return [c.index for c in self.chips]
+
+
+@dataclass(frozen=True)
+class HealthEvent:
+    """A chip health transition, produced by a backend health checker.
+
+    Unlike the reference (one-way Unhealthy with a FIXME at server.go:259),
+    events carry the new state so chips can also recover to Healthy.
+    """
+
+    chip_id: str  # "" means "all chips" (event could not be attributed)
+    health: str = HEALTHY
+
+    @property
+    def all_chips(self) -> bool:
+        return self.chip_id == ""

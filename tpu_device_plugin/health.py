@@ -1,0 +1,104 @@
+"""Health-event fan-out shared by all plugins of one serve cycle.
+
+A backend exposes ONE blocking health-wait primitive (reference analog:
+the NVML event set, nvidia.go:181-269).  With the ``mixed`` strategy two
+plugins watch the same chips; if each called the backend directly they would
+competitively drain the single event source and each event would reach only
+one of them.  HealthFanout owns the single backend watcher thread and
+duplicates every event into one subscriber queue per plugin.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+
+from .backend import ChipManager
+from .device import HealthEvent
+
+log = logging.getLogger(__name__)
+
+
+class HealthFanout:
+    """One backend health watcher, N subscriber queues.
+
+    The watcher thread starts with the first subscriber and stops when the
+    last one unsubscribes (each serve cycle builds a fresh fanout, so a
+    daemon restart cleanly tears the thread down).
+    """
+
+    def __init__(self, manager: ChipManager):
+        self._manager = manager
+        self._lock = threading.Lock()
+        self._subscribers: list["queue.Queue[HealthEvent]"] = []
+        self._stop = threading.Event()
+        self._watcher: threading.Thread | None = None
+        self._pump: threading.Thread | None = None
+        self._central: "queue.Queue[HealthEvent]" = queue.Queue()
+        self._chip_ids: list[str] = []
+        # Last known health per chip: late subscribers (plugins start
+        # sequentially, each with its own serve+register latency) must not
+        # miss transitions that happened before they joined.
+        self._state: dict[str, str] = {}
+
+    def subscribe(self) -> "queue.Queue[HealthEvent]":
+        from .api.constants import HEALTHY
+
+        q: "queue.Queue[HealthEvent]" = queue.Queue()
+        with self._lock:
+            self._subscribers.append(q)
+            if self._watcher is None:
+                self._start_locked()
+            # Replay current non-healthy state so the new subscriber's view
+            # converges even though the original events are long gone.
+            for chip_id, health in self._state.items():
+                if health != HEALTHY:
+                    q.put(HealthEvent(chip_id=chip_id, health=health))
+        return q
+
+    def unsubscribe(self, q: "queue.Queue[HealthEvent]") -> None:
+        with self._lock:
+            if q in self._subscribers:
+                self._subscribers.remove(q)
+            should_stop = not self._subscribers
+            watcher, pump = self._watcher, self._pump
+            if should_stop:
+                self._watcher = self._pump = None
+        if should_stop:
+            self._stop.set()
+            for t in (watcher, pump):
+                if t is not None:
+                    t.join(timeout=5)
+
+    # ------------------------------------------------------------------ internals
+
+    def _start_locked(self) -> None:
+        self._stop.clear()
+        chips = self._manager.devices()
+        self._chip_ids = [c.id for c in chips]
+        self._watcher = threading.Thread(
+            target=self._manager.check_health,
+            args=(self._stop, self._central, chips),
+            name="chip-health-watch",
+            daemon=True,
+        )
+        self._pump = threading.Thread(target=self._run_pump, name="chip-health-fanout", daemon=True)
+        self._watcher.start()
+        self._pump.start()
+
+    def _run_pump(self) -> None:
+        while not self._stop.is_set():
+            try:
+                event = self._central.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            with self._lock:
+                if event.all_chips:
+                    for cid in self._chip_ids:
+                        self._state[cid] = event.health
+                else:
+                    self._state[event.chip_id] = event.health
+                subscribers = list(self._subscribers)
+            for q in subscribers:
+                q.put(event)

@@ -1,0 +1,242 @@
+"""CLI entry point and restartable daemon event loop.
+
+Equivalent of the reference's process layer (cmd/nvidia-device-plugin/
+main.go:44-326): parse flags (each mirrored by an env var), build the
+effective config, then run the restart-orchestrated serve loop — re-creating
+every plugin on SIGHUP or kubelet restart, blocking quietly on chip-less
+nodes when failOnInitError is off, and shutting down cleanly on terminal
+signals.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import queue
+import signal
+import sys
+import threading
+import time
+from dataclasses import dataclass
+
+from . import __version__, config as config_mod, sharing
+from .api import constants
+from .backend import BackendInitError, ChipManager
+from .backend.fake import FakeChipManager
+from .backend.tpu import TpuChipManager
+from .config import BACKEND_FAKE, Config, FLAG_DEFS, _parse_fake_topology
+from .resource_config import parse_resource_config
+from .strategy import new_topology_strategy
+from .watchers import (
+    KubeletSocketWatcher,
+    SignalEvent,
+    SocketEvent,
+    install_signal_watcher,
+)
+
+log = logging.getLogger("tpu-device-plugin")
+
+TERMINAL_SIGNALS = {signal.SIGINT, signal.SIGTERM, signal.SIGQUIT}
+RESTART_BACKOFF_SECS = 5.0
+
+
+@dataclass(frozen=True)
+class FatalEvent:
+    message: str
+
+
+def make_backend(flags) -> ChipManager:
+    if flags.backend == BACKEND_FAKE:
+        chips, per_tray = _parse_fake_topology(flags.fake_topology)
+        return FakeChipManager(n_chips=chips, chips_per_tray=per_tray)
+    return TpuChipManager(driver_root=flags.driver_root)
+
+
+class Daemon:
+    """The restartable serve loop (reference: start(), main.go:205-326)."""
+
+    def __init__(
+        self,
+        config: Config,
+        backend: ChipManager | None = None,
+        events: "queue.Queue | None" = None,
+        lease_dir: str = sharing.DEFAULT_LEASE_DIR,
+    ):
+        self.config = config
+        self.events = events if events is not None else queue.Queue()
+        self.backend = backend if backend is not None else make_backend(config.flags)
+        self.lease_dir = lease_dir
+        self.plugin_dir = config.flags.device_plugin_path or constants.DEVICE_PLUGIN_PATH
+        self.kubelet_socket = self.plugin_dir.rstrip("/") + "/kubelet.sock"
+        self.plugins = []
+        self.started = threading.Event()  # set once plugins serve
+
+    def request_stop(self) -> None:
+        self.events.put(SignalEvent(signum=signal.SIGTERM))
+
+    def run(self) -> int:
+        log.info("running with config:\n%s", self.config.to_json())
+        resource_config = parse_resource_config(self.config.flags.resource_config)
+        if resource_config:
+            log.info("running with resource config: %s", dict(resource_config))
+
+        log.info("initialising %s chip backend", self.config.flags.backend)
+        try:
+            self.backend.init()
+        except BackendInitError as e:
+            log.error("failed to initialise chip backend: %s", e)
+            log.error(
+                "if this is not a TPU node, set failOnInitError=false (or a "
+                "nodeSelector) so the DaemonSet stays quiet here"
+            )
+            if self.config.flags.fail_on_init_error:
+                return 1
+            # Block quietly forever — but stay responsive to terminal
+            # signals (reference: main.go:227-231 select{}).
+            while True:
+                event = self.events.get()
+                if isinstance(event, SignalEvent) and event.signum in TERMINAL_SIGNALS:
+                    return 0
+
+        try:
+            sharing.ensure_lease_dir(self.lease_dir)
+        except OSError as e:
+            log.warning("could not create lease dir %s: %s", self.lease_dir, e)
+
+        watcher = KubeletSocketWatcher(self.kubelet_socket, self.events)
+        watcher.start()
+        try:
+            return self._restart_loop(resource_config)
+        finally:
+            watcher.stop()
+            self._stop_plugins()
+            self.backend.shutdown()
+
+    # ------------------------------------------------------------------ loops
+
+    def _restart_loop(self, resource_config) -> int:
+        while True:
+            self._stop_plugins()
+            strategy = new_topology_strategy(
+                self.config,
+                resource_config,
+                self.backend,
+                plugin_dir=self.plugin_dir,
+                kubelet_socket=self.kubelet_socket,
+                on_fatal=lambda msg: self.events.put(FatalEvent(message=msg)),
+                lease_dir=self.lease_dir,
+            )
+            try:
+                self.plugins = strategy.get_plugins()
+            except Exception as e:
+                log.error("failed to build plugins: %s", e)
+                return 1
+            ok = True
+            for plugin in self.plugins:
+                try:
+                    plugin.start()
+                except Exception as e:
+                    log.error(
+                        "failed to start plugin for %s: %s; retrying in %gs",
+                        plugin.resource_name,
+                        e,
+                        RESTART_BACKOFF_SECS,
+                    )
+                    ok = False
+                    break
+            if not ok:
+                # Retry everything, like the reference's plugin-start-error →
+                # restart path (main.go:264-280), with a small backoff.
+                if self._sleep_interruptible(RESTART_BACKOFF_SECS):
+                    return 0
+                continue
+            if not self.plugins:
+                log.warning("no resources to serve on this node")
+            self.started.set()
+
+            verdict = self._event_loop()
+            if verdict is not None:
+                return verdict
+            # fall through = restart requested
+
+    def _event_loop(self) -> int | None:
+        """Returns an exit code, or None to restart all plugins."""
+        while True:
+            event = self.events.get()
+            if isinstance(event, SocketEvent):
+                log.info("kubelet restart detected (%s recreated); restarting plugins", event.path)
+                return None
+            if isinstance(event, FatalEvent):
+                log.error("fatal plugin error: %s", event.message)
+                return 1
+            if isinstance(event, SignalEvent):
+                if event.signum == signal.SIGHUP:
+                    log.info("received SIGHUP; restarting plugins")
+                    return None
+                log.info("received signal %d; shutting down", event.signum)
+                return 0
+
+    def _sleep_interruptible(self, secs: float) -> bool:
+        """Sleep, but bail early on a terminal signal.  Returns True if the
+        daemon should exit."""
+        deadline = time.monotonic() + secs
+        while time.monotonic() < deadline:
+            try:
+                event = self.events.get(timeout=max(deadline - time.monotonic(), 0.01))
+            except queue.Empty:
+                return False
+            if isinstance(event, SignalEvent) and event.signum in TERMINAL_SIGNALS:
+                return True
+        return False
+
+    def _stop_plugins(self) -> None:
+        for plugin in self.plugins:
+            try:
+                plugin.stop()
+            except Exception as e:  # pragma: no cover - defensive
+                log.warning("error stopping plugin %s: %s", plugin.resource_name, e)
+        self.plugins = []
+        self.started.clear()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpu-device-plugin",
+        description="TPU-native Kubernetes device plugin daemon",
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    for d in FLAG_DEFS:
+        kwargs: dict = {
+            "dest": d.attr,
+            "default": argparse.SUPPRESS,  # only explicit flags reach config.load
+            "help": f"{d.help} [env: {d.env}]",
+        }
+        if d.type is bool:
+            kwargs["action"] = argparse.BooleanOptionalAction
+        else:
+            if d.choices:
+                kwargs["choices"] = list(d.choices)
+        parser.add_argument(d.flag, **kwargs)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        stream=sys.stdout,
+    )
+    args = build_parser().parse_args(argv)
+    try:
+        config = config_mod.load(cli_values=vars(args))
+    except config_mod.ConfigError as e:
+        log.error("invalid configuration: %s", e)
+        return 2
+
+    daemon = Daemon(config)
+    install_signal_watcher(daemon.events)
+    return daemon.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,603 @@
+"""The per-resource device-plugin gRPC server.
+
+One ``TpuDevicePlugin`` per advertised resource name, each with its own unix
+socket, kubelet registration and health-watch thread — the TPU equivalent of
+the reference's core server (cmd/nvidia-device-plugin/server.go:55-480).
+
+Lifecycle per serve cycle: ``initialize()`` caches schedulable units and
+expands time-slice replicas; ``serve()`` binds the socket with a
+crash-restart budget; ``register()`` announces the resource to the kubelet;
+a health thread streams chip state changes into every open ListAndWatch.
+Unlike the reference (server.go:259 FIXME), devices may also recover to
+Healthy.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import grpc
+
+from . import sharing
+from .allocator import Policy, PolicyError
+from .api import constants, pb, rpc
+from .backend import ChipManager
+from .config import (
+    Config,
+    DEVICE_ID_STRATEGY_INDEX,
+    DEVICE_LIST_STRATEGY_ENVVAR,
+    DEVICE_LIST_STRATEGY_VOLUME_MOUNTS,
+)
+from .device import Chip, HealthEvent, Unit
+from .replica import AllocationError, prioritize_devices, replica_id, strip_replicas
+
+log = logging.getLogger(__name__)
+
+# Container path root for the volume-mounts device-list strategy (the analog
+# of the reference's /var/run/nvidia-container-devices, server.go:50-53).
+DEVICE_LIST_AS_VOLUME_MOUNTS_ROOT = "/var/run/tpu-container-devices"
+DEVICE_LIST_AS_VOLUME_MOUNTS_HOST_PATH = "/dev/null"
+
+# Our plugin's own device-list contract: chip IDs (or indices, per
+# device-id-strategy).  sharing.container_env additionally emits the knobs
+# libtpu itself parses (TPU_VISIBLE_DEVICES etc.).
+DEFAULT_DEVICE_LIST_ENVVAR = "TPU_VISIBLE_CHIPS"
+
+DIAL_TIMEOUT_SECS = 5.0  # reference: server.go:208,219
+
+
+class CrashBudget:
+    """Allow a bounded number of rapid server crashes before declaring the
+    plugin dead (reference: server.go:177-204 — >5 crashes each <1h apart)."""
+
+    def __init__(self, max_crashes: int = 5, window_secs: float = 3600.0, clock=time.monotonic):
+        self._max = max_crashes
+        self._window = window_secs
+        self._clock = clock
+        self._count = 0
+        self._last: float | None = None
+
+    def record_crash(self) -> bool:
+        """Record one crash; returns True if a restart is still allowed."""
+        now = self._clock()
+        if self._last is not None and (now - self._last) > self._window:
+            self._count = 1
+        else:
+            self._count += 1
+        self._last = now
+        return self._count <= self._max
+
+
+class ClaimLedger:
+    """Cross-plugin chip-claim bookkeeping for the ``mixed`` strategy.
+
+    When the same physical chips are visible through two resources (a whole
+    tray and its individual chips), an Allocate through one resource claims
+    the chips, and every *other* plugin marks its overlapping units Unhealthy
+    so the scheduler stops placing pods on them.  The device-plugin API has
+    no deallocate signal, so claims expire after ``ttl_secs`` (or are
+    released explicitly, e.g. by lease-liveness integration).
+    """
+
+    def __init__(self, ttl_secs: float | None = None, clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._claims: dict[str, tuple[str, float]] = {}  # chip_id -> (resource, when)
+        self._listeners: list[Callable[[], None]] = []
+        self._ttl = ttl_secs
+        self._clock = clock
+
+    def subscribe(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+    def claim(self, resource: str, chip_ids: list[str]) -> None:
+        now = self._clock()
+        with self._lock:
+            for cid in chip_ids:
+                self._claims[cid] = (resource, now)
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn()
+
+    def release(self, chip_ids: list[str]) -> None:
+        with self._lock:
+            for cid in chip_ids:
+                self._claims.pop(cid, None)
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn()
+
+    def claimed_by_other(self, resource: str) -> set[str]:
+        now = self._clock()
+        with self._lock:
+            return {
+                cid
+                for cid, (res, when) in self._claims.items()
+                if res != resource
+                and (self._ttl is None or now - when < self._ttl)
+            }
+
+    def sweep(self) -> bool:
+        """Drop expired claims; notifies ALL listeners when anything expired
+        so every plugin re-broadcasts (the sweeping plugin is usually the one
+        whose own view was never blocked — its siblings are the ones that
+        must recover)."""
+        if self._ttl is None:
+            return False
+        now = self._clock()
+        with self._lock:
+            expired = [
+                cid for cid, (_, when) in self._claims.items() if now - when >= self._ttl
+            ]
+            for cid in expired:
+                del self._claims[cid]
+            listeners = list(self._listeners) if expired else []
+        for fn in listeners:
+            fn()
+        return bool(expired)
+
+
+@dataclass
+class _Advertised:
+    """One kubelet-visible device: a replica of (or exactly) one unit."""
+
+    id: str
+    unit: Unit
+
+
+@dataclass
+class _Stream:
+    q: "queue.Queue[list]" = field(default_factory=queue.Queue)
+
+
+class TpuDevicePlugin(rpc.DevicePluginServicer):
+    """Serves one extended resource (e.g. ``google.com/tpu``) to the kubelet."""
+
+    def __init__(
+        self,
+        config: Config,
+        resource_name: str,
+        units_fn: Callable[[], list[Unit]],
+        chip_manager: ChipManager,
+        socket_path: str,
+        device_list_envvar: str = DEFAULT_DEVICE_LIST_ENVVAR,
+        allocate_policy: Policy | None = None,
+        replicas: int = 0,
+        auto_replicas: bool = False,
+        kubelet_socket: str | None = None,
+        claims: ClaimLedger | None = None,
+        on_fatal: Callable[[str], None] | None = None,
+        lease_dir: str = sharing.DEFAULT_LEASE_DIR,
+        health_fanout=None,
+    ):
+        self.config = config
+        self.resource_name = resource_name
+        self._units_fn = units_fn
+        self._chip_manager = chip_manager
+        self.socket_path = socket_path
+        self._device_list_envvar = device_list_envvar
+        self._policy = allocate_policy
+        self.replicas = replicas
+        self.auto_replicas = auto_replicas
+        self._kubelet_socket = kubelet_socket or constants.KUBELET_SOCKET
+        self._claims = claims
+        self._on_fatal = on_fatal or (lambda msg: None)
+        self._lease_dir = lease_dir
+        if health_fanout is None:
+            from .health import HealthFanout
+
+            health_fanout = HealthFanout(chip_manager)
+        self._health_fanout = health_fanout
+
+        self._lock = threading.Lock()
+        self._units: list[Unit] = []
+        self._unit_by_id: dict[str, Unit] = {}
+        self._advertised: list[_Advertised] = []
+        self._advertised_ids: set[str] = set()
+        self._chip_health: dict[str, str] = {}
+        self._streams: list[_Stream] = []
+        self._server: grpc.Server | None = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._crash_budget = CrashBudget()
+        self._started = False
+
+    # ------------------------------------------------------------------ state
+
+    @property
+    def shared(self) -> bool:
+        """Whether this resource time-slices its units across pods."""
+        return self.replicas > 1 or self.auto_replicas
+
+    @property
+    def preferred_allocation_available(self) -> bool:
+        return self._policy is not None or self.shared
+
+    def initialize(self) -> None:
+        """Cache units and expand time-slice replicas
+        (reference: server.go:95-116)."""
+        units = self._units_fn()
+        advertised: list[_Advertised] = []
+        for unit in units:
+            if self.shared:
+                n = self.replicas
+                if self.auto_replicas:
+                    # One replica per GiB of HBM: memory as the schedulable
+                    # unit (reference: server.go:100-103, 1 per ~GB).
+                    n = max(unit.hbm_bytes >> 30, 1)
+                log.info(
+                    "replicating unit %s of %s %d times", unit.id, self.resource_name, n
+                )
+                for i in range(n):
+                    advertised.append(_Advertised(id=replica_id(unit.id, i), unit=unit))
+            else:
+                advertised.append(_Advertised(id=unit.id, unit=unit))
+        with self._lock:
+            self._units = units
+            self._unit_by_id = {u.id: u for u in units}
+            self._advertised = advertised
+            self._advertised_ids = {a.id for a in advertised}
+            self._chip_health = {
+                c.id: c.health for u in units for c in u.chips
+            }
+        if self._claims is not None and not getattr(self, "_claims_subscribed", False):
+            self._claims.subscribe(self._broadcast)
+            self._claims_subscribed = True
+
+    def start(self) -> None:
+        """initialize + serve + register + health watch
+        (reference: server.go:129-152)."""
+        self.initialize()
+        self._stop.clear()
+        self.serve()
+        self.register()
+        t = threading.Thread(
+            target=self._health_loop, name=f"health-{self.resource_name}", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+        self._started = True
+        log.info("plugin for %s serving on %s", self.resource_name, self.socket_path)
+
+    def stop(self) -> None:
+        """Stop serving and remove the socket (reference: server.go:155-165)."""
+        self._stop.set()
+        if self._server is not None:
+            self._server.stop(grace=1).wait(timeout=5)
+            self._server = None
+        try:
+            os.remove(self.socket_path)
+        except FileNotFoundError:
+            pass
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+        with self._lock:
+            self._streams.clear()
+        self._started = False
+
+    # ------------------------------------------------------------------ serve
+
+    def _new_server(self) -> grpc.Server:
+        from concurrent.futures import ThreadPoolExecutor
+
+        server = grpc.server(ThreadPoolExecutor(max_workers=16))
+        rpc.add_device_plugin_servicer(self, server)
+        return server
+
+    def serve(self) -> None:
+        """Bind the unix socket and wait for the server to answer
+        (reference: server.go:168-215)."""
+        try:
+            os.remove(self.socket_path)
+        except FileNotFoundError:
+            pass
+        self._server = self._new_server()
+        bound = self._server.add_insecure_port(f"unix:{self.socket_path}")
+        if bound == 0:
+            raise RuntimeError(f"failed to bind plugin socket {self.socket_path}")
+        self._server.start()
+
+        monitor = threading.Thread(
+            target=self._monitor_server,
+            args=(self._server,),
+            name=f"serve-monitor-{self.resource_name}",
+            daemon=True,
+        )
+        monitor.start()
+        self._threads.append(monitor)
+
+        # Block until the server actually answers, like the reference's
+        # post-Serve dial.
+        channel = grpc.insecure_channel(f"unix:{self.socket_path}")
+        try:
+            grpc.channel_ready_future(channel).result(timeout=DIAL_TIMEOUT_SECS)
+        finally:
+            channel.close()
+
+    def _monitor_server(self, server: grpc.Server) -> None:
+        """Restart the gRPC server if it dies unexpectedly, within the crash
+        budget (reference: server.go:177-204)."""
+        while not self._stop.is_set():
+            # wait_for_termination returns True on TIMEOUT (server alive) and
+            # False once the server has terminated.
+            if server.wait_for_termination(timeout=0.5):
+                continue
+            if self._stop.is_set() or self._server is not server:
+                return
+            log.error("gRPC server for %s terminated unexpectedly", self.resource_name)
+            if not self._crash_budget.record_crash():
+                self._on_fatal(
+                    f"gRPC server for {self.resource_name} has repeatedly crashed recently"
+                )
+                return
+            try:
+                self.serve()
+                # Rebinding the socket broke the kubelet's ListAndWatch
+                # stream, and a kubelet never redials an endpoint without a
+                # fresh Register — without this the resource silently drops
+                # to zero capacity until the next kubelet restart.
+                self.register()
+            except Exception as e:
+                # A dead kubelet also fails register(); the kubelet-socket
+                # watcher triggers a full plugin restart when it returns.
+                log.warning(
+                    "restart of %s incomplete (%s); awaiting kubelet", self.resource_name, e
+                )
+            return  # the new serve() spawned its own monitor
+
+    def register(self) -> None:
+        """Register this resource with the kubelet
+        (reference: server.go:218-240)."""
+        channel = grpc.insecure_channel(f"unix:{self._kubelet_socket}")
+        try:
+            grpc.channel_ready_future(channel).result(timeout=DIAL_TIMEOUT_SECS)
+            stub = rpc.RegistrationStub(channel)
+            stub.Register(
+                pb.RegisterRequest(
+                    version=constants.VERSION,
+                    endpoint=os.path.basename(self.socket_path),
+                    resource_name=self.resource_name,
+                    options=pb.DevicePluginOptions(
+                        get_preferred_allocation_available=self.preferred_allocation_available,
+                    ),
+                ),
+                timeout=DIAL_TIMEOUT_SECS,
+            )
+        finally:
+            channel.close()
+
+    # ----------------------------------------------------------------- health
+
+    def _health_loop(self) -> None:
+        """Consume the shared health fan-out and push updates into all
+        ListAndWatch streams (reference: checkHealth wiring, server.go:148 +
+        nvidia.go:181-269).  The fan-out (health.HealthFanout) owns the single
+        backend watcher thread so sibling plugins see every event too."""
+        events = self._health_fanout.subscribe()
+        try:
+            while not self._stop.is_set():
+                try:
+                    event = events.get(timeout=0.2)
+                except queue.Empty:
+                    # No event: lazily expire mixed-strategy claims; expiry
+                    # notifies every ledger listener (all sibling plugins),
+                    # so no explicit broadcast is needed here.
+                    if self._claims is not None:
+                        self._claims.sweep()
+                    continue
+                with self._lock:
+                    if event.all_chips:
+                        for cid in self._chip_health:
+                            self._chip_health[cid] = event.health
+                    elif event.chip_id in self._chip_health:
+                        self._chip_health[event.chip_id] = event.health
+                    else:
+                        continue
+                log.info(
+                    "%s: chip %s now %s",
+                    self.resource_name,
+                    event.chip_id or "<all>",
+                    event.health,
+                )
+                self._broadcast()
+        finally:
+            self._health_fanout.unsubscribe(events)
+
+    def _unit_health(self, unit: Unit, claimed_elsewhere: frozenset | set) -> str:
+        if any(
+            self._chip_health.get(c.id, constants.HEALTHY) == constants.UNHEALTHY
+            for c in unit.chips
+        ):
+            return constants.UNHEALTHY
+        if any(c.id in claimed_elsewhere for c in unit.chips):
+            return constants.UNHEALTHY
+        return constants.HEALTHY
+
+    def api_devices(self) -> list:
+        """The kubelet-facing device list, replica-expanded, with NUMA hints
+        (reference: apiDevices server.go:415-421 + buildDevice nvidia.go:162-179)."""
+        with self._lock:
+            advertised = list(self._advertised)
+        taken: frozenset | set = frozenset()
+        if self._claims is not None:
+            taken = self._claims.claimed_by_other(self.resource_name)
+        out = []
+        for adv in advertised:
+            dev = pb.Device(ID=adv.id, health=self._unit_health(adv.unit, taken))
+            numa = adv.unit.numa_node
+            if numa is not None:
+                dev.topology.nodes.add(ID=numa)
+            out.append(dev)
+        return out
+
+    def _broadcast(self) -> None:
+        devices = self.api_devices()
+        with self._lock:
+            streams = list(self._streams)
+        for s in streams:
+            s.q.put(devices)
+
+    # ------------------------------------------------------------------- RPCs
+
+    def GetDevicePluginOptions(self, request, context):  # noqa: N802
+        return pb.DevicePluginOptions(
+            get_preferred_allocation_available=self.preferred_allocation_available,
+        )
+
+    def ListAndWatch(self, request, context):  # noqa: N802
+        """Stream the device list; re-send on any health/claim change
+        (reference: server.go:251-265)."""
+        stream = _Stream()
+        with self._lock:
+            self._streams.append(stream)
+        try:
+            yield pb.ListAndWatchResponse(devices=self.api_devices())
+            while not self._stop.is_set() and context.is_active():
+                try:
+                    devices = stream.q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                yield pb.ListAndWatchResponse(devices=devices)
+        finally:
+            with self._lock:
+                if stream in self._streams:
+                    self._streams.remove(stream)
+
+    def GetPreferredAllocation(self, request, context):  # noqa: N802
+        """Spreading brain for shared resources, ICI packing otherwise
+        (reference: server.go:268-313)."""
+        response = pb.PreferredAllocationResponse()
+        for req in request.container_requests:
+            try:
+                ids = self._preferred_for(
+                    list(req.available_deviceIDs),
+                    list(req.must_include_deviceIDs),
+                    req.allocation_size,
+                )
+            except (AllocationError, PolicyError, NotImplementedError) as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            response.container_responses.add(deviceIDs=ids)
+        return response
+
+    def _preferred_for(
+        self, available: list[str], must_include: list[str], size: int
+    ) -> list[str]:
+        if self.shared:
+            result = prioritize_devices(available, must_include, size)
+            if not result.unique:
+                # Non-unique is sub-optimal but legal (reference: server.go:288-295).
+                log.warning(
+                    "%s: allocation of %d replicas is non-unique across physical chips",
+                    self.resource_name,
+                    size,
+                )
+            return result.devices
+        if self._policy is not None:
+            return self._policy.allocate(
+                strip_replicas(available), strip_replicas(must_include), size
+            )
+        raise NotImplementedError(
+            "GetPreferredAllocation() not implemented for this resource"
+        )
+
+    def Allocate(self, request, context):  # noqa: N802
+        """Pure in-memory response construction — no backend calls, keeping
+        the p50 target honest (reference: server.go:316-353; SURVEY.md §3.3)."""
+        response = pb.AllocateResponse()
+        allocated_chips: list[str] = []
+        for req in request.container_requests:
+            try:
+                container, chips = self._allocate_one(list(req.devicesIDs))
+            except AllocationError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            response.container_responses.append(container)
+            allocated_chips.extend(c.id for c in chips)
+        # Claim only once the whole request validated: a partially-valid
+        # multi-container Allocate fails as a unit and must not leave orphan
+        # claims blocking the other mixed view for the full TTL.
+        if self._claims is not None and allocated_chips:
+            self._claims.claim(self.resource_name, allocated_chips)
+        return response
+
+    def _allocate_one(self, requested_ids: list[str]):
+        with self._lock:
+            advertised_ids = self._advertised_ids
+            unit_by_id = dict(self._unit_by_id)
+        for rid in requested_ids:
+            if rid not in advertised_ids:
+                raise AllocationError(
+                    f"invalid allocation request for {self.resource_name!r}: unknown device: {rid}"
+                )
+        unit_ids = strip_replicas(requested_ids)
+        units = []
+        for uid in unit_ids:
+            unit = unit_by_id.get(uid)
+            if unit is None:
+                raise AllocationError(
+                    f"invalid allocation request for {self.resource_name!r}: unknown device: {uid}"
+                )
+            units.append(unit)
+        chips: list[Chip] = [c for u in units for c in u.chips]
+
+        container = pb.ContainerAllocateResponse()
+        device_ids = self._device_ids_for(units)
+        strategy = self.config.flags.device_list_strategy
+        if strategy == DEVICE_LIST_STRATEGY_ENVVAR:
+            container.envs[self._device_list_envvar] = ",".join(device_ids)
+        elif strategy == DEVICE_LIST_STRATEGY_VOLUME_MOUNTS:
+            container.envs[self._device_list_envvar] = DEVICE_LIST_AS_VOLUME_MOUNTS_ROOT
+            for did in device_ids:
+                container.mounts.add(
+                    container_path=os.path.join(DEVICE_LIST_AS_VOLUME_MOUNTS_ROOT, did),
+                    host_path=DEVICE_LIST_AS_VOLUME_MOUNTS_HOST_PATH,
+                )
+        for key, value in sharing.container_env(
+            chips, shared=self.shared, lease_dir=self._lease_dir
+        ).items():
+            container.envs[key] = value
+        if self.shared:
+            for cpath, hpath, ro in sharing.lease_mounts(self._lease_dir):
+                container.mounts.add(container_path=cpath, host_path=hpath, read_only=ro)
+        if self.config.flags.pass_device_specs:
+            for spec in self._device_specs(chips):
+                container.devices.add(
+                    container_path=spec[0], host_path=spec[1], permissions="rw"
+                )
+        container.annotations["tpu-device-plugin/chips"] = ",".join(
+            sorted(c.id for c in chips)
+        )
+        return container, chips
+
+    def _device_ids_for(self, units: list[Unit]) -> list[str]:
+        """IDs exposed to the container: unit IDs or chip indices
+        (reference: deviceIDsFromUUIDs server.go:397-413)."""
+        if self.config.flags.device_id_strategy == DEVICE_ID_STRATEGY_INDEX:
+            return [str(i) for u in units for i in u.chip_indices]
+        return [u.id for u in units]
+
+    def _device_specs(self, chips: list[Chip]) -> list[tuple[str, str]]:
+        """(container_path, host_path) device nodes for the allocated chips —
+        on TPU the primary exposure mechanism (reference pendant:
+        apiDeviceSpecs server.go:443-480)."""
+        root = self.config.flags.driver_root
+        specs = []
+        # Common nodes every TPU container needs, when present on the host.
+        for common in ("/dev/vfio/vfio",):
+            host = os.path.join(root, common.lstrip("/"))
+            if os.path.exists(host):
+                specs.append((common, host))
+        for chip in chips:
+            for path in chip.device_paths:
+                host = os.path.join(root, path.lstrip("/"))
+                specs.append((path, host))
+        return specs
+
+    def PreStartContainer(self, request, context):  # noqa: N802
+        return pb.PreStartContainerResponse()

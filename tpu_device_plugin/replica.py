@@ -1,0 +1,119 @@
+"""Time-slice replica allocator: the fractional-sharing core.
+
+A physical TPU chip advertised with N replicas appears to the kubelet as N
+schedulable devices ``<chip-id>-replica-<i>``.  This module holds the pure
+allocation logic that (a) maps replica IDs back to physical chips and (b)
+picks which replicas a new container should get so that load spreads across
+the least-shared chips.
+
+Behavioural contract matches the reference's sharing allocator
+(cmd/nvidia-device-plugin/replica.go:26-198 and its table-driven spec in
+replica_test.go:25-131): deterministic, lexicographic tie-breaking,
+unique-physical-chips preferred, least-utilised-first spreading, and a
+non-fatal "non-unique" signal when a request is forced to double up on one
+physical chip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Sequence
+
+REPLICA_SEP = "-replica-"
+
+
+class AllocationError(ValueError):
+    """A preferred-allocation request that cannot be satisfied at all."""
+
+
+def strip_replica(replica_id: str) -> str:
+    """Map a replica ID (or a bare chip ID) to its physical chip ID."""
+    return replica_id.split(REPLICA_SEP, 1)[0]
+
+
+def strip_replicas(replica_ids: Iterable[str]) -> list[str]:
+    """Map replica IDs to the sorted, de-duplicated physical chip IDs.
+
+    Requesting two replicas that live on one physical chip yields a container
+    that sees *one* chip — this is the sharing semantic.
+    """
+    return sorted({strip_replica(r) for r in replica_ids})
+
+
+def replica_id(chip_id: str, index: int) -> str:
+    """The advertised ID of replica ``index`` of a physical chip."""
+    return f"{chip_id}{REPLICA_SEP}{index}"
+
+
+@dataclass(frozen=True)
+class Prioritized:
+    """Result of :func:`prioritize_devices`.
+
+    ``unique`` is False when the allocation was forced to place two replicas
+    of the same physical chip into one container — legal, but worth a warning
+    log at the call site.
+    """
+
+    devices: list[str]
+    unique: bool
+
+
+def prioritize_devices(
+    available: Sequence[str],
+    must_include: Sequence[str],
+    allocation_size: int,
+) -> Prioritized:
+    """Choose ``allocation_size`` replica IDs from ``available``.
+
+    Selection policy, in priority order:
+      1. honour every ID in ``must_include`` (error if absent from
+         ``available``);
+      2. prefer physical chips not yet used by this request (uniqueness);
+      3. among those, prefer the chip with the most free replicas — i.e. the
+         least-shared chip;
+      4. break all ties lexicographically, making the result deterministic.
+
+    Raises :class:`AllocationError` when there are simply not enough replicas,
+    or when a ``must_include`` ID is not available.
+    """
+    # Free replicas per physical chip, each list kept sorted so that both the
+    # "which chip" and "which replica of it" choices are deterministic.
+    free: dict[str, list[str]] = {}
+    for rid in available:
+        free.setdefault(strip_replica(rid), []).append(rid)
+    for replicas in free.values():
+        replicas.sort()
+    # Chips already contributing a replica to this allocation.
+    used_chips: set[str] = set()
+
+    allocated: list[str] = []
+    unique = True
+
+    for rid in must_include:
+        chip = strip_replica(rid)
+        replicas = free.get(chip)
+        if replicas is None or rid not in replicas:
+            raise AllocationError(
+                f"device '{rid}' in mustIncludeDeviceIDs is missing from availableDeviceIDs"
+            )
+        if chip in used_chips:
+            unique = False
+        replicas.remove(rid)
+        used_chips.add(chip)
+        allocated.append(rid)
+
+    for _ in range(len(allocated), allocation_size):
+        # Least-utilised = most free replicas remaining; unique chips first.
+        # max() scans in sorted-chip order and keeps the first maximum, which
+        # is exactly the lexicographic tie-break.
+        candidates = [c for c in sorted(free) if free[c] and c not in used_chips]
+        if not candidates:
+            candidates = [c for c in sorted(free) if free[c]]
+            if not candidates:
+                raise AllocationError("no devices left to allocate")
+            unique = False
+        chip = max(candidates, key=lambda c: len(free[c]))
+        allocated.append(free[chip].pop(0))
+        used_chips.add(chip)
+
+    return Prioritized(devices=sorted(allocated), unique=unique)

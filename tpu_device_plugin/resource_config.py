@@ -1,0 +1,75 @@
+"""Parsing of the ``--resource-config`` sharing flag.
+
+Format: comma-separated entries ``<orig-name>:<new-name>:<replicas>``, e.g.
+``tpu:shared-tpu:4`` advertises every physical chip 4 times under the renamed
+resource ``google.com/shared-tpu``.  ``replicas = -1`` means *auto*: one
+replica per GiB of chip HBM, exposing TPU memory as the schedulable unit.
+
+Reference semantics: cmd/nvidia-device-plugin/main.go:171-203 (parsing) and
+mig-strategy.go:58-76 (per-resource lookup with identity fallback).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Variant:
+    """How one advertised resource is renamed and replicated."""
+
+    name: str
+    replicas: int = 0
+    auto_replicas: bool = False
+
+    @property
+    def shared(self) -> bool:
+        return self.replicas > 1 or self.auto_replicas
+
+
+class ResourceConfig(dict):
+    """Maps an original short resource name (e.g. ``"tpu"``) to its Variant.
+
+    Lookup of an unconfigured resource returns the identity variant: same
+    name, no replication.
+    """
+
+    def get(self, name: str, default: Variant | None = None) -> Variant:  # type: ignore[override]
+        if name in self:
+            return self[name]
+        if default is not None:
+            return default
+        return Variant(name=name, replicas=0, auto_replicas=False)
+
+
+def parse_resource_config(text: str) -> ResourceConfig:
+    """Parse ``orig:new:replicas[,orig:new:replicas...]``.
+
+    Raises ValueError on malformed entries.
+    """
+    config = ResourceConfig()
+    for entry in text.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) != 3:
+            raise ValueError(
+                f"resource-config entry {entry!r} must have three ':'-separated parts"
+            )
+        orig, new, replicas_text = parts
+        try:
+            replicas = int(replicas_text)
+        except ValueError:
+            raise ValueError(
+                f"resource-config entry {entry!r}: replicas must be an integer"
+            ) from None
+        if replicas == -1:
+            config[orig] = Variant(name=new, replicas=1, auto_replicas=True)
+        elif replicas < 0:
+            raise ValueError(
+                f"resource-config entry {entry!r}: replicas must be >= -1"
+            )
+        else:
+            config[orig] = Variant(name=new, replicas=replicas, auto_replicas=False)
+    return config

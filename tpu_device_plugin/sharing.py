@@ -1,0 +1,84 @@
+"""TPU container-sharing semantics: what goes into an Allocate response.
+
+The reference only has to emit ``NVIDIA_VISIBLE_DEVICES`` and let CUDA's
+native context time-slicing do the sharing (server.go:338-344).  libtpu is
+different: by default one process takes exclusive ownership of a chip, so a
+time-sliced allocation must also ship the multi-process environment that
+libtpu/JAX understand plus a host-shared lease directory for cooperative
+chip admission (SURVEY.md §7 step 4, "hard part #1"):
+
+  * ``TPU_VISIBLE_DEVICES``        — chip indices this container may open;
+    the knob libtpu itself parses when multiple processes split one host.
+  * ``TPU_PROCESS_BOUNDS`` / ``TPU_CHIPS_PER_PROCESS_BOUNDS`` — the process
+    grid: one process owning a bounding box of the allocated chips.
+  * ``TPU_ALLOW_MULTIPLE_LIBTPU_LOAD=1`` — permit several processes to load
+    libtpu on one host (oversubscription prerequisite).
+  * ``TPU_SHARED_LEASE_DIR``       — host directory (bind-mounted into every
+    shared pod) where the cooperative lease client (workloads.lease) takes
+    per-chip flocks so concurrent pods interleave chip ownership instead of
+    crashing on exclusive-open.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .device import Chip
+
+# Host directory used for cooperative per-chip leases across shared pods.
+DEFAULT_LEASE_DIR = "/var/run/tpu-device-plugin/leases"
+LEASE_DIR_ENV = "TPU_SHARED_LEASE_DIR"
+SHARED_ENV = "TPU_DEVICE_PLUGIN_SHARED"
+
+
+def process_bounds(chips: list[Chip]) -> tuple[str, str] | None:
+    """(TPU_CHIPS_PER_PROCESS_BOUNDS, TPU_PROCESS_BOUNDS) for one process
+    owning the bounding box of ``chips`` inside the host mesh.
+
+    Returns None when the chips do not exactly fill their bounding box (the
+    kubelet may hand out non-contiguous chips under fragmentation — the
+    Allocate result is authoritative, GetPreferredAllocation only advisory);
+    emitting a process grid inconsistent with TPU_VISIBLE_DEVICES would make
+    libtpu fail to initialise, so the bounds are omitted and libtpu falls
+    back to its own defaults.
+    """
+    if not chips:
+        return "1,1,1", "1,1,1"
+    xs = [c.coords[0] for c in chips]
+    ys = [c.coords[1] for c in chips]
+    zs = [c.coords[2] for c in chips]
+    box = (
+        max(xs) - min(xs) + 1,
+        max(ys) - min(ys) + 1,
+        max(zs) - min(zs) + 1,
+    )
+    if box[0] * box[1] * box[2] != len(chips):
+        return None
+    return ",".join(str(b) for b in box), "1,1,1"
+
+
+def container_env(chips: list[Chip], shared: bool, lease_dir: str = DEFAULT_LEASE_DIR) -> dict[str, str]:
+    """libtpu/JAX environment for a container granted ``chips``."""
+    indices = sorted(c.index for c in chips)
+    env = {
+        "TPU_VISIBLE_DEVICES": ",".join(str(i) for i in indices),
+    }
+    bounds = process_bounds(chips)
+    if bounds is not None:
+        env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = bounds[0]
+        env["TPU_PROCESS_BOUNDS"] = bounds[1]
+    if shared:
+        env[SHARED_ENV] = "1"
+        env["TPU_ALLOW_MULTIPLE_LIBTPU_LOAD"] = "1"
+        env[LEASE_DIR_ENV] = lease_dir
+    return env
+
+
+def lease_mounts(lease_dir: str = DEFAULT_LEASE_DIR):
+    """(container_path, host_path, read_only) mounts a shared container needs
+    so its lease client coordinates with other pods on the host."""
+    return [(lease_dir, lease_dir, False)]
+
+
+def ensure_lease_dir(lease_dir: str = DEFAULT_LEASE_DIR) -> None:
+    os.makedirs(lease_dir, exist_ok=True)

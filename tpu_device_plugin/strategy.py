@@ -1,0 +1,230 @@
+"""Topology strategies: how chips become advertised resources.
+
+The TPU mapping of the reference's MIG strategy factory
+(cmd/nvidia-device-plugin/mig-strategy.go:30-282):
+
+  * ``chip``  (MIG ``none`` analog)  — every chip is one schedulable device
+    under ``google.com/tpu``.
+  * ``tray``  (MIG ``single`` analog) — the uniform sub-division: one device
+    per ICI-connected tray (e.g. a v5e-4 host advertises ``google.com/tpu: 1``
+    meaning the whole 4-chip tray).  Falls back to ``chip`` when the host has
+    no multi-chip trays.
+  * ``mixed``                          — both views simultaneously: a
+    ``google.com/tpu-tray`` plugin *and* a ``google.com/tpu`` chip plugin,
+    each on its own socket/registration, sharing a ClaimLedger so an
+    allocation through one view marks the overlapping devices of the other
+    view Unhealthy (BASELINE configs[3]: v5e-4 as 1x4-chip + 4x1-chip).
+    Where MIG ``mixed`` partitions disjoint hardware, a TPU tray overlaps
+    its own chips, so reconciliation replaces disjointness.
+
+Resource-config keys: ``tpu`` renames/replicates the chip resource,
+``tpu-tray`` the tray resource (reference analog: mig-strategy.go:58-76).
+"""
+
+from __future__ import annotations
+
+import logging
+from abc import ABC, abstractmethod
+from typing import Callable
+
+from .allocator import Policy, new_best_effort_policy
+from .api import constants
+from .backend import ChipManager
+from .config import (
+    Config,
+    STRATEGY_CHIP,
+    STRATEGY_MIXED,
+    STRATEGY_TRAY,
+)
+from .device import Unit
+from .health import HealthFanout
+from .plugin import ClaimLedger, TpuDevicePlugin
+from .resource_config import ResourceConfig
+from .sharing import DEFAULT_LEASE_DIR
+
+log = logging.getLogger(__name__)
+
+RESOURCE_NAMESPACE = "google.com"
+CHIP_RESOURCE_KEY = "tpu"
+TRAY_RESOURCE_KEY = "tpu-tray"
+
+
+def chip_units(manager: ChipManager) -> list[Unit]:
+    return [Unit(id=c.id, chips=[c]) for c in manager.devices()]
+
+
+def tray_units(manager: ChipManager) -> list[Unit]:
+    trays: dict[int, list] = {}
+    for chip in manager.devices():
+        trays.setdefault(chip.tray, []).append(chip)
+    return [
+        Unit(id=f"tray-{tray}", chips=sorted(chips, key=lambda c: c.index))
+        for tray, chips in sorted(trays.items())
+    ]
+
+
+class TopologyStrategy(ABC):
+    """Maps the node's chips onto one or more device plugins
+    (reference interface: mig-strategy.go:40-43)."""
+
+    def __init__(
+        self,
+        config: Config,
+        resource_config: ResourceConfig,
+        manager: ChipManager,
+        plugin_dir: str,
+        kubelet_socket: str,
+        on_fatal: Callable[[str], None] | None = None,
+        lease_dir: str = DEFAULT_LEASE_DIR,
+    ):
+        self.config = config
+        self.resource_config = resource_config
+        self.manager = manager
+        self.plugin_dir = plugin_dir.rstrip("/") + "/"
+        self.kubelet_socket = kubelet_socket
+        self.on_fatal = on_fatal
+        self.lease_dir = lease_dir
+        # One backend health watcher per serve cycle, fanned out to every
+        # plugin — sibling plugins must each see every event.
+        self.health_fanout = HealthFanout(manager)
+
+    @abstractmethod
+    def get_plugins(self) -> list[TpuDevicePlugin]: ...
+
+    def _make_plugin(
+        self,
+        resource_key: str,
+        units_fn: Callable[[], list[Unit]],
+        socket_name: str,
+        policy: Policy | None,
+        claims: ClaimLedger | None = None,
+    ) -> TpuDevicePlugin:
+        rc = self.resource_config.get(resource_key)
+        # Sharing and topology policy are mutually exclusive per plugin
+        # (reference: server.go:269-270): a shared resource spreads via the
+        # replica allocator instead.
+        if rc.shared:
+            policy = None
+        return TpuDevicePlugin(
+            config=self.config,
+            resource_name=f"{RESOURCE_NAMESPACE}/{rc.name}",
+            units_fn=units_fn,
+            chip_manager=self.manager,
+            socket_path=self.plugin_dir + socket_name,
+            allocate_policy=policy,
+            replicas=rc.replicas,
+            auto_replicas=rc.auto_replicas,
+            kubelet_socket=self.kubelet_socket,
+            claims=claims,
+            on_fatal=self.on_fatal,
+            lease_dir=self.lease_dir,
+            health_fanout=self.health_fanout,
+        )
+
+
+class ChipStrategy(TopologyStrategy):
+    """Whole chips under google.com/tpu (MIG ``none`` analog,
+    mig-strategy.go:94-111)."""
+
+    def get_plugins(self) -> list[TpuDevicePlugin]:
+        policy = new_best_effort_policy(self.manager.topology())
+        rc = self.resource_config.get(CHIP_RESOURCE_KEY)
+        return [
+            self._make_plugin(
+                CHIP_RESOURCE_KEY,
+                lambda: chip_units(self.manager),
+                f"tpu-{rc.name.replace('/', '-')}.sock",
+                policy,
+            )
+        ]
+
+
+class TrayStrategy(TopologyStrategy):
+    """Uniform tray devices under the canonical resource name (MIG ``single``
+    analog, mig-strategy.go:114-203): the tray replaces the chip as the unit."""
+
+    def get_plugins(self) -> list[TpuDevicePlugin]:
+        units = tray_units(self.manager)
+        if all(len(u.chips) <= 1 for u in units):
+            log.info("no multi-chip trays found; falling back to chip strategy")
+            return ChipStrategy(
+                self.config,
+                self.resource_config,
+                self.manager,
+                self.plugin_dir,
+                self.kubelet_socket,
+                self.on_fatal,
+                self.lease_dir,
+            ).get_plugins()
+        sizes = {len(u.chips) for u in units}
+        if len(sizes) > 1:
+            raise RuntimeError(
+                f"tray strategy requires uniform trays, found sizes {sorted(sizes)}"
+            )
+        rc = self.resource_config.get(CHIP_RESOURCE_KEY)
+        return [
+            self._make_plugin(
+                CHIP_RESOURCE_KEY,
+                lambda: tray_units(self.manager),
+                f"tpu-{rc.name.replace('/', '-')}.sock",
+                None,
+            )
+        ]
+
+
+class MixedStrategy(TopologyStrategy):
+    """Both granularities at once, reconciled through a ClaimLedger
+    (MIG ``mixed`` analog, mig-strategy.go:206-282 — one plugin + socket per
+    resource name)."""
+
+    def get_plugins(self) -> list[TpuDevicePlugin]:
+        # The device-plugin API has no deallocate signal, so cross-view
+        # claims expire after a TTL (lazily swept by the plugins' health
+        # loops) instead of lingering until daemon restart.
+        claims = ClaimLedger(ttl_secs=self.config.flags.mixed_claim_ttl_secs or None)
+        chip_rc = self.resource_config.get(CHIP_RESOURCE_KEY)
+        tray_rc = self.resource_config.get(TRAY_RESOURCE_KEY)
+        chip_policy = new_best_effort_policy(self.manager.topology())
+        plugins = [
+            self._make_plugin(
+                CHIP_RESOURCE_KEY,
+                lambda: chip_units(self.manager),
+                f"tpu-{chip_rc.name.replace('/', '-')}.sock",
+                chip_policy,
+                claims=claims,
+            )
+        ]
+        if any(len(u.chips) > 1 for u in tray_units(self.manager)):
+            plugins.append(
+                self._make_plugin(
+                    TRAY_RESOURCE_KEY,
+                    lambda: tray_units(self.manager),
+                    f"tpu-{tray_rc.name.replace('/', '-')}.sock",
+                    None,
+                    claims=claims,
+                )
+            )
+        return plugins
+
+
+def new_topology_strategy(
+    config: Config,
+    resource_config: ResourceConfig,
+    manager: ChipManager,
+    plugin_dir: str = constants.DEVICE_PLUGIN_PATH,
+    kubelet_socket: str = constants.KUBELET_SOCKET,
+    on_fatal: Callable[[str], None] | None = None,
+    lease_dir: str = DEFAULT_LEASE_DIR,
+) -> TopologyStrategy:
+    """Strategy factory (reference: NewMigStrategy, mig-strategy.go:46-56)."""
+    classes = {
+        STRATEGY_CHIP: ChipStrategy,
+        STRATEGY_TRAY: TrayStrategy,
+        STRATEGY_MIXED: MixedStrategy,
+    }
+    cls = classes.get(config.flags.topology_strategy)
+    if cls is None:
+        raise RuntimeError(f"unknown strategy: {config.flags.topology_strategy}")
+    return cls(
+        config, resource_config, manager, plugin_dir, kubelet_socket, on_fatal, lease_dir
+    )

@@ -1,0 +1,149 @@
+"""TPU tray / ICI-slice topology model.
+
+Replaces the reference's NVLink/P2P pairwise link matrix
+(vendor/.../gpuallocator/device.go:33-72 + nvml.go:592-658) with the TPU
+interconnect reality: chips sit at integer coordinates of an ICI mesh/torus,
+groups of (usually 4) chips share a tray with the fastest links, and anything
+off-host is reached over DCN.  Placement quality is scored from coordinate
+distance instead of probed link-by-link — computed once at discovery time,
+not per RPC (the reference re-probes all pairs on every
+GetPreferredAllocation; see SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .device import Chip
+
+# Pair-connectivity scores, higher = better placement.  Plays the role of the
+# reference's link score table (besteffort_policy.go:298-356).
+SCORE_SAME_TRAY = 100
+SCORE_ICI_BASE = 60  # same slice, decays with hop distance
+SCORE_SAME_HOST = 10  # same host but no direct ICI adjacency credit
+SCORE_DCN = 1  # cross-host, data-centre network only
+
+
+@dataclass
+class Topology:
+    """Topology of all chips visible to this daemon.
+
+    ``torus_shape`` is the (x, y, z) extent of the ICI mesh the local chips
+    belong to; zero/one extents mean the axis is unused.  ``wraparound`` marks
+    torus links (v4/v5p pods); v5e slices are plain meshes.
+    """
+
+    accelerator_type: str = "v5e"
+    torus_shape: tuple[int, int, int] = (2, 2, 1)
+    wraparound: bool = False
+    chips_by_id: dict[str, Chip] = field(default_factory=dict)
+    # Chips of the same slice hosted by *other* hosts (multi-host slices,
+    # e.g. v5p-16): id -> coords.  Used for cross-host preferred allocation.
+    remote_coords: dict[str, tuple[int, int, int]] = field(default_factory=dict)
+    remote_trays: dict[str, int] = field(default_factory=dict)
+
+    def coords_of(self, chip_id: str) -> tuple[int, int, int] | None:
+        chip = self.chips_by_id.get(chip_id)
+        if chip is not None:
+            return chip.coords
+        return self.remote_coords.get(chip_id)
+
+    def tray_of(self, chip_id: str) -> int | None:
+        chip = self.chips_by_id.get(chip_id)
+        if chip is not None:
+            return chip.tray
+        return self.remote_trays.get(chip_id)
+
+    def is_local(self, chip_id: str) -> bool:
+        return chip_id in self.chips_by_id
+
+    def ici_distance(self, a: str, b: str) -> int | None:
+        """Hop count between two chips over the ICI mesh/torus; None if either
+        chip is unknown."""
+        ca, cb = self.coords_of(a), self.coords_of(b)
+        if ca is None or cb is None:
+            return None
+        hops = 0
+        for axis, (pa, pb) in enumerate(zip(ca, cb)):
+            extent = self.torus_shape[axis] if axis < len(self.torus_shape) else 1
+            d = abs(pa - pb)
+            if self.wraparound and extent > 1:
+                d = min(d, extent - d)
+            hops += d
+        return hops
+
+    def pair_score(self, a: str, b: str) -> int:
+        """Connectivity score for placing chips a and b in one allocation."""
+        same_host = self.is_local(a) and self.is_local(b)
+        ta, tb = self.tray_of(a), self.tray_of(b)
+        if same_host and ta is not None and ta == tb:
+            return SCORE_SAME_TRAY
+        hops = self.ici_distance(a, b)
+        if hops is not None:
+            # Adjacent chips on the slice score just under same-tray and the
+            # score decays per hop, bottoming out above DCN.
+            return max(SCORE_ICI_BASE - 10 * max(hops - 1, 0), SCORE_DCN + 1)
+        if same_host:
+            return SCORE_SAME_HOST
+        return SCORE_DCN
+
+    def set_score(self, chip_ids: list[str]) -> int:
+        """Total pairwise score of a candidate allocation set."""
+        total = 0
+        for i, a in enumerate(chip_ids):
+            for b in chip_ids[i + 1 :]:
+                total += self.pair_score(a, b)
+        return total
+
+    def trays(self) -> dict[int, list[Chip]]:
+        """Local chips grouped by tray, each group ordered by index."""
+        groups: dict[int, list[Chip]] = {}
+        for chip in sorted(self.chips_by_id.values(), key=lambda c: c.index):
+            groups.setdefault(chip.tray, []).append(chip)
+        return groups
+
+
+def grid_coords(n: int, shape: tuple[int, int, int]) -> list[tuple[int, int, int]]:
+    """Row-major coordinates for n chips inside an (x, y, z) grid."""
+    coords = []
+    sx, sy, _sz = (max(shape[0], 1), max(shape[1], 1), max(shape[2], 1))
+    for i in range(n):
+        coords.append((i % sx, (i // sx) % sy, i // (sx * sy)))
+    return coords
+
+
+def build_fake_topology(
+    n_chips: int,
+    chips_per_tray: int,
+    accelerator_type: str = "v5e",
+    hbm_gib: int = 16,
+    id_prefix: str = "tpu",
+) -> Topology:
+    """A deterministic host topology for the fake backend and tests.
+
+    Chips are laid out row-major on a 2D mesh whose x-extent is the tray
+    width, so one tray = one contiguous row block (matching the physical
+    v5e-4 tray of a 2x2 sub-mesh is intentionally simplified to rows: what
+    matters to the allocator is that intra-tray distance < inter-tray
+    distance).
+    """
+    width = max(chips_per_tray, 1)
+    height = max((n_chips + width - 1) // width, 1)
+    topo = Topology(
+        accelerator_type=accelerator_type,
+        torus_shape=(width, height, 1),
+        wraparound=False,
+    )
+    pad = len(str(max(n_chips - 1, 0)))
+    for i, coords in enumerate(grid_coords(n_chips, topo.torus_shape)):
+        chip = Chip(
+            id=f"{id_prefix}-{i:0{pad}d}",
+            index=i,
+            device_paths=[f"/dev/accel{i}"],
+            hbm_bytes=hbm_gib << 30,
+            coords=coords,
+            tray=i // width,
+            numa_node=0 if n_chips <= 4 else (0 if i < n_chips // 2 else 1),
+        )
+        topo.chips_by_id[chip.id] = chip
+    return topo

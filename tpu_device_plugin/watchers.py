@@ -1,0 +1,81 @@
+"""Kubelet-restart and OS-signal watchers.
+
+Equivalent of the reference's watchers (cmd/nvidia-device-plugin/
+watchers.go:9-31 + wiring main.go:234-242,286-324): detect the kubelet
+recreating its registration socket (kubelet restart ⇒ all plugins must
+re-register) and funnel OS signals into the event loop.
+
+The reference uses inotify; here a 2 Hz inode poll keeps the implementation
+dependency-free and trivially testable — detection latency is bounded by the
+poll interval, which is negligible against the kubelet's own restart time.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import signal
+import threading
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class SocketEvent:
+    """The watched socket appeared or was replaced (new inode)."""
+
+    path: str
+
+
+class KubeletSocketWatcher:
+    """Watches kubelet.sock for creation/recreation."""
+
+    def __init__(self, socket_path: str, events: "queue.Queue", poll_secs: float = 0.5):
+        self._path = socket_path
+        self._events = events
+        self._poll = poll_secs
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _signature(self) -> tuple[int, int] | None:
+        # inode alone is not enough: a remove+recreate between two polls can
+        # reuse the inode number, so the creation time disambiguates.
+        try:
+            st = os.stat(self._path)
+            return (st.st_ino, st.st_ctime_ns)
+        except FileNotFoundError:
+            return None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name="kubelet-sock-watch", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        last = self._signature()
+        while not self._stop.wait(self._poll):
+            current = self._signature()
+            if current is not None and current != last:
+                self._events.put(SocketEvent(path=self._path))
+            last = current
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+@dataclass(frozen=True)
+class SignalEvent:
+    signum: int
+
+
+def install_signal_watcher(events: "queue.Queue", signals=(signal.SIGHUP, signal.SIGINT, signal.SIGTERM, signal.SIGQUIT)) -> None:
+    """Route the given signals into the event queue
+    (reference: newOSWatcher, watchers.go:26-31)."""
+
+    def handler(signum, frame):
+        events.put(SignalEvent(signum=signum))
+
+    for s in signals:
+        signal.signal(s, handler)
