@@ -1,0 +1,380 @@
+// libtpuinfo implementation: TPU chip discovery over /dev/accel*, sysfs
+// metadata, and inotify-based device-node health watching.  See tpuinfo.h
+// for the API contract and the reference-parity notes.
+
+#include "tpuinfo.h"
+
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <limits.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/inotify.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr const char* kVersion = "0.1.0";
+
+struct Chip {
+  std::string id;
+  int32_t index = 0;
+  std::string device_path;  // path under the driver root, e.g. /dev/accel0
+  int64_t hbm_bytes = 0;
+  int32_t x = 0, y = 0, z = 0;
+  int32_t tray = 0;
+  int32_t numa_node = -1;
+};
+
+struct State {
+  std::mutex mu;
+  bool initialized = false;
+  std::string root;  // driver root, no trailing slash ("" means "/")
+  std::vector<Chip> chips;
+  std::string accelerator_type = "v5e";
+  int32_t torus_x = 1, torus_y = 1, torus_z = 1;
+  int32_t wraparound = 0;
+  // Health watching.
+  int inotify_fd = -1;
+  int watch_fd = -1;
+  std::map<std::string, bool> present;  // device node name -> last seen alive
+};
+
+State g_state;
+
+std::string JoinRoot(const std::string& root, const char* abs_path) {
+  // abs_path starts with '/'; root has no trailing slash.
+  return root + abs_path;
+}
+
+bool ReadFileString(const std::string& path, std::string* out) {
+  FILE* f = fopen(path.c_str(), "re");
+  if (f == nullptr) return false;
+  char buf[256];
+  size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  buf[n] = '\0';
+  // Trim trailing whitespace/newline.
+  while (n > 0 && (buf[n - 1] == '\n' || buf[n - 1] == ' ' || buf[n - 1] == '\t')) {
+    buf[--n] = '\0';
+  }
+  *out = buf;
+  return true;
+}
+
+bool ReadFileInt64(const std::string& path, int64_t* out) {
+  std::string s;
+  if (!ReadFileString(path, &s)) return false;
+  errno = 0;
+  char* end = nullptr;
+  long long v = strtoll(s.c_str(), &end, 10);
+  if (errno != 0 || end == s.c_str()) return false;
+  *out = static_cast<int64_t>(v);
+  return true;
+}
+
+int64_t DefaultHbmBytes(const std::string& accel_type) {
+  // Public per-chip HBM capacities of Cloud TPU generations.
+  if (accel_type == "v5p") return 95LL << 30;
+  if (accel_type == "v4") return 32LL << 30;
+  if (accel_type == "v3") return 32LL << 30;
+  if (accel_type == "v2") return 16LL << 30;
+  return 16LL << 30;  // v5e and default
+}
+
+int DefaultChipsPerTray(const std::string& accel_type) {
+  (void)accel_type;
+  return 4;  // v5e/v5p/v4 host trays carry 4 chips
+}
+
+// Cloud accelerator-type strings use marketing aliases; normalise to the
+// short generation names the rest of the stack keys on.
+std::string NormalizeType(std::string t) {
+  size_t dash = t.find('-');
+  if (dash != std::string::npos) t = t.substr(0, dash);
+  if (t == "v5litepod" || t == "v5lite") return "v5e";
+  if (t == "v6litepod" || t == "v6lite") return "v6e";
+  return t;
+}
+
+std::string DetectAcceleratorType(const std::string& root) {
+  const char* env = getenv("TPUINFO_ACCELERATOR_TYPE");
+  if (env != nullptr && env[0] != '\0') return NormalizeType(env);
+  // GKE/Cloud TPU VMs commonly export TPU_ACCELERATOR_TYPE like "v5e-4" or
+  // "v5litepod-8".
+  env = getenv("TPU_ACCELERATOR_TYPE");
+  if (env != nullptr && env[0] != '\0') return NormalizeType(env);
+  std::string from_file;
+  if (ReadFileString(JoinRoot(root, "/etc/tpu_accelerator_type"), &from_file) &&
+      !from_file.empty()) {
+    return NormalizeType(from_file);
+  }
+  return "v5e";
+}
+
+// Resolve the PCI bus/device/function identity of accel<N> from sysfs, e.g.
+// /sys/class/accel/accel0/device -> ../../../0000:05:00.0.  Returns "" when
+// unavailable (fake trees, exotic drivers).
+std::string PciIdentity(const std::string& root, int index) {
+  char link[PATH_MAX];
+  std::string sym = JoinRoot(root, "/sys/class/accel/accel") +
+                    std::to_string(index) + "/device";
+  char resolved[PATH_MAX];
+  if (realpath(sym.c_str(), resolved) != nullptr) {
+    const char* base = strrchr(resolved, '/');
+    if (base != nullptr && strchr(base, ':') != nullptr) return base + 1;
+  }
+  ssize_t n = readlink(sym.c_str(), link, sizeof(link) - 1);
+  if (n > 0) {
+    link[n] = '\0';
+    const char* base = strrchr(link, '/');
+    if (base != nullptr && strchr(base, ':') != nullptr) return base + 1;
+  }
+  return "";
+}
+
+int32_t NumaNode(const std::string& root, int index) {
+  int64_t v;
+  std::string p = JoinRoot(root, "/sys/class/accel/accel") +
+                  std::to_string(index) + "/device/numa_node";
+  if (ReadFileInt64(p, &v)) return static_cast<int32_t>(v);
+  return -1;
+}
+
+int64_t HbmBytes(const std::string& root, int index, const std::string& accel_type) {
+  // Optional per-chip override used by fake trees and future drivers.
+  int64_t v;
+  std::string p = JoinRoot(root, "/sys/class/accel/accel") +
+                  std::to_string(index) + "/device/tpu_hbm_bytes";
+  if (ReadFileInt64(p, &v) && v > 0) return v;
+  const char* env = getenv("TPUINFO_HBM_GIB");
+  if (env != nullptr && env[0] != '\0') {
+    long g = strtol(env, nullptr, 10);
+    if (g > 0) return static_cast<int64_t>(g) << 30;
+  }
+  return DefaultHbmBytes(accel_type);
+}
+
+// Enumerate /dev/accel[0-9]+ under the root.  Indices are the accel numbers.
+std::vector<int> ScanAccelIndices(const std::string& root) {
+  std::vector<int> indices;
+  std::string dev_dir = JoinRoot(root, "/dev");
+  DIR* d = opendir(dev_dir.c_str());
+  if (d == nullptr) return indices;
+  struct dirent* e;
+  while ((e = readdir(d)) != nullptr) {
+    if (strncmp(e->d_name, "accel", 5) != 0) continue;
+    const char* num = e->d_name + 5;
+    if (*num == '\0') continue;
+    char* end = nullptr;
+    long idx = strtol(num, &end, 10);
+    if (end == nullptr || *end != '\0' || idx < 0) continue;
+    indices.push_back(static_cast<int>(idx));
+  }
+  closedir(d);
+  std::sort(indices.begin(), indices.end());
+  return indices;
+}
+
+void SetupHealthWatchLocked() {
+  if (g_state.inotify_fd >= 0) {
+    close(g_state.inotify_fd);
+    g_state.inotify_fd = -1;
+    g_state.watch_fd = -1;
+  }
+  g_state.inotify_fd = inotify_init1(IN_NONBLOCK | IN_CLOEXEC);
+  if (g_state.inotify_fd < 0) return;
+  std::string dev_dir = JoinRoot(g_state.root, "/dev");
+  g_state.watch_fd = inotify_add_watch(g_state.inotify_fd, dev_dir.c_str(),
+                                       IN_CREATE | IN_DELETE | IN_ATTRIB);
+  g_state.present.clear();
+  for (const Chip& c : g_state.chips) {
+    g_state.present["accel" + std::to_string(c.index)] = true;
+  }
+}
+
+void CopyString(char* dst, size_t dst_len, const std::string& src) {
+  snprintf(dst, dst_len, "%s", src.c_str());
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpuinfo_init(const char* driver_root) {
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  std::string root = (driver_root == nullptr) ? "" : driver_root;
+  while (root.size() > 1 && root.back() == '/') root.pop_back();
+  if (root == "/") root = "";
+
+  g_state.root = root;
+  g_state.chips.clear();
+  g_state.accelerator_type = DetectAcceleratorType(root);
+
+  int chips_per_tray = DefaultChipsPerTray(g_state.accelerator_type);
+  const char* per_tray_env = getenv("TPUINFO_CHIPS_PER_TRAY");
+  if (per_tray_env != nullptr && per_tray_env[0] != '\0') {
+    long v = strtol(per_tray_env, nullptr, 10);
+    if (v > 0) chips_per_tray = static_cast<int>(v);
+  }
+
+  std::vector<int> indices = ScanAccelIndices(root);
+  int pos = 0;
+  for (int idx : indices) {
+    Chip chip;
+    chip.index = idx;
+    chip.device_path = "/dev/accel" + std::to_string(idx);
+    std::string pci = PciIdentity(root, idx);
+    chip.id = pci.empty() ? ("tpu-" + std::to_string(idx)) : ("tpu-" + pci);
+    chip.hbm_bytes = HbmBytes(root, idx, g_state.accelerator_type);
+    chip.numa_node = NumaNode(root, idx);
+    chip.tray = pos / chips_per_tray;
+    chip.x = pos % chips_per_tray;
+    chip.y = pos / chips_per_tray;
+    chip.z = 0;
+    ++pos;
+    g_state.chips.push_back(chip);
+  }
+
+  int n = static_cast<int>(g_state.chips.size());
+  g_state.torus_x = chips_per_tray;
+  g_state.torus_y = (n + chips_per_tray - 1) / chips_per_tray;
+  if (g_state.torus_y < 1) g_state.torus_y = 1;
+  g_state.torus_z = 1;
+  // v5e slices are meshes; v4/v5p pods have torus links.  Overridable.
+  const char* wrap_env = getenv("TPUINFO_WRAPAROUND");
+  if (wrap_env != nullptr && wrap_env[0] != '\0') {
+    g_state.wraparound = (wrap_env[0] == '1') ? 1 : 0;
+  } else {
+    g_state.wraparound =
+        (g_state.accelerator_type == "v4" || g_state.accelerator_type == "v5p")
+            ? 1
+            : 0;
+  }
+
+  SetupHealthWatchLocked();
+  g_state.initialized = true;
+  return n;
+}
+
+void tpuinfo_shutdown(void) {
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  g_state.initialized = false;
+  g_state.chips.clear();
+  g_state.present.clear();
+  if (g_state.inotify_fd >= 0) {
+    close(g_state.inotify_fd);
+    g_state.inotify_fd = -1;
+    g_state.watch_fd = -1;
+  }
+}
+
+int tpuinfo_chip_count(void) {
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  if (!g_state.initialized) return TPUINFO_ERR_NOT_INITIALIZED;
+  return static_cast<int>(g_state.chips.size());
+}
+
+int tpuinfo_get_chips(tpuinfo_chip_t* out, int max) {
+  if (out == nullptr || max < 0) return TPUINFO_ERR_INVALID;
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  if (!g_state.initialized) return TPUINFO_ERR_NOT_INITIALIZED;
+  int n = std::min(static_cast<int>(g_state.chips.size()), max);
+  for (int i = 0; i < n; ++i) {
+    const Chip& c = g_state.chips[i];
+    tpuinfo_chip_t* o = &out[i];
+    CopyString(o->id, sizeof(o->id), c.id);
+    o->index = c.index;
+    CopyString(o->device_path, sizeof(o->device_path), c.device_path);
+    o->hbm_bytes = c.hbm_bytes;
+    o->x = c.x;
+    o->y = c.y;
+    o->z = c.z;
+    o->tray = c.tray;
+    o->numa_node = c.numa_node;
+  }
+  return n;
+}
+
+int tpuinfo_get_topology(tpuinfo_topology_t* out) {
+  if (out == nullptr) return TPUINFO_ERR_INVALID;
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  if (!g_state.initialized) return TPUINFO_ERR_NOT_INITIALIZED;
+  CopyString(out->accelerator_type, sizeof(out->accelerator_type),
+             g_state.accelerator_type);
+  out->torus_x = g_state.torus_x;
+  out->torus_y = g_state.torus_y;
+  out->torus_z = g_state.torus_z;
+  out->wraparound = g_state.wraparound;
+  return 0;
+}
+
+int tpuinfo_wait_health_events(tpuinfo_health_event_t* out, int max,
+                               int timeout_ms) {
+  if (out == nullptr || max <= 0) return TPUINFO_ERR_INVALID;
+
+  int fd = -1;
+  {
+    std::lock_guard<std::mutex> lock(g_state.mu);
+    if (!g_state.initialized) return TPUINFO_ERR_NOT_INITIALIZED;
+    // dup() under the lock: a concurrent shutdown/re-init may close the
+    // original inotify fd while this thread is blocked in poll(); the dup
+    // keeps the inotify object alive for this call and avoids polling a
+    // recycled descriptor number.
+    if (g_state.inotify_fd >= 0) fd = dup(g_state.inotify_fd);
+  }
+
+  // Block (outside the lock) until the watched /dev directory changes or the
+  // timeout elapses; a failed inotify setup degrades to a plain sleep +
+  // rescan below, so health still converges by polling.
+  if (fd >= 0) {
+    struct pollfd pfd = {fd, POLLIN, 0};
+    int rc = poll(&pfd, 1, timeout_ms);
+    if (rc > 0) {
+      // Drain the inotify buffer; the rescan below derives the actual
+      // transitions, so the event payloads themselves only serve as a wakeup.
+      char buf[4096];
+      while (read(fd, buf, sizeof(buf)) > 0) {
+      }
+    }
+    close(fd);
+  } else {
+    struct timespec ts = {timeout_ms / 1000, (timeout_ms % 1000) * 1000000L};
+    nanosleep(&ts, nullptr);
+  }
+
+  // Rescan device-node liveness and report transitions.
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  if (!g_state.initialized) return TPUINFO_ERR_NOT_INITIALIZED;
+  int written = 0;
+  for (const Chip& c : g_state.chips) {
+    std::string name = "accel" + std::to_string(c.index);
+    std::string path = JoinRoot(g_state.root, c.device_path.c_str());
+    struct stat st;
+    bool alive = (stat(path.c_str(), &st) == 0);
+    auto it = g_state.present.find(name);
+    bool was_alive = (it == g_state.present.end()) ? true : it->second;
+    if (alive != was_alive && written < max) {
+      tpuinfo_health_event_t* o = &out[written++];
+      CopyString(o->chip_id, sizeof(o->chip_id), c.id);
+      o->healthy = alive ? 1 : 0;
+      g_state.present[name] = alive;
+    }
+  }
+  return written;
+}
+
+const char* tpuinfo_version(void) { return kVersion; }
+
+}  // extern "C"

@@ -1,0 +1,88 @@
+/* libtpuinfo: native TPU chip discovery, topology and health for the
+ * tpu-device-plugin daemon.
+ *
+ * This is the framework's native boundary — the role the NVML C library
+ * plays in the reference (vendor/.../nvml/nvml.h + bindings), rebuilt for
+ * TPU hosts: chips are enumerated from <driver_root>/dev/accel*, metadata
+ * (PCI identity, NUMA node, HBM size) is read from <driver_root>/sys, and
+ * health is synthesized from device-node liveness via inotify (TPUs expose
+ * no XID-style event stream; see SURVEY.md section 7, hard part #2).
+ *
+ * The library is deliberately loadable via dlopen with no hard dependency
+ * on a TPU driver, mirroring the reference's dlopen of libnvidia-ml
+ * (nvml_dl.go:29-36): on a chip-less node tpuinfo_init simply reports zero
+ * chips and the daemon's failOnInitError policy takes over.
+ *
+ * All functions are thread-safe. Strings are NUL-terminated and truncated
+ * to the fixed field sizes.
+ */
+
+#ifndef TPUINFO_H_
+#define TPUINFO_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define TPUINFO_ID_LEN 64
+#define TPUINFO_PATH_LEN 128
+#define TPUINFO_TYPE_LEN 16
+
+/* Error codes (negative returns). */
+#define TPUINFO_ERR_NOT_INITIALIZED -1
+#define TPUINFO_ERR_IO -2
+#define TPUINFO_ERR_INVALID -3
+
+typedef struct {
+  char id[TPUINFO_ID_LEN];          /* stable chip id, e.g. "tpu-0000:05:00.0" */
+  int32_t index;                    /* host-local index: /dev/accel<index> */
+  char device_path[TPUINFO_PATH_LEN]; /* "/dev/accel<index>" (relative to driver root) */
+  int64_t hbm_bytes;                /* HBM capacity */
+  int32_t x, y, z;                  /* ICI mesh coordinates within the local slice */
+  int32_t tray;                     /* tray index on this host */
+  int32_t numa_node;                /* host NUMA node, -1 if unknown */
+} tpuinfo_chip_t;
+
+typedef struct {
+  char accelerator_type[TPUINFO_TYPE_LEN]; /* "v5e", "v5p", "v4", ... */
+  int32_t torus_x, torus_y, torus_z;       /* ICI mesh extents */
+  int32_t wraparound;                      /* 1 when the links form a torus */
+} tpuinfo_topology_t;
+
+typedef struct {
+  char chip_id[TPUINFO_ID_LEN]; /* "" = event applies to all chips */
+  int32_t healthy;              /* 1 = Healthy, 0 = Unhealthy */
+} tpuinfo_health_event_t;
+
+/* Discover chips under driver_root (normally "/"). Returns the number of
+ * chips found (0 on a chip-less node) or a negative error. Re-init is
+ * allowed and rescans. */
+int tpuinfo_init(const char* driver_root);
+
+void tpuinfo_shutdown(void);
+
+int tpuinfo_chip_count(void);
+
+/* Copies up to max chips into out; returns the number written or a
+ * negative error. */
+int tpuinfo_get_chips(tpuinfo_chip_t* out, int max);
+
+int tpuinfo_get_topology(tpuinfo_topology_t* out);
+
+/* Blocks up to timeout_ms for device-node liveness changes; returns the
+ * number of events written to out (0 on timeout) or a negative error.
+ * A vanished /dev/accel* node yields healthy=0 for that chip; reappearance
+ * yields healthy=1 (recovery is a first-class transition, unlike the
+ * reference's one-way Unhealthy, server.go:259). */
+int tpuinfo_wait_health_events(tpuinfo_health_event_t* out, int max,
+                               int timeout_ms);
+
+const char* tpuinfo_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUINFO_H_ */

@@ -1,11 +1,21 @@
 import os
 import sys
 
-# Run all JAX-touching tests on a virtual 8-device CPU mesh so sharding
-# logic is exercised without TPU hardware.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Run all JAX-touching tests on a virtual 8-device CPU mesh so sharding logic
+# is exercised without TPU hardware.  The interpreter may preload jax with a
+# TPU platform latched from the environment (sitecustomize), so setting env
+# vars is not enough — update the live config before any backend initialises.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # older jax: XLA_FLAGS alone handles it
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,168 @@
+"""Native libtpuinfo + TpuChipManager against a fake device tree.
+
+Builds the C++ library (skipped when no toolchain), points --driver-root at a
+synthetic /dev + /sys layout, and exercises discovery, metadata, topology and
+the inotify-based health-wait primitive including recovery.
+"""
+
+import os
+import shutil
+import subprocess
+import time
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+LIB_PATH = os.path.join(NATIVE_DIR, "libtpuinfo.so")
+
+
+def build_lib():
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain available")
+    subprocess.run(["make", "-C", NATIVE_DIR], check=True, capture_output=True)
+
+
+@pytest.fixture(scope="module")
+def lib_path():
+    build_lib()
+    return LIB_PATH
+
+
+@pytest.fixture
+def fake_tree(tmp_path):
+    """A synthetic driver root with 4 chips: /dev/accel0..3 + sysfs metadata."""
+    root = tmp_path / "root"
+    (root / "dev").mkdir(parents=True)
+    for i in range(4):
+        (root / "dev" / f"accel{i}").write_text("")
+        dev_dir = root / "sys" / "class" / "accel" / f"accel{i}" / "device"
+        dev_dir.mkdir(parents=True)
+        (dev_dir / "numa_node").write_text("0\n")
+        (dev_dir / "tpu_hbm_bytes").write_text(str(16 << 30))
+    return str(root)
+
+
+@pytest.fixture
+def native(lib_path, monkeypatch):
+    from tpu_device_plugin.backend.native import NativeTpuInfo
+
+    monkeypatch.delenv("TPUINFO_ACCELERATOR_TYPE", raising=False)
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    n = NativeTpuInfo(lib_path=lib_path)
+    yield n
+    n.shutdown()
+
+
+def test_load_and_version(native):
+    assert native.version() == "0.1.0"
+
+
+def test_missing_library_raises():
+    from tpu_device_plugin.backend.native import NativeTpuInfo, NativeUnavailableError
+
+    with pytest.raises(NativeUnavailableError):
+        NativeTpuInfo(lib_path="/nonexistent/libtpuinfo.so")
+
+
+def test_discovery_and_metadata(native, fake_tree):
+    assert native.init(fake_tree) == 4
+    chips = native.chips()
+    assert [c.index for c in chips] == [0, 1, 2, 3]
+    # No PCI links in the fake tree -> index-derived stable IDs.
+    assert chips[0].id == "tpu-0"
+    assert chips[0].device_paths == ["/dev/accel0"]
+    assert chips[0].hbm_bytes == 16 << 30
+    assert chips[0].numa_node == 0
+    assert [c.tray for c in chips] == [0, 0, 0, 0]
+    assert chips[1].coords == (1, 0, 0)
+
+
+def test_topology(native, fake_tree):
+    native.init(fake_tree)
+    topo = native.topology()
+    assert topo.accelerator_type == "v5e"
+    assert topo.torus_shape == (4, 1, 1)
+    assert not topo.wraparound
+    assert set(topo.chips_by_id) == {"tpu-0", "tpu-1", "tpu-2", "tpu-3"}
+
+
+def test_chipless_root(native, tmp_path):
+    empty = tmp_path / "empty"
+    (empty / "dev").mkdir(parents=True)
+    assert native.init(str(empty)) == 0
+
+
+def test_health_node_removal_and_recovery(native, fake_tree):
+    from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+
+    native.init(fake_tree)
+    assert native.wait_health_events(timeout_ms=50) == []
+
+    os.remove(os.path.join(fake_tree, "dev", "accel2"))
+    deadline = time.monotonic() + 5
+    events = []
+    while not events and time.monotonic() < deadline:
+        events = native.wait_health_events(timeout_ms=200)
+    assert [(e.chip_id, e.health) for e in events] == [("tpu-2", UNHEALTHY)]
+
+    with open(os.path.join(fake_tree, "dev", "accel2"), "w"):
+        pass
+    events = []
+    deadline = time.monotonic() + 5
+    while not events and time.monotonic() < deadline:
+        events = native.wait_health_events(timeout_ms=200)
+    assert [(e.chip_id, e.health) for e in events] == [("tpu-2", HEALTHY)]
+
+
+def test_tpu_chip_manager_end_to_end(lib_path, fake_tree):
+    import queue
+    import threading
+
+    from tpu_device_plugin.api.constants import UNHEALTHY
+    from tpu_device_plugin.backend.tpu import TpuChipManager
+
+    mgr = TpuChipManager(driver_root=fake_tree, lib_path=lib_path)
+    mgr.init()
+    try:
+        devs = mgr.devices()
+        assert len(devs) == 4
+        assert mgr.topology().accelerator_type == "v5e"
+
+        stop = threading.Event()
+        events: queue.Queue = queue.Queue()
+        t = threading.Thread(
+            target=mgr.check_health, args=(stop, events, devs), daemon=True
+        )
+        t.start()
+        try:
+            os.remove(os.path.join(fake_tree, "dev", "accel1"))
+            ev = events.get(timeout=10)
+            assert ev.chip_id == "tpu-1" and ev.health == UNHEALTHY
+        finally:
+            stop.set()
+            t.join(timeout=5)
+    finally:
+        mgr.shutdown()
+
+
+def test_tpu_chip_manager_chipless_node_fails_init(lib_path, tmp_path):
+    from tpu_device_plugin.backend import BackendInitError
+    from tpu_device_plugin.backend.tpu import TpuChipManager
+
+    empty = tmp_path / "empty"
+    (empty / "dev").mkdir(parents=True)
+    mgr = TpuChipManager(driver_root=str(empty), lib_path=lib_path)
+    with pytest.raises(BackendInitError, match="no TPU chips"):
+        mgr.init()
+
+
+def test_accelerator_type_detection(native, fake_tree, monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-16")
+    native.init(fake_tree)
+    topo = native.topology()
+    assert topo.accelerator_type == "v5p"
+    assert topo.wraparound  # v5p pods have torus links
+    chips = native.chips()
+    # The fake tree's per-chip sysfs override (tpu_hbm_bytes = 16 GiB) takes
+    # precedence over the v5p per-type default (95 GiB).
+    assert chips[0].hbm_bytes == 16 << 30

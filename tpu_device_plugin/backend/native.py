@@ -62,9 +62,11 @@ class _HealthEventStruct(ctypes.Structure):
 
 
 def _candidate_paths(lib_path: str | None) -> list[str]:
-    candidates = []
     if lib_path:
-        candidates.append(lib_path)
+        # An explicit path is authoritative — no silent fallback to another
+        # installation.
+        return [lib_path]
+    candidates = []
     env = os.environ.get(ENV_LIBRARY)
     if env:
         candidates.append(env)
