@@ -1,0 +1,112 @@
+"""Benchmark: Allocate() p50 latency through the real gRPC stack.
+
+The BASELINE.json north star for the pod-admission path is "Allocate() p50
+< 50 ms".  This harness stands up the daemon's plugin server exactly as
+production does — time-sliced resource (4 chips x 4 replicas), real unix
+socket, real kubelet registration — and measures Allocate round-trips from
+a kubelet-side client.
+
+Prints ONE JSON line:
+  {"metric": "allocate_p50_latency_ms", "value": <p50 ms>, "unit": "ms",
+   "vs_baseline": <p50/50ms>}   (vs_baseline < 1.0 beats the target)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import grpc
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tpu_device_plugin.api import pb, rpc  # noqa: E402
+from tpu_device_plugin.backend.fake import FakeChipManager  # noqa: E402
+from tpu_device_plugin.config import Config, Flags  # noqa: E402
+from tpu_device_plugin.plugin import TpuDevicePlugin  # noqa: E402
+from tpu_device_plugin.strategy import chip_units  # noqa: E402
+
+BASELINE_P50_MS = 50.0
+WARMUP_RPCS = 50
+MEASURED_RPCS = 2000
+
+
+class _Kubelet(rpc.RegistrationServicer):
+    def Register(self, request, context):  # noqa: N802
+        return pb.Empty()
+
+
+def run_bench() -> dict:
+    tmp = tempfile.mkdtemp(prefix="tpu-dp-bench-")
+    kubelet_server = grpc.server(ThreadPoolExecutor(max_workers=2))
+    rpc.add_registration_servicer(_Kubelet(), kubelet_server)
+    kubelet_sock = os.path.join(tmp, "kubelet.sock")
+    assert kubelet_server.add_insecure_port(f"unix:{kubelet_sock}") != 0
+    kubelet_server.start()
+
+    manager = FakeChipManager(n_chips=4, chips_per_tray=4)
+    manager.init()
+    plugin = TpuDevicePlugin(
+        config=Config(flags=Flags(backend="fake")),
+        resource_name="google.com/shared-tpu",
+        units_fn=lambda: chip_units(manager),
+        chip_manager=manager,
+        socket_path=os.path.join(tmp, "tpu-shared-tpu.sock"),
+        kubelet_socket=kubelet_sock,
+        replicas=4,
+        lease_dir=os.path.join(tmp, "leases"),
+    )
+    plugin.start()
+    try:
+        channel = grpc.insecure_channel(f"unix:{plugin.socket_path}")
+        grpc.channel_ready_future(channel).result(timeout=5)
+        stub = rpc.DevicePluginStub(channel)
+
+        device_ids = [d.ID for d in plugin.api_devices()]
+        assert len(device_ids) == 16  # 4 chips x 4 replicas
+
+        def allocate(i: int) -> float:
+            req = pb.AllocateRequest(
+                container_requests=[
+                    pb.ContainerAllocateRequest(
+                        devicesIDs=[device_ids[i % len(device_ids)]]
+                    )
+                ]
+            )
+            t0 = time.perf_counter()
+            stub.Allocate(req)
+            return (time.perf_counter() - t0) * 1000.0
+
+        for i in range(WARMUP_RPCS):
+            allocate(i)
+        latencies = [allocate(i) for i in range(MEASURED_RPCS)]
+        channel.close()
+    finally:
+        plugin.stop()
+        kubelet_server.stop(grace=0.2).wait()
+        manager.shutdown()
+
+    latencies.sort()
+    p50 = statistics.median(latencies)
+    p99 = latencies[int(len(latencies) * 0.99) - 1]
+    print(
+        f"allocate latency over {MEASURED_RPCS} RPCs: "
+        f"p50={p50:.3f}ms p99={p99:.3f}ms max={latencies[-1]:.3f}ms "
+        f"(target p50 < {BASELINE_P50_MS}ms)",
+        file=sys.stderr,
+    )
+    return {
+        "metric": "allocate_p50_latency_ms",
+        "value": round(p50, 4),
+        "unit": "ms",
+        "vs_baseline": round(p50 / BASELINE_P50_MS, 5),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_bench()))

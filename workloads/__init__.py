@@ -1,0 +1,15 @@
+"""JAX example workloads for the TPU device plugin.
+
+The role examples/ and the PyTorch MNIST pod play in the reference
+(examples/pods/pod1-shared-pytorch.yml): the things users actually run on
+chips handed out by the plugin.  TPU-native equivalents:
+
+  * ``model`` / ``train`` — a small decoder-only transformer with a fully
+    sharded (data x model parallel) training step, used by the example pods,
+    the multi-chip dry-run and the benchmark harness.
+  * ``lease``  — the cooperative per-chip lease client that time-sliced pods
+    use to interleave chip ownership (libtpu grants exclusive chip access,
+    so oversubscribed pods must coordinate; SURVEY.md §7 hard part #1).
+  * ``busy_probe`` — measures aggregate chip-busy %, the BASELINE.md
+    north-star metric the reference never had instrumentation for.
+"""

@@ -1,0 +1,115 @@
+"""Aggregate chip-busy measurement for oversubscribed TPU sharing.
+
+The BASELINE.md north star is "≥90% aggregate chip-busy with 8 time-sliced
+JAX pods on a v5e-4 host" — a metric the reference never instrumented
+(SURVEY.md §6).  This probe is that instrumentation: each participating pod
+runs compute bursts under the cooperative chip lease and appends its
+busy/wall accounting to a shared stats file; the aggregate busy fraction is
+the unioned busy time across pods divided by wall time.
+
+Run standalone (one process simulates one pod):
+
+    python -m workloads.busy_probe --duration 10 --report /path/stats.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+from . import lease
+
+
+def make_burst_fn(matrix_dim: int = 1024, target_burst_secs: float = 0.25):
+    """A compute burst sized to keep the MXU busy: chained bf16 matmuls.
+
+    The step count is calibrated so one burst takes ~target_burst_secs on
+    this device — long enough that lease-handoff overhead (flock wakeup,
+    scheduling) stays a small fraction of the duty cycle, short enough that
+    siblings still interleave many times per second."""
+
+    @jax.jit
+    def chained(x):
+        for _ in range(8):
+            x = jnp.tanh(x @ x)
+        return x
+
+    x = jnp.ones((matrix_dim, matrix_dim), jnp.bfloat16)
+    chained(x).block_until_ready()  # compile outside the measured region
+    t0 = time.monotonic()
+    chained(x).block_until_ready()
+    step_secs = max(time.monotonic() - t0, 1e-6)
+    steps_per_burst = max(int(target_burst_secs / step_secs), 1)
+
+    def burst():
+        result = x
+        for _ in range(steps_per_burst):
+            result = chained(result)
+        result.block_until_ready()
+
+    return burst
+
+
+def run_probe(duration_secs: float, report_path: str | None, matrix_dim: int = 1024) -> dict:
+    burst = make_burst_fn(matrix_dim=matrix_dim)
+    stats = lease.run_leased_bursts(burst, duration_secs)
+    stats.update(
+        {
+            "pid": os.getpid(),
+            "busy_fraction": stats["busy_secs"] / max(stats["wall_secs"], 1e-9),
+            "t_end": time.time(),
+        }
+    )
+    if report_path:
+        with open(report_path, "a") as f:
+            f.write(json.dumps(stats) + "\n")
+    return stats
+
+
+def aggregate(report_path: str) -> dict:
+    """Aggregate busy fraction across all pods that appended to the report.
+
+    Bursts hold an exclusive per-chip lease, so per-pod busy intervals are
+    disjoint and aggregate busy = sum of busy seconds / max wall window.
+    """
+    rows = []
+    with open(report_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    if not rows:
+        return {"pods": 0, "aggregate_busy_fraction": 0.0}
+    wall = max(r["wall_secs"] for r in rows)
+    busy = sum(r["busy_secs"] for r in rows)
+    return {
+        "pods": len(rows),
+        "wall_secs": wall,
+        "busy_secs": busy,
+        "aggregate_busy_fraction": min(busy / max(wall, 1e-9), 1.0),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="TPU chip-busy probe")
+    parser.add_argument("--duration", type=float, default=10.0)
+    parser.add_argument("--report", default="")
+    parser.add_argument("--matrix-dim", type=int, default=1024)
+    parser.add_argument("--aggregate", action="store_true",
+                        help="aggregate an existing report instead of probing")
+    args = parser.parse_args(argv)
+    if args.aggregate:
+        print(json.dumps(aggregate(args.report)))
+        return 0
+    stats = run_probe(args.duration, args.report or None, args.matrix_dim)
+    print(json.dumps(stats))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

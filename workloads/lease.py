@@ -1,0 +1,112 @@
+"""Cooperative per-chip lease client for time-sliced TPU pods.
+
+CUDA time-shares GPU contexts natively, which is all the reference needs
+(its containers just see the same GPU).  libtpu instead grants one process
+exclusive chip access, so pods oversubscribed onto a chip must *cooperate*:
+each takes the chip lease (an flock on a per-chip file in the host-shared
+lease directory the plugin mounts into every shared pod), runs a burst of
+steps, releases, repeats.  The kernel guarantees fairness-by-queueing and
+automatic release when a pod dies mid-burst (flocks drop with the fd).
+
+Usage inside a pod (env vars are injected by the plugin's Allocate):
+
+    from workloads import lease
+    with lease.chip_lease():          # blocks until this pod owns its chips
+        ... run a burst of train steps ...
+    # released: another pod's turn
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import time
+from contextlib import contextmanager
+
+from tpu_device_plugin.sharing import DEFAULT_LEASE_DIR, LEASE_DIR_ENV
+
+
+def _chip_ids_from_env() -> list[str]:
+    raw = os.environ.get("TPU_VISIBLE_CHIPS", "")
+    return [c for c in raw.split(",") if c]
+
+
+def lease_path(lease_dir: str, chip_id: str) -> str:
+    return os.path.join(lease_dir, f"chip-{chip_id.replace('/', '_')}.lock")
+
+
+@contextmanager
+def chip_lease(chip_ids: list[str] | None = None, lease_dir: str | None = None):
+    """Blocks until ALL of this pod's chips are leased, then yields.
+
+    Chips are locked in sorted order, which makes concurrent gang
+    acquisitions deadlock-free.  Defaults come from the environment the
+    plugin injected (TPU_VISIBLE_CHIPS, TPU_SHARED_LEASE_DIR).
+    """
+    lease_dir = lease_dir or os.environ.get(LEASE_DIR_ENV, DEFAULT_LEASE_DIR)
+    chip_ids = sorted(chip_ids if chip_ids is not None else _chip_ids_from_env())
+    os.makedirs(lease_dir, exist_ok=True)
+    fds: list[int] = []
+    try:
+        for cid in chip_ids:
+            fd = os.open(lease_path(lease_dir, cid), os.O_CREAT | os.O_RDWR, 0o666)
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            fds.append(fd)
+        yield
+    finally:
+        for fd in reversed(fds):
+            try:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            finally:
+                os.close(fd)
+
+
+def try_chip_lease(chip_ids: list[str] | None = None, lease_dir: str | None = None):
+    """Non-blocking variant: returns a release() callable or None if any
+    chip is currently owned by another pod."""
+    lease_dir = lease_dir or os.environ.get(LEASE_DIR_ENV, DEFAULT_LEASE_DIR)
+    chip_ids = sorted(chip_ids if chip_ids is not None else _chip_ids_from_env())
+    os.makedirs(lease_dir, exist_ok=True)
+    fds: list[int] = []
+
+    def release():
+        for fd in reversed(fds):
+            try:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            finally:
+                os.close(fd)
+
+    for cid in chip_ids:
+        fd = os.open(lease_path(lease_dir, cid), os.O_CREAT | os.O_RDWR, 0o666)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except BlockingIOError:
+            os.close(fd)
+            release()
+            return None
+        fds.append(fd)
+    return release
+
+
+def run_leased_bursts(
+    burst_fn,
+    duration_secs: float,
+    chip_ids: list[str] | None = None,
+    lease_dir: str | None = None,
+    backoff_secs: float = 0.002,
+) -> dict:
+    """Interleave with sibling pods for ``duration_secs``: lease, run one
+    burst_fn() (a batch of steps), release, repeat.  Returns busy/wall
+    accounting used by the busy probe."""
+    t_start = time.monotonic()
+    busy = 0.0
+    bursts = 0
+    while time.monotonic() - t_start < duration_secs:
+        with chip_lease(chip_ids, lease_dir):
+            t0 = time.monotonic()
+            burst_fn()
+            busy += time.monotonic() - t0
+        bursts += 1
+        time.sleep(backoff_secs)  # let a waiting sibling grab the flock
+    wall = time.monotonic() - t_start
+    return {"busy_secs": busy, "wall_secs": wall, "bursts": bursts}

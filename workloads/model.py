@@ -1,0 +1,148 @@
+"""A small decoder-only transformer, written TPU-first.
+
+Pure-JAX (explicit parameter pytree, no framework classes) so that sharding
+is transparent: every parameter leaf carries an obvious partition axis and
+the whole model jits into a handful of large MXU-friendly matmuls in
+bfloat16 compute.  Used as the flagship workload by the example pods, the
+benchmark and the multi-chip dry-run (__graft_entry__.py).
+
+Sharding convention over a Mesh with axes ("data", "model"):
+  * activations  : batch sharded on "data"
+  * attention    : head dimension sharded on "model"
+  * MLP          : hidden dimension sharded on "model"
+  * embeddings   : replicated (small at these sizes)
+XLA inserts the all-reduces at the attention/MLP output projections — the
+standard Megatron-style tensor-parallel cut expressed purely through
+jax.sharding annotations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 128
+    max_seq_len: int = 128
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(config: ModelConfig, key: jax.Array) -> dict:
+    """Parameter pytree; leaf names mirror the sharding specs in
+    param_specs()."""
+    keys = jax.random.split(key, 2 + config.n_layers)
+    scale = 0.02
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * scale)
+
+    params = {
+        "embed": dense(keys[0], (config.vocab_size, config.d_model)),
+        "unembed": dense(keys[1], (config.d_model, config.vocab_size)),
+        "layers": [],
+    }
+    for i in range(config.n_layers):
+        k = jax.random.split(keys[2 + i], 6)
+        params["layers"].append(
+            {
+                "ln1": jnp.ones((config.d_model,), jnp.float32),
+                "ln2": jnp.ones((config.d_model,), jnp.float32),
+                "wqkv": dense(k[0], (config.d_model, 3, config.n_heads, config.head_dim)),
+                "wo": dense(k[1], (config.n_heads, config.head_dim, config.d_model)),
+                "w_up": dense(k[2], (config.d_model, config.d_ff)),
+                "w_down": dense(k[3], (config.d_ff, config.d_model)),
+            }
+        )
+    return params
+
+
+def param_specs(config: ModelConfig) -> dict:
+    """PartitionSpecs matching init_params' tree: the Megatron tensor-
+    parallel cut over the "model" mesh axis."""
+    layer = {
+        "ln1": P(),
+        "ln2": P(),
+        "wqkv": P(None, None, "model", None),
+        "wo": P("model", None, None),
+        "w_up": P(None, "model"),
+        "w_down": P("model", None),
+    }
+    return {
+        "embed": P(),
+        "unembed": P(),
+        "layers": [dict(layer) for _ in range(config.n_layers)],
+    }
+
+
+def _rmsnorm(x: jax.Array, gain: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * gain.astype(x.dtype)
+
+
+def _rope(x: jax.Array) -> jax.Array:
+    """Rotary position embedding over the last (head_dim) axis.
+    x: [batch, seq, heads, head_dim]."""
+    _, seq, _, head_dim = x.shape
+    half = head_dim // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = jnp.arange(seq, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _attention(x: jax.Array, layer: dict, config: ModelConfig) -> jax.Array:
+    batch, seq, _ = x.shape
+    qkv = jnp.einsum("bsd,dthk->tbshk", x, layer["wqkv"].astype(x.dtype))
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    q, k = _rope(q), _rope(k)
+    logits = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(config.head_dim).astype(x.dtype)
+    mask = jnp.tril(jnp.ones((seq, seq), bool))
+    logits = jnp.where(mask[None, None], logits.astype(jnp.float32), -1e30)
+    weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhst,bthk->bshk", weights, v)
+    return jnp.einsum("bshk,hkd->bsd", out, layer["wo"].astype(x.dtype))
+
+
+def _mlp(x: jax.Array, layer: dict) -> jax.Array:
+    hidden = jax.nn.gelu(x @ layer["w_up"].astype(x.dtype))
+    return hidden @ layer["w_down"].astype(x.dtype)
+
+
+def forward(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+    """Logits for next-token prediction.  tokens: [batch, seq] int32."""
+    x = params["embed"].astype(config.dtype)[tokens]
+    for layer in params["layers"]:
+        x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, config)
+        x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
+    # Final projection in float32 for a stable softmax/loss.
+    return (x.astype(jnp.float32) @ params["unembed"])
+
+
+def loss_fn(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+    """Causal LM cross-entropy: predict tokens[:, 1:] from tokens[:, :-1]."""
+    logits = forward(params, tokens[:, :-1], config)
+    targets = tokens[:, 1:]
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def make_forward_fn(config: ModelConfig):
+    """A jittable (params, tokens) -> logits closure for the graft entry."""
+    return partial(forward, config=config)
