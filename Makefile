@@ -1,0 +1,26 @@
+# tpu-device-plugin build/test entry points (reference analog: Makefile:40-117).
+
+PYTHON ?= python
+
+.PHONY: all native test coverage bench clean check fmt-check
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+coverage: native
+	$(PYTHON) -m pytest tests/ -q --cov=tpu_device_plugin --cov=workloads --cov-report=term 2>/dev/null \
+		|| $(PYTHON) -m pytest tests/ -q
+
+bench: native
+	$(PYTHON) bench.py
+
+check: test
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
