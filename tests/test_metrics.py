@@ -1,0 +1,100 @@
+"""Observability endpoint: registry rendering, HTTP scrape, daemon wiring."""
+
+import queue
+import threading
+import urllib.request
+
+import pytest
+
+from tpu_device_plugin.metrics import MetricsServer, Registry
+
+from .fake_kubelet import FakeKubelet
+
+
+def test_registry_counters_and_labels():
+    reg = Registry()
+    reg.describe("allocations_total", "allocs")
+    reg.inc("allocations_total", {"resource": "google.com/tpu"})
+    reg.inc("allocations_total", {"resource": "google.com/tpu"}, 2)
+    text = reg.render()
+    assert 'tpu_device_plugin_allocations_total{resource="google.com/tpu"} 3' in text
+    assert "# TYPE tpu_device_plugin_allocations_total counter" in text
+
+
+def test_registry_gauges_and_failing_collector():
+    reg = Registry()
+    reg.register_gauge("devices", lambda: [({"health": "Healthy"}, 4.0)])
+    reg.register_gauge("broken", lambda: 1 / 0)
+    text = reg.render()
+    assert 'tpu_device_plugin_devices{health="Healthy"} 4' in text  # scrape survives
+
+
+def test_timed_context_manager():
+    from tpu_device_plugin import metrics
+
+    before = dict(metrics.registry._counters)
+    with metrics.timed("allocate", {"resource": "r"}):
+        pass
+    text = metrics.registry.render()
+    assert 'tpu_device_plugin_allocate_count{resource="r"}' in text
+
+
+def test_http_scrape():
+    reg = Registry()
+    reg.inc("allocations_total", {}, 7)
+    server = MetricsServer(0, reg)
+    port = server.start()
+    try:
+        body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics").read().decode()
+        assert "tpu_device_plugin_allocations_total 7" in body
+        health = urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz").read()
+        assert health == b"ok\n"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope")
+    finally:
+        server.stop()
+
+
+def test_daemon_serves_device_gauge_and_allocation_counters(tmp_path):
+    import socket
+
+    from tpu_device_plugin.api import pb
+    from tpu_device_plugin.backend.fake import FakeChipManager
+    from tpu_device_plugin.config import Config, Flags
+    from tpu_device_plugin.main import Daemon
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+
+    kubelet = FakeKubelet(str(tmp_path / "dp"))
+    kubelet.start()
+    mgr = FakeChipManager(n_chips=4, chips_per_tray=4)
+    flags = Flags(
+        backend="fake",
+        device_plugin_path=kubelet.plugin_dir,
+        metrics_port=port,
+        resource_config="tpu:shared-tpu:2",
+    )
+    daemon = Daemon(Config(flags=flags), backend=mgr, events=queue.Queue(),
+                    lease_dir=str(tmp_path / "leases"))
+    t = threading.Thread(target=daemon.run, daemon=True)
+    t.start()
+    try:
+        assert daemon.started.wait(10)
+        stub = kubelet.plugin_client("tpu-shared-tpu.sock")
+        stub.Allocate(
+            pb.AllocateRequest(
+                container_requests=[
+                    pb.ContainerAllocateRequest(devicesIDs=["tpu-0-replica-0"])
+                ]
+            )
+        )
+        body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics").read().decode()
+        assert 'allocations_total{resource="google.com/shared-tpu"}' in body
+        assert 'devices{health="Healthy",resource="google.com/shared-tpu"} 8' in body
+        assert "allocate_seconds_total" in body
+    finally:
+        daemon.request_stop()
+        t.join(timeout=10)
+        kubelet.stop()

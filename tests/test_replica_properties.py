@@ -1,0 +1,85 @@
+"""Property-based spec of the replica allocator (hypothesis).
+
+The reference's table tests pin specific cases; these properties pin the
+invariants for ALL inputs: completeness, membership, no-double-spend of
+replica IDs, correctness of the uniqueness verdict, and determinism.
+"""
+
+from hypothesis import given, settings, strategies as st
+
+from tpu_device_plugin.replica import (
+    AllocationError,
+    prioritize_devices,
+    strip_replica,
+    strip_replicas,
+)
+
+chip_ids = st.sampled_from(["a", "b", "c", "d", "e"])
+replica_pools = st.lists(
+    st.tuples(chip_ids, st.integers(0, 5)).map(lambda t: f"{t[0]}-replica-{t[1]}"),
+    min_size=0,
+    max_size=20,
+    unique=True,
+)
+
+
+@given(replica_pools, st.integers(0, 20), st.data())
+@settings(max_examples=200, deadline=None)
+def test_prioritize_invariants(available, size, data):
+    must_include = data.draw(
+        st.lists(st.sampled_from(available), max_size=min(size, len(available)), unique=True)
+        if available and size
+        else st.just([])
+    )
+    try:
+        result = prioritize_devices(available, must_include, size)
+    except AllocationError:
+        # Legal only when the request is unsatisfiable.
+        assert size > len(available) or any(
+            m not in available for m in must_include
+        ) or (size > 0 and not available)
+        return
+    devices = result.devices
+    assert len(devices) == size
+    assert len(set(devices)) == size  # no replica ID handed out twice
+    assert set(devices) <= set(available)
+    assert set(must_include) <= set(devices)
+    assert devices == sorted(devices)
+    chips = [strip_replica(d) for d in devices]
+    if result.unique:
+        assert len(set(chips)) == size  # verdict "unique" means distinct chips
+    else:
+        assert len(set(chips)) < size
+
+
+@given(replica_pools, st.integers(0, 10))
+@settings(max_examples=100, deadline=None)
+def test_prioritize_deterministic(available, size):
+    try:
+        first = prioritize_devices(available, [], size)
+        second = prioritize_devices(list(reversed(available)), [], size)
+    except AllocationError:
+        return
+    assert first == second  # input order never matters
+
+
+@given(replica_pools, st.integers(1, 10))
+@settings(max_examples=100, deadline=None)
+def test_prioritize_spreads_before_doubling(available, size):
+    """No chip receives a second replica while another chip is untouched."""
+    try:
+        result = prioritize_devices(available, [], size)
+    except AllocationError:
+        return
+    used = [strip_replica(d) for d in result.devices]
+    counts = {c: used.count(c) for c in used}
+    untouched = {strip_replica(a) for a in available} - set(used)
+    if untouched:
+        assert max(counts.values()) == 1
+
+
+@given(st.lists(st.text(alphabet="ab-replic0123", max_size=12), max_size=10))
+@settings(max_examples=100, deadline=None)
+def test_strip_replicas_sorted_unique(ids):
+    out = strip_replicas(ids)
+    assert out == sorted(set(out))

@@ -71,6 +71,14 @@ class Flags:
     # overlapping resource becomes schedulable again (the device-plugin API
     # has no deallocate signal).  0 disables expiry.
     mixed_claim_ttl_secs: float = 300.0
+    # Prometheus /metrics + /healthz HTTP port; 0 disables the endpoint.
+    metrics_port: int = 0
+    # Multi-host slice overrides (else read from TPU_TOPOLOGY /
+    # TPU_HOST_BOUNDS / TPU_WORKER_ID metadata): global chip grid "XxYxZ",
+    # host grid "a,b,c", and this host's index.  -1 = use metadata.
+    slice_topology: str = ""
+    slice_host_bounds: str = ""
+    slice_worker_id: int = -1
 
 
 @dataclass
@@ -109,6 +117,14 @@ FLAG_DEFS: list[FlagDef] = [
             "kubelet device-plugin socket directory (default: the kubelet standard path)"),
     FlagDef("mixed_claim_ttl_secs", "--mixed-claim-ttl-secs", "MIXED_CLAIM_TTL_SECS", float,
             "mixed strategy: seconds before a cross-view chip claim expires (0 = never)"),
+    FlagDef("metrics_port", "--metrics-port", "METRICS_PORT", int,
+            "Prometheus /metrics + /healthz port (0 = disabled)"),
+    FlagDef("slice_topology", "--slice-topology", "SLICE_TOPOLOGY", str,
+            "multi-host slice chip grid XxYxZ (overrides TPU_TOPOLOGY metadata)"),
+    FlagDef("slice_host_bounds", "--slice-host-bounds", "SLICE_HOST_BOUNDS", str,
+            "multi-host slice host grid a,b,c (overrides TPU_HOST_BOUNDS metadata)"),
+    FlagDef("slice_worker_id", "--slice-worker-id", "SLICE_WORKER_ID", int,
+            "this host's index in the slice (overrides TPU_WORKER_ID metadata; -1 = metadata)"),
 ]
 
 
@@ -194,9 +210,9 @@ def load(
         d = by_attr[attr]
         if d.type is bool:
             value = _coerce_bool(value)
-        elif d.type is float:
+        elif d.type in (float, int):
             try:
-                value = float(value)
+                value = d.type(value)
             except (TypeError, ValueError):
                 raise ConfigError(f"{source}: expected a number for {d.flag}, got {value!r}")
         else:

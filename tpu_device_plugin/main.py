@@ -98,10 +98,46 @@ class Daemon:
                 if isinstance(event, SignalEvent) and event.signum in TERMINAL_SIGNALS:
                     return 0
 
+        # Multi-host slice metadata (v5p-16 and friends): lift the node-local
+        # topology into global slice coordinates so preferred allocations
+        # pack ICI-adjacent blocks that line up across hosts.
+        try:
+            from .slice_topology import apply_slice, slice_info_from_env
+
+            info = slice_info_from_env(
+                topology_override=self.config.flags.slice_topology,
+                host_bounds_override=self.config.flags.slice_host_bounds,
+                worker_id_override=self.config.flags.slice_worker_id,
+            )
+            if info is not None:
+                apply_slice(self.backend.topology(), info)
+                log.info(
+                    "multi-host slice: worker %d of %s hosts, global topology %s",
+                    info.worker_id,
+                    info.n_hosts,
+                    info.topology,
+                )
+        except Exception as e:
+            log.warning("ignoring invalid slice metadata: %s", e)
+
         try:
             sharing.ensure_lease_dir(self.lease_dir)
         except OSError as e:
             log.warning("could not create lease dir %s: %s", self.lease_dir, e)
+
+        metrics_server = None
+        if self.config.flags.metrics_port:
+            from .metrics import MetricsServer, registry
+
+            # register_gauge replaces by name, so a restarted daemon neither
+            # duplicates the series nor pins its predecessor.
+            registry.register_gauge("devices", self._collect_device_gauge)
+            metrics_server = MetricsServer(self.config.flags.metrics_port)
+            try:
+                metrics_server.start()
+            except OSError as e:
+                log.warning("metrics endpoint disabled: %s", e)
+                metrics_server = None
 
         watcher = KubeletSocketWatcher(self.kubelet_socket, self.events)
         watcher.start()
@@ -110,6 +146,11 @@ class Daemon:
         finally:
             watcher.stop()
             self._stop_plugins()
+            if metrics_server is not None:
+                metrics_server.stop()
+                from .metrics import registry
+
+                registry.unregister_gauge("devices")
             self.backend.shutdown()
 
     # ------------------------------------------------------------------ loops
@@ -188,6 +229,20 @@ class Daemon:
             if isinstance(event, SignalEvent) and event.signum in TERMINAL_SIGNALS:
                 return True
         return False
+
+    def _collect_device_gauge(self):
+        """(labels, value) rows for the advertised-devices gauge, evaluated
+        at scrape time over whatever plugins are currently serving."""
+        rows = []
+        for plugin in list(self.plugins):
+            by_health: dict[str, int] = {}
+            for dev in plugin.api_devices():
+                by_health[dev.health] = by_health.get(dev.health, 0) + 1
+            for health, count in sorted(by_health.items()):
+                rows.append(
+                    ({"resource": plugin.resource_name, "health": health}, float(count))
+                )
+        return rows
 
     def _stop_plugins(self) -> None:
         for plugin in self.plugins:

@@ -1,0 +1,170 @@
+"""Observability: Prometheus-style /metrics and /healthz endpoints.
+
+The reference has no metrics at all (SURVEY.md §5: stdlib log to stdout
+only); this module is the deliberate improvement: a tiny dependency-free
+HTTP endpoint exposing allocation counters, RPC latency sums, device/health
+gauges, and plugin restarts, scrapeable by any Prometheus-compatible stack.
+Disabled by default (--metrics-port 0).
+"""
+
+from __future__ import annotations
+
+import http.server
+import logging
+import threading
+import time
+from collections import defaultdict
+from typing import Callable
+
+log = logging.getLogger(__name__)
+
+PREFIX = "tpu_device_plugin"
+
+
+class Registry:
+    """Thread-safe counters + gauge callbacks rendered in Prometheus text
+    exposition format."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, tuple[tuple[str, str], ...]], float] = defaultdict(float)
+        self._gauges: list[tuple[str, Callable[[], list[tuple[dict, float]]]]] = []
+        self._help: dict[str, str] = {}
+
+    def describe(self, name: str, help_text: str) -> None:
+        with self._lock:
+            self._help[name] = help_text
+
+    def inc(self, name: str, labels: dict | None = None, value: float = 1.0) -> None:
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            self._counters[key] += value
+
+    def observe_seconds(self, name: str, seconds: float, labels: dict | None = None) -> None:
+        """Record one timed event as <name>_seconds_total + <name>_count."""
+        self.inc(f"{name}_seconds_total", labels, seconds)
+        self.inc(f"{name}_count", labels, 1.0)
+
+    def register_gauge(self, name: str, collect: Callable[[], list[tuple[dict, float]]]) -> None:
+        """collect() returns (labels, value) pairs evaluated at scrape time.
+        Re-registering a name replaces the previous collector (a restarted
+        daemon must not leave duplicate series or pin its predecessor)."""
+        with self._lock:
+            self._gauges = [(n, c) for n, c in self._gauges if n != name]
+            self._gauges.append((name, collect))
+
+    def unregister_gauge(self, name: str) -> None:
+        with self._lock:
+            self._gauges = [(n, c) for n, c in self._gauges if n != name]
+
+    def render(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = list(self._gauges)
+            help_texts = dict(self._help)
+
+        def fmt_labels(labels) -> str:
+            if not labels:
+                return ""
+            inner = ",".join(f'{k}="{v}"' for k, v in labels)
+            return "{" + inner + "}"
+
+        seen_help = set()
+        for (name, labels), value in sorted(counters.items()):
+            full = f"{PREFIX}_{name}"
+            if full not in seen_help:
+                lines.append(f"# HELP {full} {help_texts.get(name, name)}")
+                lines.append(f"# TYPE {full} counter")
+                seen_help.add(full)
+            lines.append(f"{full}{fmt_labels(labels)} {value:g}")
+        for name, collect in gauges:
+            full = f"{PREFIX}_{name}"
+            lines.append(f"# HELP {full} {help_texts.get(name, name)}")
+            lines.append(f"# TYPE {full} gauge")
+            try:
+                for labels, value in collect():
+                    lines.append(f"{full}{fmt_labels(sorted(labels.items()))} {value:g}")
+            except Exception as e:  # never fail a scrape on one collector
+                log.warning("gauge %s collector failed: %s", name, e)
+        return "\n".join(lines) + "\n"
+
+
+# The process-wide registry the plugin servers record into.
+registry = Registry()
+registry.describe("allocations_total", "Allocate container requests served")
+registry.describe("allocation_errors_total", "Allocate requests rejected")
+registry.describe("preferred_allocations_total", "GetPreferredAllocation container requests served")
+registry.describe("health_events_total", "chip health transitions observed")
+registry.describe("plugin_restarts_total", "plugin serve-cycle restarts")
+registry.describe("allocate_seconds_total", "cumulative Allocate handler time")
+registry.describe("allocate_count", "Allocate handler invocations")
+registry.describe("devices", "advertised devices by resource and health")
+
+
+class timed:
+    """Context manager recording a handler's wall time into the registry."""
+
+    def __init__(self, name: str, labels: dict | None = None):
+        self._name = name
+        self._labels = labels
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        registry.observe_seconds(self._name, time.perf_counter() - self._t0, self._labels)
+        return False
+
+
+class MetricsServer:
+    """Serves /metrics and /healthz on localhost-any."""
+
+    def __init__(self, port: int, reg: Registry | None = None):
+        self.port = port
+        self._registry = reg or registry
+        self._httpd: http.server.ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> int:
+        """Returns the bound port (useful with port=0 in tests)."""
+        reg = self._registry
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    body = b"ok\n"
+                    ctype = "text/plain"
+                elif self.path == "/metrics":
+                    body = reg.render().encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(("", self.port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-http", daemon=True
+        )
+        self._thread.start()
+        bound = self._httpd.server_address[1]
+        log.info("metrics endpoint on :%d (/metrics, /healthz)", bound)
+        return bound
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

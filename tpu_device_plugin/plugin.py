@@ -25,6 +25,7 @@ from typing import Callable
 import grpc
 
 from . import sharing
+from .metrics import registry as metrics_registry, timed as metrics_timed
 from .allocator import Policy, PolicyError
 from .api import constants, pb, rpc
 from .backend import ChipManager
@@ -332,6 +333,7 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
             if self._stop.is_set() or self._server is not server:
                 return
             log.error("gRPC server for %s terminated unexpectedly", self.resource_name)
+            metrics_registry.inc("plugin_restarts_total", {"resource": self.resource_name})
             if not self._crash_budget.record_crash():
                 self._on_fatal(
                     f"gRPC server for {self.resource_name} has repeatedly crashed recently"
@@ -405,6 +407,10 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
                     self.resource_name,
                     event.chip_id or "<all>",
                     event.health,
+                )
+                metrics_registry.inc(
+                    "health_events_total",
+                    {"resource": self.resource_name, "health": event.health},
                 )
                 self._broadcast()
         finally:
@@ -483,6 +489,9 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
                 )
             except (AllocationError, PolicyError, NotImplementedError) as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            metrics_registry.inc(
+                "preferred_allocations_total", {"resource": self.resource_name}
+            )
             response.container_responses.add(deviceIDs=ids)
         return response
 
@@ -512,13 +521,17 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
         the p50 target honest (reference: server.go:316-353; SURVEY.md §3.3)."""
         response = pb.AllocateResponse()
         allocated_chips: list[str] = []
-        for req in request.container_requests:
-            try:
-                container, chips = self._allocate_one(list(req.devicesIDs))
-            except AllocationError as e:
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            response.container_responses.append(container)
-            allocated_chips.extend(c.id for c in chips)
+        labels = {"resource": self.resource_name}
+        with metrics_timed("allocate", labels):
+            for req in request.container_requests:
+                try:
+                    container, chips = self._allocate_one(list(req.devicesIDs))
+                except AllocationError as e:
+                    metrics_registry.inc("allocation_errors_total", labels)
+                    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                metrics_registry.inc("allocations_total", labels)
+                response.container_responses.append(container)
+                allocated_chips.extend(c.id for c in chips)
         # Claim only once the whole request validated: a partially-valid
         # multi-container Allocate fails as a unit and must not leave orphan
         # claims blocking the other mixed view for the full TTL.
@@ -565,6 +578,14 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
         if self.shared:
             for cpath, hpath, ro in sharing.lease_mounts(self._lease_dir):
                 container.mounts.add(container_path=cpath, host_path=hpath, read_only=ro)
+        # Multi-host slice membership: containers get the global-slice env
+        # (worker id, chip/host grids) needed to initialise multi-host JAX.
+        slice_info = getattr(self._chip_manager.topology(), "slice_info", None)
+        if slice_info is not None:
+            from .slice_topology import container_slice_env
+
+            for key, value in container_slice_env(slice_info).items():
+                container.envs[key] = value
         if self.config.flags.pass_device_specs:
             for spec in self._device_specs(chips):
                 container.devices.add(

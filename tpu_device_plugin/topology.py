@@ -38,9 +38,15 @@ class Topology:
     wraparound: bool = False
     chips_by_id: dict[str, Chip] = field(default_factory=dict)
     # Chips of the same slice hosted by *other* hosts (multi-host slices,
-    # e.g. v5p-16): id -> coords.  Used for cross-host preferred allocation.
+    # e.g. v5p-16): id -> coords.  Consumed by multi_host_slice_policy /
+    # callers that model the whole slice (e.g. a cluster-level scheduler
+    # extender); a node-local plugin's kubelet requests only ever contain
+    # local IDs.
     remote_coords: dict[str, tuple[int, int, int]] = field(default_factory=dict)
     remote_trays: dict[str, int] = field(default_factory=dict)
+    # Multi-host slice metadata (slice_topology.SliceInfo) when this host is
+    # part of a declared slice; drives the global-slice container env.
+    slice_info: object | None = None
 
     def coords_of(self, chip_id: str) -> tuple[int, int, int] | None:
         chip = self.chips_by_id.get(chip_id)
