@@ -21,6 +21,35 @@ def test_registry_counters_and_labels():
     assert "# TYPE tpu_device_plugin_allocations_total counter" in text
 
 
+def test_counter_precision_past_six_digits():
+    # %g-style rendering would flatten 1000001 to "1e+06", breaking rate().
+    reg = Registry()
+    reg.inc("allocations_total", {}, 1_000_001)
+    assert "tpu_device_plugin_allocations_total 1000001" in reg.render()
+    reg2 = Registry()
+    reg2.inc("allocate_seconds_total", {}, 123456.789012)
+    assert "123456.789012" in reg2.render()
+
+
+def test_non_finite_values_render_as_prometheus_specials():
+    reg = Registry()
+    reg.register_gauge("devices", lambda: [({"k": "inf"}, float("inf")),
+                                           ({"k": "nan"}, float("nan")),
+                                           ({"k": "ninf"}, float("-inf"))])
+    text = reg.render()
+    assert 'k="inf"} +Inf' in text
+    assert 'k="nan"} NaN' in text
+    assert 'k="ninf"} -Inf' in text
+
+
+def test_label_values_are_escaped():
+    reg = Registry()
+    reg.inc("allocations_total", {"resource": 'a"b\\c\nd'})
+    line = [l for l in reg.render().splitlines() if l.startswith("tpu_")][0]
+    assert 'resource="a\\"b\\\\c\\nd"' in line
+    assert "\n" not in line
+
+
 def test_registry_gauges_and_failing_collector():
     reg = Registry()
     reg.register_gauge("devices", lambda: [({"health": "Healthy"}, 4.0)])
@@ -32,7 +61,6 @@ def test_registry_gauges_and_failing_collector():
 def test_timed_context_manager():
     from tpu_device_plugin import metrics
 
-    before = dict(metrics.registry._counters)
     with metrics.timed("allocate", {"resource": "r"}):
         pass
     text = metrics.registry.render()

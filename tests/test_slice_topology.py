@@ -67,7 +67,64 @@ def test_parse_env_invalid(env):
 
 def test_wraparound_flag():
     info = slice_info_from_env({**V5P16_ENV, "TPU_TOPOLOGY_WRAP": "true,true,true"})
-    assert info.wraparound
+    assert info.wraparound == (True, True, True)
+    # Per-axis: only the z axis is a ring.
+    info = slice_info_from_env({**V5P16_ENV, "TPU_TOPOLOGY_WRAP": "false,false,true"})
+    assert info.wraparound == (False, False, True)
+    # A single value broadcasts.
+    info = slice_info_from_env({**V5P16_ENV, "TPU_TOPOLOGY_WRAP": "true"})
+    assert info.wraparound == (True, True, True)
+    # Malformed ambient wrap degrades to no-wrap (never fatal: there is no
+    # wrap flag, so this can only come from the node environment).
+    info = slice_info_from_env({**V5P16_ENV, "TPU_TOPOLOGY_WRAP": "yes,no,maybe"})
+    assert info.wraparound == (False, False, False)
+
+
+def test_half_configured_slice_flags_rejected():
+    # An explicit --slice-topology without --slice-host-bounds (and no env
+    # fallback) must raise, not silently run node-local.
+    with pytest.raises(SliceConfigError):
+        slice_info_from_env({}, topology_override="2x2x4")
+    with pytest.raises(SliceConfigError):
+        slice_info_from_env({}, host_bounds_override="1,1,4")
+    # A lone --slice-worker-id is just as explicit.
+    with pytest.raises(SliceConfigError):
+        slice_info_from_env({}, worker_id_override=2)
+    assert slice_info_from_env({}, worker_id_override=-1) is None
+    # ...but env can supply the missing half (worker id still required for
+    # a multi-host grid).
+    info = slice_info_from_env(
+        {"TPU_HOST_BOUNDS": "1,1,4", "TPU_WORKER_ID": "2"},
+        topology_override="2x2x4",
+    )
+    assert info.topology == (2, 2, 4)
+
+
+def test_multi_host_slice_requires_worker_id():
+    # Defaulting to worker 0 on a 4-host slice would make every host claim
+    # block 0; must raise instead.
+    env = {k: v for k, v in V5P16_ENV.items() if k != "TPU_WORKER_ID"}
+    with pytest.raises(SliceConfigError, match="worker id"):
+        slice_info_from_env(env)
+    # Single-host "slice" is fine without one.
+    info = slice_info_from_env({"TPU_TOPOLOGY": "2x2x1", "TPU_HOST_BOUNDS": "1,1,1"})
+    assert info.worker_id == 0
+
+
+def test_daemon_exits_on_explicit_half_configured_slice_flags(tmp_path, monkeypatch):
+    from tpu_device_plugin.config import Config, Flags
+    from tpu_device_plugin.main import Daemon
+
+    for k in ("TPU_TOPOLOGY", "TPU_HOST_BOUNDS", "TPU_WORKER_ID", "TPU_TOPOLOGY_WRAP"):
+        monkeypatch.delenv(k, raising=False)
+    flags = Flags(
+        backend="fake",
+        device_plugin_path=str(tmp_path / "dp"),
+        slice_topology="2x2x4",  # no --slice-host-bounds, no env fallback
+    )
+    daemon = Daemon(Config(flags=flags), backend=FakeChipManager(n_chips=4),
+                    lease_dir=str(tmp_path / "leases"))
+    assert daemon.run() == 1
 
 
 def test_apply_slice_global_coords_from_index_order():
@@ -102,10 +159,58 @@ def test_apply_slice_wrap_distance():
     assert topo0.ici_distance("tpu-0", "far") == 1  # wraps around the ring
 
 
-def test_apply_slice_mismatched_block_is_ignored():
+def test_apply_slice_per_axis_wrap():
+    # z-only ring: z distances wrap, x distances don't.
+    topo = build_fake_topology(4, 2)
+    info = slice_info_from_env({**V5P16_ENV, "TPU_WORKER_ID": "0",
+                                "TPU_TOPOLOGY_WRAP": "false,false,true"})
+    apply_slice(topo, info)
+    assert topo.wraparound == (False, False, True)
+    topo.remote_coords["far-z"] = (0, 0, 3)
+    assert topo.ici_distance("tpu-0", "far-z") == 1  # wraps on z
+    # x axis must NOT wrap: distance along x stays |dx|.
+    topo.remote_coords["far-x"] = (1, 0, 0)
+    assert topo.ici_distance("tpu-0", "far-x") == 1
+    env = container_slice_env(info)
+    assert env["TPU_TOPOLOGY_WRAP"] == "false,false,true"
+
+
+def test_apply_slice_mismatch_leaves_wrap_untouched():
+    # Rejected slice metadata must not flip wraparound on the local topology.
+    topo = build_fake_topology(8, 4)
+    info = slice_info_from_env({**V5P16_ENV, "TPU_WORKER_ID": "0",
+                                "TPU_TOPOLOGY_WRAP": "true,true,true"})
+    with pytest.raises(SliceConfigError):
+        apply_slice(topo, info)
+    assert topo.slice_info is None
+    assert not any(topo.wrap_axes())
+
+
+def test_daemon_exits_on_explicit_slice_flags_with_mismatched_block(tmp_path, monkeypatch):
+    # Explicit flags whose block can't fit this host's chips must fail loud
+    # (8 local chips, per-host block of 4).
+    from tpu_device_plugin.config import Config, Flags
+    from tpu_device_plugin.main import Daemon
+
+    for k in ("TPU_TOPOLOGY", "TPU_HOST_BOUNDS", "TPU_WORKER_ID", "TPU_TOPOLOGY_WRAP"):
+        monkeypatch.delenv(k, raising=False)
+    flags = Flags(
+        backend="fake",
+        device_plugin_path=str(tmp_path / "dp"),
+        slice_topology="2x2x4",
+        slice_host_bounds="1,1,4",
+        slice_worker_id=0,
+    )
+    daemon = Daemon(Config(flags=flags), backend=FakeChipManager(n_chips=8),
+                    lease_dir=str(tmp_path / "leases"))
+    assert daemon.run() == 1
+
+
+def test_apply_slice_mismatched_block_raises_and_leaves_topo_untouched():
     topo = build_fake_topology(8, 4)  # 8 local chips, block would be 4
     info = SliceInfo(worker_id=0, topology=(2, 2, 2), host_bounds=(1, 1, 2))
-    apply_slice(topo, info)
+    with pytest.raises(SliceConfigError):
+        apply_slice(topo, info)
     assert topo.slice_info is None
     assert topo.torus_shape == (4, 2, 1)  # untouched
     assert topo.chips_by_id["tpu-0"].coords == (0, 0, 0)

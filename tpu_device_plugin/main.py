@@ -98,27 +98,52 @@ class Daemon:
                 if isinstance(event, SignalEvent) and event.signum in TERMINAL_SIGNALS:
                     return 0
 
+        # Once init succeeded, every exit path must release the backend (the
+        # native library has an explicit shutdown hook).
+        try:
+            return self._run_initialized(resource_config)
+        finally:
+            self.backend.shutdown()
+
+    def _run_initialized(self, resource_config) -> int:
         # Multi-host slice metadata (v5p-16 and friends): lift the node-local
         # topology into global slice coordinates so preferred allocations
         # pack ICI-adjacent blocks that line up across hosts.
-        try:
-            from .slice_topology import apply_slice, slice_info_from_env
+        from .slice_topology import SliceConfigError, apply_slice, slice_info_from_env
 
+        flags = self.config.flags
+        explicit_slice_flags = bool(
+            flags.slice_topology or flags.slice_host_bounds or flags.slice_worker_id >= 0
+        )
+        try:
             info = slice_info_from_env(
-                topology_override=self.config.flags.slice_topology,
-                host_bounds_override=self.config.flags.slice_host_bounds,
-                worker_id_override=self.config.flags.slice_worker_id,
+                topology_override=flags.slice_topology,
+                host_bounds_override=flags.slice_host_bounds,
+                worker_id_override=flags.slice_worker_id,
             )
-            if info is not None:
+        except SliceConfigError as e:
+            if explicit_slice_flags:
+                # An operator-supplied --slice-* flag must fail loud, not
+                # leave a healthy-looking node-local daemon.
+                log.error("invalid slice configuration: %s", e)
+                return 1
+            log.warning("ignoring invalid slice metadata from environment: %s", e)
+            info = None
+        if info is not None:
+            try:
                 apply_slice(self.backend.topology(), info)
+            except SliceConfigError as e:
+                if explicit_slice_flags:
+                    log.error("invalid slice configuration: %s", e)
+                    return 1
+                log.warning("ignoring slice metadata from environment: %s", e)
+            else:
                 log.info(
                     "multi-host slice: worker %d of %s hosts, global topology %s",
                     info.worker_id,
                     info.n_hosts,
                     info.topology,
                 )
-        except Exception as e:
-            log.warning("ignoring invalid slice metadata: %s", e)
 
         try:
             sharing.ensure_lease_dir(self.lease_dir)
@@ -129,15 +154,19 @@ class Daemon:
         if self.config.flags.metrics_port:
             from .metrics import MetricsServer, registry
 
-            # register_gauge replaces by name, so a restarted daemon neither
-            # duplicates the series nor pins its predecessor.
-            registry.register_gauge("devices", self._collect_device_gauge)
             metrics_server = MetricsServer(self.config.flags.metrics_port)
             try:
                 metrics_server.start()
             except OSError as e:
                 log.warning("metrics endpoint disabled: %s", e)
                 metrics_server = None
+            else:
+                # Registered only after a successful bind, so a failed start
+                # leaves nothing in the process-global registry pinning this
+                # daemon.  register_gauge replaces by name, so a restarted
+                # daemon neither duplicates the series nor pins its
+                # predecessor.
+                registry.register_gauge("devices", self._collect_device_gauge)
 
         watcher = KubeletSocketWatcher(self.kubelet_socket, self.events)
         watcher.start()
@@ -151,7 +180,6 @@ class Daemon:
                 from .metrics import registry
 
                 registry.unregister_gauge("devices")
-            self.backend.shutdown()
 
     # ------------------------------------------------------------------ loops
 

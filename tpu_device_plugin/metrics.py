@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import http.server
 import logging
+import math
 import threading
 import time
 from collections import defaultdict
@@ -64,11 +65,28 @@ class Registry:
             gauges = list(self._gauges)
             help_texts = dict(self._help)
 
+        def esc(v) -> str:
+            # Exposition format requires escaping \ " and newline in label
+            # values; one bad value would otherwise kill the whole scrape.
+            return (
+                str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+            )
+
         def fmt_labels(labels) -> str:
             if not labels:
                 return ""
-            inner = ",".join(f'{k}="{v}"' for k, v in labels)
+            inner = ",".join(f'{k}="{esc(v)}"' for k, v in labels)
             return "{" + inner + "}"
+
+        def fmt_value(value: float) -> str:
+            # repr keeps full float precision; %g would flatten counters past
+            # 6 significant digits (1000001 -> "1e+06"), breaking rate().
+            value = float(value)
+            if not math.isfinite(value):
+                return "+Inf" if value > 0 else ("-Inf" if value < 0 else "NaN")
+            if value == int(value) and abs(value) < 2**53:
+                return str(int(value))
+            return repr(value)
 
         seen_help = set()
         for (name, labels), value in sorted(counters.items()):
@@ -77,14 +95,16 @@ class Registry:
                 lines.append(f"# HELP {full} {help_texts.get(name, name)}")
                 lines.append(f"# TYPE {full} counter")
                 seen_help.add(full)
-            lines.append(f"{full}{fmt_labels(labels)} {value:g}")
+            lines.append(f"{full}{fmt_labels(labels)} {fmt_value(value)}")
         for name, collect in gauges:
             full = f"{PREFIX}_{name}"
             lines.append(f"# HELP {full} {help_texts.get(name, name)}")
             lines.append(f"# TYPE {full} gauge")
             try:
                 for labels, value in collect():
-                    lines.append(f"{full}{fmt_labels(sorted(labels.items()))} {value:g}")
+                    lines.append(
+                        f"{full}{fmt_labels(sorted(labels.items()))} {fmt_value(value)}"
+                    )
             except Exception as e:  # never fail a scrape on one collector
                 log.warning("gauge %s collector failed: %s", name, e)
         return "\n".join(lines) + "\n"

@@ -25,7 +25,6 @@ from typing import Callable
 import grpc
 
 from . import sharing
-from .metrics import registry as metrics_registry, timed as metrics_timed
 from .allocator import Policy, PolicyError
 from .api import constants, pb, rpc
 from .backend import ChipManager
@@ -36,6 +35,8 @@ from .config import (
     DEVICE_LIST_STRATEGY_VOLUME_MOUNTS,
 )
 from .device import Chip, HealthEvent, Unit
+from .metrics import registry as metrics_registry
+from .metrics import timed as metrics_timed
 from .replica import AllocationError, prioritize_devices, replica_id, strip_replicas
 
 log = logging.getLogger(__name__)

@@ -28,7 +28,7 @@ import logging
 import os
 from dataclasses import dataclass
 
-from .topology import Topology
+from .topology import Topology, grid_coord
 
 log = logging.getLogger(__name__)
 
@@ -51,7 +51,9 @@ class SliceInfo:
     topology: tuple[int, int, int]
     # Host grid over the same axes; chips_per_host = topology / host_bounds.
     host_bounds: tuple[int, int, int]
-    wraparound: bool = False
+    # Per-axis torus wrap (TPU_TOPOLOGY_WRAP is per-axis: "false,false,true"
+    # means only the z axis is a ring).
+    wraparound: tuple[bool, bool, bool] = (False, False, False)
 
     @property
     def n_hosts(self) -> int:
@@ -68,8 +70,7 @@ class SliceInfo:
 
     def host_coords(self, worker_id: int) -> tuple[int, int, int]:
         """Host position in the host grid, x-major like chip coords."""
-        a, b, _c = self.host_bounds
-        return (worker_id % a, (worker_id // a) % b, worker_id // (a * b))
+        return grid_coord(worker_id, self.host_bounds)
 
     def host_offset(self, worker_id: int) -> tuple[int, int, int]:
         """Global chip-coordinate offset of a host's block."""
@@ -96,6 +97,22 @@ def _parse_triple(text: str, sep: str) -> tuple[int, int, int]:
     return tuple(values)  # type: ignore[return-value]
 
 
+def _parse_wrap(text: str) -> tuple[bool, bool, bool]:
+    """Per-axis torus wrap from TPU_TOPOLOGY_WRAP ("true,false,true"; a
+    single value broadcasts to all axes)."""
+    parts = [p.strip() for p in text.lower().split(",") if p.strip()]
+    if not parts:
+        return (False, False, False)
+    if len(parts) == 1:
+        parts = parts * 3
+    if len(parts) != 3:
+        raise SliceConfigError(f"expected 1 or 3 wrap values, got {text!r}")
+    for p in parts:
+        if p not in ("true", "false"):
+            raise SliceConfigError(f"invalid wrap value {p!r} in {text!r}")
+    return tuple(p == "true" for p in parts)  # type: ignore[return-value]
+
+
 def slice_info_from_env(
     env=None,
     topology_override: str = "",
@@ -111,7 +128,16 @@ def slice_info_from_env(
     env = os.environ if env is None else env
     topo_text = topology_override or env.get(ENV_TOPOLOGY, "")
     bounds_text = host_bounds_override or env.get(ENV_HOST_BOUNDS, "")
+    explicit_worker = worker_id_override is not None and worker_id_override >= 0
     if not topo_text or not bounds_text:
+        if topology_override or host_bounds_override or explicit_worker:
+            # An explicit --slice-* flag must never be silently dropped.
+            raise SliceConfigError(
+                "slice flags require both a topology and host bounds "
+                f"(--slice-topology/--slice-host-bounds or {ENV_TOPOLOGY}/"
+                f"{ENV_HOST_BOUNDS}); got topology={topo_text!r} "
+                f"host_bounds={bounds_text!r}"
+            )
         return None
     topology = _parse_triple(topo_text, "x")
     host_bounds = _parse_triple(bounds_text, ",")
@@ -120,22 +146,37 @@ def slice_info_from_env(
             raise SliceConfigError(
                 f"topology {topology} not divisible by host bounds {host_bounds}"
             )
-    if worker_id_override is not None and worker_id_override >= 0:
-        worker_id = worker_id_override
-    else:
-        try:
-            worker_id = int(env.get(ENV_WORKER_ID, "0"))
-        except ValueError:
-            raise SliceConfigError(f"invalid {ENV_WORKER_ID}") from None
     n_hosts = 1
     for b in host_bounds:
         n_hosts *= b
+    if worker_id_override is not None and worker_id_override >= 0:
+        worker_id = worker_id_override
+    elif (raw_worker := env.get(ENV_WORKER_ID)) is not None:
+        try:
+            worker_id = int(raw_worker)
+        except ValueError:
+            raise SliceConfigError(f"invalid {ENV_WORKER_ID}={raw_worker!r}") from None
+    elif n_hosts > 1:
+        # Defaulting to 0 on a multi-host slice would make every host claim
+        # block 0 and stamp TPU_WORKER_ID=0 into all containers.
+        raise SliceConfigError(
+            f"slice spans {n_hosts} hosts but no worker id was supplied "
+            f"(set --slice-worker-id or {ENV_WORKER_ID})"
+        )
+    else:
+        worker_id = 0
     if not 0 <= worker_id < n_hosts:
         raise SliceConfigError(
             f"{ENV_WORKER_ID}={worker_id} outside host grid {host_bounds}"
         )
-    wrap = env.get(ENV_TOPOLOGY_WRAP, "").lower()
-    wraparound = "true" in wrap
+    try:
+        wraparound = _parse_wrap(env.get(ENV_TOPOLOGY_WRAP, ""))
+    except SliceConfigError as e:
+        # Wrap comes only from ambient env (no flag exists for it); a
+        # malformed value must never take down a daemon whose explicit
+        # flags are all valid.  Meshes are the safe default.
+        log.warning("ignoring unparseable %s: %s", ENV_TOPOLOGY_WRAP, e)
+        wraparound = (False, False, False)
     return SliceInfo(
         worker_id=worker_id,
         topology=topology,
@@ -158,8 +199,10 @@ def container_slice_env(info: SliceInfo) -> dict[str, str]:
         ENV_TOPOLOGY: "x".join(str(v) for v in info.topology),
         ENV_HOST_BOUNDS: ",".join(str(v) for v in info.host_bounds),
     }
-    if info.wraparound:
-        env[ENV_TOPOLOGY_WRAP] = "true,true,true"
+    if any(info.wraparound):
+        env[ENV_TOPOLOGY_WRAP] = ",".join(
+            "true" if w else "false" for w in info.wraparound
+        )
     return env
 
 
@@ -170,7 +213,10 @@ def apply_slice(topo: Topology, info: SliceInfo) -> Topology:
     order, matching how hosts wire chips to the slice fabric) is offset by
     this host's block position; the torus shape becomes the global grid, and
     the SliceInfo is retained on the topology so Allocate can emit the
-    global-slice container env.  Mutates and returns ``topo``.
+    global-slice container env.  Mutates and returns ``topo``; raises
+    SliceConfigError (leaving ``topo`` untouched) when the host's chips
+    cannot fit the slice's per-host block — the caller decides whether
+    that is fatal (explicit flags) or ignorable (ambient env metadata).
 
     Note the deliberate scope: the device-plugin API is node-local, so a
     preferred allocation can only ever choose among chips this host
@@ -179,28 +225,22 @@ def apply_slice(topo: Topology, info: SliceInfo) -> Topology:
     matter for the container env and the torus wrap distances, not for
     scoring phantom remote candidates.
     """
-    topo.wraparound = topo.wraparound or info.wraparound
-
     block = info.chips_per_host_block
     block_size = block[0] * block[1] * block[2]
     n_local = len(topo.chips_by_id)
     if n_local > block_size:
-        log.warning(
-            "host has %d chips but the slice block is %s; slice metadata ignored",
-            n_local,
-            block,
+        raise SliceConfigError(
+            f"host has {n_local} chips but the slice's per-host block is only "
+            f"{block}"
         )
-        return topo
 
+    local_wrap = topo.wrap_axes()
+    topo.wraparound = tuple(a or b for a, b in zip(local_wrap, info.wraparound))
     topo.torus_shape = info.topology
     offset = info.host_offset(info.worker_id)
     ordered = sorted(topo.chips_by_id.values(), key=lambda c: c.index)
     for pos, chip in enumerate(ordered):
-        local = (
-            pos % block[0],
-            (pos // block[0]) % block[1],
-            pos // (block[0] * block[1]),
-        )
+        local = grid_coord(pos, block)
         chip.coords = (offset[0] + local[0], offset[1] + local[1], offset[2] + local[2])
     topo.slice_info = info
     return topo

@@ -35,7 +35,9 @@ class Topology:
 
     accelerator_type: str = "v5e"
     torus_shape: tuple[int, int, int] = (2, 2, 1)
-    wraparound: bool = False
+    # Torus links: a plain bool applies to every axis; a (bool, bool, bool)
+    # marks individual ring axes (TPU_TOPOLOGY_WRAP is per-axis).
+    wraparound: bool | tuple[bool, bool, bool] = False
     chips_by_id: dict[str, Chip] = field(default_factory=dict)
     # Chips of the same slice hosted by *other* hosts (multi-host slices,
     # e.g. v5p-16): id -> coords.  Consumed by multi_host_slice_policy /
@@ -63,17 +65,24 @@ class Topology:
     def is_local(self, chip_id: str) -> bool:
         return chip_id in self.chips_by_id
 
+    def wrap_axes(self) -> tuple[bool, bool, bool]:
+        """Per-axis torus wrap, normalising the scalar-bool form."""
+        if isinstance(self.wraparound, tuple):
+            return self.wraparound
+        return (bool(self.wraparound),) * 3
+
     def ici_distance(self, a: str, b: str) -> int | None:
         """Hop count between two chips over the ICI mesh/torus; None if either
         chip is unknown."""
         ca, cb = self.coords_of(a), self.coords_of(b)
         if ca is None or cb is None:
             return None
+        wrap = self.wrap_axes()
         hops = 0
         for axis, (pa, pb) in enumerate(zip(ca, cb)):
             extent = self.torus_shape[axis] if axis < len(self.torus_shape) else 1
             d = abs(pa - pb)
-            if self.wraparound and extent > 1:
+            if axis < 3 and wrap[axis] and extent > 1:
                 d = min(d, extent - d)
             hops += d
         return hops
@@ -109,13 +118,18 @@ class Topology:
         return groups
 
 
+def grid_coord(i: int, shape: tuple[int, int, int]) -> tuple[int, int, int]:
+    """Row-major (x-major) coordinate of linear index i in an (x, y, z) grid.
+
+    The single source of truth for index→coordinate order; chip layout, host
+    layout, and slice-block layout all use it so they can never de-sync."""
+    sx, sy = max(shape[0], 1), max(shape[1], 1)
+    return (i % sx, (i // sx) % sy, i // (sx * sy))
+
+
 def grid_coords(n: int, shape: tuple[int, int, int]) -> list[tuple[int, int, int]]:
     """Row-major coordinates for n chips inside an (x, y, z) grid."""
-    coords = []
-    sx, sy, _sz = (max(shape[0], 1), max(shape[1], 1), max(shape[2], 1))
-    for i in range(n):
-        coords.append((i % sx, (i // sx) % sy, i // (sx * sy)))
-    return coords
+    return [grid_coord(i, shape) for i in range(n)]
 
 
 def build_fake_topology(
