@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test coverage bench clean check fmt-check
+.PHONY: all native test coverage bench busy-bench clean check fmt-check
 
 all: native
 
@@ -18,6 +18,13 @@ coverage: native
 
 bench: native
 	$(PYTHON) bench.py
+
+# North-star measurement: 8 time-sliced pods on a 4-chip host (BASELINE.md).
+# Runs hardware-free on CPU; on a TPU host use PLATFORM=tpu.
+PLATFORM ?= cpu
+busy-bench: native
+	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
+		--duration 8 --platform $(PLATFORM)
 
 check: test
 

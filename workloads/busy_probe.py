@@ -17,7 +17,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
+from contextlib import nullcontext
 
 import jax
 import jax.numpy as jnp
@@ -25,42 +27,53 @@ import jax.numpy as jnp
 from . import lease
 
 
-def make_burst_fn(matrix_dim: int = 1024, target_burst_secs: float = 0.25):
+def make_burst_fn(
+    matrix_dim: int = 1024,
+    target_burst_secs: float = 0.25,
+    timed_section=nullcontext,
+):
     """A compute burst sized to keep the MXU busy: chained bf16 matmuls.
 
     The step count is calibrated so one burst takes ~target_burst_secs on
     this device — long enough that lease-handoff overhead (flock wakeup,
     scheduling) stays a small fraction of the duty cycle, short enough that
-    siblings still interleave many times per second."""
+    siblings still interleave many times per second.
 
-    @jax.jit
+    Compilation is done ahead-of-time (host-side, no chip time needed), so
+    only the single timed calibration step runs under ``timed_section`` —
+    holding the chip lease across a multi-second compile would starve
+    siblings that are already in their measured window."""
+
     def chained(x):
         for _ in range(8):
             x = jnp.tanh(x @ x)
         return x
 
     x = jnp.ones((matrix_dim, matrix_dim), jnp.bfloat16)
-    chained(x).block_until_ready()  # compile outside the measured region
-    t0 = time.monotonic()
-    chained(x).block_until_ready()
-    step_secs = max(time.monotonic() - t0, 1e-6)
+    compiled = jax.jit(chained).lower(x).compile()
+    with timed_section():
+        compiled(x).block_until_ready()  # warm-up: exclude one-time dispatch costs
+        t0 = time.monotonic()
+        compiled(x).block_until_ready()
+        step_secs = max(time.monotonic() - t0, 1e-6)
     steps_per_burst = max(int(target_burst_secs / step_secs), 1)
 
     def burst():
         result = x
         for _ in range(steps_per_burst):
-            result = chained(result)
+            result = compiled(result)
         result.block_until_ready()
 
     return burst
 
 
 def run_probe(duration_secs: float, report_path: str | None, matrix_dim: int = 1024) -> dict:
-    burst = make_burst_fn(matrix_dim=matrix_dim)
+    burst = make_burst_fn(matrix_dim=matrix_dim, timed_section=lease.chip_lease)
     stats = lease.run_leased_bursts(burst, duration_secs)
     stats.update(
         {
             "pid": os.getpid(),
+            "chips": sorted(lease.chip_ids_from_env()),
             "busy_fraction": stats["busy_secs"] / max(stats["wall_secs"], 1e-9),
             "t_end": time.time(),
         }
@@ -74,8 +87,15 @@ def run_probe(duration_secs: float, report_path: str | None, matrix_dim: int = 1
 def aggregate(report_path: str) -> dict:
     """Aggregate busy fraction across all pods that appended to the report.
 
-    Bursts hold an exclusive per-chip lease, so per-pod busy intervals are
-    disjoint and aggregate busy = sum of busy seconds / max wall window.
+    Bursts hold an exclusive per-chip lease, so sibling pods' busy intervals
+    on one chip are disjoint: per-chip busy = sum of its pods' busy seconds,
+    per-chip fraction = busy / the union wall window of the pods that used it,
+    and the aggregate (the BASELINE north-star number) is the mean fraction
+    over chips.  Rows without chip attribution keep the original single-chip
+    semantics (one shared bucket) — but only when the whole report lacks it:
+    mixing them with attributed rows would double-count a chip as a phantom
+    extra bucket, so then they are left out of the per-chip fractions (still
+    counted in pods/busy totals).
     """
     rows = []
     with open(report_path) as f:
@@ -85,13 +105,43 @@ def aggregate(report_path: str) -> dict:
                 rows.append(json.loads(line))
     if not rows:
         return {"pods": 0, "aggregate_busy_fraction": 0.0}
+    any_attributed = any(r.get("chips") for r in rows)
+    per_chip: dict[str, list[dict]] = {}
+    for r in rows:
+        chips = r.get("chips") or ([] if any_attributed else [""])
+        for chip in chips:
+            per_chip.setdefault(chip, []).append(r)
+    chip_fractions = {}
+    for chip, chip_rows in per_chip.items():
+        busy = sum(r["busy_secs"] for r in chip_rows)
+        ends = [r.get("t_end") for r in chip_rows]
+        if all(e is not None for e in ends):
+            # True union of the pods' measurement intervals: a gap where no
+            # pod was probing the chip is unmeasured, not idle.
+            intervals = sorted(
+                (e - r["wall_secs"], e) for e, r in zip(ends, chip_rows)
+            )
+            window = 0.0
+            cur_start, cur_end = intervals[0]
+            for start, end in intervals[1:]:
+                if start > cur_end:
+                    window += cur_end - cur_start
+                    cur_start, cur_end = start, end
+                else:
+                    cur_end = max(cur_end, end)
+            window += cur_end - cur_start
+        else:
+            window = max(r["wall_secs"] for r in chip_rows)
+        chip_fractions[chip] = min(busy / max(window, 1e-9), 1.0)
     wall = max(r["wall_secs"] for r in rows)
     busy = sum(r["busy_secs"] for r in rows)
     return {
         "pods": len(rows),
+        "chips": len(per_chip),
         "wall_secs": wall,
         "busy_secs": busy,
-        "aggregate_busy_fraction": min(busy / max(wall, 1e-9), 1.0),
+        "per_chip_busy_fraction": chip_fractions,
+        "aggregate_busy_fraction": sum(chip_fractions.values()) / len(chip_fractions),
     }
 
 
@@ -103,6 +153,19 @@ def main(argv=None) -> int:
     parser.add_argument("--aggregate", action="store_true",
                         help="aggregate an existing report instead of probing")
     args = parser.parse_args(argv)
+    # Honour JAX_PLATFORMS even when a host sitecustomize pre-registered a
+    # different backend: config.update wins as long as no backend has
+    # initialised yet in this process (same pattern as __graft_entry__).
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        try:
+            jax.config.update("jax_platforms", platforms)
+        except (AttributeError, RuntimeError) as e:
+            print(
+                f"busy_probe: could not force JAX_PLATFORMS={platforms} "
+                f"({e}); measuring on the already-initialised backend",
+                file=sys.stderr,
+            )
     if args.aggregate:
         print(json.dumps(aggregate(args.report)))
         return 0

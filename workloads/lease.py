@@ -26,7 +26,8 @@ from contextlib import contextmanager
 from tpu_device_plugin.sharing import DEFAULT_LEASE_DIR, LEASE_DIR_ENV
 
 
-def _chip_ids_from_env() -> list[str]:
+def chip_ids_from_env() -> list[str]:
+    """Chip ids the plugin granted this pod (from TPU_VISIBLE_CHIPS)."""
     raw = os.environ.get("TPU_VISIBLE_CHIPS", "")
     return [c for c in raw.split(",") if c]
 
@@ -44,7 +45,7 @@ def chip_lease(chip_ids: list[str] | None = None, lease_dir: str | None = None):
     plugin injected (TPU_VISIBLE_CHIPS, TPU_SHARED_LEASE_DIR).
     """
     lease_dir = lease_dir or os.environ.get(LEASE_DIR_ENV, DEFAULT_LEASE_DIR)
-    chip_ids = sorted(chip_ids if chip_ids is not None else _chip_ids_from_env())
+    chip_ids = sorted(chip_ids if chip_ids is not None else chip_ids_from_env())
     os.makedirs(lease_dir, exist_ok=True)
     fds: list[int] = []
     try:
@@ -65,7 +66,7 @@ def try_chip_lease(chip_ids: list[str] | None = None, lease_dir: str | None = No
     """Non-blocking variant: returns a release() callable or None if any
     chip is currently owned by another pod."""
     lease_dir = lease_dir or os.environ.get(LEASE_DIR_ENV, DEFAULT_LEASE_DIR)
-    chip_ids = sorted(chip_ids if chip_ids is not None else _chip_ids_from_env())
+    chip_ids = sorted(chip_ids if chip_ids is not None else chip_ids_from_env())
     os.makedirs(lease_dir, exist_ok=True)
     fds: list[int] = []
 

@@ -1,0 +1,240 @@
+"""End-to-end oversubscription harness: the BASELINE north-star measurement.
+
+Stands up the daemon's plugin server exactly as production does (time-sliced
+shared resource, real unix socket, real kubelet registration), then plays the
+role of kubelet + N JAX pods:
+
+  1. ListAndWatch streams the replica-expanded device list.
+  2. For each pod, GetPreferredAllocation picks the least-shared replica and
+     Allocate returns the container environment (TPU_VISIBLE_CHIPS, lease dir,
+     libtpu multi-process env — tpu_device_plugin/sharing.py).
+  3. Each pod is a real subprocess running ``workloads.busy_probe`` under that
+     environment, interleaving compute bursts through the cooperative chip
+     lease.
+  4. The per-chip busy accounting is aggregated into the north-star number:
+     aggregate chip-busy fraction (target >= 0.90 with 8 pods on a v5e-4
+     host — BASELINE.md; the reference never instrumented this, SURVEY.md §6).
+
+Run (CPU anywhere, or on a TPU host with --platform tpu):
+
+    python -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
+        --duration 8 --platform cpu
+
+Prints ONE JSON line with the aggregate busy fraction and vs_baseline
+(value / 0.90; >= 1.0 beats the target).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import grpc
+
+BASELINE_BUSY_FRACTION = 0.90
+
+
+def _start_stack(n_chips: int, chips_per_tray: int, replicas: int, tmp: str):
+    """Daemon-side setup: fake kubelet registration server + shared plugin."""
+    from tpu_device_plugin.api import pb, rpc
+    from tpu_device_plugin.backend.fake import FakeChipManager
+    from tpu_device_plugin.config import Config, Flags
+    from tpu_device_plugin.plugin import TpuDevicePlugin
+    from tpu_device_plugin.strategy import chip_units
+
+    class _Kubelet(rpc.RegistrationServicer):
+        def Register(self, request, context):  # noqa: N802
+            return pb.Empty()
+
+    kubelet_server = grpc.server(ThreadPoolExecutor(max_workers=2))
+    rpc.add_registration_servicer(_Kubelet(), kubelet_server)
+    kubelet_sock = os.path.join(tmp, "kubelet.sock")
+    if kubelet_server.add_insecure_port(f"unix:{kubelet_sock}") == 0:
+        raise RuntimeError(f"could not bind fake kubelet socket at {kubelet_sock}")
+    kubelet_server.start()
+
+    manager = FakeChipManager(n_chips=n_chips, chips_per_tray=chips_per_tray)
+    manager.init()
+    plugin = TpuDevicePlugin(
+        config=Config(flags=Flags(backend="fake")),
+        resource_name="google.com/shared-tpu",
+        units_fn=lambda: chip_units(manager),
+        chip_manager=manager,
+        socket_path=os.path.join(tmp, "tpu-shared-tpu.sock"),
+        kubelet_socket=kubelet_sock,
+        replicas=replicas,
+        lease_dir=os.path.join(tmp, "leases"),
+    )
+    plugin.start()
+    return plugin, manager, kubelet_server
+
+
+def _admit_pods(stub, pb, n_pods: int) -> list[dict]:
+    """Kubelet-side admission: preferred allocation + Allocate per pod."""
+    stream = stub.ListAndWatch(pb.Empty())
+    advertised = [d.ID for d in next(iter(stream)).devices]
+    stream.cancel()
+    available = sorted(advertised)
+    pod_envs = []
+    for _ in range(n_pods):
+        pref = stub.GetPreferredAllocation(
+            pb.PreferredAllocationRequest(
+                container_requests=[
+                    pb.ContainerPreferredAllocationRequest(
+                        available_deviceIDs=available, allocation_size=1
+                    )
+                ]
+            )
+        )
+        chosen = list(pref.container_responses[0].deviceIDs)
+        if len(chosen) != 1:
+            raise RuntimeError(
+                f"preferred allocation returned {chosen!r} for size 1 — "
+                f"likely more pods than replicas ({len(available)} device(s) left)"
+            )
+        resp = stub.Allocate(
+            pb.AllocateRequest(
+                container_requests=[
+                    pb.ContainerAllocateRequest(devicesIDs=chosen)
+                ]
+            )
+        )
+        pod_envs.append(dict(resp.container_responses[0].envs))
+        available.remove(chosen[0])
+    return pod_envs
+
+
+def run(
+    n_chips: int = 4,
+    chips_per_tray: int = 4,
+    replicas: int = 2,
+    n_pods: int = 8,
+    duration_secs: float = 8.0,
+    matrix_dim: int = 512,
+    platform: str | None = None,
+) -> dict:
+    from tpu_device_plugin.api import pb, rpc
+    from workloads import busy_probe
+
+    tmp = tempfile.mkdtemp(prefix="tpu-dp-oversub-")
+    report = os.path.join(tmp, "stats.jsonl")
+    plugin, manager, kubelet_server = _start_stack(
+        n_chips, chips_per_tray, replicas, tmp
+    )
+    try:
+        channel = grpc.insecure_channel(f"unix:{plugin.socket_path}")
+        grpc.channel_ready_future(channel).result(timeout=5)
+        stub = rpc.DevicePluginStub(channel)
+        pod_envs = _admit_pods(stub, pb, n_pods)
+        channel.close()
+
+        procs = []
+        for env_overlay in pod_envs:
+            env = dict(os.environ)
+            env.update(env_overlay)
+            if platform:
+                env["JAX_PLATFORMS"] = platform
+                if platform != "tpu":
+                    # Neutralise any host sitecustomize that force-registers a
+                    # TPU PJRT backend in every python process (it would win
+                    # over JAX_PLATFORMS and serialise pods on the real chip).
+                    env.pop("PALLAS_AXON_POOL_IPS", None)
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        "-m",
+                        "workloads.busy_probe",
+                        "--duration",
+                        str(duration_secs),
+                        "--matrix-dim",
+                        str(matrix_dim),
+                        "--report",
+                        report,
+                    ],
+                    env=env,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.PIPE,
+                    cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                )
+            )
+        t0 = time.monotonic()
+        failures = []
+        try:
+            for p in procs:
+                _, stderr = p.communicate(timeout=duration_secs * 10 + 300)
+                if p.returncode != 0:
+                    failures.append(stderr.decode(errors="replace")[-2000:])
+        finally:
+            for p in procs:  # don't orphan wedged pods holding chip leases
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        if failures:
+            raise RuntimeError(f"{len(failures)} pod(s) failed: {failures[0]}")
+        harness_wall = time.monotonic() - t0
+    finally:
+        plugin.stop()
+        kubelet_server.stop(grace=0.2).wait()
+        manager.shutdown()
+
+    agg = busy_probe.aggregate(report)
+    shutil.rmtree(tmp, ignore_errors=True)
+    agg.update(
+        {
+            "n_pods": n_pods,
+            "n_chips": n_chips,
+            "replicas_per_chip": replicas,
+            "harness_wall_secs": round(harness_wall, 3),
+        }
+    )
+    return agg
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--chips", type=int, default=4)
+    parser.add_argument("--chips-per-tray", type=int, default=4)
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--pods", type=int, default=8)
+    parser.add_argument("--duration", type=float, default=8.0)
+    parser.add_argument("--matrix-dim", type=int, default=512)
+    parser.add_argument(
+        "--platform",
+        default=None,
+        help="force JAX_PLATFORMS in pods (cpu for hardware-free runs, tpu on a TPU host)",
+    )
+    args = parser.parse_args(argv)
+    agg = run(
+        n_chips=args.chips,
+        chips_per_tray=args.chips_per_tray,
+        replicas=args.replicas,
+        n_pods=args.pods,
+        duration_secs=args.duration,
+        matrix_dim=args.matrix_dim,
+        platform=args.platform,
+    )
+    value = agg["aggregate_busy_fraction"]
+    print(
+        json.dumps(
+            {
+                "metric": "aggregate_chip_busy_fraction",
+                "value": round(value, 4),
+                "unit": "fraction",
+                "vs_baseline": round(value / BASELINE_BUSY_FRACTION, 4),
+                **{k: v for k, v in agg.items() if k != "aggregate_busy_fraction"},
+            }
+        )
+    )
+    return 0 if value >= BASELINE_BUSY_FRACTION else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
