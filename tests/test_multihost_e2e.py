@@ -1,0 +1,138 @@
+"""Multi-host slice end-to-end: two hosts of a v5p-16, full daemon stack each.
+
+BASELINE configs[4] ("Multi-host v5p-16 slice: GetPreferredAllocation packs
+ICI-adjacent chips across hosts").  The device-plugin API is node-local, so a
+v5p-16 (topology 2x2x4, host grid 1,1,4, 4 chips/host) runs one daemon per
+host; this test simulates two of the four hosts in-process — each with its own
+fake kubelet, its own chips, and the same slice flags apart from the worker id
+— and checks the cross-host contract:
+
+  * both daemons advertise the same resource with host-local devices;
+  * Allocate stamps each container with ITS host's global-slice environment
+    (TPU_WORKER_ID differs, grids match) so a one-worker-per-host job can
+    initialise multi-host JAX;
+  * preferred allocation on each host packs an ICI-compact set in *global*
+    coordinates (the reference has no cross-host story at all — SURVEY.md §5).
+"""
+
+import threading
+
+import pytest
+
+from tpu_device_plugin.api import pb
+from tpu_device_plugin.backend.fake import FakeChipManager
+from tpu_device_plugin.config import Config, Flags
+from tpu_device_plugin.main import Daemon
+
+from .fake_kubelet import FakeKubelet
+
+V5P16 = dict(slice_topology="2x2x4", slice_host_bounds="1,1,4")
+
+
+class Host:
+    """One simulated slice member: fake kubelet + full daemon."""
+
+    def __init__(self, tmp_path, worker_id: int, n_chips: int = 4):
+        self.worker_id = worker_id
+        self.kubelet = FakeKubelet(str(tmp_path / f"host{worker_id}" / "dp"))
+        self.kubelet.start()
+        flags = Flags(
+            backend="fake",
+            fake_topology=f"{n_chips}x4",
+            slice_worker_id=worker_id,
+            device_plugin_path=self.kubelet.plugin_dir,
+            **V5P16,
+        )
+        self.daemon = Daemon(
+            Config(flags=flags),
+            backend=FakeChipManager(
+                n_chips=n_chips,
+                chips_per_tray=4,
+                accelerator_type="v5p",
+                id_prefix=f"h{worker_id}-tpu",
+            ),
+            lease_dir=str(tmp_path / f"host{worker_id}" / "leases"),
+        )
+        self.result: dict = {}
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+        self.registration = self.kubelet.wait_for_registration()
+        assert self.daemon.started.wait(5)
+        self.stub = self.kubelet.plugin_client(self.registration.endpoint)
+
+    def _run(self):
+        self.result["code"] = self.daemon.run()
+
+    def stop(self):
+        self.daemon.request_stop()
+        self.thread.join(timeout=10)
+        self.kubelet.stop()
+
+    def devices(self):
+        stream = self.stub.ListAndWatch(pb.Empty())
+        devices = list(next(iter(stream)).devices)
+        stream.cancel()
+        return devices
+
+
+@pytest.fixture
+def hosts(tmp_path):
+    members = [Host(tmp_path, worker_id=0), Host(tmp_path, worker_id=2)]
+    yield members
+    for h in members:
+        h.stop()
+        assert h.result["code"] == 0
+
+
+def test_both_hosts_advertise_same_resource_with_local_devices(hosts):
+    h0, h2 = hosts
+    assert h0.registration.resource_name == h2.registration.resource_name
+    ids0 = {d.ID for d in h0.devices()}
+    ids2 = {d.ID for d in h2.devices()}
+    assert len(ids0) == len(ids2) == 4
+    assert ids0.isdisjoint(ids2)  # node-local advertisement, no phantom remotes
+
+
+def test_allocate_stamps_per_host_slice_env(hosts):
+    for host in hosts:
+        ids = sorted(d.ID for d in host.devices())
+        resp = host.stub.Allocate(
+            pb.AllocateRequest(
+                container_requests=[pb.ContainerAllocateRequest(devicesIDs=ids)]
+            )
+        )
+        envs = dict(resp.container_responses[0].envs)
+        assert envs["TPU_WORKER_ID"] == str(host.worker_id)
+        assert envs["TPU_TOPOLOGY"] == "2x2x4"
+        assert envs["TPU_HOST_BOUNDS"] == "1,1,4"
+
+
+def test_preferred_allocation_packs_ici_compact_global_sets(hosts):
+    """Size-2 requests come back as global-coordinate ICI neighbours.
+
+    Each v5p host block is 2x2x1, so within a host every chip pair differs by
+    one hop in x or y, except diagonal pairs (2 hops).  The policy must avoid
+    the diagonals: for a must-include corner chip, the partner is an adjacent
+    chip, never the diagonal one.
+    """
+    for host in hosts:
+        ids = sorted(d.ID for d in host.devices())
+        # Host chips are laid out row-major in the 2x2 block:
+        # index 0=(0,0) 1=(1,0) 2=(0,1) 3=(1,1); 0-3 and 1-2 are diagonals.
+        corner, diagonal = ids[0], ids[3]
+        pref = host.stub.GetPreferredAllocation(
+            pb.PreferredAllocationRequest(
+                container_requests=[
+                    pb.ContainerPreferredAllocationRequest(
+                        available_deviceIDs=ids,
+                        must_include_deviceIDs=[corner],
+                        allocation_size=2,
+                    )
+                ]
+            )
+        )
+        chosen = set(pref.container_responses[0].deviceIDs)
+        assert corner in chosen and len(chosen) == 2
+        assert diagonal not in chosen, (
+            f"host {host.worker_id}: picked diagonal {chosen} over an ICI neighbour"
+        )

@@ -130,13 +130,16 @@ def run(
     )
     try:
         channel = grpc.insecure_channel(f"unix:{plugin.socket_path}")
-        grpc.channel_ready_future(channel).result(timeout=5)
-        stub = rpc.DevicePluginStub(channel)
-        pod_envs = _admit_pods(stub, pb, n_pods)
-        channel.close()
+        try:
+            grpc.channel_ready_future(channel).result(timeout=5)
+            stub = rpc.DevicePluginStub(channel)
+            pod_envs = _admit_pods(stub, pb, n_pods)
+        finally:
+            channel.close()
 
         procs = []
-        for env_overlay in pod_envs:
+        stderr_paths = []
+        for i, env_overlay in enumerate(pod_envs):
             env = dict(os.environ)
             env.update(env_overlay)
             if platform:
@@ -146,32 +149,40 @@ def run(
                     # TPU PJRT backend in every python process (it would win
                     # over JAX_PLATFORMS and serialise pods on the real chip).
                     env.pop("PALLAS_AXON_POOL_IPS", None)
-            procs.append(
-                subprocess.Popen(
-                    [
-                        sys.executable,
-                        "-m",
-                        "workloads.busy_probe",
-                        "--duration",
-                        str(duration_secs),
-                        "--matrix-dim",
-                        str(matrix_dim),
-                        "--report",
-                        report,
-                    ],
-                    env=env,
-                    stdout=subprocess.DEVNULL,
-                    stderr=subprocess.PIPE,
-                    cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            # Per-pod stderr files, not pipes: a chatty pod that filled a
+            # 64KiB pipe would block mid-write while holding its chip lease,
+            # wedging every sibling waiting on the flock.
+            stderr_path = os.path.join(tmp, f"pod-{i}.stderr")
+            stderr_paths.append(stderr_path)
+            with open(stderr_path, "wb") as stderr_file:
+                procs.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable,
+                            "-m",
+                            "workloads.busy_probe",
+                            "--duration",
+                            str(duration_secs),
+                            "--matrix-dim",
+                            str(matrix_dim),
+                            "--report",
+                            report,
+                        ],
+                        env=env,
+                        stdout=subprocess.DEVNULL,
+                        stderr=stderr_file,
+                        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    )
                 )
-            )
         t0 = time.monotonic()
         failures = []
         try:
-            for p in procs:
-                _, stderr = p.communicate(timeout=duration_secs * 10 + 300)
+            deadline = time.monotonic() + duration_secs * 10 + 300
+            for p, stderr_path in zip(procs, stderr_paths):
+                p.wait(timeout=max(deadline - time.monotonic(), 1.0))
                 if p.returncode != 0:
-                    failures.append(stderr.decode(errors="replace")[-2000:])
+                    with open(stderr_path, "rb") as f:
+                        failures.append(f.read().decode(errors="replace")[-2000:])
         finally:
             for p in procs:  # don't orphan wedged pods holding chip leases
                 if p.poll() is None:
@@ -180,13 +191,12 @@ def run(
         if failures:
             raise RuntimeError(f"{len(failures)} pod(s) failed: {failures[0]}")
         harness_wall = time.monotonic() - t0
+        agg = busy_probe.aggregate(report)
     finally:
         plugin.stop()
         kubelet_server.stop(grace=0.2).wait()
         manager.shutdown()
-
-    agg = busy_probe.aggregate(report)
-    shutil.rmtree(tmp, ignore_errors=True)
+        shutil.rmtree(tmp, ignore_errors=True)
     agg.update(
         {
             "n_pods": n_pods,
