@@ -79,8 +79,10 @@ class Host:
 def hosts(tmp_path):
     members = [Host(tmp_path, worker_id=0), Host(tmp_path, worker_id=2)]
     yield members
+    for h in members:  # stop everything before asserting, so one hung
+        h.stop()  # daemon can't leak the other host's servers
     for h in members:
-        h.stop()
+        assert not h.thread.is_alive(), f"host {h.worker_id} daemon did not stop"
         assert h.result["code"] == 0
 
 

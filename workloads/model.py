@@ -35,6 +35,17 @@ class ModelConfig:
     d_ff: int = 128
     max_seq_len: int = 128
     dtype: jnp.dtype = jnp.bfloat16
+    # "native": XLA einsum attention — partitions under pjit/tensor
+    # parallelism.  "flash": the Pallas online-softmax kernel
+    # (workloads/ops/attention.py) for the single-device hot path; compiles
+    # to a real TPU kernel on hardware, interpret mode elsewhere.
+    attention_impl: str = "native"
+
+    def __post_init__(self):
+        if self.attention_impl not in ("native", "flash"):
+            raise ValueError(
+                f"attention_impl must be 'native' or 'flash', got {self.attention_impl!r}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -111,11 +122,16 @@ def _attention(x: jax.Array, layer: dict, config: ModelConfig) -> jax.Array:
     qkv = jnp.einsum("bsd,dthk->tbshk", x, layer["wqkv"].astype(x.dtype))
     q, k, v = qkv[0], qkv[1], qkv[2]
     q, k = _rope(q), _rope(k)
-    logits = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(config.head_dim).astype(x.dtype)
-    mask = jnp.tril(jnp.ones((seq, seq), bool))
-    logits = jnp.where(mask[None, None], logits.astype(jnp.float32), -1e30)
-    weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-    out = jnp.einsum("bhst,bthk->bshk", weights, v)
+    if config.attention_impl == "flash":
+        from workloads.ops import flash_attention
+
+        out = flash_attention(q, k, v)
+    else:
+        logits = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(config.head_dim).astype(x.dtype)
+        mask = jnp.tril(jnp.ones((seq, seq), bool))
+        logits = jnp.where(mask[None, None], logits.astype(jnp.float32), -1e30)
+        weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhst,bthk->bshk", weights, v)
     return jnp.einsum("bshk,hkd->bsd", out, layer["wo"].astype(x.dtype))
 
 

@@ -1,0 +1,3 @@
+"""TPU-first custom ops (Pallas kernels) for the example workloads."""
+
+from .attention import flash_attention  # noqa: F401

@@ -1,0 +1,200 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The flagship workload's hot op, written for the hardware (see
+/opt/skills/guides/pallas_guide.md): the [seq, seq] score matrix never
+materialises in HBM — each q block streams over k/v blocks in VMEM with an
+online-softmax accumulator in float32, so HBM traffic is O(seq * d) instead
+of O(seq^2) and the matmuls stay on the MXU.
+
+Differentiable via jax.custom_vjp: the kernel saves the per-row logsumexp,
+and the backward pass recomputes probabilities from (q, k, lse) — the
+standard flash recipe (memory-efficient forward, recompute backward) —
+in plain fused XLA ops.
+
+Reference pendant: none.  The reference daemon has no compute kernels at
+all; this lives with the JAX example workloads that replace its CUDA/
+PyTorch example pods (SURVEY.md §7 step 8).
+
+Interpret mode (``interpret=True``, auto-detected off-TPU) runs the same
+kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_q, block_k, seq_valid
+):
+    """One (batch*head, q-block) grid cell: stream k/v blocks with online
+    softmax.  Refs: q [block_q, d], k/v [seq_pad, d], o [block_q, d],
+    lse [block_q]."""
+    qi = pl.program_id(1)
+    seq_pad = k_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+
+    q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, carry):
+        m_prev, l_prev, acc_prev = carry
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k_ids = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_ids < seq_valid
+        if causal:
+            mask &= k_ids <= q_ids
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc_prev * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    n_blocks = seq_pad // block_k
+    if causal:
+        # Blocks fully above the diagonal contribute nothing: stop after the
+        # block containing this q block's last row.
+        n_blocks = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, n_blocks)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q_ref.shape[1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+
+    l_safe = jnp.where(l > 0, l, 1.0)  # fully-masked (padded) rows
+    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[:] = m + jnp.log(l_safe)
+
+
+def _pad_seq(x, multiple):
+    seq = x.shape[1]
+    pad = (-seq) % multiple
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _flash_forward(q, k, v, causal, interpret, block_q, block_k):
+    """q/k/v: [batch, seq, heads, head_dim] -> (out, lse[batch*heads, seq_pad])."""
+    batch, seq, heads, head_dim = q.shape
+    sm_scale = 1.0 / (head_dim**0.5)
+    block_q = min(block_q, max(seq, 1))
+    block_k = min(block_k, max(seq, 1))
+
+    qf = _pad_seq(
+        jnp.transpose(q, (0, 2, 1, 3)).reshape(batch * heads, seq, head_dim), block_q
+    )
+    kf = _pad_seq(
+        jnp.transpose(k, (0, 2, 1, 3)).reshape(batch * heads, seq, head_dim), block_k
+    )
+    vf = _pad_seq(
+        jnp.transpose(v, (0, 2, 1, 3)).reshape(batch * heads, seq, head_dim), block_k
+    )
+    seq_q_pad = qf.shape[1]
+
+    kernel = functools.partial(
+        _flash_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        seq_valid=seq,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(batch * heads, seq_q_pad // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, kf.shape[1], head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, vf.shape[1], head_dim), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qf.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch * heads, seq_q_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    out = out[:, :seq].reshape(batch, heads, seq, head_dim).transpose(0, 2, 1, 3)
+    return out, lse[:, :seq]
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    interpret: bool | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """Scaled-dot-product attention, [batch, seq, heads, head_dim] layout.
+
+    ``interpret=None`` auto-selects interpret mode off-TPU so the same code
+    runs in CPU tests and compiles to a real kernel on TPU hardware.
+    """
+    out, _ = _flash_forward(
+        q, k, v, causal, _default_interpret() if interpret is None else interpret,
+        block_q, block_k,
+    )
+    return out
+
+
+def _fwd(q, k, v, causal, interpret, block_q, block_k):
+    out, lse = _flash_forward(
+        q, k, v, causal, _default_interpret() if interpret is None else interpret,
+        block_q, block_k,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _bwd(causal, interpret, block_q, block_k, residuals, dout):
+    """Flash backward: recompute p from (q, k, lse) instead of storing the
+    [seq, seq] probability matrix.  Plain XLA ops — at the flagship's sizes
+    these fuse into a handful of MXU matmuls; a Pallas backward kernel drops
+    in behind the same custom_vjp seam when sequence lengths warrant it.
+    """
+    q, k, v, out, lse = residuals
+    batch, seq, heads, head_dim = q.shape
+    sm_scale = 1.0 / (head_dim**0.5)
+    f32 = jnp.float32
+    qf, kf, vf, of, dof = (x.astype(f32) for x in (q, k, v, out, dout))
+
+    s = jnp.einsum("bshk,bthk->bhst", qf, kf) * sm_scale
+    if causal:
+        mask = jnp.tril(jnp.ones((seq, seq), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    lse_b = lse.reshape(batch, heads, seq)
+    p = jnp.exp(s - lse_b[..., None])
+
+    dv = jnp.einsum("bhst,bshk->bthk", p, dof)
+    dp = jnp.einsum("bshk,bthk->bhst", dof, vf)
+    delta = jnp.sum(dof * of, axis=-1).transpose(0, 2, 1)  # [b, h, s]
+    ds = p * (dp - delta[..., None]) * sm_scale
+    dq = jnp.einsum("bhst,bthk->bshk", ds, kf)
+    dk = jnp.einsum("bhst,bshk->bthk", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -176,10 +176,15 @@ def run(
                 )
         t0 = time.monotonic()
         failures = []
+        wedged = []
         try:
             deadline = time.monotonic() + duration_secs * 10 + 300
-            for p, stderr_path in zip(procs, stderr_paths):
-                p.wait(timeout=max(deadline - time.monotonic(), 1.0))
+            for i, (p, stderr_path) in enumerate(zip(procs, stderr_paths)):
+                try:
+                    p.wait(timeout=max(deadline - time.monotonic(), 1.0))
+                except subprocess.TimeoutExpired:
+                    wedged.append(i)
+                    continue
                 if p.returncode != 0:
                     with open(stderr_path, "rb") as f:
                         failures.append(f.read().decode(errors="replace")[-2000:])
@@ -188,6 +193,14 @@ def run(
                 if p.poll() is None:
                     p.kill()
                     p.wait()
+        if wedged:
+            tails = []
+            for i in wedged:
+                with open(stderr_paths[i], "rb") as f:
+                    tails.append(f"pod {i}: {f.read().decode(errors='replace')[-2000:]}")
+            raise RuntimeError(
+                f"{len(wedged)} pod(s) timed out and were killed: " + "; ".join(tails)
+            )
         if failures:
             raise RuntimeError(f"{len(failures)} pod(s) failed: {failures[0]}")
         harness_wall = time.monotonic() - t0
