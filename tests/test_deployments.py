@@ -1,0 +1,57 @@
+"""Deployment manifests stay in sync with the daemon's flag surface.
+
+helm isn't available in the test image (CI renders the chart for real), so
+these tests guard the cheap-but-common drift: an env var name in the helm
+daemonset template or static DaemonSets that no longer matches any FlagDef
+env alias in tpu_device_plugin/config.py (the reference wires every flag to
+an env var through its chart — templates/daemonset.yml:62-81)."""
+
+import os
+import re
+
+from tpu_device_plugin.config import FLAG_DEFS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HELM_DAEMONSET = os.path.join(
+    REPO, "deployments", "helm", "tpu-device-plugin", "templates", "daemonset.yml"
+)
+STATIC_DIR = os.path.join(REPO, "deployments", "static")
+
+# TPU_WORKER_ID etc. are ambient TPU VM metadata, not daemon flags.
+AMBIENT_OK = {"TPU_WORKER_ID", "TPU_TOPOLOGY", "TPU_HOST_BOUNDS", "TPU_TOPOLOGY_WRAP"}
+
+
+def env_names(path: str) -> set[str]:
+    text = open(path).read()
+    return set(re.findall(r"-\s+name:\s+([A-Z][A-Z0-9_]+)\s*$", text, re.M))
+
+
+def known_env_aliases() -> set[str]:
+    return {d.env for d in FLAG_DEFS}
+
+
+def test_helm_daemonset_env_names_are_flag_aliases():
+    unknown = env_names(HELM_DAEMONSET) - known_env_aliases() - AMBIENT_OK
+    assert not unknown, f"helm template sets env vars with no flag alias: {unknown}"
+
+
+def test_static_daemonsets_env_names_are_flag_aliases():
+    for name in os.listdir(STATIC_DIR):
+        path = os.path.join(STATIC_DIR, name)
+        unknown = env_names(path) - known_env_aliases() - AMBIENT_OK
+        assert not unknown, f"{name} sets env vars with no flag alias: {unknown}"
+
+
+def test_helm_values_cover_wired_env_vars():
+    """Every non-conditional env var in the template has a matching value
+    key, so `helm template` with default values renders."""
+    text = open(HELM_DAEMONSET).read()
+    for ref in set(re.findall(r"\.Values\.(\w+)", text)):
+        values = open(
+            os.path.join(
+                REPO, "deployments", "helm", "tpu-device-plugin", "values.yaml"
+            )
+        ).read()
+        assert re.search(rf"^{ref}:", values, re.M) or re.search(
+            rf"^\s+{ref}:", values, re.M
+        ), f"values.yaml missing key {ref!r} used by daemonset.yml"
