@@ -9,6 +9,14 @@ a kubelet-side client.
 Prints ONE JSON line:
   {"metric": "allocate_p50_latency_ms", "value": <p50 ms>, "unit": "ms",
    "vs_baseline": <p50/50ms>}   (vs_baseline < 1.0 beats the target)
+
+The line also carries the OTHER north-star number as extra fields —
+"aggregate_chip_busy_fraction" / "busy_vs_baseline" (target >= 0.90, so
+busy_vs_baseline >= 1.0 beats it) — measured by the full oversubscription
+harness (workloads/oversubscribe.py: real gRPC admission, subprocess pods
+interleaving through the chip lease).  Set BENCH_SKIP_BUSY=1 to skip it;
+any failure there degrades to omitting the extra fields, never breaking
+the primary metric.
 """
 
 from __future__ import annotations
@@ -108,5 +116,33 @@ def run_bench() -> dict:
     }
 
 
+def busy_extras() -> dict:
+    """Aggregate chip-busy under 4-way oversubscription (extra fields)."""
+    from workloads.oversubscribe import BASELINE_BUSY_FRACTION, run as busy_run
+
+    agg = busy_run(
+        n_chips=2,
+        chips_per_tray=2,
+        replicas=2,
+        n_pods=4,
+        duration_secs=4.0,
+        matrix_dim=256,
+        platform="cpu",  # pods measure the sharing machinery, not the chip
+    )
+    value = agg["aggregate_busy_fraction"]
+    return {
+        "aggregate_chip_busy_fraction": round(value, 4),
+        "busy_vs_baseline": round(value / BASELINE_BUSY_FRACTION, 4),
+        "busy_pods": agg["pods"],
+        "busy_chips": agg["chips"],
+    }
+
+
 if __name__ == "__main__":
-    print(json.dumps(run_bench()))
+    result = run_bench()
+    if os.environ.get("BENCH_SKIP_BUSY") != "1":
+        try:
+            result.update(busy_extras())
+        except Exception as e:  # extras must never break the primary metric
+            print(f"bench: busy extras skipped: {e}", file=sys.stderr)
+    print(json.dumps(result))

@@ -102,13 +102,28 @@ def test_half_configured_slice_flags_rejected():
 
 def test_multi_host_slice_requires_worker_id():
     # Defaulting to worker 0 on a 4-host slice would make every host claim
-    # block 0; must raise instead.
+    # block 0; must raise instead (metadata server also unreachable here).
     env = {k: v for k, v in V5P16_ENV.items() if k != "TPU_WORKER_ID"}
     with pytest.raises(SliceConfigError, match="worker id"):
-        slice_info_from_env(env)
+        slice_info_from_env(env, metadata_worker_id=None)
+    with pytest.raises(SliceConfigError, match="worker id"):
+        slice_info_from_env(env, metadata_worker_id=lambda: None)
     # Single-host "slice" is fine without one.
     info = slice_info_from_env({"TPU_TOPOLOGY": "2x2x1", "TPU_HOST_BOUNDS": "1,1,1"})
     assert info.worker_id == 0
+
+
+def test_worker_id_falls_back_to_node_metadata():
+    """DaemonSet containers don't inherit the TPU VM env; the node metadata
+    server (agent-worker-number) is the source of last resort."""
+    env = {k: v for k, v in V5P16_ENV.items() if k != "TPU_WORKER_ID"}
+    info = slice_info_from_env(env, metadata_worker_id=lambda: 3)
+    assert info.worker_id == 3
+    # Env beats metadata when both exist.
+    info = slice_info_from_env(
+        dict(env, TPU_WORKER_ID="1"), metadata_worker_id=lambda: 3
+    )
+    assert info.worker_id == 1
 
 
 def test_daemon_exits_on_explicit_half_configured_slice_flags(tmp_path, monkeypatch):

@@ -37,6 +37,38 @@ ENV_TOPOLOGY = "TPU_TOPOLOGY"
 ENV_HOST_BOUNDS = "TPU_HOST_BOUNDS"
 ENV_TOPOLOGY_WRAP = "TPU_TOPOLOGY_WRAP"
 
+# Cloud TPU VMs publish the host's worker number as a GCE instance metadata
+# attribute.  A containerised daemon (DaemonSet) does NOT inherit the node
+# VM's environment, but it CAN reach the node's metadata server — so this is
+# the worker-id source of last resort when neither --slice-worker-id nor
+# TPU_WORKER_ID is present in the container env.
+METADATA_WORKER_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/attributes/"
+    "agent-worker-number"
+)
+
+
+def _metadata_worker_id(timeout_secs: float = 2.0) -> int | None:
+    """Worker number from the node's metadata server, None if unreachable."""
+    import http.client
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        METADATA_WORKER_URL, headers={"Metadata-Flavor": "Google"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_secs) as resp:
+            return int(resp.read().decode().strip())
+    except (
+        urllib.error.URLError,
+        http.client.HTTPException,  # malformed/truncated response
+        OSError,
+        ValueError,
+        TimeoutError,
+    ):
+        return None
+
 
 class SliceConfigError(ValueError):
     pass
@@ -118,12 +150,17 @@ def slice_info_from_env(
     topology_override: str = "",
     host_bounds_override: str = "",
     worker_id_override: int | None = None,
+    metadata_worker_id=_metadata_worker_id,
 ) -> SliceInfo | None:
     """Parse slice metadata; None when this node is not part of a declared
     multi-host slice.
 
     Explicit overrides (the daemon's --slice-* flags) win over the TPU_*
-    metadata env vars — runtimes may rewrite those at process start.
+    metadata env vars — runtimes may rewrite those at process start.  The
+    worker id resolves flag > TPU_WORKER_ID env > node metadata server
+    (``metadata_worker_id``, injectable for tests): a DaemonSet container
+    never inherits the TPU VM's environment, but it can reach the node's
+    metadata service.
     """
     env = os.environ if env is None else env
     topo_text = topology_override or env.get(ENV_TOPOLOGY, "")
@@ -159,10 +196,14 @@ def slice_info_from_env(
     elif n_hosts > 1:
         # Defaulting to 0 on a multi-host slice would make every host claim
         # block 0 and stamp TPU_WORKER_ID=0 into all containers.
-        raise SliceConfigError(
-            f"slice spans {n_hosts} hosts but no worker id was supplied "
-            f"(set --slice-worker-id or {ENV_WORKER_ID})"
-        )
+        worker_id = metadata_worker_id() if metadata_worker_id is not None else None
+        if worker_id is None:
+            raise SliceConfigError(
+                f"slice spans {n_hosts} hosts but no worker id was supplied "
+                f"(set --slice-worker-id or {ENV_WORKER_ID}; the node metadata "
+                f"server was also unreachable)"
+            )
+        log.info("worker id %d resolved from node metadata server", worker_id)
     else:
         worker_id = 0
     if not 0 <= worker_id < n_hosts:
