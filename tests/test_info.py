@@ -1,0 +1,41 @@
+"""tpu-info CLI: the node-side visibility tool (nvidia-smi role)."""
+
+import json
+
+from tpu_device_plugin.info import collect, main, render
+from tpu_device_plugin.config import Flags
+
+
+def test_collect_fake_topology():
+    info = collect(Flags(backend="fake", fake_topology="8x4"))
+    assert info["n_chips"] == 8
+    assert len(info["trays"]) == 2
+    assert info["chips"][0]["device_paths"] == ["/dev/accel0"]
+    assert all(len(c["coords"]) == 3 for c in info["chips"])
+
+
+def test_render_mentions_every_chip():
+    info = collect(Flags(backend="fake", fake_topology="4x4"))
+    text = render(info)
+    for c in info["chips"]:
+        assert c["id"] in text
+
+
+def test_render_handles_unknown_numa():
+    """The native backend reports numa_node=None when sysfs has no NUMA
+    info; the table must render '-' rather than crash."""
+    info = collect(Flags(backend="fake", fake_topology="4x4"))
+    for c in info["chips"]:
+        c["numa_node"] = None
+    assert " -" in render(info)
+
+
+def test_main_json_roundtrip(capsys):
+    assert main(["--backend", "fake", "--fake-topology", "4x4", "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["n_chips"] == 4
+
+
+def test_main_chipless_node_exit_code(capsys, tmp_path):
+    assert main(["--backend", "tpu", "--driver-root", str(tmp_path)]) == 1
+    assert "no TPU stack" in capsys.readouterr().err

@@ -1,0 +1,125 @@
+"""tpu-info: operator CLI showing what the daemon would advertise.
+
+The ``nvidia-smi`` role in the reference's workflow — its tutorial validates
+sharing by eyeballing nvidia-smi on the node (SHARED_GPU_TUTORIAL.md:26-38);
+TPU hosts have no equivalent, so the framework ships one.  Reads through the
+same ``ChipManager`` backends as the daemon (native libtpuinfo over
+/dev/accel*, or the fake), so what it prints is exactly what the plugin
+serves to the kubelet.
+
+    python -m tpu_device_plugin.info                     # real chips
+    python -m tpu_device_plugin.info --backend fake --fake-topology 8x4
+    python -m tpu_device_plugin.info --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .backend import BackendInitError
+from .config import Flags
+from .slice_topology import SliceConfigError, slice_info_from_env
+
+
+def collect(flags: Flags) -> dict:
+    """Chip/topology snapshot through the daemon's own backend."""
+    from .main import make_backend
+
+    backend = make_backend(flags)
+    backend.init()
+    try:
+        topo = backend.topology()
+        chips = backend.devices()
+        info = {
+            "accelerator_type": topo.accelerator_type,
+            "torus_shape": list(topo.torus_shape),
+            "n_chips": len(chips),
+            "trays": {
+                str(tray): [c.id for c in members]
+                for tray, members in sorted(topo.trays().items())
+            },
+            "chips": [
+                {
+                    "id": c.id,
+                    "index": c.index,
+                    "device_paths": list(c.device_paths),
+                    "hbm_gib": round(c.hbm_bytes / (1 << 30), 1),
+                    "coords": list(c.coords),
+                    "tray": c.tray,
+                    "numa_node": c.numa_node,
+                }
+                for c in chips
+            ],
+        }
+        slice_info = getattr(topo, "slice_info", None)
+        if slice_info is None:
+            try:
+                # Same resolution the daemon uses (incl. metadata fallback).
+                slice_info = slice_info_from_env()
+            except SliceConfigError as e:
+                print(f"tpu-info: ignoring ambient slice metadata: {e}", file=sys.stderr)
+                slice_info = None
+        if slice_info is not None:
+            info["slice"] = {
+                "worker_id": slice_info.worker_id,
+                "topology": "x".join(str(v) for v in slice_info.topology),
+                "host_bounds": ",".join(str(v) for v in slice_info.host_bounds),
+                "n_hosts": slice_info.n_hosts,
+            }
+        return info
+    finally:
+        backend.shutdown()
+
+
+def render(info: dict) -> str:
+    lines = [
+        f"{info['accelerator_type']}: {info['n_chips']} chip(s), "
+        f"ICI mesh {'x'.join(str(v) for v in info['torus_shape'])}, "
+        f"{len(info['trays'])} tray(s)"
+    ]
+    if "slice" in info:
+        s = info["slice"]
+        lines.append(
+            f"slice: worker {s['worker_id']}/{s['n_hosts']} of {s['topology']} "
+            f"(host grid {s['host_bounds']})"
+        )
+    header = f"{'IDX':>3}  {'ID':<24} {'PATH':<16} {'HBM':>7}  {'COORDS':<9} {'TRAY':>4} {'NUMA':>4}"
+    lines += [header, "-" * len(header)]
+    for c in info["chips"]:
+        coords = ",".join(str(v) for v in c["coords"])
+        path = c["device_paths"][0] if c["device_paths"] else "-"
+        numa = "-" if c["numa_node"] is None else str(c["numa_node"])
+        lines.append(
+            f"{c['index']:>3}  {c['id']:<24} {path:<16} "
+            f"{c['hbm_gib']:>6.1f}G  {coords:<9} {c['tray']:>4} {numa:>4}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpu-info", description="show the TPU chips this node advertises"
+    )
+    parser.add_argument("--backend", choices=("tpu", "fake"), default="tpu")
+    parser.add_argument("--fake-topology", default="4x4")
+    parser.add_argument("--driver-root", default="/")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+    flags = Flags(
+        backend=args.backend,
+        fake_topology=args.fake_topology,
+        driver_root=args.driver_root,
+    )
+    try:
+        info = collect(flags)
+    except BackendInitError as e:
+        print(f"tpu-info: no TPU stack on this node: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(info, indent=2) if args.as_json else render(info))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

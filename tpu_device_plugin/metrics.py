@@ -119,6 +119,13 @@ registry.describe("health_events_total", "chip health transitions observed")
 registry.describe("plugin_restarts_total", "plugin serve-cycle restarts")
 registry.describe("allocate_seconds_total", "cumulative Allocate handler time")
 registry.describe("allocate_count", "Allocate handler invocations")
+registry.describe(
+    "preferred_allocation_seconds_total",
+    "cumulative GetPreferredAllocation handler time",
+)
+registry.describe(
+    "preferred_allocation_count", "GetPreferredAllocation handler invocations"
+)
 registry.describe("devices", "advertised devices by resource and health")
 
 

@@ -481,19 +481,19 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
         """Spreading brain for shared resources, ICI packing otherwise
         (reference: server.go:268-313)."""
         response = pb.PreferredAllocationResponse()
-        for req in request.container_requests:
-            try:
-                ids = self._preferred_for(
-                    list(req.available_deviceIDs),
-                    list(req.must_include_deviceIDs),
-                    req.allocation_size,
-                )
-            except (AllocationError, PolicyError, NotImplementedError) as e:
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            metrics_registry.inc(
-                "preferred_allocations_total", {"resource": self.resource_name}
-            )
-            response.container_responses.add(deviceIDs=ids)
+        labels = {"resource": self.resource_name}
+        with metrics_timed("preferred_allocation", labels):
+            for req in request.container_requests:
+                try:
+                    ids = self._preferred_for(
+                        list(req.available_deviceIDs),
+                        list(req.must_include_deviceIDs),
+                        req.allocation_size,
+                    )
+                except (AllocationError, PolicyError, NotImplementedError) as e:
+                    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                metrics_registry.inc("preferred_allocations_total", labels)
+                response.container_responses.add(deviceIDs=ids)
         return response
 
     def _preferred_for(

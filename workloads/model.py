@@ -117,12 +117,18 @@ def _rope(x: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
-def _attention(x: jax.Array, layer: dict, config: ModelConfig) -> jax.Array:
+def _attention(
+    x: jax.Array, layer: dict, config: ModelConfig, attention_fn=None
+) -> jax.Array:
     batch, seq, _ = x.shape
     qkv = jnp.einsum("bsd,dthk->tbshk", x, layer["wqkv"].astype(x.dtype))
     q, k, v = qkv[0], qkv[1], qkv[2]
     q, k = _rope(q), _rope(k)
-    if config.attention_impl == "flash":
+    if attention_fn is not None:
+        # Injected core (e.g. sequence-parallel ring attention bound to a
+        # mesh — workloads/train.py make_seq_parallel_train_step).
+        out = attention_fn(q, k, v)
+    elif config.attention_impl == "flash":
         from workloads.ops import flash_attention
 
         out = flash_attention(q, k, v)
@@ -140,19 +146,23 @@ def _mlp(x: jax.Array, layer: dict) -> jax.Array:
     return hidden @ layer["w_down"].astype(x.dtype)
 
 
-def forward(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+def forward(
+    params: dict, tokens: jax.Array, config: ModelConfig, attention_fn=None
+) -> jax.Array:
     """Logits for next-token prediction.  tokens: [batch, seq] int32."""
     x = params["embed"].astype(config.dtype)[tokens]
     for layer in params["layers"]:
-        x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, config)
+        x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, config, attention_fn)
         x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
     # Final projection in float32 for a stable softmax/loss.
     return (x.astype(jnp.float32) @ params["unembed"])
 
 
-def loss_fn(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+def loss_fn(
+    params: dict, tokens: jax.Array, config: ModelConfig, attention_fn=None
+) -> jax.Array:
     """Causal LM cross-entropy: predict tokens[:, 1:] from tokens[:, :-1]."""
-    logits = forward(params, tokens[:, :-1], config)
+    logits = forward(params, tokens[:, :-1], config, attention_fn)
     targets = tokens[:, 1:]
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]

@@ -1,3 +1,5 @@
-"""TPU-first custom ops (Pallas kernels) for the example workloads."""
+"""TPU-first custom ops for the example workloads: Pallas kernels and
+mesh-level collectives (ring attention)."""
 
 from .attention import flash_attention  # noqa: F401
+from .ring import ring_attention  # noqa: F401

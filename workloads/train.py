@@ -85,6 +85,72 @@ def _opt_shardings_like(opt_shape, params_shape, param_shardings, mesh):
     return jax.tree.map(pick, opt_shape)
 
 
+def make_sp_mesh(
+    n_devices: int | None = None, seq_parallel: int = 2, model_parallel: int = 1
+) -> Mesh:
+    """A ("data", "seq", "model") mesh for sequence-parallel training.
+
+    The "seq" axis carries ring attention's k/v rotation (ICI neighbours);
+    "model" stays available for the Megatron cut (size 1 by default)."""
+    devices = jax.devices()[: n_devices or len(jax.devices())]
+    n = len(devices)
+    if n_devices is not None and n < n_devices:
+        raise ValueError(
+            f"requested a {n_devices}-device mesh but only {n} devices are visible"
+        )
+    if n % (seq_parallel * model_parallel) != 0:
+        raise ValueError(
+            f"{n} devices not divisible by seq_parallel*model_parallel="
+            f"{seq_parallel * model_parallel}"
+        )
+    import numpy as np
+
+    grid = np.array(devices).reshape(
+        n // (seq_parallel * model_parallel), seq_parallel, model_parallel
+    )
+    return Mesh(grid, axis_names=("data", "seq", "model"))
+
+
+def make_seq_parallel_train_step(config: ModelConfig, mesh: Mesh, optimizer):
+    """Sequence-parallel variant of the full training step: activations are
+    sharded [data, seq] and attention runs as ring attention over the mesh's
+    "seq" axis (workloads/ops/ring.py) — k/v shards circulate via ppermute
+    so no device ever holds the full sequence.  Long-context configuration;
+    requires (max_seq_len - 1) divisible by the seq axis (the LM loss drops
+    one position)."""
+    from workloads.ops.ring import ring_attention
+
+    n_seq = mesh.shape["seq"]
+    if (config.max_seq_len - 1) % n_seq:
+        raise ValueError(
+            f"max_seq_len-1 ({config.max_seq_len - 1}) must divide across the "
+            f"seq axis ({n_seq}); pick max_seq_len = k*{n_seq} + 1"
+        )
+
+    def attention_fn(q, k, v):
+        return ring_attention(q, k, v, mesh, axis="seq")
+
+    # Tokens keep the odd max_seq_len (the LM loss drops one position), so
+    # they shard on data only; the seq axis materialises on the sliced
+    # activations inside the step via ring attention's shard_map.
+    data_sharding = NamedSharding(mesh, P("data", None))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p, t: loss_fn(p, t, config, attention_fn)
+        )(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def step(params, opt_state, tokens):
+        tokens = jax.device_put(tokens, data_sharding)
+        return train_step(params, opt_state, tokens)
+
+    return step
+
+
 def make_train_step(config: ModelConfig, mesh: Mesh, optimizer):
     """The jitted full training step: (params, opt_state, tokens) ->
     (params, opt_state, loss)."""
