@@ -25,7 +25,10 @@
 
 namespace {
 
-constexpr const char* kVersion = "0.1.0";
+// ABI version: bump the minor on any struct-layout change (0.2.0 added
+// tpuinfo_health_event_t.code); the Python loader refuses a mismatched
+// major.minor so a stale .so can't misparse event batches.
+constexpr const char* kVersion = "0.2.0";
 
 struct Chip {
   std::string id;
@@ -369,6 +372,7 @@ int tpuinfo_wait_health_events(tpuinfo_health_event_t* out, int max,
       tpuinfo_health_event_t* o = &out[written++];
       CopyString(o->chip_id, sizeof(o->chip_id), c.id);
       o->healthy = alive ? 1 : 0;
+      o->code = TPUINFO_EVENT_NODE_LIVENESS;
       g_state.present[name] = alive;
     }
   }

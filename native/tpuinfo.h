@@ -51,9 +51,16 @@ typedef struct {
   int32_t wraparound;                      /* 1 when the links form a torus */
 } tpuinfo_topology_t;
 
+/* Health-event codes (tpuinfo_health_event_t.code).  Deployments can
+ * suppress individual codes via the DP_DISABLE_HEALTHCHECKS environment
+ * variable, the contract the reference defines for XID codes
+ * (cmd/nvidia-device-plugin/nvidia.go:31-38). */
+#define TPUINFO_EVENT_NODE_LIVENESS 0 /* /dev/accel* vanished or reappeared */
+
 typedef struct {
   char chip_id[TPUINFO_ID_LEN]; /* "" = event applies to all chips */
   int32_t healthy;              /* 1 = Healthy, 0 = Unhealthy */
+  int32_t code;                 /* TPUINFO_EVENT_* classification */
 } tpuinfo_health_event_t;
 
 /* Discover chips under driver_root (normally "/"). Returns the number of

@@ -1,0 +1,98 @@
+"""DP_DISABLE_HEALTHCHECKS environment contract.
+
+The reference defines this escape hatch at nvidia.go:31-38,181-208 and pins
+the additional-code parsing with the table at nvidia_test.go:26-74 (one of
+its two unit-test files).  Same cases here, plus fan-out integration the
+reference never had.
+"""
+
+from __future__ import annotations
+
+import queue
+
+import pytest
+
+from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+from tpu_device_plugin.backend.fake import FakeChipManager
+from tpu_device_plugin.health import (
+    ENV_DISABLE_HEALTH_CHECKS,
+    HealthFanout,
+    get_additional_skip_codes,
+    health_checks_disabled,
+)
+
+
+# The reference's getAdditionalXids table, verbatim (nvidia_test.go:26-74).
+@pytest.mark.parametrize(
+    ("value", "expected"),
+    [
+        ("", []),
+        (",", []),
+        ("not-an-int", []),
+        ("68", [68]),
+        ("-68", []),
+        ("68  ", [68]),
+        ("68,", [68]),
+        (",68", [68]),
+        ("68,67", [68, 67]),
+        ("68,not-an-int,67", [68, 67]),
+    ],
+)
+def test_get_additional_skip_codes(value, expected):
+    assert get_additional_skip_codes(value) == expected
+
+
+@pytest.mark.parametrize(
+    ("value", "disabled"),
+    [
+        ("", False),
+        ("all", True),
+        ("ALL", True),  # reference lowercases before comparing (nvidia.go:182)
+        ("events", True),
+        ("xids", True),  # the reference's token keeps working for drop-in configs
+        ("some-events-here", True),  # substring match, as in the reference
+        ("68,67", False),  # a plain skip list does not disable checking
+    ],
+)
+def test_health_checks_disabled(value, disabled):
+    assert health_checks_disabled(value) is disabled
+
+
+def test_disabled_fanout_delivers_nothing(monkeypatch):
+    monkeypatch.setenv(ENV_DISABLE_HEALTH_CHECKS, "all")
+    mgr = FakeChipManager(n_chips=2)
+    mgr.init()
+    fanout = HealthFanout(mgr)
+    q = fanout.subscribe()
+    mgr.inject("tpu-0", UNHEALTHY)
+    with pytest.raises(queue.Empty):
+        q.get(timeout=0.5)
+    fanout.unsubscribe(q)
+
+
+def test_skip_codes_filter_events_but_not_liveness(monkeypatch):
+    monkeypatch.setenv(ENV_DISABLE_HEALTH_CHECKS, "7")
+    mgr = FakeChipManager(n_chips=2)
+    mgr.init()
+    fanout = HealthFanout(mgr)
+    q = fanout.subscribe()
+    # Code 7 is in the operator's skip list: dropped, chip stays advertised
+    # healthy (the reference's `skippedXids[e.Edata] -> continue`).
+    mgr.inject("tpu-0", UNHEALTHY, code=7)
+    with pytest.raises(queue.Empty):
+        q.get(timeout=0.5)
+    # Default liveness events (code 0) still flow.
+    mgr.inject("tpu-1", UNHEALTHY)
+    ev = q.get(timeout=5)
+    assert (ev.chip_id, ev.health) == ("tpu-1", UNHEALTHY)
+    # A late subscriber sees only the non-skipped transition replayed.
+    q2 = fanout.subscribe()
+    ev = q2.get(timeout=5)
+    assert ev.chip_id == "tpu-1"
+    with pytest.raises(queue.Empty):
+        q2.get(timeout=0.3)
+    # Recovery still flows after a skipped event.
+    mgr.inject("tpu-1", HEALTHY)
+    assert q.get(timeout=5).health == HEALTHY
+    for sub in (q, q2):
+        fanout.unsubscribe(sub)

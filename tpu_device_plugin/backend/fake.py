@@ -90,10 +90,10 @@ class FakeChipManager(ChipManager):
 
     # -- test/bench controls --------------------------------------------------
 
-    def inject(self, chip_id: str, health: str = UNHEALTHY) -> None:
+    def inject(self, chip_id: str, health: str = UNHEALTHY, code: int = 0) -> None:
         """Script a health transition; '' = all chips."""
         assert health in (HEALTHY, UNHEALTHY)
-        self._injected.put(HealthEvent(chip_id=chip_id, health=health))
+        self._injected.put(HealthEvent(chip_id=chip_id, health=health, code=code))
 
     def _require_init(self) -> None:
         if not self.initialized or self._topology is None:

@@ -19,6 +19,9 @@ from ..device import Chip, HealthEvent
 from ..topology import Topology
 
 ENV_LIBRARY = "TPUINFO_LIBRARY"
+# Expected libtpuinfo ABI (native/tpuinfo.cc kVersion): major.minor pins the
+# struct layouts; the patch digit is free to drift.
+ABI_VERSION = "0.2.0"
 _ID_LEN = 64
 _PATH_LEN = 128
 _TYPE_LEN = 16
@@ -58,6 +61,7 @@ class _HealthEventStruct(ctypes.Structure):
     _fields_ = [
         ("chip_id", ctypes.c_char * _ID_LEN),
         ("healthy", ctypes.c_int32),
+        ("code", ctypes.c_int32),
     ]
 
 
@@ -91,6 +95,14 @@ class NativeTpuInfo:
         if self._lib is None:
             raise NativeUnavailableError(str(last_error) or "no candidate paths")
         self._declare()
+        # Struct layouts (ctypes side) are pinned to the library's ABI
+        # major.minor; a stale .so would misparse array-element strides
+        # (e.g. health-event batches), so refuse it up front.
+        found = self.version()
+        if found.rsplit(".", 1)[0] != ABI_VERSION.rsplit(".", 1)[0]:
+            raise NativeUnavailableError(
+                f"libtpuinfo ABI {found} incompatible with expected {ABI_VERSION}"
+            )
 
     def _declare(self) -> None:
         lib = self._lib
@@ -169,6 +181,7 @@ class NativeTpuInfo:
             HealthEvent(
                 chip_id=buf[i].chip_id.decode(),
                 health=HEALTHY if buf[i].healthy else UNHEALTHY,
+                code=buf[i].code,
             )
             for i in range(n)
         ]

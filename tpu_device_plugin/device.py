@@ -89,6 +89,10 @@ class HealthEvent:
 
     chip_id: str  # "" means "all chips" (event could not be attributed)
     health: str = HEALTHY
+    # Event classification (native TPUINFO_EVENT_*); deployments can suppress
+    # individual codes via DP_DISABLE_HEALTHCHECKS, the contract the reference
+    # defines for XID codes (nvidia.go:31-38).
+    code: int = 0
 
     @property
     def all_chips(self) -> bool:

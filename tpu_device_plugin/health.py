@@ -11,6 +11,7 @@ duplicates every event into one subscriber queue per plugin.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 
@@ -18,6 +19,54 @@ from .backend import ChipManager
 from .device import HealthEvent
 
 log = logging.getLogger(__name__)
+
+# Same environment contract as the reference (nvidia.go:31-38,181-208):
+# DP_DISABLE_HEALTHCHECKS="all" (or a value containing the event-group
+# token) disables health checking entirely; otherwise the value is a
+# comma-separated list of event codes to ignore in addition to the built-in
+# application-level skip list.  The reference's group token is "xids"; TPUs
+# have no XID stream, so "events" is the native token — "xids" is still
+# honored so an existing cluster configuration drops in unchanged.
+ENV_DISABLE_HEALTH_CHECKS = "DP_DISABLE_HEALTHCHECKS"
+_ALL_TOKENS = ("events", "xids")
+
+# Event codes that indicate a workload/application-level fault rather than a
+# sick chip — the analog of the reference's application-error XID skip list
+# (nvidia.go:193-199).  Node-liveness (code 0) is not in it: a vanished
+# device node is always chip-level.  Currently empty because the native
+# layer only emits liveness events; runtime error classes slot in here.
+APPLICATION_ERROR_CODES: frozenset = frozenset()
+
+
+def health_checks_disabled(value: str | None = None) -> bool:
+    """True when the env (or the given raw value) turns health checks off."""
+    raw = os.environ.get(ENV_DISABLE_HEALTH_CHECKS, "") if value is None else value
+    raw = raw.lower()
+    if raw == "all":
+        return True
+    return any(token in raw for token in _ALL_TOKENS)
+
+
+def get_additional_skip_codes(value: str) -> list:
+    """Parse a comma-separated list of event codes, dropping malformed entries.
+
+    Mirrors the reference's getAdditionalXids (nvidia.go:271-294; behavior
+    pinned by the nvidia_test.go:26-74 table): entries are trimmed, empty
+    entries skipped, and anything that is not an unsigned integer is logged
+    and ignored.
+    """
+    if not value:
+        return []
+    codes = []
+    for part in value.split(","):
+        trimmed = part.strip()
+        if not trimmed:
+            continue
+        if not trimmed.isdigit():
+            log.warning("Ignoring malformed health event code %r", trimmed)
+            continue
+        codes.append(int(trimmed))
+    return codes
 
 
 class HealthFanout:
@@ -37,6 +86,7 @@ class HealthFanout:
         self._pump: threading.Thread | None = None
         self._central: "queue.Queue[HealthEvent]" = queue.Queue()
         self._chip_ids: list[str] = []
+        self._skip_codes: set = set()
         # Last known health per chip: late subscribers (plugins start
         # sequentially, each with its own serve+register latency) must not
         # miss transitions that happened before they joined.
@@ -74,6 +124,16 @@ class HealthFanout:
     # ------------------------------------------------------------------ internals
 
     def _start_locked(self) -> None:
+        # Read the env at watcher start, exactly when the reference reads it
+        # (checkHealth entry, nvidia.go:182): one serve cycle = one decision.
+        raw = os.environ.get(ENV_DISABLE_HEALTH_CHECKS, "").lower()
+        if health_checks_disabled(raw):
+            log.warning(
+                "%s=%r: chip health checking disabled", ENV_DISABLE_HEALTH_CHECKS, raw
+            )
+            return
+        self._skip_codes = set(APPLICATION_ERROR_CODES)
+        self._skip_codes.update(get_additional_skip_codes(raw))
         self._stop.clear()
         chips = self._manager.devices()
         self._chip_ids = [c.id for c in chips]
@@ -92,6 +152,13 @@ class HealthFanout:
             try:
                 event = self._central.get(timeout=0.2)
             except queue.Empty:
+                continue
+            if event.code in self._skip_codes:
+                log.info(
+                    "Ignoring health event code %d for %r (skip list)",
+                    event.code,
+                    event.chip_id or "all chips",
+                )
                 continue
             with self._lock:
                 if event.all_chips:

@@ -78,18 +78,30 @@ def _ring_local(q, k, v, axis_name: str, n_shards: int, causal: bool):
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, axis: str = "seq", causal: bool = True):
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh,
+    axis: str = "seq",
+    causal: bool = True,
+    batch_axis: str | None = None,
+):
     """Sequence-parallel attention over ``mesh[axis]``.
 
     q/k/v: [batch, seq, heads, head_dim] global arrays with seq divisible by
     the mesh axis size.  Returns attention output with the same sharding.
+    On a multi-axis mesh pass ``batch_axis`` (e.g. ``"data"``) so the batch
+    dim stays sharded across that axis — leaving it unmapped would make
+    shard_map all-gather the batch and replicate the attention compute on
+    every device along it.
     """
     n_shards = mesh.shape[axis]
     if q.shape[1] % n_shards:
         raise ValueError(
             f"seq {q.shape[1]} not divisible by mesh axis {axis!r} size {n_shards}"
         )
-    spec = P(None, axis, None, None)
+    spec = P(batch_axis, axis, None, None)
     run = shard_map(
         partial(_ring_local, axis_name=axis, n_shards=n_shards, causal=causal),
         mesh=mesh,

@@ -128,7 +128,7 @@ def make_seq_parallel_train_step(config: ModelConfig, mesh: Mesh, optimizer):
         )
 
     def attention_fn(q, k, v):
-        return ring_attention(q, k, v, mesh, axis="seq")
+        return ring_attention(q, k, v, mesh, axis="seq", batch_axis="data")
 
     # Tokens keep the odd max_seq_len (the LM loss drops one position), so
     # they shard on data only; the seq axis materialises on the sliced
