@@ -117,15 +117,15 @@ def run_bench() -> dict:
 
 
 def busy_extras() -> dict:
-    """Aggregate chip-busy under 4-way oversubscription (extra fields)."""
+    """Aggregate chip-busy at the north-star config: 8 pods on a v5e-4."""
     from workloads.oversubscribe import BASELINE_BUSY_FRACTION, run as busy_run
 
     agg = busy_run(
-        n_chips=2,
-        chips_per_tray=2,
+        n_chips=4,
+        chips_per_tray=4,
         replicas=2,
-        n_pods=4,
-        duration_secs=4.0,
+        n_pods=8,
+        duration_secs=6.0,
         matrix_dim=256,
         platform="cpu",  # pods measure the sharing machinery, not the chip
     )

@@ -70,6 +70,29 @@ def test_disabled_fanout_delivers_nothing(monkeypatch):
     fanout.unsubscribe(q)
 
 
+def test_disabled_decision_is_sticky_per_serve_cycle(monkeypatch):
+    """One serve cycle = one env read (reference: checkHealth entry,
+    nvidia.go:182): a second plugin subscribing after the env changed must
+    not start the pump mid-cycle; a fresh cycle re-reads the env."""
+    monkeypatch.setenv(ENV_DISABLE_HEALTH_CHECKS, "all")
+    mgr = FakeChipManager(n_chips=2)
+    mgr.init()
+    fanout = HealthFanout(mgr)
+    q1 = fanout.subscribe()
+    monkeypatch.delenv(ENV_DISABLE_HEALTH_CHECKS)
+    q2 = fanout.subscribe()  # same cycle: still disabled
+    mgr.inject("tpu-0", UNHEALTHY)
+    for q in (q1, q2):
+        with pytest.raises(queue.Empty):
+            q.get(timeout=0.5)
+    fanout.unsubscribe(q1)
+    fanout.unsubscribe(q2)
+    # New cycle (all subscribers gone): env is re-read, events flow again.
+    q3 = fanout.subscribe()
+    assert q3.get(timeout=5).chip_id == "tpu-0"  # replayed current state
+    fanout.unsubscribe(q3)
+
+
 def test_skip_codes_filter_events_but_not_liveness(monkeypatch):
     monkeypatch.setenv(ENV_DISABLE_HEALTH_CHECKS, "7")
     mgr = FakeChipManager(n_chips=2)

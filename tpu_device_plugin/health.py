@@ -87,6 +87,10 @@ class HealthFanout:
         self._central: "queue.Queue[HealthEvent]" = queue.Queue()
         self._chip_ids: list[str] = []
         self._skip_codes: set = set()
+        # Sticky "disabled" decision: one serve cycle = one env read
+        # (reference: checkHealth entry, nvidia.go:182), even with several
+        # plugins subscribing to the same fanout.
+        self._disabled = False
         # Last known health per chip: late subscribers (plugins start
         # sequentially, each with its own serve+register latency) must not
         # miss transitions that happened before they joined.
@@ -98,7 +102,7 @@ class HealthFanout:
         q: "queue.Queue[HealthEvent]" = queue.Queue()
         with self._lock:
             self._subscribers.append(q)
-            if self._watcher is None:
+            if self._watcher is None and not self._disabled:
                 self._start_locked()
             # Replay current non-healthy state so the new subscriber's view
             # converges even though the original events are long gone.
@@ -115,6 +119,7 @@ class HealthFanout:
             watcher, pump = self._watcher, self._pump
             if should_stop:
                 self._watcher = self._pump = None
+                self._disabled = False  # next serve cycle re-reads the env
         if should_stop:
             self._stop.set()
             for t in (watcher, pump):
@@ -131,6 +136,7 @@ class HealthFanout:
             log.warning(
                 "%s=%r: chip health checking disabled", ENV_DISABLE_HEALTH_CHECKS, raw
             )
+            self._disabled = True
             return
         self._skip_codes = set(APPLICATION_ERROR_CODES)
         self._skip_codes.update(get_additional_skip_codes(raw))
