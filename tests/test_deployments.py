@@ -43,16 +43,24 @@ def test_static_daemonsets_env_names_are_flag_aliases():
 
 
 def test_helm_values_cover_wired_env_vars():
-    """Every .Values.<key> the template references is a top-level key in
-    values.yaml, so `helm template` with default values renders."""
+    """Every .Values.<key> any chart template references is a top-level key
+    in values.yaml, so `helm template` with default values renders."""
+    import glob
+
     import yaml
 
-    text = open(HELM_DAEMONSET).read()
+    template_dir = os.path.join(
+        REPO, "deployments", "helm", "tpu-device-plugin", "templates"
+    )
     with open(
         os.path.join(REPO, "deployments", "helm", "tpu-device-plugin", "values.yaml")
     ) as f:
         values = yaml.safe_load(f)
-    missing = {
-        ref for ref in set(re.findall(r"\.Values\.(\w+)", text)) if ref not in values
-    }
-    assert not missing, f"values.yaml missing top-level keys {missing} used by daemonset.yml"
+    for path in glob.glob(os.path.join(template_dir, "*")):
+        text = open(path).read()
+        missing = {
+            ref for ref in set(re.findall(r"\.Values\.(\w+)", text)) if ref not in values
+        }
+        assert not missing, (
+            f"values.yaml missing top-level keys {missing} used by {os.path.basename(path)}"
+        )
