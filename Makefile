@@ -28,6 +28,24 @@ busy-bench: native
 
 check: test
 
+# Containerised variants: `make docker-test`, `make docker-bench`, ... run
+# the same target inside the devel image (reference analog: Makefile:33-66
+# DOCKER_TARGETS).  `make image` builds the deployable plugin image.
+DOCKER ?= docker
+BUILDIMAGE ?= tpu-device-plugin-devel
+MAKE_TARGETS := native test coverage bench busy-bench check clean
+
+.PHONY: .build-image image $(patsubst %,docker-%,$(MAKE_TARGETS))
+
+.build-image:
+	$(DOCKER) build -t $(BUILDIMAGE) -f docker/Dockerfile.devel docker
+
+$(patsubst %,docker-%,$(MAKE_TARGETS)): docker-%: .build-image
+	$(DOCKER) run --rm -v $(CURDIR):/work -w /work $(BUILDIMAGE) make $(*)
+
+image:
+	$(DOCKER) build -t tpu-device-plugin:devel -f deployments/container/Dockerfile .
+
 clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

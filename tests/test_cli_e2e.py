@@ -1,0 +1,122 @@
+"""Daemon-as-subprocess e2e: the real CLI (`python -m tpu_device_plugin.main`)
+driven over real unix-socket gRPC, including process signals.
+
+The in-process tests (test_daemon.py, test_plugin_e2e.py) exercise the same
+code paths but share the interpreter; this file pins the actual shipped
+entrypoint — argv parsing through serving through signal-driven restart and
+shutdown — the way the DaemonSet runs it (reference: main() main.go:44-326)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpu_device_plugin.api import pb
+
+from .fake_kubelet import FakeKubelet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def kubelet(tmp_path):
+    k = FakeKubelet(str(tmp_path))
+    k.start()
+    yield k
+    k.stop()
+
+
+@pytest.fixture
+def daemon(kubelet, tmp_path):
+    env = dict(os.environ)
+    env.pop("DP_DISABLE_HEALTHCHECKS", None)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "tpu_device_plugin.main",
+            "--backend", "fake", "--fake-topology", "4x4",
+            "--resource-config", "tpu:shared-tpu:4",
+            "--device-plugin-path", str(tmp_path),
+        ],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    yield proc
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait()
+
+
+def test_cli_full_flow_signals_and_shutdown(kubelet, daemon, tmp_path):
+    reg = kubelet.wait_for_registration(timeout=15)
+    assert reg.resource_name == "google.com/shared-tpu"
+
+    stub = kubelet.plugin_client(reg.endpoint)
+    stream = stub.ListAndWatch(pb.Empty())
+    devices = list(next(iter(stream)).devices)
+    stream.cancel()
+    assert len(devices) == 16  # 4 chips x 4 replicas
+
+    ids = sorted(d.ID for d in devices)
+    pref = stub.GetPreferredAllocation(
+        pb.PreferredAllocationRequest(
+            container_requests=[
+                pb.ContainerPreferredAllocationRequest(
+                    available_deviceIDs=ids, allocation_size=2
+                )
+            ]
+        )
+    )
+    chosen = list(pref.container_responses[0].deviceIDs)
+    assert len({c.rsplit("-replica-", 1)[0] for c in chosen}) == 2  # spread
+
+    resp = stub.Allocate(
+        pb.AllocateRequest(
+            container_requests=[pb.ContainerAllocateRequest(devicesIDs=chosen)]
+        )
+    )
+    container = resp.container_responses[0]
+    envs = dict(container.envs)
+    assert envs["TPU_DEVICE_PLUGIN_SHARED"] == "1"
+    assert len(envs["TPU_VISIBLE_CHIPS"].split(",")) == 2
+    assert len(container.devices) == 2  # /dev/accel* specs
+
+    # SIGHUP: full plugin restart -> a new registration arrives.
+    n_regs = len(kubelet.registrations)
+    kubelet.registered.clear()
+    daemon.send_signal(signal.SIGHUP)
+    kubelet.wait_for_registration(timeout=15)
+    assert len(kubelet.registrations) > n_regs
+
+    # SIGTERM: clean exit, plugin socket removed (kubelet.sock is ours).
+    daemon.send_signal(signal.SIGTERM)
+    assert daemon.wait(timeout=15) == 0
+    leftovers = [
+        f for f in os.listdir(tmp_path)
+        if f.endswith(".sock") and f != "kubelet.sock"
+    ]
+    assert not leftovers
+
+
+def test_cli_reregisters_after_kubelet_restart(kubelet, daemon, tmp_path):
+    kubelet.wait_for_registration(timeout=15)
+    # Simulate a kubelet restart: tear the server down, recreate the socket.
+    kubelet.stop()
+    try:
+        os.remove(kubelet.socket_path)
+    except FileNotFoundError:
+        pass
+    time.sleep(0.3)
+    kubelet.registered.clear()
+    kubelet.start()
+    reg = kubelet.wait_for_registration(timeout=15)
+    assert reg.resource_name == "google.com/shared-tpu"
+
+    daemon.send_signal(signal.SIGTERM)
+    assert daemon.wait(timeout=15) == 0
