@@ -41,7 +41,8 @@ MAKE_TARGETS := native test coverage bench busy-bench check clean
 	$(DOCKER) build -t $(BUILDIMAGE) -f docker/Dockerfile.devel docker
 
 $(patsubst %,docker-%,$(MAKE_TARGETS)): docker-%: .build-image
-	$(DOCKER) run --rm -v $(CURDIR):/work -w /work $(BUILDIMAGE) make $(*)
+	$(DOCKER) run --rm --user $(shell id -u):$(shell id -g) \
+		-v $(CURDIR):/work -w /work $(BUILDIMAGE) make $(*)
 
 image:
 	$(DOCKER) build -t tpu-device-plugin:devel -f deployments/container/Dockerfile .

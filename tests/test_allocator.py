@@ -158,3 +158,66 @@ class TestStaticSlicePolicy:
             topo, {2: [["tpu-0", "tpu-1"], ["tpu-2", "tpu-3"]]}
         )
         assert policy.allocate(ids(8), ["tpu-2"], 2) == ["tpu-2", "tpu-3"]
+
+
+class TestStatefulAllocator:
+    """The gpuallocator.Allocator analog (allocator.go:14-120)."""
+
+    def test_allocate_free_cycle(self):
+        from tpu_device_plugin.allocator import new_simple_allocator
+
+        alloc = new_simple_allocator(ids(4))
+        got = alloc.allocate(2)
+        assert got == ["tpu-0", "tpu-1"]
+        assert alloc.remaining == ["tpu-2", "tpu-3"]
+        assert alloc.allocated == ["tpu-0", "tpu-1"]
+        alloc.free(got)
+        assert alloc.remaining == ids(4)
+        assert alloc.allocated == []
+
+    def test_allocate_exhausted_returns_empty(self):
+        from tpu_device_plugin.allocator import new_simple_allocator
+
+        alloc = new_simple_allocator(ids(2))
+        assert alloc.allocate(2) == ["tpu-0", "tpu-1"]
+        # allocator.go:81-93 — unsatisfiable num yields the empty set, no error.
+        assert alloc.allocate(1) == []
+        assert alloc.allocate(0) == []
+
+    def test_allocate_specific_unavailable(self):
+        from tpu_device_plugin.allocator import new_simple_allocator
+
+        alloc = new_simple_allocator(ids(3))
+        alloc.allocate_specific(["tpu-1"])
+        with pytest.raises(PolicyError, match="unavailable"):
+            alloc.allocate_specific(["tpu-1", "tpu-2"])
+        # All-or-nothing: tpu-2 must not have been claimed by the failed call.
+        assert "tpu-2" in alloc.remaining
+
+    def test_free_unknown_id_rejected(self):
+        from tpu_device_plugin.allocator import new_simple_allocator
+
+        alloc = new_simple_allocator(ids(2))
+        with pytest.raises(PolicyError, match="do not belong"):
+            alloc.free(["ghost"])
+        assert alloc.remaining == ids(2)
+
+    def test_best_effort_allocator_prefers_trays(self):
+        from tpu_device_plugin.allocator import new_best_effort_allocator
+
+        topo = build_fake_topology(8, 4)
+        alloc = new_best_effort_allocator(topo, ids(8))
+        first = alloc.allocate(4)
+        second = alloc.allocate(4)
+        # Two tray-aligned grabs drain the host cleanly.
+        assert first == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+        assert second == ["tpu-4", "tpu-5", "tpu-6", "tpu-7"]
+        alloc.free(first)
+        assert alloc.allocate(4) == first
+
+    def test_inventory_defaults_to_topology(self):
+        from tpu_device_plugin.allocator import new_best_effort_allocator
+
+        topo = build_fake_topology(4, 4)
+        alloc = new_best_effort_allocator(topo)
+        assert alloc.remaining == ids(4)

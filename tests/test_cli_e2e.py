@@ -35,6 +35,10 @@ def kubelet(tmp_path):
 def daemon(kubelet, tmp_path):
     env = dict(os.environ)
     env.pop("DP_DISABLE_HEALTHCHECKS", None)
+    # A log file, not PIPE: nothing drains a pipe while tests block on
+    # registration waits (a chatty daemon would deadlock on a full pipe
+    # buffer), and unlike DEVNULL the log survives for triage on failure.
+    log = open(tmp_path / "daemon.log", "wb")
     proc = subprocess.Popen(
         [
             sys.executable, "-m", "tpu_device_plugin.main",
@@ -44,13 +48,14 @@ def daemon(kubelet, tmp_path):
         ],
         cwd=REPO,
         env=env,
-        stdout=subprocess.PIPE,
+        stdout=log,
         stderr=subprocess.STDOUT,
     )
     yield proc
     if proc.poll() is None:
         proc.kill()
         proc.wait()
+    log.close()
 
 
 def test_cli_full_flow_signals_and_shutdown(kubelet, daemon, tmp_path):

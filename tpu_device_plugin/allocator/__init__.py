@@ -51,6 +51,11 @@ def validate_request(
 from .simple import SimplePolicy  # noqa: E402
 from .besteffort import BestEffortPolicy  # noqa: E402
 from .static_slices import StaticSlicePolicy  # noqa: E402
+from .stateful import (  # noqa: E402
+    Allocator,
+    new_best_effort_allocator,
+    new_simple_allocator,
+)
 
 
 def new_best_effort_policy(topology: Topology) -> Policy:
@@ -63,6 +68,9 @@ __all__ = [
     "SimplePolicy",
     "BestEffortPolicy",
     "StaticSlicePolicy",
+    "Allocator",
+    "new_simple_allocator",
+    "new_best_effort_allocator",
     "new_best_effort_policy",
     "validate_request",
 ]

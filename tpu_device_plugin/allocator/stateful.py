@@ -1,0 +1,100 @@
+"""Stateful chip allocator: policy-driven alloc/free bookkeeping.
+
+TPU equivalent of the reference's vendored ``gpuallocator.Allocator``
+(vendor/.../gpuallocator/allocator.go:14-120): an object that owns the node's
+chip inventory, hands out sets chosen by a pluggable ``Policy``, and takes
+them back on free.  The reference's device-plugin daemon never instantiates
+it (the kubelet owns allocation state; see SURVEY.md §5 "checkpoint/resume"),
+but the library ships it for standalone schedulers — node agents, scheduler
+extenders, test harnesses — and this framework mirrors that surface so the
+same callers exist on TPU (e.g. ``workloads/oversubscribe.py``-style local
+harnesses can lease chips without a kubelet).
+
+Differences from the reference, on purpose:
+
+* ``allocate(num)`` returns ``[]`` when the policy cannot satisfy ``num``
+  (reference: empty slice) but re-raises genuine request errors from
+  ``allocate_specific`` instead of panicking (allocator.go:86-90).
+* ``free`` only accepts IDs that belong to this allocator's universe; the
+  reference silently inserts arbitrary devices into ``remaining``
+  (allocator.go:115-119), which can grow the pool past the hardware.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+from ..topology import Topology
+from . import Policy, PolicyError
+from .besteffort import BestEffortPolicy
+from .simple import SimplePolicy
+
+
+class Allocator:
+    """Tracks remaining vs. allocated chips, delegating choice to a Policy
+    (reference: Allocator struct, allocator.go:14-20)."""
+
+    def __init__(self, policy: Policy, device_ids: Iterable[str]):
+        self._policy = policy
+        self._all = frozenset(device_ids)
+        self._remaining = set(self._all)
+        self._allocated: set[str] = set()
+
+    @property
+    def remaining(self) -> list[str]:
+        return sorted(self._remaining)
+
+    @property
+    def allocated(self) -> list[str]:
+        return sorted(self._allocated)
+
+    def allocate(self, num: int) -> list[str]:
+        """Pick ``num`` chips via the policy and mark them allocated; ``[]``
+        if the pool cannot satisfy the request (allocator.go:81-93)."""
+        if num <= 0:
+            return []
+        try:
+            chosen = self._policy.allocate(sorted(self._remaining), [], num)
+        except PolicyError:
+            return []
+        self.allocate_specific(chosen)
+        return chosen
+
+    def allocate_specific(self, device_ids: Sequence[str]) -> None:
+        """Claim an explicit set; all-or-nothing (allocator.go:96-112)."""
+        requested = set(device_ids)
+        unavailable = requested - self._remaining
+        if unavailable:
+            raise PolicyError(
+                f"devices {sorted(unavailable)} are unavailable for allocation, "
+                f"available: {sorted(self._remaining)}"
+            )
+        self._remaining -= requested
+        self._allocated |= requested
+
+    def free(self, device_ids: Sequence[str]) -> None:
+        """Return chips to the pool (allocator.go:115-119; see module note on
+        the unknown-ID guard)."""
+        requested = set(device_ids)
+        unknown = requested - self._all
+        if unknown:
+            raise PolicyError(
+                f"devices {sorted(unknown)} do not belong to this allocator"
+            )
+        self._allocated -= requested
+        self._remaining |= requested
+
+
+def new_simple_allocator(device_ids: Iterable[str]) -> Allocator:
+    """Reference pendant: NewSimpleAllocator (allocator.go:34-38)."""
+    return Allocator(SimplePolicy(), device_ids)
+
+
+def new_best_effort_allocator(
+    topology: Topology, device_ids: Iterable[str] | None = None
+) -> Allocator:
+    """Reference pendant: NewBestEffortAllocator (allocator.go:40-44), except
+    the chip inventory comes from the cached topology snapshot instead of a
+    fresh NVML enumeration per constructor (device.go:33-72)."""
+    ids = device_ids if device_ids is not None else topology.chips_by_id.keys()
+    return Allocator(BestEffortPolicy(topology), ids)

@@ -1,0 +1,109 @@
+"""Ulysses-style all-to-all sequence/context parallelism.
+
+The second of the two standard long-context recipes (the first, ring
+attention, is ``workloads/ops/ring.py``): instead of circulating k/v shards
+around a ring, one ``lax.all_to_all`` per tensor re-partitions the
+sequence-sharded activations into head-sharded full sequences — each device
+then runs ordinary full-sequence attention over heads/N local heads — and a
+reverse all-to-all restores the sequence sharding.  On TPU the all-to-alls
+ride the ICI mesh; the local attention is the Pallas flash kernel
+(``workloads/ops/attention.py``), so the [seq, seq] score matrix still never
+touches HBM.
+
+Trade-off vs ring: Ulysses moves each activation exactly twice (two
+all-to-alls of 1/N of the tensor per device) regardless of sequence length,
+while ring moves k/v N-1 times but overlaps transfers with compute; Ulysses
+needs heads divisible by the axis size, ring does not.  Both are exposed so
+the training step can pick per topology (``workloads/train.py``).
+
+Differentiable end-to-end: all_to_all transposes to the reverse all_to_all,
+and the local kernel carries its own custom_vjp.
+
+Reference pendant: none — the reference daemon has no model code; this is
+part of the JAX workload suite exercising the multi-chip slices the device
+plugin allocates (SURVEY.md §5 "long-context" analog note).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.4.35
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from .attention import flash_attention
+
+_SEQ_DIM, _HEAD_DIM = 1, 2
+
+
+def _ulysses_local(q, k, v, axis_name: str, causal: bool, local_attn):
+    """Per-device body: q/k/v [batch, seq/N, heads, d] -> same shape."""
+
+    def seq_to_heads(x):  # -> [batch, seq, heads/N, d]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=_HEAD_DIM, concat_axis=_SEQ_DIM, tiled=True
+        )
+
+    def heads_to_seq(x):  # -> [batch, seq/N, heads, d]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=_SEQ_DIM, concat_axis=_HEAD_DIM, tiled=True
+        )
+
+    out = local_attn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    mesh,
+    axis: str = "seq",
+    causal: bool = True,
+    batch_axis: str | None = None,
+    local_attn: Callable | None = None,
+):
+    """Sequence-parallel attention over ``mesh[axis]`` via head/seq all-to-all.
+
+    q/k/v: [batch, seq, heads, head_dim] global arrays with both seq and
+    heads divisible by the mesh axis size.  Returns attention output with the
+    same sharding.  ``batch_axis`` keeps the batch dim mapped on a second
+    mesh axis (see ring_attention's note).  ``local_attn(q, k, v, causal)``
+    overrides the per-device full-sequence attention (default: the Pallas
+    flash kernel).
+    """
+    n_shards = mesh.shape[axis]
+    if q.shape[_SEQ_DIM] % n_shards:
+        raise ValueError(
+            f"seq {q.shape[_SEQ_DIM]} not divisible by mesh axis {axis!r} "
+            f"size {n_shards}"
+        )
+    if q.shape[_HEAD_DIM] % n_shards:
+        raise ValueError(
+            f"heads {q.shape[_HEAD_DIM]} not divisible by mesh axis {axis!r} "
+            f"size {n_shards} (use ring attention for head counts the axis "
+            f"cannot split)"
+        )
+    attn = local_attn if local_attn is not None else flash_attention
+    spec = P(batch_axis, axis, None, None)
+    body = partial(_ulysses_local, axis_name=axis, causal=causal, local_attn=attn)
+    # The Pallas kernel's out_shape carries no varying-mesh-axes (vma)
+    # annotation, so shard_map's replication checker must be off; sharding
+    # correctness is pinned by the dense-reference tests instead.
+    try:
+        run = shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+    except TypeError:  # pragma: no cover - older jax spells it check_rep
+        run = shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False,
+        )
+    return run(q, k, v)

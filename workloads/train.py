@@ -111,14 +111,20 @@ def make_sp_mesh(
     return Mesh(grid, axis_names=("data", "seq", "model"))
 
 
-def make_seq_parallel_train_step(config: ModelConfig, mesh: Mesh, optimizer):
+def make_seq_parallel_train_step(
+    config: ModelConfig, mesh: Mesh, optimizer, attention: str = "ring"
+):
     """Sequence-parallel variant of the full training step: activations are
-    sharded [data, seq] and attention runs as ring attention over the mesh's
-    "seq" axis (workloads/ops/ring.py) — k/v shards circulate via ppermute
-    so no device ever holds the full sequence.  Long-context configuration;
-    requires (max_seq_len - 1) divisible by the seq axis (the LM loss drops
-    one position)."""
+    sharded [data, seq] and attention runs sequence-parallel over the mesh's
+    "seq" axis — ``attention="ring"`` circulates k/v shards via ppermute
+    (workloads/ops/ring.py, no device ever holds the full sequence) and
+    ``attention="ulysses"`` re-partitions seq<->heads with two all-to-alls
+    around the local flash kernel (workloads/ops/ulysses.py, needs heads
+    divisible by the seq axis).  Long-context configuration; requires
+    (max_seq_len - 1) divisible by the seq axis (the LM loss drops one
+    position)."""
     from workloads.ops.ring import ring_attention
+    from workloads.ops.ulysses import ulysses_attention
 
     n_seq = mesh.shape["seq"]
     if (config.max_seq_len - 1) % n_seq:
@@ -126,9 +132,23 @@ def make_seq_parallel_train_step(config: ModelConfig, mesh: Mesh, optimizer):
             f"max_seq_len-1 ({config.max_seq_len - 1}) must divide across the "
             f"seq axis ({n_seq}); pick max_seq_len = k*{n_seq} + 1"
         )
+    if attention == "ring":
 
-    def attention_fn(q, k, v):
-        return ring_attention(q, k, v, mesh, axis="seq", batch_axis="data")
+        def attention_fn(q, k, v):
+            return ring_attention(q, k, v, mesh, axis="seq", batch_axis="data")
+
+    elif attention == "ulysses":
+        if config.n_heads % n_seq:
+            raise ValueError(
+                f"ulysses attention needs n_heads ({config.n_heads}) divisible "
+                f"by the seq axis ({n_seq}); use attention='ring'"
+            )
+
+        def attention_fn(q, k, v):
+            return ulysses_attention(q, k, v, mesh, axis="seq", batch_axis="data")
+
+    else:
+        raise ValueError(f"unknown attention {attention!r} (ring|ulysses)")
 
     # Tokens keep the odd max_seq_len (the LM loss drops one position), so
     # they shard on data only; the seq axis materialises on the sliced
