@@ -2,6 +2,8 @@
 
 PYTHON ?= python
 
+include versions.mk
+
 .PHONY: all native test coverage bench busy-bench clean check fmt-check
 
 all: native
@@ -43,6 +45,10 @@ MAKE_TARGETS := native test coverage bench busy-bench check clean
 $(patsubst %,docker-%,$(MAKE_TARGETS)): docker-%: .build-image
 	$(DOCKER) run --rm --user $(shell id -u):$(shell id -g) \
 		-v $(CURDIR):/work -w /work $(BUILDIMAGE) make $(*)
+
+# Deployable images: build-slim / build-ubi9 / push-* / multi-arch come from
+# packaging.mk; `make image` stays the quick local single-arch build.
+include deployments/container/packaging.mk
 
 image:
 	$(DOCKER) build -t tpu-device-plugin:devel -f deployments/container/Dockerfile .

@@ -1,0 +1,74 @@
+# Image packaging: per-distribution build/push targets with an optional
+# multi-arch mode (reference analog: deployments/container/{Makefile,
+# multi-arch.mk,native-only.mk} — same capability, collapsed into one file
+# since the arch switch is two variables here, not two target sets).
+#
+#   make build-slim              # python:3.12-slim based image (default)
+#   make build-ubi9              # Red Hat UBI9 based image
+#   make push-slim OUT_REGISTRY=ghcr.io/acme
+#   make build-slim BUILD_MULTI_ARCH_IMAGES=true PUSH_ON_BUILD=true
+#
+# Distributions map to Dockerfile flavors; the pushed tag is
+# <image>:<version>-<dist>, and the default distribution additionally
+# pushes the bare <image>:<version> short tag.
+
+DISTRIBUTIONS := slim ubi9
+DEFAULT_PUSH_TARGET := slim
+
+BUILD_TARGETS := $(patsubst %,build-%,$(DISTRIBUTIONS))
+PUSH_TARGETS := $(patsubst %,push-%,$(DISTRIBUTIONS))
+.PHONY: $(BUILD_TARGETS) $(PUSH_TARGETS) push-short
+
+# Multi-arch builds go through buildx and can push straight from the
+# builder (classic `docker build` cannot hold a foreign-arch manifest list
+# locally); native-only builds use the plain docker driver + docker push.
+BUILD_MULTI_ARCH_IMAGES ?= false
+PUSH_ON_BUILD ?= false
+ifeq ($(BUILD_MULTI_ARCH_IMAGES),true)
+  BUILDX := buildx
+  IMAGE_PLATFORMS ?= linux/amd64,linux/arm64
+  DOCKER_BUILD_OPTIONS = --platform=$(IMAGE_PLATFORMS) \
+      --output=type=image,push=$(PUSH_ON_BUILD)
+  ifneq ($(PUSH_ON_BUILD),true)
+    $(warning BUILD_MULTI_ARCH_IMAGES=true with PUSH_ON_BUILD=false leaves \
+the manifest list in the buildx cache only: the local docker image store \
+cannot hold it, so the push-% targets will not find the image. Set \
+PUSH_ON_BUILD=true to push from the builder.)
+  endif
+else
+  BUILDX :=
+  DOCKER_BUILD_OPTIONS =
+endif
+
+IMAGE_TAG = $(VERSION)-$(DIST)
+IMAGE = $(IMAGE_NAME):$(IMAGE_TAG)
+
+# Pushes can retag into a different registry/version than the local build.
+OUT_IMAGE_NAME ?= $(IMAGE_NAME)
+OUT_IMAGE_VERSION ?= $(VERSION)
+OUT_IMAGE = $(OUT_IMAGE_NAME):$(OUT_IMAGE_VERSION)-$(DIST)
+
+build-%: DIST = $(*)
+build-%: DOCKERFILE = deployments/container/Dockerfile$(DOCKERFILE_SUFFIX)
+build-slim: DOCKERFILE_SUFFIX :=
+build-ubi9: DOCKERFILE_SUFFIX := .ubi9
+
+$(BUILD_TARGETS): build-%:
+	DOCKER_BUILDKIT=1 $(DOCKER) $(BUILDX) build --pull \
+		$(DOCKER_BUILD_OPTIONS) \
+		--tag $(IMAGE) \
+		--build-arg VERSION="$(VERSION)" \
+		-f $(DOCKERFILE) $(CURDIR)
+
+push-%: DIST = $(*)
+
+$(PUSH_TARGETS): push-%:
+	$(DOCKER) tag "$(IMAGE)" "$(OUT_IMAGE)"
+	$(DOCKER) push "$(OUT_IMAGE)"
+
+# The default distribution also pushes the bare-version short tag.
+push-$(DEFAULT_PUSH_TARGET): push-short
+push-short: DIST = $(DEFAULT_PUSH_TARGET)
+push-short:
+	$(DOCKER) tag "$(IMAGE)" "$(OUT_IMAGE_NAME):$(OUT_IMAGE_VERSION)"
+	$(DOCKER) push "$(OUT_IMAGE_NAME):$(OUT_IMAGE_VERSION)"

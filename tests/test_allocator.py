@@ -221,3 +221,14 @@ class TestStatefulAllocator:
         topo = build_fake_topology(4, 4)
         alloc = new_best_effort_allocator(topo)
         assert alloc.remaining == ids(4)
+
+    def test_double_free_rejected(self):
+        from tpu_device_plugin.allocator import new_simple_allocator
+
+        alloc = new_simple_allocator(ids(2))
+        got = alloc.allocate(1)
+        alloc.free(got)
+        with pytest.raises(PolicyError, match="stale or double free"):
+            alloc.free(got)
+        # The pool is unchanged by the rejected free.
+        assert alloc.remaining == ids(2)

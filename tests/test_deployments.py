@@ -64,3 +64,62 @@ def test_helm_values_cover_wired_env_vars():
         assert not missing, (
             f"values.yaml missing top-level keys {missing} used by {os.path.basename(path)}"
         )
+
+
+def test_packaging_make_targets_expand():
+    """The per-distribution image targets (packaging.mk, reference analog
+    deployments/container/{Makefile,multi-arch.mk,native-only.mk}) expand to
+    the right Dockerfile, tag, and push lines — checked via `make -n` so no
+    docker daemon is needed."""
+    import subprocess
+
+    def dry_run(*args):
+        out = subprocess.run(
+            ["make", "-n", *args], capture_output=True, text=True, cwd=REPO
+        )
+        assert out.returncode == 0, out.stderr
+        return out.stdout
+
+    slim = dry_run("build-slim", "VERSION=v9.9.9")
+    assert "--tag tpu-device-plugin:v9.9.9-slim" in slim
+    assert "-f deployments/container/Dockerfile " in slim
+
+    ubi9 = dry_run("build-ubi9", "VERSION=v9.9.9")
+    assert "--tag tpu-device-plugin:v9.9.9-ubi9" in ubi9
+    assert "-f deployments/container/Dockerfile.ubi9" in ubi9
+
+    multi = dry_run(
+        "build-slim", "BUILD_MULTI_ARCH_IMAGES=true", "PUSH_ON_BUILD=true"
+    )
+    assert "buildx build" in multi
+    assert "--platform=linux/amd64,linux/arm64" in multi
+    assert "push=true" in multi
+
+    push = dry_run(
+        "push-slim", "VERSION=v9.9.9", "OUT_IMAGE_NAME=reg.example/tpu-device-plugin"
+    )
+    # The default distribution pushes both the dist tag and the short tag.
+    assert 'docker push "reg.example/tpu-device-plugin:v9.9.9-slim"' in push
+    assert 'docker push "reg.example/tpu-device-plugin:v9.9.9"' in push
+
+    push_ubi9 = dry_run("push-ubi9", "VERSION=v9.9.9")
+    assert 'docker push "tpu-device-plugin:v9.9.9-ubi9"' in push_ubi9
+    # Only the default distribution pushes the bare-version short tag.
+    assert ':v9.9.9"' not in push_ubi9
+
+
+def test_ubi9_dockerfile_mirrors_slim_stages():
+    """Both image flavors assemble the same payload: libtpuinfo build stage +
+    daemon runtime with the same entrypoint."""
+    slim = open(os.path.join(REPO, "deployments", "container", "Dockerfile")).read()
+    ubi9 = open(
+        os.path.join(REPO, "deployments", "container", "Dockerfile.ubi9")
+    ).read()
+    for needle in (
+        "make -C /src/native",
+        "COPY tpu_device_plugin/ /app/tpu_device_plugin/",
+        "COPY --from=build /src/native/libtpuinfo.so /app/native/libtpuinfo.so",
+        'ENTRYPOINT ["python", "-m", "tpu_device_plugin.main"]',
+    ):
+        assert needle in slim, needle
+        assert needle in ubi9, needle

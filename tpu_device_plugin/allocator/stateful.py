@@ -15,9 +15,10 @@ Differences from the reference, on purpose:
 * ``allocate(num)`` returns ``[]`` when the policy cannot satisfy ``num``
   (reference: empty slice) but re-raises genuine request errors from
   ``allocate_specific`` instead of panicking (allocator.go:86-90).
-* ``free`` only accepts IDs that belong to this allocator's universe; the
-  reference silently inserts arbitrary devices into ``remaining``
-  (allocator.go:115-119), which can grow the pool past the hardware.
+* ``free`` only accepts IDs that are currently allocated; the reference
+  silently inserts arbitrary devices into ``remaining`` (allocator.go:115-119),
+  which can grow the pool past the hardware — and a permissive free would let
+  a stale double-free release chips a later caller now holds.
 """
 
 from __future__ import annotations
@@ -74,12 +75,19 @@ class Allocator:
 
     def free(self, device_ids: Sequence[str]) -> None:
         """Return chips to the pool (allocator.go:115-119; see module note on
-        the unknown-ID guard)."""
+        the strictness guard).  All-or-nothing: rejecting stale/double frees
+        keeps a buggy caller from releasing chips a later caller now holds."""
         requested = set(device_ids)
         unknown = requested - self._all
         if unknown:
             raise PolicyError(
                 f"devices {sorted(unknown)} do not belong to this allocator"
+            )
+        stale = requested - self._allocated
+        if stale:
+            raise PolicyError(
+                f"devices {sorted(stale)} are not currently allocated "
+                f"(stale or double free)"
             )
         self._allocated -= requested
         self._remaining |= requested

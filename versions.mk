@@ -1,0 +1,13 @@
+# Central version pins consumed by the Makefile and image packaging
+# (reference analog: versions.mk).
+
+VERSION ?= v0.1.0
+
+# Container image coordinates.  REGISTRY is empty for local-only builds;
+# set REGISTRY=<host>/<org> to namespace pushes.
+REGISTRY ?=
+IMAGE_NAME ?= $(if $(REGISTRY),$(REGISTRY)/)tpu-device-plugin
+
+# Toolchain floors (informational; the devel image and CI enforce them).
+PYTHON_MIN_VERSION := 3.10
+CXX_STANDARD := c++17
