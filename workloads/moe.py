@@ -1,0 +1,222 @@
+"""Mixture-of-Experts FFN with expert parallelism, GShard-style.
+
+The expert-parallel (ep) axis of the workload suite: the transformer's FFN
+is replaced by N experts whose weights shard over an "expert" mesh axis.
+Routing is top-1 with a fixed per-expert capacity, expressed as dense
+one-hot dispatch/combine einsums — every shape is static, so the whole
+layer jits into a handful of MXU matmuls and XLA inserts the expert-axis
+collectives from the sharding annotations alone (the idiomatic TPU
+formulation; no hand-written all_to_all).
+
+Capacity keeps the computation static: each expert processes at most
+C = ceil(seq * capacity_factor / n_experts) tokens per sequence; overflow
+tokens are dropped from the expert path (their residual stream passes
+through unchanged — standard top-1 MoE behavior).  A load-balancing
+auxiliary loss (mean gate mass x token fraction per expert, scaled by E)
+keeps the router from collapsing onto one expert.
+
+Composes with the flagship model: ``init_moe_model_params`` /
+``moe_loss_fn`` swap the dense FFN of ``workloads.model`` for this layer,
+trained over a ("data", "expert", "model") mesh — dp x ep x tp in one step
+(__graft_entry__.dryrun_multichip).
+
+Reference pendant: none — the reference daemon has no model code; this
+belongs to the JAX workload suite exercising multi-chip slices the device
+plugin allocates (SURVEY.md §2 parallelism checklist note).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .model import ModelConfig, _attention, _rmsnorm
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int = 4
+    capacity_factor: float = 2.0
+    aux_loss_weight: float = 0.01
+
+
+def init_moe_ffn_params(key: jax.Array, d_model: int, d_ff: int, n_experts: int):
+    k = jax.random.split(key, 3)
+    scale = 0.02
+
+    def dense(kk, shape):
+        return jax.random.normal(kk, shape, jnp.float32) * scale
+
+    return {
+        "router": dense(k[0], (d_model, n_experts)),
+        "w_up": dense(k[1], (n_experts, d_model, d_ff)),
+        "w_down": dense(k[2], (n_experts, d_ff, d_model)),
+    }
+
+
+def moe_ffn_specs() -> dict:
+    """Experts shard over the "expert" axis; the tiny router replicates."""
+    return {
+        "router": P(),
+        "w_up": P("expert", None, None),
+        "w_down": P("expert", None, None),
+    }
+
+
+def expert_capacity(seq: int, n_experts: int, capacity_factor: float) -> int:
+    return max(1, math.ceil(seq * capacity_factor / n_experts))
+
+
+def moe_ffn(params: dict, x: jax.Array, moe: MoEConfig):
+    """Top-1 MoE FFN.  x: [batch, seq, d_model] -> (y, aux_loss).
+
+    Dense dispatch: gather/scatter is two einsums against one-hot masks, so
+    the per-expert batch [E, batch, C, d] is a static-shape tensor sharded
+    on the expert axis.
+    """
+    batch, seq, d_model = x.shape
+    n_experts = params["router"].shape[1]
+    cap = expert_capacity(seq, n_experts, moe.capacity_factor)
+
+    # Route in float32: tiny tensors, and argmax/softmax stability matters.
+    logits = jnp.einsum(
+        "bsd,de->bse", x.astype(jnp.float32), params["router"]
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [b, s, E]
+    expert_idx = jnp.argmax(probs, axis=-1)  # [b, s]
+    gate = jnp.max(probs, axis=-1)  # [b, s]
+
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)  # [b,s,E]
+    # Position of each token within its expert's buffer (first-come order
+    # along the sequence), and the capacity cut.
+    pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0  # [b, s, E], -1 if not routed
+    kept = (pos >= 0) & (pos < cap)
+    dispatch = (
+        jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+        * kept[..., None]
+    )
+    # dispatch: [b, s, E, C] — 1 where token (b, s) occupies slot (e, c).
+    combine = dispatch * gate[..., None, None]
+
+    # Load-balancing aux loss (GShard eq. 4): E * Σ_e fraction_e * gatemass_e.
+    fraction = jnp.mean(onehot, axis=(0, 1))  # tokens routed to e
+    gate_mass = jnp.mean(probs, axis=(0, 1))
+    aux = moe.aux_loss_weight * n_experts * jnp.sum(fraction * gate_mass)
+
+    compute_dtype = x.dtype
+    expert_in = jnp.einsum(
+        "bsec,bsd->ebcd", dispatch.astype(compute_dtype), x
+    )  # [E, b, C, d]
+    hidden = jax.nn.gelu(
+        jnp.einsum("ebcd,edf->ebcf", expert_in, params["w_up"].astype(compute_dtype))
+    )
+    expert_out = jnp.einsum(
+        "ebcf,efd->ebcd", hidden, params["w_down"].astype(compute_dtype)
+    )
+    y = jnp.einsum("bsec,ebcd->bsd", combine.astype(compute_dtype), expert_out)
+    return y, aux
+
+
+def init_moe_model_params(
+    config: ModelConfig, moe: MoEConfig, key: jax.Array
+) -> dict:
+    """The flagship transformer with its dense FFN swapped for MoE."""
+    from .model import init_params
+
+    params = init_params(config, key)
+    # Fresh key stream: splitting `key` again would replay the exact keys
+    # init_params consumed, making MoE weights bit-identical to attention
+    # weights of the neighbouring layer.
+    keys = jax.random.split(jax.random.fold_in(key, 1), config.n_layers)
+    for i, layer in enumerate(params["layers"]):
+        del layer["w_up"], layer["w_down"]
+        layer["moe"] = init_moe_ffn_params(
+            keys[i], config.d_model, config.d_ff, moe.n_experts
+        )
+    return params
+
+
+def moe_param_specs(config: ModelConfig) -> dict:
+    """Attention keeps the Megatron "model" cut; experts shard on "expert"."""
+    from .model import param_specs
+
+    specs = param_specs(config)
+    for layer in specs["layers"]:
+        del layer["w_up"], layer["w_down"]
+        layer["moe"] = moe_ffn_specs()
+    return specs
+
+
+def moe_forward(
+    params: dict, tokens: jax.Array, config: ModelConfig, moe: MoEConfig
+):
+    """Logits + total aux loss for the MoE transformer."""
+    x = params["embed"].astype(config.dtype)[tokens]
+    aux_total = jnp.float32(0.0)
+    for layer in params["layers"]:
+        x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, config)
+        ffn_out, aux = moe_ffn(layer["moe"], _rmsnorm(x, layer["ln2"]), moe)
+        x = x + ffn_out
+        aux_total = aux_total + aux
+    return x.astype(jnp.float32) @ params["unembed"], aux_total
+
+
+def moe_loss_fn(
+    params: dict, tokens: jax.Array, config: ModelConfig, moe: MoEConfig
+) -> jax.Array:
+    """Causal LM cross-entropy + router load-balancing loss."""
+    logits, aux = moe_forward(params, tokens[:, :-1], config, moe)
+    targets = tokens[:, 1:]
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll) + aux
+
+
+def make_moe_mesh(
+    n_devices: int, expert_parallel: int = 2, model_parallel: int = 1
+):
+    """A ("data", "expert", "model") mesh: dp x ep x tp."""
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devices = jax.devices()[:n_devices]
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"requested a {n_devices}-device mesh but only "
+            f"{len(devices)} devices are visible"
+        )
+    denom = expert_parallel * model_parallel
+    if n_devices % denom:
+        raise ValueError(f"{n_devices} devices not divisible by ep*tp={denom}")
+    grid = np.array(devices).reshape(
+        n_devices // denom, expert_parallel, model_parallel
+    )
+    return Mesh(grid, axis_names=("data", "expert", "model"))
+
+
+def make_moe_train_state(
+    config: ModelConfig, moe: MoEConfig, mesh, seed: int = 0
+):
+    """(params, opt_state) placed per moe_param_specs, + the optimizer."""
+    from .train import make_sharded_train_state
+
+    return make_sharded_train_state(
+        mesh,
+        lambda: init_moe_model_params(config, moe, jax.random.PRNGKey(seed)),
+        moe_param_specs(config),
+    )
+
+
+def make_moe_train_step(config: ModelConfig, moe: MoEConfig, mesh, optimizer):
+    """The full dp x ep x tp training step: forward (attention tensor-
+    parallel, FFN expert-parallel), backward, Adam update — XLA derives
+    every collective from the shardings."""
+    from .train import make_sharded_train_step
+
+    return make_sharded_train_step(
+        lambda p, t: moe_loss_fn(p, t, config, moe), mesh, optimizer
+    )

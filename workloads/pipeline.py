@@ -1,0 +1,220 @@
+"""Pipeline parallelism: GPipe-schedule training over a "pipe" mesh axis.
+
+The pp axis of the workload suite.  The flagship transformer's layer stack
+is split into S contiguous stages whose (stacked) weights shard over the
+mesh's "pipe" axis; the batch is split into M microbatches that stream
+through the stages.  Expressed the idiomatic TPU way: one jitted
+``shard_map`` whose body runs a ``lax.scan`` over the M+S-1 schedule steps,
+passing activations stage-to-stage with ``lax.ppermute`` (ICI neighbour
+transfers) — no host-side scheduling, no per-stage processes; XLA sees one
+static program.  Differentiable end-to-end (scan + ppermute transpose), so
+the full fwd+bwd+Adam step jits over ("data", "pipe"): dp x pp.
+
+Embedding/unembedding are replicated and computed outside the pipelined
+region (they are tiny at these sizes); only the transformer blocks are
+staged.  Bubble fraction is the GPipe (S-1)/(M+S-1); pick M >= S.
+
+Reference pendant: none — the reference daemon has no model code; this
+belongs to the JAX workload suite exercising multi-chip slices the device
+plugin allocates (SURVEY.md §2 parallelism checklist note).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.4.35
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from .model import ModelConfig, _attention, _mlp, _rmsnorm, init_params
+
+
+def make_pp_mesh(n_devices: int, pipe_parallel: int = 2) -> Mesh:
+    """A ("data", "pipe") mesh: batch data-parallel, layers staged."""
+    devices = jax.devices()[:n_devices]
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"requested a {n_devices}-device mesh but only "
+            f"{len(devices)} devices are visible"
+        )
+    if n_devices % pipe_parallel:
+        raise ValueError(
+            f"{n_devices} devices not divisible by pipe_parallel={pipe_parallel}"
+        )
+    grid = np.array(devices).reshape(n_devices // pipe_parallel, pipe_parallel)
+    return Mesh(grid, axis_names=("data", "pipe"))
+
+
+def init_pipeline_params(config: ModelConfig, n_stages: int, key: jax.Array):
+    """Flagship params with the layer list stacked into [S, L/S, ...] leaves
+    (stage-major), ready to shard on the "pipe" axis."""
+    if config.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers ({config.n_layers}) must divide into {n_stages} stages"
+        )
+    params = init_params(config, key)
+    layers = params.pop("layers")
+    per_stage = config.n_layers // n_stages
+    stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *layers)
+    stacked = jax.tree.map(
+        lambda leaf: leaf.reshape((n_stages, per_stage) + leaf.shape[1:]), stacked
+    )
+    params["stages"] = stacked
+    return params
+
+
+def pipeline_param_specs(config: ModelConfig) -> dict:
+    """Stage-stacked leaves shard their leading dim on "pipe"."""
+    layer = {
+        "ln1": P("pipe"),
+        "ln2": P("pipe"),
+        "wqkv": P("pipe"),
+        "wo": P("pipe"),
+        "w_up": P("pipe"),
+        "w_down": P("pipe"),
+    }
+    return {"embed": P(), "unembed": P(), "stages": layer}
+
+
+def _stage_blocks(local_layers: dict, x: jax.Array, config: ModelConfig):
+    """Apply this stage's L/S transformer blocks (leaves [L/S, ...])."""
+
+    def block(carry, layer):
+        h = carry + _attention(_rmsnorm(carry, layer["ln1"]), layer, config)
+        h = h + _mlp(_rmsnorm(h, layer["ln2"]), layer)
+        return h, None
+
+    out, _ = jax.lax.scan(block, x, local_layers)
+    return out
+
+
+def _pipeline_local(
+    stages, x_mb, *, config: ModelConfig, n_stages: int, n_microbatches: int
+):
+    """Per-device body: stages leaves [1, L/S, ...] (this stage's slice),
+    x_mb [M, mb_local, s, d].  Returns [M, mb_local, s, d] — the last
+    stage's outputs, replicated over "pipe" via a masked psum."""
+    local_layers = jax.tree.map(lambda leaf: leaf[0], stages)
+    stage = jax.lax.axis_index("pipe")
+    m, mb, seq, d = x_mb.shape
+    is_first = (stage == 0).astype(x_mb.dtype)
+    is_last = (stage == n_stages - 1).astype(x_mb.dtype)
+
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def schedule_step(carry, t):
+        state, ys = carry
+        # Activations flow one stage down the ring; stage 0 instead picks up
+        # the next microbatch (clamped index: past-the-end steps reprocess
+        # the last microbatch, and their products never reach collection).
+        incoming = jax.lax.ppermute(state, "pipe", perm)
+        xt = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.minimum(t, n_microbatches - 1), keepdims=False
+        )
+        inp = is_first * xt + (1 - is_first) * incoming
+        out = _stage_blocks(local_layers, inp, config)
+        # The last stage banks microbatch t-(S-1) once the pipe has filled.
+        idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
+        collect = is_last * (t >= n_stages - 1).astype(x_mb.dtype)
+        slot = jax.lax.dynamic_index_in_dim(ys, idx, keepdims=False)
+        ys = jax.lax.dynamic_update_index_in_dim(
+            ys, collect * out + (1 - collect) * slot, idx, 0
+        )
+        return (out, ys), None
+
+    state0 = jnp.zeros((mb, seq, d), x_mb.dtype)
+    ys0 = jnp.zeros_like(x_mb)
+    (_, ys), _ = jax.lax.scan(
+        schedule_step, (state0, ys0), jnp.arange(m + n_stages - 1)
+    )
+    # Only the last stage holds real outputs; psum replicates them pipe-wide.
+    return jax.lax.psum(is_last * ys, "pipe")
+
+
+def pipeline_forward(
+    params: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    mesh: Mesh,
+    n_microbatches: int,
+) -> jax.Array:
+    """Logits via the pipelined layer stack.  tokens: [batch, T] with batch
+    divisible by n_microbatches x mesh["data"]."""
+    n_stages = mesh.shape["pipe"]
+    batch, seq = tokens.shape
+    if batch % n_microbatches:
+        raise ValueError(
+            f"batch {batch} not divisible by n_microbatches={n_microbatches}"
+        )
+    x = params["embed"].astype(config.dtype)[tokens]
+    x_mb = x.reshape(n_microbatches, batch // n_microbatches, seq, -1)
+
+    body = partial(
+        _pipeline_local,
+        config=config,
+        n_stages=n_stages,
+        n_microbatches=n_microbatches,
+    )
+    stage_spec = jax.tree.map(lambda _: P("pipe"), params["stages"])
+    act_spec = P(None, "data", None, None)
+    kwargs = dict(
+        mesh=mesh, in_specs=(stage_spec, act_spec), out_specs=act_spec
+    )
+    try:
+        run = shard_map(body, check_vma=False, **kwargs)
+    except TypeError:  # pragma: no cover - older jax spells it check_rep
+        run = shard_map(body, check_rep=False, **kwargs)
+    ys = run(params["stages"], x_mb)
+    ys = ys.reshape(batch, seq, -1)
+    return ys.astype(jnp.float32) @ params["unembed"]
+
+
+def pipeline_loss_fn(
+    params: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    mesh: Mesh,
+    n_microbatches: int,
+) -> jax.Array:
+    """Causal LM loss through the pipeline (same contract as model.loss_fn)."""
+    logits = pipeline_forward(params, tokens[:, :-1], config, mesh, n_microbatches)
+    targets = tokens[:, 1:]
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def make_pipeline_train_state(
+    config: ModelConfig, mesh: Mesh, seed: int = 0
+):
+    """(params, opt_state) with stages sharded on "pipe"."""
+    from .train import make_sharded_train_state
+
+    n_stages = mesh.shape["pipe"]
+    return make_sharded_train_state(
+        mesh,
+        lambda: init_pipeline_params(config, n_stages, jax.random.PRNGKey(seed)),
+        pipeline_param_specs(config),
+    )
+
+
+def make_pipeline_train_step(
+    config: ModelConfig, mesh: Mesh, optimizer, n_microbatches: int = 4
+):
+    """The full dp x pp training step: pipelined forward, backward through
+    the schedule (scan/ppermute transpose), Adam update."""
+    from .train import make_sharded_train_step
+
+    return make_sharded_train_step(
+        lambda p, t: pipeline_loss_fn(p, t, config, mesh, n_microbatches),
+        mesh,
+        optimizer,
+    )

@@ -47,24 +47,54 @@ def make_mesh(n_devices: int | None = None, model_parallel: int | None = None) -
     return Mesh(grid, axis_names=("data", "model"))
 
 
-def make_train_state(config: ModelConfig, mesh: Mesh, seed: int = 0):
-    """(params, opt_state) placed according to the tensor-parallel specs."""
-    optimizer = optax.adamw(1e-3)
-    specs = param_specs(config)
+def make_sharded_train_state(mesh: Mesh, init_fn, specs, optimizer=None):
+    """Generic sharded state init: jit ``init_fn`` (-> params pytree) with
+    out_shardings from ``specs``; optimizer moments shard exactly like their
+    parameters.  Shared by the tensor-, expert- and pipeline-parallel
+    variants (workloads/{train,moe,pipeline}.py)."""
+    optimizer = optax.adamw(1e-3) if optimizer is None else optimizer
 
     def init():
-        params = init_params(config, jax.random.PRNGKey(seed))
+        params = init_fn()
         return params, optimizer.init(params)
 
     param_shardings = jax.tree.map(
         lambda spec: NamedSharding(mesh, spec), specs,
         is_leaf=lambda x: isinstance(x, P),
     )
-    # Optimizer moments shard exactly like their parameters.
     params_shape, opt_shape = jax.eval_shape(init)
     opt_shardings = _opt_shardings_like(opt_shape, params_shape, param_shardings, mesh)
     init_jit = jax.jit(init, out_shardings=(param_shardings, opt_shardings))
     return init_jit(), optimizer
+
+
+def make_sharded_train_step(loss_fn, mesh: Mesh, optimizer):
+    """Generic full train step for a ``loss_fn(params, tokens)``: forward,
+    backward, optimizer update, jitted with donated state; tokens land
+    batch-sharded on "data"."""
+    data_sharding = NamedSharding(mesh, P("data", None))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def step(params, opt_state, tokens):
+        tokens = jax.device_put(tokens, data_sharding)
+        return train_step(params, opt_state, tokens)
+
+    return step
+
+
+def make_train_state(config: ModelConfig, mesh: Mesh, seed: int = 0):
+    """(params, opt_state) placed according to the tensor-parallel specs."""
+    return make_sharded_train_state(
+        mesh,
+        lambda: init_params(config, jax.random.PRNGKey(seed)),
+        param_specs(config),
+    )
 
 
 def _opt_shardings_like(opt_shape, params_shape, param_shardings, mesh):
@@ -153,41 +183,17 @@ def make_seq_parallel_train_step(
     # Tokens keep the odd max_seq_len (the LM loss drops one position), so
     # they shard on data only; the seq axis materialises on the sliced
     # activations inside the step via ring attention's shard_map.
-    data_sharding = NamedSharding(mesh, P("data", None))
-
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p, t: loss_fn(p, t, config, attention_fn)
-        )(params, tokens)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    def step(params, opt_state, tokens):
-        tokens = jax.device_put(tokens, data_sharding)
-        return train_step(params, opt_state, tokens)
-
-    return step
+    return make_sharded_train_step(
+        lambda p, t: loss_fn(p, t, config, attention_fn), mesh, optimizer
+    )
 
 
 def make_train_step(config: ModelConfig, mesh: Mesh, optimizer):
     """The jitted full training step: (params, opt_state, tokens) ->
     (params, opt_state, loss)."""
-    data_sharding = NamedSharding(mesh, P("data", None))
-
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, config)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    def step(params, opt_state, tokens):
-        tokens = jax.device_put(tokens, data_sharding)
-        return train_step(params, opt_state, tokens)
-
-    return step
+    return make_sharded_train_step(
+        lambda p, t: loss_fn(p, t, config), mesh, optimizer
+    )
 
 
 def synthetic_batch(config: ModelConfig, batch_size: int, seed: int = 0) -> jax.Array:
