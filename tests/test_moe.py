@@ -57,9 +57,9 @@ def test_capacity_drops_overflow_tokens():
     token per sequence goes through the expert path."""
     d_model, d_ff = 8, 16
     params = init_moe_ffn_params(jax.random.PRNGKey(0), d_model, d_ff, 2)
-    # Huge bias toward expert 0 for every token.
-    params["router"] = jnp.zeros_like(params["router"]).at[:, 0].set(0.0)
-    params["router"] = params["router"].at[0, 0].set(100.0)
+    # Huge bias toward expert 0 for every token (x is all-ones, so any
+    # positive weight in column 0 dominates the zeroed column 1).
+    params["router"] = jnp.zeros_like(params["router"]).at[0, 0].set(100.0)
     x = jnp.ones((1, 4, d_model), jnp.float32)
     moe = MoEConfig(n_experts=2, capacity_factor=0.5)  # cap = 1
     y, _ = moe_ffn(params, x, moe)

@@ -1,0 +1,67 @@
+"""Checkpoint / resume for the training workloads (orbax).
+
+The reference daemon is stateless and ships no checkpointing at all
+(SURVEY.md §5: "Checkpoint / resume: none"); the training workloads here
+are long-running JAX jobs on shared/preempted TPU chips, where resume is
+table stakes — a time-sliced pod can be rescheduled at any point.  This
+module wraps orbax's CheckpointManager with the two things every workload
+step needs:
+
+  * ``save(step, (params, opt_state))`` — async-safe, versioned, retained
+    up to ``max_to_keep``.
+  * ``restore_latest(like=(params, opt_state))`` — sharding-aware: the
+    restored leaves land directly on the donor state's devices/shardings
+    (a resumed pod restores straight onto its ("data", "model", ...) mesh
+    without a host-memory detour).
+
+Works with every state layout in the suite (tensor-, expert-, pipeline-
+parallel) since state is just a pytree + shardings.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class TrainCheckpointer:
+    """Thin, version-tolerant wrapper over ocp.CheckpointManager."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self._manager = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state) -> None:
+        self._manager.save(step, args=ocp.args.StandardSave(state))
+
+    def wait(self) -> None:
+        """Block until pending async saves are durable."""
+        self._manager.wait_until_finished()
+
+    @property
+    def latest_step(self) -> int | None:
+        return self._manager.latest_step()
+
+    def restore_latest(self, like):
+        """Restore the newest checkpoint shaped/sharded like ``like`` (a
+        live state pytree or an eval_shape of one); None if no checkpoint
+        exists."""
+        step = self._manager.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=getattr(leaf, "sharding", None)
+            ),
+            like,
+        )
+        return self._manager.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def close(self) -> None:
+        self._manager.close()

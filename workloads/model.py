@@ -158,15 +158,20 @@ def forward(
     return (x.astype(jnp.float32) @ params["unembed"])
 
 
+def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean next-token NLL; shared by every loss variant (dense, MoE,
+    pipeline)."""
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
 def loss_fn(
     params: dict, tokens: jax.Array, config: ModelConfig, attention_fn=None
 ) -> jax.Array:
     """Causal LM cross-entropy: predict tokens[:, 1:] from tokens[:, :-1]."""
     logits = forward(params, tokens[:, :-1], config, attention_fn)
-    targets = tokens[:, 1:]
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return cross_entropy(logits, tokens[:, 1:])
 
 
 def make_forward_fn(config: ModelConfig):

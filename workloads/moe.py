@@ -169,11 +169,10 @@ def moe_loss_fn(
     params: dict, tokens: jax.Array, config: ModelConfig, moe: MoEConfig
 ) -> jax.Array:
     """Causal LM cross-entropy + router load-balancing loss."""
+    from .model import cross_entropy
+
     logits, aux = moe_forward(params, tokens[:, :-1], config, moe)
-    targets = tokens[:, 1:]
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll) + aux
+    return cross_entropy(logits, tokens[:, 1:]) + aux
 
 
 def make_moe_mesh(

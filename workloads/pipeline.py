@@ -185,11 +185,10 @@ def pipeline_loss_fn(
     n_microbatches: int,
 ) -> jax.Array:
     """Causal LM loss through the pipeline (same contract as model.loss_fn)."""
+    from .model import cross_entropy
+
     logits = pipeline_forward(params, tokens[:, :-1], config, mesh, n_microbatches)
-    targets = tokens[:, 1:]
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return cross_entropy(logits, tokens[:, 1:])
 
 
 def make_pipeline_train_state(

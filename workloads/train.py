@@ -201,3 +201,64 @@ def synthetic_batch(config: ModelConfig, batch_size: int, seed: int = 0) -> jax.
     return jax.random.randint(
         key, (batch_size, config.max_seq_len), 0, config.vocab_size, jnp.int32
     )
+
+
+def main(argv=None) -> int:
+    """Runnable training entry for the example pods:
+    ``python -m workloads.train --steps 50 --checkpoint-dir /ckpt``.
+
+    Resumes automatically from the newest checkpoint in --checkpoint-dir —
+    a time-sliced/preempted pod restarts and continues where it left off
+    (workloads/checkpoint.py)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="train the flagship model")
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument("--layers", type=int, default=2)
+    parser.add_argument("--checkpoint-dir", default=None)
+    parser.add_argument("--checkpoint-every", type=int, default=10)
+    args = parser.parse_args(argv)
+
+    config = ModelConfig(max_seq_len=args.seq_len, n_layers=args.layers)
+    mesh = make_mesh()
+    (params, opt_state), optimizer = make_train_state(config, mesh)
+    step = make_train_step(config, mesh, optimizer)
+
+    ckpt = None
+    start = 0
+    if args.checkpoint_dir:
+        from .checkpoint import TrainCheckpointer
+
+        ckpt = TrainCheckpointer(args.checkpoint_dir)
+        restored = ckpt.restore_latest(like=(params, opt_state))
+        if restored is not None:
+            params, opt_state = restored
+            start = ckpt.latest_step
+            print(f"resumed from checkpoint step {start}")
+            if start >= args.steps:
+                ckpt.close()
+                print(
+                    f"done: checkpoint step {start} >= --steps {args.steps}; "
+                    f"nothing to do"
+                )
+                return 0
+
+    loss = float("nan")
+    for s in range(start + 1, args.steps + 1):
+        tokens = synthetic_batch(config, args.batch_size, seed=s)
+        params, opt_state, loss = step(params, opt_state, tokens)
+        if ckpt and (s % args.checkpoint_every == 0 or s == args.steps):
+            ckpt.save(s, (params, opt_state))
+        if s % 10 == 0 or s == args.steps:
+            print(f"step {s}: loss={float(loss):.4f}")
+    if ckpt:
+        ckpt.wait()
+        ckpt.close()
+    print(f"done: steps={args.steps} mesh={dict(mesh.shape)} loss={float(loss):.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
