@@ -126,3 +126,17 @@ def test_daemon_serves_device_gauge_and_allocation_counters(tmp_path):
         daemon.request_stop()
         t.join(timeout=10)
         kubelet.stop()
+
+
+def test_observe_seconds_emits_histogram_buckets():
+    from tpu_device_plugin.metrics import Registry
+
+    reg = Registry()
+    reg.observe_seconds("allocate", 0.003, {"resource": "tpu"})
+    reg.observe_seconds("allocate", 0.3, {"resource": "tpu"})
+    out = reg.render()
+    # 3ms lands in every bucket from le=0.005 up; 300ms only in le=0.5/1.0/+Inf.
+    assert 'allocate_seconds_bucket{le="0.005",resource="tpu"} 1' in out
+    assert 'allocate_seconds_bucket{le="0.5",resource="tpu"} 2' in out
+    assert 'allocate_seconds_bucket{le="+Inf",resource="tpu"} 2' in out
+    assert 'allocate_count{resource="tpu"} 2' in out

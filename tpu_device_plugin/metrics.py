@@ -41,10 +41,35 @@ class Registry:
         with self._lock:
             self._counters[key] += value
 
+    # Upper bounds in seconds for handler-latency histograms: sub-ms
+    # resolution around the Allocate p50 target (50 ms) with a long tail.
+    LATENCY_BUCKETS = (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0
+    )
+
     def observe_seconds(self, name: str, seconds: float, labels: dict | None = None) -> None:
-        """Record one timed event as <name>_seconds_total + <name>_count."""
-        self.inc(f"{name}_seconds_total", labels, seconds)
-        self.inc(f"{name}_count", labels, 1.0)
+        """Record one timed event as a Prometheus histogram:
+        <name>_seconds_bucket{le=...} + <name>_seconds_total + <name>_count
+        (sum/count keep their existing series names for dashboards built on
+        them).  All series update under one lock acquisition so a concurrent
+        scrape can never observe non-cumulative buckets."""
+        updates: list[tuple[str, dict | None, float]] = [
+            (f"{name}_seconds_total", labels, seconds),
+            (f"{name}_count", labels, 1.0),
+        ]
+        for le in self.LATENCY_BUCKETS:
+            if seconds <= le:
+                updates.append(
+                    (f"{name}_seconds_bucket", {**(labels or {}), "le": str(le)}, 1.0)
+                )
+        updates.append(
+            (f"{name}_seconds_bucket", {**(labels or {}), "le": "+Inf"}, 1.0)
+        )
+        with self._lock:
+            for series, lab, value in updates:
+                self._counters[
+                    (series, tuple(sorted((lab or {}).items())))
+                ] += value
 
     def register_gauge(self, name: str, collect: Callable[[], list[tuple[dict, float]]]) -> None:
         """collect() returns (labels, value) pairs evaluated at scrape time.

@@ -47,11 +47,15 @@ def make_mesh(n_devices: int | None = None, model_parallel: int | None = None) -
     return Mesh(grid, axis_names=("data", "model"))
 
 
-def make_sharded_train_state(mesh: Mesh, init_fn, specs, optimizer=None):
+def make_sharded_train_state(mesh: Mesh, init_fn, specs, optimizer=None, abstract=False):
     """Generic sharded state init: jit ``init_fn`` (-> params pytree) with
     out_shardings from ``specs``; optimizer moments shard exactly like their
     parameters.  Shared by the tensor-, expert- and pipeline-parallel
-    variants (workloads/{train,moe,pipeline}.py)."""
+    variants (workloads/{train,moe,pipeline}.py).
+
+    ``abstract=True`` returns ShapeDtypeStructs carrying the shardings
+    instead of materialized arrays — a checkpoint-restore target without
+    paying for an initialization that would be thrown away."""
     optimizer = optax.adamw(1e-3) if optimizer is None else optimizer
 
     def init():
@@ -64,6 +68,20 @@ def make_sharded_train_state(mesh: Mesh, init_fn, specs, optimizer=None):
     )
     params_shape, opt_shape = jax.eval_shape(init)
     opt_shardings = _opt_shardings_like(opt_shape, params_shape, param_shardings, mesh)
+    if abstract:
+        def attach(shapes, shardings):
+            return jax.tree.map(
+                lambda leaf, sh: jax.ShapeDtypeStruct(
+                    leaf.shape, leaf.dtype, sharding=sh
+                ),
+                shapes,
+                shardings,
+            )
+
+        return (
+            attach(params_shape, param_shardings),
+            attach(opt_shape, opt_shardings),
+        ), optimizer
     init_jit = jax.jit(init, out_shardings=(param_shardings, opt_shardings))
     return init_jit(), optimizer
 
@@ -88,12 +106,13 @@ def make_sharded_train_step(loss_fn, mesh: Mesh, optimizer):
     return step
 
 
-def make_train_state(config: ModelConfig, mesh: Mesh, seed: int = 0):
+def make_train_state(config: ModelConfig, mesh: Mesh, seed: int = 0, abstract=False):
     """(params, opt_state) placed according to the tensor-parallel specs."""
     return make_sharded_train_state(
         mesh,
         lambda: init_params(config, jax.random.PRNGKey(seed)),
         param_specs(config),
+        abstract=abstract,
     )
 
 
@@ -223,8 +242,6 @@ def main(argv=None) -> int:
 
     config = ModelConfig(max_seq_len=args.seq_len, n_layers=args.layers)
     mesh = make_mesh()
-    (params, opt_state), optimizer = make_train_state(config, mesh)
-    step = make_train_step(config, mesh, optimizer)
 
     ckpt = None
     start = 0
@@ -232,24 +249,32 @@ def main(argv=None) -> int:
         from .checkpoint import TrainCheckpointer
 
         ckpt = TrainCheckpointer(args.checkpoint_dir)
-        restored = ckpt.restore_latest(like=(params, opt_state))
-        if restored is not None:
-            params, opt_state = restored
-            start = ckpt.latest_step
-            print(f"resumed from checkpoint step {start}")
-            if start >= args.steps:
-                ckpt.close()
-                print(
-                    f"done: checkpoint step {start} >= --steps {args.steps}; "
-                    f"nothing to do"
-                )
-                return 0
+    if ckpt is not None and ckpt.latest_step is not None:
+        # Restore onto an abstract target: no throwaway on-device init, so
+        # a preemption restart never holds two copies of the state.
+        abstract_state, optimizer = make_train_state(config, mesh, abstract=True)
+        params, opt_state = ckpt.restore_latest(like=abstract_state)
+        start = ckpt.latest_step
+        print(f"resumed from checkpoint step {start}")
+        if start >= args.steps:
+            ckpt.close()
+            print(
+                f"done: checkpoint step {start} >= --steps {args.steps}; "
+                f"nothing to do"
+            )
+            return 0
+    else:
+        (params, opt_state), optimizer = make_train_state(config, mesh)
+    step = make_train_step(config, mesh, optimizer)
 
     loss = float("nan")
     for s in range(start + 1, args.steps + 1):
         tokens = synthetic_batch(config, args.batch_size, seed=s)
         params, opt_state, loss = step(params, opt_state, tokens)
-        if ckpt and (s % args.checkpoint_every == 0 or s == args.steps):
+        checkpoint_due = (
+            args.checkpoint_every > 0 and s % args.checkpoint_every == 0
+        )
+        if ckpt and (checkpoint_due or s == args.steps):
             ckpt.save(s, (params, opt_state))
         if s % 10 == 0 or s == args.steps:
             print(f"step {s}: loss={float(loss):.4f}")
