@@ -27,7 +27,7 @@ def test_counter_precision_past_six_digits():
     reg.inc("allocations_total", {}, 1_000_001)
     assert "tpu_device_plugin_allocations_total 1000001" in reg.render()
     reg2 = Registry()
-    reg2.inc("allocate_seconds_total", {}, 123456.789012)
+    reg2.inc("request_seconds_sum", {}, 123456.789012)
     assert "123456.789012" in reg2.render()
 
 
@@ -64,7 +64,7 @@ def test_timed_context_manager():
     with metrics.timed("allocate", {"resource": "r"}):
         pass
     text = metrics.registry.render()
-    assert 'tpu_device_plugin_allocate_count{resource="r"}' in text
+    assert 'tpu_device_plugin_allocate_seconds_count{resource="r"}' in text
 
 
 def test_http_scrape():
@@ -121,7 +121,8 @@ def test_daemon_serves_device_gauge_and_allocation_counters(tmp_path):
         body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics").read().decode()
         assert 'allocations_total{resource="google.com/shared-tpu"}' in body
         assert 'devices{health="Healthy",resource="google.com/shared-tpu"} 8' in body
-        assert "allocate_seconds_total" in body
+        assert "allocate_seconds_sum" in body
+        assert "TYPE tpu_device_plugin_allocate_seconds histogram" in body
     finally:
         daemon.request_stop()
         t.join(timeout=10)
@@ -139,4 +140,14 @@ def test_observe_seconds_emits_histogram_buckets():
     assert 'allocate_seconds_bucket{le="0.005",resource="tpu"} 1' in out
     assert 'allocate_seconds_bucket{le="0.5",resource="tpu"} 2' in out
     assert 'allocate_seconds_bucket{le="+Inf",resource="tpu"} 2' in out
-    assert 'allocate_count{resource="tpu"} 2' in out
+    assert 'allocate_seconds_count{resource="tpu"} 2' in out
+    assert 'allocate_seconds_sum{resource="tpu"}' in out
+    # One TYPE line for the whole family, marked histogram, buckets in
+    # ascending le order with +Inf last.
+    assert out.count("TYPE tpu_device_plugin_allocate_seconds ") == 1
+    assert "TYPE tpu_device_plugin_allocate_seconds histogram" in out
+    bucket_lines = [l for l in out.splitlines() if "_bucket" in l]
+    les = [l.split('le="')[1].split('"')[0] for l in bucket_lines]
+    inf_pos = les.index("+Inf")
+    floats = [float(x) for x in les[:inf_pos]]
+    assert floats == sorted(floats)

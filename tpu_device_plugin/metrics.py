@@ -36,10 +36,13 @@ class Registry:
         with self._lock:
             self._help[name] = help_text
 
+    @staticmethod
+    def _key(name: str, labels: dict | None) -> tuple:
+        return (name, tuple(sorted((labels or {}).items())))
+
     def inc(self, name: str, labels: dict | None = None, value: float = 1.0) -> None:
-        key = (name, tuple(sorted((labels or {}).items())))
         with self._lock:
-            self._counters[key] += value
+            self._counters[self._key(name, labels)] += value
 
     # Upper bounds in seconds for handler-latency histograms: sub-ms
     # resolution around the Allocate p50 target (50 ms) with a long tail.
@@ -48,14 +51,13 @@ class Registry:
     )
 
     def observe_seconds(self, name: str, seconds: float, labels: dict | None = None) -> None:
-        """Record one timed event as a Prometheus histogram:
-        <name>_seconds_bucket{le=...} + <name>_seconds_total + <name>_count
-        (sum/count keep their existing series names for dashboards built on
-        them).  All series update under one lock acquisition so a concurrent
-        scrape can never observe non-cumulative buckets."""
+        """Record one timed event as a standard Prometheus histogram family
+        ``<name>_seconds``: _bucket{le=...} / _sum / _count.  All series
+        update under one lock acquisition so a concurrent scrape can never
+        observe non-cumulative buckets."""
         updates: list[tuple[str, dict | None, float]] = [
-            (f"{name}_seconds_total", labels, seconds),
-            (f"{name}_count", labels, 1.0),
+            (f"{name}_seconds_sum", labels, seconds),
+            (f"{name}_seconds_count", labels, 1.0),
         ]
         for le in self.LATENCY_BUCKETS:
             if seconds <= le:
@@ -67,9 +69,7 @@ class Registry:
         )
         with self._lock:
             for series, lab, value in updates:
-                self._counters[
-                    (series, tuple(sorted((lab or {}).items())))
-                ] += value
+                self._counters[self._key(series, lab)] += value
 
     def register_gauge(self, name: str, collect: Callable[[], list[tuple[dict, float]]]) -> None:
         """collect() returns (labels, value) pairs evaluated at scrape time.
@@ -113,14 +113,35 @@ class Registry:
                 return str(int(value))
             return repr(value)
 
+        def family_of(name: str) -> tuple[str, str]:
+            """(family, type): histogram series share the `<x>_seconds`
+            family so scrapers recognise the _bucket/_sum/_count triple."""
+            for suffix in ("_bucket", "_sum", "_count"):
+                base = name[: -len(suffix)]
+                if name.endswith(suffix) and base.endswith("_seconds"):
+                    return base, "histogram"
+            return name, "counter"
+
+        def le_order(labels: tuple) -> float:
+            le = dict(labels).get("le")
+            if le is None:
+                return float("-inf")  # _sum/_count after buckets is fine
+            return float("inf") if le == "+Inf" else float(le)
+
         seen_help = set()
-        for (name, labels), value in sorted(counters.items()):
-            full = f"{PREFIX}_{name}"
-            if full not in seen_help:
-                lines.append(f"# HELP {full} {help_texts.get(name, name)}")
-                lines.append(f"# TYPE {full} counter")
-                seen_help.add(full)
-            lines.append(f"{full}{fmt_labels(labels)} {fmt_value(value)}")
+        ordered = sorted(
+            counters.items(), key=lambda kv: (kv[0][0], le_order(kv[0][1]), kv[0][1])
+        )
+        for (name, labels), value in ordered:
+            family, mtype = family_of(name)
+            full_family = f"{PREFIX}_{family}"
+            if full_family not in seen_help:
+                lines.append(
+                    f"# HELP {full_family} {help_texts.get(family, family)}"
+                )
+                lines.append(f"# TYPE {full_family} {mtype}")
+                seen_help.add(full_family)
+            lines.append(f"{PREFIX}_{name}{fmt_labels(labels)} {fmt_value(value)}")
         for name, collect in gauges:
             full = f"{PREFIX}_{name}"
             lines.append(f"# HELP {full} {help_texts.get(name, name)}")
@@ -142,14 +163,10 @@ registry.describe("allocation_errors_total", "Allocate requests rejected")
 registry.describe("preferred_allocations_total", "GetPreferredAllocation container requests served")
 registry.describe("health_events_total", "chip health transitions observed")
 registry.describe("plugin_restarts_total", "plugin serve-cycle restarts")
-registry.describe("allocate_seconds_total", "cumulative Allocate handler time")
-registry.describe("allocate_count", "Allocate handler invocations")
+registry.describe("allocate_seconds", "Allocate handler latency histogram")
 registry.describe(
-    "preferred_allocation_seconds_total",
-    "cumulative GetPreferredAllocation handler time",
-)
-registry.describe(
-    "preferred_allocation_count", "GetPreferredAllocation handler invocations"
+    "preferred_allocation_seconds",
+    "GetPreferredAllocation handler latency histogram",
 )
 registry.describe("devices", "advertised devices by resource and health")
 

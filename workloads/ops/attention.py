@@ -75,7 +75,9 @@ def _flash_kernel(
 
     l_safe = jnp.where(l > 0, l, 1.0)  # fully-masked (padded) rows
     o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = m + jnp.log(l_safe)
+    # Trailing unit dim: Mosaic requires 2-D-tileable blocks, and a
+    # [block_q] block cannot tile the (8, 128) constraint on real TPUs.
+    lse_ref[:] = (m + jnp.log(l_safe))[:, None]
 
 
 def _pad_seq(x, multiple):
@@ -122,24 +124,181 @@ def _flash_forward(q, k, v, causal, interpret, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(qf.shape, q.dtype),
-            jax.ShapeDtypeStruct((batch * heads, seq_q_pad), jnp.float32),
+            jax.ShapeDtypeStruct((batch * heads, seq_q_pad, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
 
     out = out[:, :seq].reshape(batch, heads, seq, head_dim).transpose(0, 2, 1, 3)
-    return out, lse[:, :seq]
+    return out, lse[:, :seq, 0]
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, sm_scale, causal, block_q, block_k, seq_valid,
+):
+    """One (batch*head, q-block) grid cell of the backward pass: accumulate
+    dq over k/v blocks.  p is recomputed from (q, k, lse) — the flash
+    recipe's recompute-don't-store backward, as a kernel."""
+    qi = pl.program_id(1)
+    seq_k_pad = k_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:][:, 0]
+    delta = delta_ref[:][:, 0]
+    q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, dq_acc):
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        k_ids = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = (k_ids < seq_valid) & (q_ids < seq_valid)
+        if causal:
+            mask &= k_ids <= q_ids
+        # Explicit zeroing (not just s=-inf): padded q rows carry lse=-inf,
+        # where exp(s - lse) would otherwise produce 1, not 0.
+        p = jnp.exp(jnp.where(mask, s, NEG_INF) - lse[:, None]) * mask
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq_acc + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    n_blocks = seq_k_pad // block_k
+    if causal:
+        n_blocks = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, n_blocks)
+    dq0 = jnp.zeros((block_q, q_ref.shape[1]), jnp.float32)
+    dq_ref[:] = jax.lax.fori_loop(0, n_blocks, body, dq0).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, sm_scale, causal, block_q, block_k, seq_valid,
+):
+    """One (batch*head, k-block) grid cell: accumulate dk/dv over q blocks,
+    starting at the diagonal when causal (earlier q blocks are fully
+    masked)."""
+    ki = pl.program_id(1)
+    seq_q_pad = q_ref.shape[0]
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(qb * block_q, block_q), :][:, 0]
+        delta = delta_ref[pl.ds(qb * block_q, block_q), :][:, 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        q_ids = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        mask = (k_ids < seq_valid) & (q_ids < seq_valid)
+        if causal:
+            mask &= k_ids <= q_ids
+        p = jnp.exp(jnp.where(mask, s, NEG_INF) - lse[:, None]) * mask
+        dv_acc = dv_acc + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_acc = dk_acc + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    start = (ki * block_k) // block_q if causal else 0
+    zeros = jnp.zeros((block_k, k_ref.shape[1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, seq_q_pad // block_q, body, (zeros, zeros))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q, block_k):
+    """dq/dk/dv via the two backward kernels; same layout contract as
+    _flash_forward."""
+    batch, seq, heads, head_dim = q.shape
+    sm_scale = 1.0 / (head_dim**0.5)
+    block_q = min(block_q, max(seq, 1))
+    block_k = min(block_k, max(seq, 1))
+
+    def flat(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(batch * heads, seq, head_dim)
+
+    qf = _pad_seq(flat(q), block_q)
+    dof = _pad_seq(flat(dout), block_q)
+    of = _pad_seq(flat(out), block_q)
+    kf = _pad_seq(flat(k), block_k)
+    vf = _pad_seq(flat(v), block_k)
+    seq_q_pad, seq_k_pad = qf.shape[1], kf.shape[1]
+    # Per-row lse (padded rows -> -inf so they can't fake p=1) and
+    # delta = rowsum(dout * out), the softmax-jacobian diagonal term.
+    lse_pad = jnp.pad(
+        lse, ((0, 0), (0, seq_q_pad - seq)), constant_values=NEG_INF
+    )[..., None]
+    delta = jnp.sum(
+        dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1
+    )[..., None]
+
+    kwargs = dict(
+        sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_valid=seq,
+    )
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **kwargs),
+        grid=(batch * heads, seq_q_pad // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, seq_k_pad, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, seq_k_pad, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_pad, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **kwargs),
+        grid=(batch * heads, seq_k_pad // block_k),
+        in_specs=[
+            pl.BlockSpec((None, seq_q_pad, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, seq_q_pad, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, seq_q_pad, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, seq_q_pad, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(kf.shape, k.dtype),
+            jax.ShapeDtypeStruct(vf.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_pad, delta)
+
+    def unflat(x, seq_len):
+        return (
+            x[:, :seq_len]
+            .reshape(batch, heads, seq_len, head_dim)
+            .transpose(0, 2, 1, 3)
+        )
+
+    return unflat(dq, seq), unflat(dk, seq), unflat(dv, seq)
 
 
 def _default_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q,
     k,
@@ -148,12 +307,17 @@ def flash_attention(
     interpret: bool | None = None,
     block_q: int = 128,
     block_k: int = 128,
+    bwd_impl: str = "pallas",
 ):
     """Scaled-dot-product attention, [batch, seq, heads, head_dim] layout.
 
     ``interpret=None`` auto-selects interpret mode off-TPU so the same code
     runs in CPU tests and compiles to a real kernel on TPU hardware.
+    ``bwd_impl`` picks the backward pass: "pallas" (the blocked recompute
+    kernels — the [seq, seq] matrices never touch HBM in either direction)
+    or "xla" (dense recompute in fused XLA einsums; fine at short seq).
     """
+    _check_bwd_impl(bwd_impl)
     out, _ = _flash_forward(
         q, k, v, causal, _default_interpret() if interpret is None else interpret,
         block_q, block_k,
@@ -161,7 +325,15 @@ def flash_attention(
     return out
 
 
-def _fwd(q, k, v, causal, interpret, block_q, block_k):
+def _check_bwd_impl(bwd_impl: str) -> None:
+    """Validated at the call site (not first grad trace) so a typo fails in
+    the inference code that introduced it, not weeks later in fine-tuning."""
+    if bwd_impl not in ("pallas", "xla"):
+        raise ValueError(f"bwd_impl must be 'pallas' or 'xla', got {bwd_impl!r}")
+
+
+def _fwd(q, k, v, causal, interpret, block_q, block_k, bwd_impl):
+    _check_bwd_impl(bwd_impl)
     out, lse = _flash_forward(
         q, k, v, causal, _default_interpret() if interpret is None else interpret,
         block_q, block_k,
@@ -169,13 +341,10 @@ def _fwd(q, k, v, causal, interpret, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, interpret, block_q, block_k, residuals, dout):
-    """Flash backward: recompute p from (q, k, lse) instead of storing the
-    [seq, seq] probability matrix.  Plain XLA ops — at the flagship's sizes
-    these fuse into a handful of MXU matmuls; a Pallas backward kernel drops
-    in behind the same custom_vjp seam when sequence lengths warrant it.
-    """
-    q, k, v, out, lse = residuals
+def _flash_backward_xla(q, k, v, out, dout, lse, causal):
+    """Dense recompute backward in plain XLA: materialises [seq, seq] p, so
+    only suitable when that fits comfortably — kept as the reference
+    implementation the Pallas kernels are pinned against."""
     batch, seq, heads, head_dim = q.shape
     sm_scale = 1.0 / (head_dim**0.5)
     f32 = jnp.float32
@@ -195,6 +364,20 @@ def _bwd(causal, interpret, block_q, block_k, residuals, dout):
     dq = jnp.einsum("bhst,bthk->bshk", ds, kf)
     dk = jnp.einsum("bhst,bshk->bthk", ds, qf)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _bwd(causal, interpret, block_q, block_k, bwd_impl, residuals, dout):
+    """Flash backward: recompute p from (q, k, lse) instead of storing the
+    [seq, seq] probability matrix — as blocked Pallas kernels by default,
+    dense XLA einsums with bwd_impl="xla"."""
+    q, k, v, out, lse = residuals
+    if bwd_impl == "xla":
+        return _flash_backward_xla(q, k, v, out, dout, lse, causal)
+    return _flash_backward_pallas(
+        q, k, v, out, dout, lse, causal,
+        _default_interpret() if interpret is None else interpret,
+        block_q, block_k,
+    )
 
 
 flash_attention.defvjp(_fwd, _bwd)
