@@ -125,7 +125,7 @@ class Registry:
         def le_order(labels: tuple) -> float:
             le = dict(labels).get("le")
             if le is None:
-                return float("-inf")  # _sum/_count after buckets is fine
+                return float("-inf")  # no-op for _sum/_count: name key dominates
             return float("inf") if le == "+Inf" else float(le)
 
         seen_help = set()

@@ -144,10 +144,12 @@ def run(
             env.update(env_overlay)
             if platform:
                 env["JAX_PLATFORMS"] = platform
-                if platform != "tpu":
+                if platform not in ("tpu", "axon"):
                     # Neutralise any host sitecustomize that force-registers a
                     # TPU PJRT backend in every python process (it would win
                     # over JAX_PLATFORMS and serialise pods on the real chip).
+                    # "axon" (tunnelled TPU) keeps it: that env is what
+                    # registers the tunnel's PJRT plugin in the pod.
                     env.pop("PALLAS_AXON_POOL_IPS", None)
             # Per-pod stderr files, not pipes: a chatty pod that filled a
             # 64KiB pipe would block mid-write while holding its chip lease,
