@@ -289,6 +289,76 @@ int tpuinfo_chip_count(void) {
   return static_cast<int>(g_state.chips.size());
 }
 
+int tpuinfo_chips_in_use(int32_t* counts, int max) {
+  if (counts == nullptr || max < 0) return TPUINFO_ERR_INVALID;
+  // index (position) -> resolved device path.  Resolve symlinks once so
+  // /proc fd links (which are fully resolved) compare equal even when
+  // driver_root or /dev contains links.
+  std::vector<std::pair<int, std::string>> targets;
+  {
+    std::lock_guard<std::mutex> lock(g_state.mu);
+    if (!g_state.initialized) return TPUINFO_ERR_NOT_INITIALIZED;
+    int n = std::min(static_cast<int>(g_state.chips.size()), max);
+    for (int i = 0; i < n; ++i) {
+      const Chip& c = g_state.chips[i];
+      std::string target = JoinRoot(g_state.root, c.device_path.c_str());
+      char resolved[PATH_MAX];
+      if (realpath(target.c_str(), resolved) != nullptr) target = resolved;
+      targets.emplace_back(i, target);
+    }
+  }
+  for (size_t i = 0; i < targets.size(); ++i) counts[i] = 0;
+
+  // ONE /proc traversal counts holders for every chip: per-process, each
+  // chip is counted at most once no matter how many fds point at it.
+  DIR* proc = opendir("/proc");
+  if (proc == nullptr) return TPUINFO_ERR_IO;
+  struct dirent* pent;
+  while ((pent = readdir(proc)) != nullptr) {
+    if (pent->d_name[0] < '0' || pent->d_name[0] > '9') continue;
+    std::string fd_dir = std::string("/proc/") + pent->d_name + "/fd";
+    DIR* fds = opendir(fd_dir.c_str());
+    if (fds == nullptr) continue;  // other user's process: lower bound
+    std::vector<bool> holds(targets.size(), false);
+    struct dirent* fent;
+    while ((fent = readdir(fds)) != nullptr) {
+      if (fent->d_name[0] == '.') continue;
+      std::string link = fd_dir + "/" + fent->d_name;
+      char buf[PATH_MAX];
+      ssize_t n = readlink(link.c_str(), buf, sizeof(buf) - 1);
+      if (n <= 0) continue;
+      buf[n] = '\0';
+      for (size_t i = 0; i < targets.size(); ++i) {
+        if (!holds[i] && targets[i].second == buf) holds[i] = true;
+      }
+    }
+    closedir(fds);
+    for (size_t i = 0; i < targets.size(); ++i) {
+      if (holds[i]) ++counts[i];
+    }
+  }
+  closedir(proc);
+  return static_cast<int>(targets.size());
+}
+
+int tpuinfo_chip_in_use(int index) {
+  int pos = -1;
+  int n_chips;
+  {
+    std::lock_guard<std::mutex> lock(g_state.mu);
+    if (!g_state.initialized) return TPUINFO_ERR_NOT_INITIALIZED;
+    n_chips = static_cast<int>(g_state.chips.size());
+    for (int i = 0; i < n_chips; ++i) {
+      if (g_state.chips[i].index == index) pos = i;
+    }
+  }
+  if (pos < 0) return TPUINFO_ERR_INVALID;
+  std::vector<int32_t> counts(n_chips, 0);
+  int rc = tpuinfo_chips_in_use(counts.data(), n_chips);
+  if (rc < 0) return rc;
+  return counts[pos];
+}
+
 int tpuinfo_get_chips(tpuinfo_chip_t* out, int max) {
   if (out == nullptr || max < 0) return TPUINFO_ERR_INVALID;
   std::lock_guard<std::mutex> lock(g_state.mu);

@@ -86,6 +86,20 @@ int tpuinfo_get_topology(tpuinfo_topology_t* out);
 int tpuinfo_wait_health_events(tpuinfo_health_event_t* out, int max,
                                int timeout_ms);
 
+/* Open-handle holder counts for all chips in enumeration order (the
+ * nvidia-smi "in use by" analog): ONE /proc fd-table walk fills counts[i]
+ * with the number of processes holding chip i's device node open.  Pids
+ * whose fd tables are unreadable are skipped, so under an unprivileged
+ * caller this is a lower bound — and inside a container without hostPID
+ * only same-namespace processes are visible (deploy the daemonset with
+ * hostPID for node-wide counts).  Returns the number of entries written
+ * or a negative error. */
+int tpuinfo_chips_in_use(int32_t* counts, int max);
+
+/* Single-chip convenience over the same walk. index is the host-local
+ * chip index. Returns >= 0 or a negative error. */
+int tpuinfo_chip_in_use(int index);
+
 const char* tpuinfo_version(void);
 
 #ifdef __cplusplus

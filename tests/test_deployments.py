@@ -123,3 +123,44 @@ def test_ubi9_dockerfile_mirrors_slim_stages():
     ):
         assert needle in slim, needle
         assert needle in ubi9, needle
+
+
+def test_example_pods_are_valid_and_request_known_resources():
+    """Every example pod parses as YAML, and any google.com/* resource it
+    requests is one the daemon's strategies can actually advertise."""
+    import yaml
+
+    known = {"google.com/tpu", "google.com/shared-tpu", "google.com/tpu-tray"}
+    pods_dir = os.path.join(REPO, "examples", "pods")
+    seen_resources = set()
+
+    def container_lists(node):
+        """Yield every `containers` list at any nesting depth, so Pod,
+        Job, StatefulSet, Deployment... templates are all covered."""
+        if isinstance(node, dict):
+            if isinstance(node.get("containers"), list):
+                yield node["containers"]
+            for value in node.values():
+                yield from container_lists(value)
+        elif isinstance(node, list):
+            for item in node:
+                yield from container_lists(item)
+
+    checked = 0
+    for name in sorted(os.listdir(pods_dir)):
+        with open(os.path.join(pods_dir, name)) as f:
+            docs = list(yaml.safe_load_all(f))
+        for doc in docs:
+            if not doc:
+                continue
+            for containers in container_lists(doc):
+                for container in containers:
+                    limits = container.get("resources", {}).get("limits", {})
+                    for res in limits:
+                        if res.startswith("google.com/"):
+                            assert res in known, f"{name}: unknown resource {res}"
+                            seen_resources.add(res)
+                            checked += 1
+    assert checked >= 5  # the walker actually found the example requests
+    # The example set must exercise all three advertised resource flavors.
+    assert seen_resources == known

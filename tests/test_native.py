@@ -169,3 +169,31 @@ def test_accelerator_type_detection(native, fake_tree, monkeypatch):
     # The fake tree's per-chip sysfs override (tpu_hbm_bytes = 16 GiB) takes
     # precedence over the v5p per-type default (95 GiB).
     assert chips[0].hbm_bytes == 16 << 30
+
+
+def test_chip_in_use_counts_open_handles(native, fake_tree):
+    n = native.init(fake_tree)
+    assert n == 4
+    # Nothing holds accel1 yet.
+    assert native.chip_in_use(1) == 0
+    # Hold accel1 open in this process: the /proc fd walk must see it.
+    with open(os.path.join(fake_tree, "dev", "accel1")):
+        assert native.chip_in_use(1) >= 1
+        assert native.chip_in_use(0) == 0
+    assert native.chip_in_use(1) == 0
+    # Unknown index is an error -> None through the binding.
+    assert native.chip_in_use(99) is None
+
+
+def test_tpu_manager_chips_in_use(lib_path, fake_tree):
+    from tpu_device_plugin.backend.tpu import TpuChipManager
+
+    mgr = TpuChipManager(driver_root=fake_tree, lib_path=lib_path)
+    mgr.init()
+    try:
+        with open(os.path.join(fake_tree, "dev", "accel2")):
+            usage = mgr.chips_in_use()
+            assert usage.get(2, 0) >= 1
+            assert usage.get(0) == 0
+    finally:
+        mgr.shutdown()

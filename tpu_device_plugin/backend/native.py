@@ -124,6 +124,16 @@ class NativeTpuInfo:
         lib.tpuinfo_wait_health_events.restype = ctypes.c_int
         lib.tpuinfo_version.argtypes = []
         lib.tpuinfo_version.restype = ctypes.c_char_p
+        # Added after v0: older .so builds lack them; probed defensively.
+        if hasattr(lib, "tpuinfo_chips_in_use"):
+            lib.tpuinfo_chips_in_use.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int,
+            ]
+            lib.tpuinfo_chips_in_use.restype = ctypes.c_int
+        if hasattr(lib, "tpuinfo_chip_in_use"):
+            lib.tpuinfo_chip_in_use.argtypes = [ctypes.c_int]
+            lib.tpuinfo_chip_in_use.restype = ctypes.c_int
 
     # ------------------------------------------------------------------ calls
 
@@ -171,6 +181,31 @@ class NativeTpuInfo:
         for chip in self.chips():
             topo.chips_by_id[chip.id] = chip
         return topo
+
+    def chip_in_use(self, index: int) -> int | None:
+        """Processes currently holding /dev/accel<index> open (lower bound
+        under an unprivileged caller); None when the loaded .so predates the
+        call or the probe fails."""
+        if not hasattr(self._lib, "tpuinfo_chip_in_use"):
+            return None
+        n = self._lib.tpuinfo_chip_in_use(index)
+        return None if n < 0 else n
+
+    def chips_in_use(self) -> dict[int, int]:
+        """index -> open-handle holder count for every chip, from ONE /proc
+        walk; {} when the loaded .so predates the call or the probe fails."""
+        if not hasattr(self._lib, "tpuinfo_chips_in_use"):
+            return {}
+        chips = self.chips()
+        if not chips:
+            return {}
+        counts = (ctypes.c_int32 * len(chips))()
+        n = self._lib.tpuinfo_chips_in_use(counts, len(chips))
+        if n < 0:
+            return {}
+        # chips() preserves the library's enumeration order, which is what
+        # counts[] is keyed by.
+        return {chips[i].index: counts[i] for i in range(min(n, len(chips)))}
 
     def wait_health_events(self, timeout_ms: int = 1000) -> list[HealthEvent]:
         buf = (_HealthEventStruct * _MAX_EVENTS)()

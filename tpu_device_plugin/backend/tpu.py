@@ -59,6 +59,14 @@ class TpuChipManager(ChipManager):
         self._require_init()
         return self._topology
 
+    def chips_in_use(self) -> dict[int, int]:
+        """chip index -> count of processes holding its device node open
+        (the nvidia-smi "in use" analog, surfaced by tpu-info): one /proc
+        walk for the whole host. {} with an .so predating the call. Counts
+        are namespace-local — deploy with hostPID for node-wide visibility."""
+        self._require_init()
+        return self._native.chips_in_use()
+
     def check_health(
         self,
         stop: threading.Event,

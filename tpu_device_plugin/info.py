@@ -23,6 +23,18 @@ from .config import Flags
 from .slice_topology import SliceConfigError, slice_info_from_env
 
 
+def _in_use(backend) -> dict:
+    """index -> open-handle holder count; {} for backends without the probe
+    (fake) or when it fails."""
+    fn = getattr(backend, "chips_in_use", None)
+    if not callable(fn):
+        return {}
+    try:
+        return fn()
+    except Exception:
+        return {}
+
+
 def collect(flags: Flags) -> dict:
     """Chip/topology snapshot through the daemon's own backend."""
     from .main import make_backend
@@ -32,6 +44,7 @@ def collect(flags: Flags) -> dict:
     try:
         topo = backend.topology()
         chips = backend.devices()
+        in_use = _in_use(backend)
         info = {
             "accelerator_type": topo.accelerator_type,
             "torus_shape": list(topo.torus_shape),
@@ -49,6 +62,7 @@ def collect(flags: Flags) -> dict:
                     "coords": list(c.coords),
                     "tray": c.tray,
                     "numa_node": c.numa_node,
+                    "in_use_by": in_use.get(c.index),
                 }
                 for c in chips
             ],
@@ -85,15 +99,19 @@ def render(info: dict) -> str:
             f"slice: worker {s['worker_id']}/{s['n_hosts']} of {s['topology']} "
             f"(host grid {s['host_bounds']})"
         )
-    header = f"{'IDX':>3}  {'ID':<24} {'PATH':<16} {'HBM':>7}  {'COORDS':<9} {'TRAY':>4} {'NUMA':>4}"
+    header = (
+        f"{'IDX':>3}  {'ID':<24} {'PATH':<16} {'HBM':>7}  "
+        f"{'COORDS':<9} {'TRAY':>4} {'NUMA':>4} {'USE':>4}"
+    )
     lines += [header, "-" * len(header)]
     for c in info["chips"]:
         coords = ",".join(str(v) for v in c["coords"])
         path = c["device_paths"][0] if c["device_paths"] else "-"
         numa = "-" if c["numa_node"] is None else str(c["numa_node"])
+        use = "-" if c.get("in_use_by") is None else str(c["in_use_by"])
         lines.append(
             f"{c['index']:>3}  {c['id']:<24} {path:<16} "
-            f"{c['hbm_gib']:>6.1f}G  {coords:<9} {c['tray']:>4} {numa:>4}"
+            f"{c['hbm_gib']:>6.1f}G  {coords:<9} {c['tray']:>4} {numa:>4} {use:>4}"
         )
     return "\n".join(lines)
 
