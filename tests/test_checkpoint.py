@@ -72,9 +72,9 @@ def test_max_to_keep_prunes_old_steps(tmp_path):
     ckpt.close()
 
 
-def test_train_cli_resumes_from_checkpoint(tmp_path):
-    """The pod-facing entry (`python -m workloads.train`) checkpoints and
-    resumes across process restarts."""
+def run_train_cli(extra_args, timeout=300):
+    """Launch `python -m workloads.train` with the common tiny-model flags;
+    shared by every CLI behavior test below."""
     import os
     import subprocess
     import sys
@@ -82,23 +82,44 @@ def test_train_cli_resumes_from_checkpoint(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    def cmd(steps):
+    cmd = [
+        sys.executable, "-m", "workloads.train",
+        "--batch-size", "2", "--seq-len", "16", "--layers", "1",
+        *extra_args,
+    ]
+    return subprocess.run(
+        cmd, capture_output=True, text=True, cwd=repo, env=env, timeout=timeout
+    )
+
+
+def test_train_cli_resumes_from_checkpoint(tmp_path):
+    """The pod-facing entry (`python -m workloads.train`) checkpoints and
+    resumes across process restarts."""
+
+    def args(steps):
         return [
-            sys.executable, "-m", "workloads.train",
-            "--steps", str(steps), "--batch-size", "2",
-            "--seq-len", "16", "--layers", "1",
+            "--steps", str(steps),
             "--checkpoint-dir", str(tmp_path / "ckpt"), "--checkpoint-every", "3",
         ]
 
-    first = subprocess.run(
-        cmd(3), capture_output=True, text=True, cwd=repo, env=env, timeout=300
-    )
+    first = run_train_cli(args(3))
     assert first.returncode == 0, first.stderr
     assert "resumed" not in first.stdout
 
-    second = subprocess.run(
-        cmd(6), capture_output=True, text=True, cwd=repo, env=env, timeout=300
-    )
+    second = run_train_cli(args(6))
     assert second.returncode == 0, second.stderr
     assert "resumed from checkpoint step 3" in second.stdout
     assert "done: steps=6" in second.stdout
+
+
+def test_train_cli_profile_dir_writes_trace(tmp_path):
+    import os
+
+    out = run_train_cli(["--steps", "2", "--profile-dir", str(tmp_path / "trace")])
+    assert out.returncode == 0, out.stderr
+    assert "profile trace written" in out.stdout
+    # jax writes <dir>/plugins/profile/<ts>/*.trace.json.gz (or .xplane.pb).
+    found = []
+    for root, _, files in os.walk(tmp_path / "trace"):
+        found += [f for f in files if "trace" in f or f.endswith(".pb")]
+    assert found, "no trace artifacts written"

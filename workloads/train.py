@@ -238,6 +238,12 @@ def main(argv=None) -> int:
     parser.add_argument("--layers", type=int, default=2)
     parser.add_argument("--checkpoint-dir", default=None)
     parser.add_argument("--checkpoint-every", type=int, default=10)
+    parser.add_argument(
+        "--profile-dir",
+        default=None,
+        help="capture a jax.profiler trace of the training loop here "
+        "(view with TensorBoard); the reference stack has no tracing at all",
+    )
     args = parser.parse_args(argv)
 
     config = ModelConfig(max_seq_len=args.seq_len, n_layers=args.layers)
@@ -267,17 +273,33 @@ def main(argv=None) -> int:
         (params, opt_state), optimizer = make_train_state(config, mesh)
     step = make_train_step(config, mesh, optimizer)
 
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
     loss = float("nan")
-    for s in range(start + 1, args.steps + 1):
-        tokens = synthetic_batch(config, args.batch_size, seed=s)
-        params, opt_state, loss = step(params, opt_state, tokens)
-        checkpoint_due = (
-            args.checkpoint_every > 0 and s % args.checkpoint_every == 0
-        )
-        if ckpt and (checkpoint_due or s == args.steps):
-            ckpt.save(s, (params, opt_state))
-        if s % 10 == 0 or s == args.steps:
-            print(f"step {s}: loss={float(loss):.4f}")
+    try:
+        for s in range(start + 1, args.steps + 1):
+            tokens = synthetic_batch(config, args.batch_size, seed=s)
+            params, opt_state, loss = step(params, opt_state, tokens)
+            checkpoint_due = (
+                args.checkpoint_every > 0 and s % args.checkpoint_every == 0
+            )
+            if ckpt and (checkpoint_due or s == args.steps):
+                ckpt.save(s, (params, opt_state))
+            if s % 10 == 0 or s == args.steps:
+                print(f"step {s}: loss={float(loss):.4f}")
+        if args.profile_dir:
+            # Success path only: blocking here may surface deferred XLA
+            # errors, and the success line must not appear in a failed log.
+            jax.block_until_ready(loss)
+            jax.profiler.stop_trace()
+            print(f"profile trace written to {args.profile_dir}")
+    except BaseException:
+        if args.profile_dir:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass  # the original exception is what matters
+        raise
     if ckpt:
         ckpt.wait()
         ckpt.close()
