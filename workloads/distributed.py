@@ -1,0 +1,115 @@
+"""Multi-host bring-up: jax.distributed from the daemon-injected slice env.
+
+The communication backend of the workload suite.  On a multi-host slice
+each host's device plugin stamps the global-slice env into its Allocate
+responses (`TPU_WORKER_ID`, `TPU_TOPOLOGY`, `TPU_HOST_BOUNDS` —
+tpu_device_plugin/slice_topology.py `container_slice_env`); this module
+turns that env into a connected JAX runtime: ``initialize_from_slice_env``
+wires `jax.distributed` (coordinator = worker 0), after which
+``jax.devices()`` spans every host and ``global_mesh`` lays the usual
+parallelism axes over the whole slice.  All cross-host traffic is XLA
+collectives — psum/all_gather/ppermute over ICI within a host block and
+DCN between blocks — inserted by the compiler from shardings; there is no
+NCCL/MPI-style hand-driven transport to manage, which IS the TPU-native
+replacement for one.
+
+Hardware-free testing: the same code path runs N CPU processes
+(`tests/test_distributed.py` spawns two and psums across them), so the
+multi-host bring-up logic is exercised in CI without a pod slice.
+
+Reference pendant: none — the reference daemon is strictly single-node
+(SURVEY.md §5 distributed-communication note); its workloads never span
+hosts.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+def slice_process_info(environ=None) -> tuple[int, int] | None:
+    """(process_id, num_processes) from the daemon-injected slice env, or
+    None when this container is not part of a declared multi-host slice."""
+    env = os.environ if environ is None else environ
+    worker = env.get("TPU_WORKER_ID")
+    host_bounds = env.get("TPU_HOST_BOUNDS")
+    if worker is None or host_bounds is None:
+        return None
+    try:
+        n_hosts = 1
+        for part in host_bounds.split(","):
+            n_hosts *= int(part)
+        return int(worker), n_hosts
+    except ValueError as e:
+        raise ValueError(
+            f"malformed slice env TPU_WORKER_ID={worker!r} "
+            f"TPU_HOST_BOUNDS={host_bounds!r}: {e}"
+        ) from None
+
+
+def initialize_from_slice_env(
+    coordinator_address: str | None = None, environ=None
+) -> bool:
+    """Connect this process to the slice-wide JAX runtime.
+
+    Returns True when a multi-host slice env was found and
+    jax.distributed.initialize ran; False on a single-host container (no
+    initialization needed — jax.devices() is already complete).
+
+    ``coordinator_address`` defaults to ``$TPU_COORDINATOR_ADDRESS`` or
+    worker 0's pod DNS name from ``$TPU_WORKER_HOSTNAMES`` (comma list)
+    on port 8476 — pass it explicitly when neither is set.
+    """
+    env = os.environ if environ is None else environ
+    info = slice_process_info(env)
+    if info is None:
+        return False
+    process_id, num_processes = info
+    if num_processes <= 1:
+        return False
+    if coordinator_address is None:
+        coordinator_address = env.get("TPU_COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        hostnames = env.get("TPU_WORKER_HOSTNAMES")
+        if hostnames:
+            coordinator_address = (
+                f"{hostnames.split(',')[0]}:{DEFAULT_COORDINATOR_PORT}"
+            )
+    if coordinator_address is None:
+        raise ValueError(
+            "multi-host slice env present but no coordinator address: set "
+            "TPU_COORDINATOR_ADDRESS or TPU_WORKER_HOSTNAMES, or pass "
+            "coordinator_address="
+        )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def global_mesh(
+    data: int | None = None, model: int = 1, axis_names=("data", "model")
+) -> jax.sharding.Mesh:
+    """A mesh over every device of the connected slice (all hosts).
+
+    Defaults to all-data-parallel; pass ``model`` to carve a trailing
+    tensor-parallel axis (kept within a host when model divides the local
+    device count, so its collectives ride ICI not DCN).
+    """
+    devices = jax.devices()
+    n = len(devices)
+    if data is None:
+        if n % model:
+            raise ValueError(f"{n} global devices not divisible by model={model}")
+        data = n // model
+    if data * model != n:
+        raise ValueError(f"mesh {data}x{model} != {n} global devices")
+    grid = np.array(devices).reshape(data, model)
+    return jax.sharding.Mesh(grid, axis_names=axis_names)
