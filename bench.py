@@ -102,10 +102,34 @@ def run_bench() -> dict:
             stub.GetPreferredAllocation(req)
             return (time.perf_counter() - t0) * 1000.0
 
+        def health_propagation(n_flips: int = 20) -> list[float]:
+            """Inject a health flip, time until ListAndWatch re-sends the
+            device list reflecting it — the failover-visibility latency."""
+            from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+
+            stream = stub.ListAndWatch(pb.Empty())
+            next(stream)  # initial list
+            samples = []
+            state = UNHEALTHY
+            for _ in range(n_flips):
+                t0 = time.perf_counter()
+                manager.inject("tpu-0", state)
+                want = "Unhealthy" if state == UNHEALTHY else "Healthy"
+                while True:
+                    update = next(stream)
+                    got = {d.ID: d.health for d in update.devices}
+                    if got.get("tpu-0-replica-0") == want:
+                        break
+                samples.append((time.perf_counter() - t0) * 1000.0)
+                state = HEALTHY if state == UNHEALTHY else UNHEALTHY
+            stream.cancel()
+            return samples
+
         for i in range(WARMUP_RPCS):
             allocate(i)
             preferred(i)
         latencies = [allocate(i) for i in range(MEASURED_RPCS)]
+        health_samples = sorted(health_propagation())
         # GetPreferredAllocation carries the spreading/topology work the
         # reference re-probes hardware for per RPC (device.go:33-72); here
         # it runs against the cached snapshot, so it is measured too.
@@ -120,11 +144,13 @@ def run_bench() -> dict:
     p50 = statistics.median(latencies)
     p99 = latencies[int(len(latencies) * 0.99) - 1]
     pref_p50 = statistics.median(pref_latencies)
+    health_p50 = statistics.median(health_samples)
     print(
         f"allocate latency over {MEASURED_RPCS} RPCs: "
         f"p50={p50:.3f}ms p99={p99:.3f}ms max={latencies[-1]:.3f}ms "
         f"(target p50 < {BASELINE_P50_MS}ms); "
-        f"preferred-allocation p50={pref_p50:.3f}ms",
+        f"preferred-allocation p50={pref_p50:.3f}ms; "
+        f"health-event -> ListAndWatch re-send p50={health_p50:.3f}ms",
         file=sys.stderr,
     )
     return {
@@ -134,6 +160,7 @@ def run_bench() -> dict:
         "vs_baseline": round(p50 / BASELINE_P50_MS, 5),
         "allocate_p99_latency_ms": round(p99, 4),
         "preferred_allocation_p50_ms": round(pref_p50, 4),
+        "health_propagation_p50_ms": round(health_p50, 4),
     }
 
 

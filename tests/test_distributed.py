@@ -25,7 +25,10 @@ WORKER = textwrap.dedent(
     assert initialize_from_slice_env() is True
     import numpy as np
     import jax.numpy as jnp
-    from jax import shard_map
+    try:  # same compat range as workloads/ops (jax >= 0.4.35)
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     pid = jax.process_index()
@@ -81,9 +84,16 @@ def test_two_process_slice_bringup():
             )
         )
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=180)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        # A hung worker must not outlive the test (orphans wedge CI).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
     for worker_id, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {worker_id} failed:\n{out}"
         assert f"worker {worker_id}: psum" in out
@@ -95,20 +105,44 @@ def test_single_host_env_is_noop():
     assert slice_process_info({}) is None
     assert initialize_from_slice_env(environ={}) is False
     # A 1-host slice needs no distributed runtime either.
-    env = {"TPU_WORKER_ID": "0", "TPU_HOST_BOUNDS": "1,1,1"}
+    env = {
+        "TPU_WORKER_ID": "0",
+        "TPU_TOPOLOGY": "2x2x1",
+        "TPU_HOST_BOUNDS": "1,1,1",
+    }
     assert initialize_from_slice_env(environ=env) is False
 
 
 def test_malformed_slice_env_fails_loud():
+    """Validation comes from the daemon's canonical parser."""
+    from tpu_device_plugin.slice_topology import SliceConfigError
     from workloads.distributed import slice_process_info
 
-    with pytest.raises(ValueError, match="malformed"):
-        slice_process_info({"TPU_WORKER_ID": "x", "TPU_HOST_BOUNDS": "1,1,2"})
+    with pytest.raises(SliceConfigError, match="invalid TPU_WORKER_ID"):
+        slice_process_info(
+            {
+                "TPU_WORKER_ID": "x",
+                "TPU_TOPOLOGY": "2x2x2",
+                "TPU_HOST_BOUNDS": "1,1,2",
+            }
+        )
+    with pytest.raises(SliceConfigError, match="outside host grid"):
+        slice_process_info(
+            {
+                "TPU_WORKER_ID": "7",
+                "TPU_TOPOLOGY": "2x2x2",
+                "TPU_HOST_BOUNDS": "1,1,2",
+            }
+        )
 
 
 def test_missing_coordinator_fails_loud():
     from workloads.distributed import initialize_from_slice_env
 
-    env = {"TPU_WORKER_ID": "1", "TPU_HOST_BOUNDS": "1,1,2"}
+    env = {
+        "TPU_WORKER_ID": "1",
+        "TPU_TOPOLOGY": "2x2x2",
+        "TPU_HOST_BOUNDS": "1,1,2",
+    }
     with pytest.raises(ValueError, match="coordinator"):
         initialize_from_slice_env(environ=env)

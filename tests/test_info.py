@@ -39,3 +39,41 @@ def test_main_json_roundtrip(capsys):
 def test_main_chipless_node_exit_code(capsys, tmp_path):
     assert main(["--backend", "tpu", "--driver-root", str(tmp_path)]) == 1
     assert "no TPU stack" in capsys.readouterr().err
+
+
+def test_watch_mode_refreshes_until_interrupted(tmp_path):
+    """--watch loops snapshots; an interrupt stops it cleanly (rc 0)."""
+    import os
+    import subprocess
+    import sys
+    import time
+    import signal as _signal
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_path = tmp_path / "watch.out"
+    with open(out_path, "wb") as out_file:
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "tpu_device_plugin.info",
+                "--backend", "fake", "--fake-topology", "2x2", "--watch", "0.2",
+            ],
+            cwd=repo, stdout=out_file, stderr=subprocess.STDOUT,
+        )
+        # Interrupt only once two refreshes are visibly out: a SIGINT during
+        # interpreter startup would land outside the loop's handler.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if open(out_path).read().count("IDX") >= 2:
+                break
+            time.sleep(0.1)
+        else:
+            proc.kill()
+            raise AssertionError("watch mode never produced two refreshes")
+        proc.send_signal(_signal.SIGINT)
+        assert proc.wait(timeout=10) == 0, open(out_path).read()
+
+
+def test_watch_rejects_nonpositive():
+    from tpu_device_plugin.info import main
+
+    assert main(["--backend", "fake", "--watch", "0"]) == 2

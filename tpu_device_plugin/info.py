@@ -124,19 +124,49 @@ def main(argv=None) -> int:
     parser.add_argument("--fake-topology", default="4x4")
     parser.add_argument("--driver-root", default="/")
     parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument(
+        "--watch",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="refresh the table every SECONDS (like watch(1); Ctrl-C stops)",
+    )
     args = parser.parse_args(argv)
     flags = Flags(
         backend=args.backend,
         fake_topology=args.fake_topology,
         driver_root=args.driver_root,
     )
+
+    def snapshot() -> int:
+        try:
+            info = collect(flags)
+        except BackendInitError as e:
+            print(f"tpu-info: no TPU stack on this node: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(info, indent=2) if args.as_json else render(info))
+        return 0
+
+    if args.watch is None:
+        return snapshot()
+    if args.watch <= 0:
+        print("tpu-info: --watch must be positive", file=sys.stderr)
+        return 2
+    import time
+
+    # Terminal clear only for a human-facing table on a tty: JSON consumers
+    # and piped output must not receive ANSI control codes.
+    clear = not args.as_json and sys.stdout.isatty()
     try:
-        info = collect(flags)
-    except BackendInitError as e:
-        print(f"tpu-info: no TPU stack on this node: {e}", file=sys.stderr)
-        return 1
-    print(json.dumps(info, indent=2) if args.as_json else render(info))
-    return 0
+        while True:
+            if clear:
+                print("\033[2J\033[H", end="")  # clear screen, home cursor
+            rc = snapshot()
+            if rc != 0:
+                return rc
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
 
 
 if __name__ == "__main__":

@@ -33,23 +33,23 @@ DEFAULT_COORDINATOR_PORT = 8476
 
 
 def slice_process_info(environ=None) -> tuple[int, int] | None:
-    """(process_id, num_processes) from the daemon-injected slice env, or
-    None when this container is not part of a declared multi-host slice."""
+    """(process_id, num_processes) from the daemon-injected slice env
+    (TPU_TOPOLOGY + TPU_HOST_BOUNDS + TPU_WORKER_ID), or None when this
+    container is not part of a declared multi-host slice.
+
+    Parsing delegates to the daemon's own canonical parser
+    (slice_topology.slice_info_from_env) so arity/range validation — wrong
+    bounds arity, worker id outside the host grid — stays in one place;
+    malformed env raises its SliceConfigError.  The node-metadata fallback
+    is disabled: a workload container must carry an explicit worker id.
+    """
+    from tpu_device_plugin.slice_topology import slice_info_from_env
+
     env = os.environ if environ is None else environ
-    worker = env.get("TPU_WORKER_ID")
-    host_bounds = env.get("TPU_HOST_BOUNDS")
-    if worker is None or host_bounds is None:
+    info = slice_info_from_env(env=env, metadata_worker_id=None)
+    if info is None:
         return None
-    try:
-        n_hosts = 1
-        for part in host_bounds.split(","):
-            n_hosts *= int(part)
-        return int(worker), n_hosts
-    except ValueError as e:
-        raise ValueError(
-            f"malformed slice env TPU_WORKER_ID={worker!r} "
-            f"TPU_HOST_BOUNDS={host_bounds!r}: {e}"
-        ) from None
+    return info.worker_id, info.n_hosts
 
 
 def initialize_from_slice_env(
