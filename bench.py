@@ -107,7 +107,9 @@ def run_bench() -> dict:
             device list reflecting it — the failover-visibility latency."""
             from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
 
-            stream = stub.ListAndWatch(pb.Empty())
+            # Call deadline: a regressed health path must fail the bench
+            # with DEADLINE_EXCEEDED, not hang it.
+            stream = stub.ListAndWatch(pb.Empty(), timeout=60)
             next(stream)  # initial list
             samples = []
             state = UNHEALTHY

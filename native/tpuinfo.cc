@@ -28,7 +28,9 @@ namespace {
 // ABI version: bump the minor on any struct-layout change (0.2.0 added
 // tpuinfo_health_event_t.code); the Python loader refuses a mismatched
 // major.minor so a stale .so can't misparse event batches.
-constexpr const char* kVersion = "0.2.0";
+// 0.2.1: + tpuinfo_chips_in_use/tpuinfo_chip_in_use (append-only, no
+// layout change, so patch not minor — the loader pins major.minor).
+constexpr const char* kVersion = "0.2.1";
 
 struct Chip {
   std::string id;

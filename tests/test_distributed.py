@@ -146,3 +146,60 @@ def test_missing_coordinator_fails_loud():
     }
     with pytest.raises(ValueError, match="coordinator"):
         initialize_from_slice_env(environ=env)
+
+
+def test_two_process_training_step(tmp_path):
+    """`python -m workloads.train` joins the slice from the daemon-injected
+    env and runs the full step across two real processes."""
+    port = free_port()
+    procs = []
+    for worker_id in range(2):
+        env = dict(os.environ)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "TPU_WORKER_ID": str(worker_id),
+                "TPU_TOPOLOGY": "2x2x2",
+                "TPU_HOST_BOUNDS": "1,1,2",
+                "TPU_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            }
+        )
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "workloads.train",
+                    # batch divisible by the data axis whatever the local
+                    # device count (1 outside pytest, 8 under conftest's
+                    # XLA_FLAGS -> up to data=4 after the tp cut).
+                    "--steps", "2", "--batch-size", "8",
+                    "--seq-len", "16", "--layers", "1",
+                ],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+    for worker_id, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {worker_id} failed:\n{out}"
+        assert f"joined slice as worker {worker_id}/2" in out
+        assert "done: steps=2" in out
+
+
+def test_partial_slice_env_fails_loud():
+    """worker-id/host-bounds without topology is a misconfiguration, not a
+    single-host container: silent False would hang the rest of the slice."""
+    from tpu_device_plugin.slice_topology import SliceConfigError
+    from workloads.distributed import slice_process_info
+
+    with pytest.raises(SliceConfigError, match="partial slice env"):
+        slice_process_info({"TPU_WORKER_ID": "1", "TPU_HOST_BOUNDS": "1,1,2"})

@@ -35,12 +35,34 @@ def _in_use(backend) -> dict:
         return {}
 
 
-def collect(flags: Flags) -> dict:
-    """Chip/topology snapshot through the daemon's own backend."""
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _ambient_slice_info():
+    """Ambient slice metadata, resolved once per process: the resolution can
+    include a node-metadata HTTP probe (2 s timeout), which must not run on
+    every --watch tick."""
+    try:
+        # Same resolution the daemon uses (incl. metadata fallback).
+        return slice_info_from_env()
+    except SliceConfigError as e:
+        print(f"tpu-info: ignoring ambient slice metadata: {e}", file=sys.stderr)
+        return None
+
+
+def collect(flags: Flags, backend=None) -> dict:
+    """Chip/topology snapshot through the daemon's own backend.
+
+    Pass an already-initialised ``backend`` to reuse it across snapshots
+    (--watch: one init + one slice-metadata resolution, not one per tick);
+    ownership stays with the caller then."""
     from .main import make_backend
 
-    backend = make_backend(flags)
-    backend.init()
+    owns_backend = backend is None
+    if owns_backend:
+        backend = make_backend(flags)
+        backend.init()
     try:
         topo = backend.topology()
         chips = backend.devices()
@@ -69,12 +91,7 @@ def collect(flags: Flags) -> dict:
         }
         slice_info = getattr(topo, "slice_info", None)
         if slice_info is None:
-            try:
-                # Same resolution the daemon uses (incl. metadata fallback).
-                slice_info = slice_info_from_env()
-            except SliceConfigError as e:
-                print(f"tpu-info: ignoring ambient slice metadata: {e}", file=sys.stderr)
-                slice_info = None
+            slice_info = _ambient_slice_info()
         if slice_info is not None:
             info["slice"] = {
                 "worker_id": slice_info.worker_id,
@@ -84,7 +101,8 @@ def collect(flags: Flags) -> dict:
             }
         return info
     finally:
-        backend.shutdown()
+        if owns_backend:
+            backend.shutdown()
 
 
 def render(info: dict) -> str:
@@ -138,9 +156,9 @@ def main(argv=None) -> int:
         driver_root=args.driver_root,
     )
 
-    def snapshot() -> int:
+    def snapshot(backend=None) -> int:
         try:
-            info = collect(flags)
+            info = collect(flags, backend=backend)
         except BackendInitError as e:
             print(f"tpu-info: no TPU stack on this node: {e}", file=sys.stderr)
             return 1
@@ -154,6 +172,16 @@ def main(argv=None) -> int:
         return 2
     import time
 
+    from .main import make_backend
+
+    # One backend for the whole watch session: re-initialising (and
+    # re-resolving slice metadata) every tick would dominate the refresh.
+    try:
+        backend = make_backend(flags)
+        backend.init()
+    except BackendInitError as e:
+        print(f"tpu-info: no TPU stack on this node: {e}", file=sys.stderr)
+        return 1
     # Terminal clear only for a human-facing table on a tty: JSON consumers
     # and piped output must not receive ANSI control codes.
     clear = not args.as_json and sys.stdout.isatty()
@@ -161,12 +189,14 @@ def main(argv=None) -> int:
         while True:
             if clear:
                 print("\033[2J\033[H", end="")  # clear screen, home cursor
-            rc = snapshot()
+            rc = snapshot(backend)
             if rc != 0:
                 return rc
             time.sleep(args.watch)
     except KeyboardInterrupt:
         return 0
+    finally:
+        backend.shutdown()
 
 
 if __name__ == "__main__":

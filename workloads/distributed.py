@@ -43,11 +43,24 @@ def slice_process_info(environ=None) -> tuple[int, int] | None:
     malformed env raises its SliceConfigError.  The node-metadata fallback
     is disabled: a workload container must carry an explicit worker id.
     """
-    from tpu_device_plugin.slice_topology import slice_info_from_env
+    from tpu_device_plugin.slice_topology import (
+        SliceConfigError,
+        slice_info_from_env,
+    )
 
     env = os.environ if environ is None else environ
     info = slice_info_from_env(env=env, metadata_worker_id=None)
     if info is None:
+        # A partial slice env must fail loud, not silently train
+        # single-host while the slice's worker 0 blocks waiting for this
+        # process to connect.
+        present = [k for k in ("TPU_WORKER_ID", "TPU_HOST_BOUNDS") if k in env]
+        if present and "TPU_TOPOLOGY" not in env:
+            raise SliceConfigError(
+                f"partial slice env: {', '.join(present)} set but "
+                f"TPU_TOPOLOGY missing — the daemon injects all three "
+                f"(slice_topology.container_slice_env)"
+            )
         return None
     return info.worker_id, info.n_hosts
 
@@ -64,6 +77,12 @@ def initialize_from_slice_env(
     ``coordinator_address`` defaults to ``$TPU_COORDINATOR_ADDRESS`` or
     worker 0's pod DNS name from ``$TPU_WORKER_HOSTNAMES`` (comma list)
     on port 8476 — pass it explicitly when neither is set.
+
+    Caveat: some TPU runtimes rewrite ``TPU_TOPOLOGY``-family env vars at
+    interpreter start (a sitecustomize registering the local PJRT plugin).
+    The daemon-injected values must win, so such containers should mount
+    the plugin's env last — the daemon side has the analogous --slice-*
+    flag overrides (slice_topology.slice_info_from_env).
     """
     env = os.environ if environ is None else environ
     info = slice_process_info(env)

@@ -244,7 +244,23 @@ def main(argv=None) -> int:
         help="capture a jax.profiler trace of the training loop here "
         "(view with TensorBoard); the reference stack has no tracing at all",
     )
+    parser.add_argument(
+        "--coordinator-address",
+        default=None,
+        help="host:port of worker 0 for multi-host slices (defaults to the "
+        "daemon-injected slice env; ignored on single-host containers)",
+    )
     args = parser.parse_args(argv)
+
+    # Multi-host slice container? Wire jax.distributed from the env the
+    # device plugin injected at Allocate time; no-op on a single host.
+    from .distributed import initialize_from_slice_env
+
+    if initialize_from_slice_env(coordinator_address=args.coordinator_address):
+        print(
+            f"joined slice as worker {jax.process_index()}/{jax.process_count()}"
+            f" ({jax.device_count()} global devices)"
+        )
 
     config = ModelConfig(max_seq_len=args.seq_len, n_layers=args.layers)
     mesh = make_mesh()
