@@ -1,0 +1,151 @@
+"""2D sequence parallelism (Ulysses x ring) vs dense attention, and vs each
+1D formulation, on meshes carved from the 8-device CPU pool."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from workloads.ops.usp import usp_attention
+
+from .test_flash_attention import make_qkv, naive_attention
+
+
+def mesh_2d(n_ring, n_uly, extra=None):
+    n = n_ring * n_uly * (extra or 1)
+    devices = np.array(jax.devices()[:n])
+    if extra:
+        return Mesh(
+            devices.reshape(extra, n_ring, n_uly), ("data", "seq_r", "seq_u")
+        )
+    return Mesh(devices.reshape(n_ring, n_uly), ("seq_r", "seq_u"))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("shape", [(2, 4), (4, 2)])
+def test_matches_dense(causal, shape):
+    q, k, v = make_qkv(batch=2, seq=64, heads=8, head_dim=16)
+    mesh = mesh_2d(*shape)
+    out = usp_attention(q, k, v, mesh, causal=causal)
+    expected = naive_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
+def test_matches_1d_formulations():
+    from workloads.ops.ring import ring_attention
+    from workloads.ops.ulysses import ulysses_attention
+
+    q, k, v = make_qkv(batch=1, seq=64, heads=8, head_dim=16)
+    mesh = mesh_2d(2, 4)
+    out_2d = usp_attention(q, k, v, mesh)
+    ring_mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
+    np.testing.assert_allclose(
+        np.asarray(out_2d),
+        np.asarray(ring_attention(q, k, v, ring_mesh)),
+        atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_2d),
+        np.asarray(ulysses_attention(q, k, v, ring_mesh)),
+        atol=2e-5,
+    )
+
+
+def test_gradients_match_dense():
+    q, k, v = make_qkv(batch=1, seq=32, heads=4, head_dim=16)
+    mesh = mesh_2d(2, 2)
+
+    def loss_usp(q, k, v):
+        return jnp.sum(usp_attention(q, k, v, mesh) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(naive_attention(q, k, v, True) ** 2)
+
+    got = jax.grad(loss_usp, argnums=(0, 1, 2))(q, k, v)
+    expected = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for g, e, name in zip(got, expected, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(e), atol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_with_data_axis_and_jit():
+    """Batch sharded on a data axis alongside the 2D seq sharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    q, k, v = make_qkv(batch=4, seq=32, heads=4, head_dim=16)
+    mesh = mesh_2d(2, 2, extra=2)
+    sharding = NamedSharding(mesh, P("data", ("seq_r", "seq_u"), None, None))
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    out = jax.jit(
+        lambda q, k, v: usp_attention(q, k, v, mesh, batch_axis="data")
+    )(q, k, v)
+    assert out.sharding.spec == P("data", ("seq_r", "seq_u"), None, None)
+    expected = naive_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
+def test_rejects_indivisible():
+    q, k, v = make_qkv(batch=1, seq=60, heads=8, head_dim=16)
+    mesh = mesh_2d(2, 4)
+    with pytest.raises(ValueError, match="seq"):
+        usp_attention(q, k, v, mesh)
+    q2, k2, v2 = make_qkv(batch=1, seq=64, heads=2, head_dim=16)
+    with pytest.raises(ValueError, match="heads"):
+        usp_attention(q2, k2, v2, mesh)
+
+
+def test_usp_train_step():
+    """Full training step over ("data", "seq_r", "seq_u"): the 2D
+    long-context configuration learns and matches the dense loss scale."""
+    from workloads.model import ModelConfig
+    from workloads.train import (
+        make_seq_parallel_train_step,
+        make_train_state,
+        make_usp_mesh,
+        synthetic_batch,
+    )
+
+    config = ModelConfig(max_seq_len=33, n_layers=1)  # 32 % (2*2) == 0
+    mesh = make_usp_mesh(8, ring=2, ulysses=2)  # data=2
+    assert dict(mesh.shape) == {"data": 2, "seq_r": 2, "seq_u": 2, "model": 1}
+    (params, opt_state), optimizer = make_train_state(config, mesh)
+    step = make_seq_parallel_train_step(config, mesh, optimizer, attention="usp")
+    tokens = synthetic_batch(config, batch_size=4)
+    params, opt_state, loss = step(params, opt_state, tokens)
+    assert np.isfinite(float(loss))
+    _, _, loss2 = step(params, opt_state, tokens)
+    assert float(loss2) < float(loss)
+
+
+def test_mode_mesh_mismatch_fails_loud():
+    from workloads.model import ModelConfig
+    from workloads.train import (
+        make_seq_parallel_train_step,
+        make_sp_mesh,
+        make_usp_mesh,
+    )
+
+    config = ModelConfig(max_seq_len=17, n_layers=1)
+
+    class _Opt:
+        pass
+
+    with pytest.raises(ValueError, match="make_usp_mesh"):
+        make_seq_parallel_train_step(
+            config, make_sp_mesh(8), _Opt(), attention="usp"
+        )
+    with pytest.raises(ValueError, match="make_sp_mesh"):
+        make_seq_parallel_train_step(
+            config, make_usp_mesh(8), _Opt(), attention="ring"
+        )
+
+
+def test_mesh_builders_reject_zero_devices():
+    from workloads.train import make_sp_mesh, make_usp_mesh
+
+    with pytest.raises(ValueError, match="positive"):
+        make_sp_mesh(0)
+    with pytest.raises(ValueError, match="positive"):
+        make_usp_mesh(0)

@@ -1,0 +1,97 @@
+"""Unified (2D) sequence parallelism: Ulysses x ring composed.
+
+For contexts longer than either recipe scales to alone, the sequence dim
+shards over TWO mesh axes: an outer ring axis and an inner Ulysses axis
+(spec ``P(batch, ("seq_r", "seq_u"))`` — ring-major, so each ring shard
+owns a contiguous span of the sequence).  Per attention call:
+
+  1. all_to_all over the Ulysses axis re-partitions seq<->heads — each
+     device now holds its ring shard's FULL contiguous span with heads/u
+     local heads (workloads/ops/ulysses.py recipe);
+  2. ring attention circulates k/v spans around the ring axis via ppermute
+     (workloads/ops/ring.py recipe, unchanged — the contiguous-span
+     position math holds because the ring axis is major);
+  3. the reverse all_to_all restores the 2D sharding.
+
+Capacity multiplies: seq/(r*u) resident per device, Ulysses head-split
+bounded by n_heads only per u, ring unbounded in r.  On a TPU mesh the
+Ulysses axis should map to ICI-adjacent chips (its all-to-alls move the
+most bytes at once) with the ring axis across trays/hosts — ring transfers
+overlap with compute.
+
+Differentiable end-to-end (both building blocks are).
+
+Reference pendant: none — the reference daemon has no model code
+(SURVEY.md §5 long-context note); part of the JAX workload suite.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.4.35
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from .ring import _ring_local
+from .ulysses import _ulysses_local
+
+_SEQ_DIM, _HEAD_DIM = 1, 2
+
+
+def usp_attention(
+    q,
+    k,
+    v,
+    mesh,
+    ring_axis: str = "seq_r",
+    ulysses_axis: str = "seq_u",
+    causal: bool = True,
+    batch_axis: str | None = None,
+):
+    """2D sequence-parallel attention over ``mesh[ring_axis] x
+    mesh[ulysses_axis]``.
+
+    q/k/v: [batch, seq, heads, head_dim] global arrays; seq must divide by
+    ring*ulysses and heads by ulysses.  Returns output with the same
+    sharding.  ``batch_axis`` keeps the batch dim mapped (see
+    ring_attention's note).
+    """
+    n_ring = mesh.shape[ring_axis]
+    n_uly = mesh.shape[ulysses_axis]
+    if q.shape[_SEQ_DIM] % (n_ring * n_uly):
+        raise ValueError(
+            f"seq {q.shape[_SEQ_DIM]} not divisible by "
+            f"{ring_axis}*{ulysses_axis} = {n_ring}*{n_uly}"
+        )
+    if q.shape[_HEAD_DIM] % n_uly:
+        raise ValueError(
+            f"heads {q.shape[_HEAD_DIM]} not divisible by {ulysses_axis} "
+            f"size {n_uly}"
+        )
+    # Ring-major: each ring shard owns a contiguous global span, so the
+    # ring body's block-position math (causal masking) holds unchanged.
+    # The per-device body IS the Ulysses body with the ring body as its
+    # local attention — the composition is literal reuse.
+    def ring_as_local_attn(ql, kl, vl, causal_):
+        return _ring_local(
+            ql, kl, vl, axis_name=ring_axis, n_shards=n_ring, causal=causal_
+        )
+
+    spec = P(batch_axis, (ring_axis, ulysses_axis), None, None)
+    run = shard_map(
+        partial(
+            _ulysses_local,
+            axis_name=ulysses_axis,
+            causal=causal,
+            local_attn=ring_as_local_attn,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return run(q, k, v)

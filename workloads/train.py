@@ -134,6 +134,33 @@ def _opt_shardings_like(opt_shape, params_shape, param_shardings, mesh):
     return jax.tree.map(pick, opt_shape)
 
 
+def _data_led_mesh(n_devices: int | None, trailing: dict[str, int]) -> Mesh:
+    """A mesh with a leading "data" axis absorbing whatever the named
+    trailing axes don't; shared by the sp/usp mesh builders."""
+    import math
+
+    import numpy as np
+
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices <= 0:
+        raise ValueError(f"n_devices must be positive, got {n_devices}")
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"requested a {n_devices}-device mesh but only "
+            f"{len(devices)} devices are visible"
+        )
+    denom = math.prod(trailing.values())
+    if n_devices % denom:
+        axes = "*".join(trailing)
+        raise ValueError(f"{n_devices} devices not divisible by {axes}={denom}")
+    grid = np.array(devices[:n_devices]).reshape(
+        (n_devices // denom, *trailing.values())
+    )
+    return Mesh(grid, axis_names=("data", *trailing.keys()))
+
+
 def make_sp_mesh(
     n_devices: int | None = None, seq_parallel: int = 2, model_parallel: int = 1
 ) -> Mesh:
@@ -141,45 +168,65 @@ def make_sp_mesh(
 
     The "seq" axis carries ring attention's k/v rotation (ICI neighbours);
     "model" stays available for the Megatron cut (size 1 by default)."""
-    devices = jax.devices()[: n_devices or len(jax.devices())]
-    n = len(devices)
-    if n_devices is not None and n < n_devices:
-        raise ValueError(
-            f"requested a {n_devices}-device mesh but only {n} devices are visible"
-        )
-    if n % (seq_parallel * model_parallel) != 0:
-        raise ValueError(
-            f"{n} devices not divisible by seq_parallel*model_parallel="
-            f"{seq_parallel * model_parallel}"
-        )
-    import numpy as np
-
-    grid = np.array(devices).reshape(
-        n // (seq_parallel * model_parallel), seq_parallel, model_parallel
+    return _data_led_mesh(
+        n_devices, {"seq": seq_parallel, "model": model_parallel}
     )
-    return Mesh(grid, axis_names=("data", "seq", "model"))
+
+
+def make_usp_mesh(
+    n_devices: int | None = None,
+    ring: int = 2,
+    ulysses: int = 2,
+    model_parallel: int = 1,
+) -> Mesh:
+    """A ("data", "seq_r", "seq_u", "model") mesh for 2D (Ulysses x ring)
+    sequence parallelism — map "seq_u" to ICI-adjacent chips (its
+    all-to-alls move the most bytes at once), "seq_r" across trays/hosts;
+    "model" stays available for the Megatron cut (size 1 by default)."""
+    return _data_led_mesh(
+        n_devices, {"seq_r": ring, "seq_u": ulysses, "model": model_parallel}
+    )
 
 
 def make_seq_parallel_train_step(
     config: ModelConfig, mesh: Mesh, optimizer, attention: str = "ring"
 ):
     """Sequence-parallel variant of the full training step: activations are
-    sharded [data, seq] and attention runs sequence-parallel over the mesh's
-    "seq" axis — ``attention="ring"`` circulates k/v shards via ppermute
-    (workloads/ops/ring.py, no device ever holds the full sequence) and
-    ``attention="ulysses"`` re-partitions seq<->heads with two all-to-alls
-    around the local flash kernel (workloads/ops/ulysses.py, needs heads
-    divisible by the seq axis).  Long-context configuration; requires
-    (max_seq_len - 1) divisible by the seq axis (the LM loss drops one
-    position)."""
+    sharded [data, seq] and attention runs sequence-parallel —
+    ``attention="ring"`` circulates k/v shards via ppermute over the mesh's
+    "seq" axis (workloads/ops/ring.py, no device ever holds the full
+    sequence), ``attention="ulysses"`` re-partitions seq<->heads with two
+    all-to-alls around the local flash kernel (workloads/ops/ulysses.py,
+    needs heads divisible by the seq axis), and ``attention="usp"``
+    composes both over a 2D ("seq_r", "seq_u") sharding (workloads/ops/
+    usp.py, make_usp_mesh).  Long-context configuration; requires
+    (max_seq_len - 1) divisible by the total seq sharding (the LM loss
+    drops one position)."""
     from workloads.ops.ring import ring_attention
     from workloads.ops.ulysses import ulysses_attention
+    from workloads.ops.usp import usp_attention
 
-    n_seq = mesh.shape["seq"]
+    axis_names = set(mesh.axis_names)
+    needed = {"seq_r", "seq_u"} if attention == "usp" else {"seq"}
+    if attention in ("ring", "ulysses", "usp") and not needed <= axis_names:
+        builder = "make_usp_mesh" if attention == "usp" else "make_sp_mesh"
+        raise ValueError(
+            f"attention={attention!r} needs mesh axes {sorted(needed)} "
+            f"(build the mesh with {builder}); got {mesh.axis_names}"
+        )
+    if attention == "usp":
+        n_seq = mesh.shape["seq_r"] * mesh.shape["seq_u"]
+        if config.n_heads % mesh.shape["seq_u"]:
+            raise ValueError(
+                f"usp attention needs n_heads ({config.n_heads}) divisible by "
+                f"the seq_u axis ({mesh.shape['seq_u']})"
+            )
+    else:
+        n_seq = mesh.shape["seq"]
     if (config.max_seq_len - 1) % n_seq:
         raise ValueError(
             f"max_seq_len-1 ({config.max_seq_len - 1}) must divide across the "
-            f"seq axis ({n_seq}); pick max_seq_len = k*{n_seq} + 1"
+            f"seq sharding ({n_seq}); pick max_seq_len = k*{n_seq} + 1"
         )
     if attention == "ring":
 
@@ -196,8 +243,13 @@ def make_seq_parallel_train_step(
         def attention_fn(q, k, v):
             return ulysses_attention(q, k, v, mesh, axis="seq", batch_axis="data")
 
+    elif attention == "usp":
+
+        def attention_fn(q, k, v):
+            return usp_attention(q, k, v, mesh, batch_axis="data")
+
     else:
-        raise ValueError(f"unknown attention {attention!r} (ring|ulysses)")
+        raise ValueError(f"unknown attention {attention!r} (ring|ulysses|usp)")
 
     # Tokens keep the odd max_seq_len (the LM loss drops one position), so
     # they shard on data only; the seq axis materialises on the sliced
