@@ -1,0 +1,119 @@
+"""KV-cached autoregressive decoding for the flagship transformer.
+
+The serving counterpart of workloads/train.py: greedy generation with a
+static-shape KV cache, written for XLA — the whole decode loop is ONE
+``lax.scan`` under jit (no per-token retrace, no dynamic shapes), attention
+reads the full cache with a position mask, and cache updates are
+``dynamic_update_slice`` at the current position.  On a shared TPU chip an
+inference pod runs exactly like the training pods (same Allocate env, same
+cooperative lease).
+
+Decoding is O(seq) per token instead of the O(seq^2) of re-running the
+dense forward, and the cache is the only state carried between tokens.
+
+Reference pendant: none — the reference daemon has no model code; part of
+the JAX workload suite (SURVEY.md §7 step 8).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .model import ModelConfig, _mlp, _rmsnorm, apply_rope, rope_angles
+
+
+def _rope_at(x: jax.Array, pos: jax.Array) -> jax.Array:
+    """Rotary embedding for single-position vectors, sharing the model's
+    frequency/rotation core.  x: [batch, 1, heads, head_dim]; pos: scalar."""
+    return apply_rope(x, rope_angles(jnp.asarray(pos)[None], x.shape[-1]))
+
+
+def init_kv_cache(config: ModelConfig, batch: int, max_len: int):
+    """Per-layer (k, v) buffers: [layers, 2, batch, max_len, heads, head_dim]."""
+    return jnp.zeros(
+        (config.n_layers, 2, batch, max_len, config.n_heads, config.head_dim),
+        config.dtype,
+    )
+
+
+def decode_step(params: dict, cache: jax.Array, token: jax.Array, pos: jax.Array,
+                config: ModelConfig):
+    """One token through the cached model.
+
+    token: [batch] int32 (the token at position ``pos``); returns
+    (logits [batch, vocab], updated cache)."""
+    x = params["embed"].astype(config.dtype)[token][:, None, :]  # [b, 1, d]
+    max_len = cache.shape[3]
+    k_pos = jnp.arange(max_len)
+
+    for i, layer in enumerate(params["layers"]):
+        h = _rmsnorm(x, layer["ln1"])
+        qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(x.dtype))
+        q, k, v = qkv[0], qkv[1], qkv[2]  # [b, 1, H, hd]
+        q, k = _rope_at(q, pos), _rope_at(k, pos)
+        cache = jax.lax.dynamic_update_slice(
+            cache, k[None, None], (i, 0, 0, pos, 0, 0)
+        )
+        cache = jax.lax.dynamic_update_slice(
+            cache, v[None, None], (i, 1, 0, pos, 0, 0)
+        )
+        keys, values = cache[i, 0], cache[i, 1]  # [b, max_len, H, hd]
+        logits = jnp.einsum("bshk,bthk->bhst", q, keys) / jnp.sqrt(
+            config.head_dim
+        ).astype(x.dtype)
+        mask = (k_pos <= pos)[None, None, None, :]
+        logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+        weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhst,bthk->bshk", weights, values)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(x.dtype))
+        x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
+
+    logits = x[:, 0].astype(jnp.float32) @ params["unembed"]
+    return logits, cache
+
+
+@partial(jax.jit, static_argnames=("config", "max_new_tokens"))
+def generate(
+    params: dict,
+    prompt: jax.Array,
+    config: ModelConfig,
+    max_new_tokens: int,
+):
+    """Greedy decode: prompt [batch, prompt_len] -> [batch, max_new_tokens].
+
+    Prefill and decode are one fused scan over positions 0..prompt_len+new-2;
+    within the prompt the scan consumes prompt tokens, beyond it the argmax
+    of the previous step (static shapes throughout)."""
+    batch, prompt_len = prompt.shape
+    if prompt_len < 1:
+        raise ValueError("prompt must contain at least one token")
+    total = prompt_len + max_new_tokens
+    if total > config.max_seq_len:
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {total} exceeds "
+            f"max_seq_len {config.max_seq_len}"
+        )
+    cache = init_kv_cache(config, batch, total)
+    # Padded input stream: prompt then zeros (replaced by generated tokens).
+    stream = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
+
+    def step(carry, pos):
+        cache, prev_tok = carry
+        # Inside the prompt, feed the ground-truth token; beyond it, the
+        # previously generated one.
+        tok = jnp.where(pos < prompt_len, stream[:, pos], prev_tok)
+        logits, cache = decode_step(params, cache, tok, pos, config)
+        next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, next_tok), next_tok
+
+    (_, _), outs = jax.lax.scan(
+        step,
+        (cache, jnp.zeros((batch,), jnp.int32)),
+        jnp.arange(total - 1),
+    )
+    # outs[p] = argmax after consuming position p; generated tokens are the
+    # predictions from positions prompt_len-1 .. total-2.
+    return jnp.transpose(outs, (1, 0))[:, prompt_len - 1 :]

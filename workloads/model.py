@@ -104,17 +104,30 @@ def _rmsnorm(x: jax.Array, gain: jax.Array) -> jax.Array:
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * gain.astype(x.dtype)
 
 
-def _rope(x: jax.Array) -> jax.Array:
-    """Rotary position embedding over the last (head_dim) axis.
-    x: [batch, seq, heads, head_dim]."""
-    _, seq, _, head_dim = x.shape
+def rope_angles(positions: jax.Array, head_dim: int) -> jax.Array:
+    """Rotary angles for the given positions: [n_positions, head_dim//2].
+    Single source of the frequency formula — the KV-cache decode path
+    (workloads/generate.py) must stay numerically identical to this."""
     half = head_dim // 2
     freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
-    angles = jnp.arange(seq, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return positions.astype(jnp.float32)[:, None] * freqs[None, :]
+
+
+def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
+    """Rotate x [batch, seq, heads, head_dim] by angles [seq, head_dim//2]
+    (seq may be 1 for broadcasting a single position)."""
+    half = x.shape[-1] // 2
     cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
     sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _rope(x: jax.Array) -> jax.Array:
+    """Rotary position embedding over the last (head_dim) axis.
+    x: [batch, seq, heads, head_dim]."""
+    _, seq, _, head_dim = x.shape
+    return apply_rope(x, rope_angles(jnp.arange(seq), head_dim))
 
 
 def _attention(
