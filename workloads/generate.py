@@ -22,7 +22,14 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from .model import ModelConfig, _mlp, _rmsnorm, apply_rope, rope_angles
+from .model import (
+    ModelConfig,
+    _mlp,
+    _rmsnorm,
+    apply_rope,
+    masked_attention,
+    rope_angles,
+)
 
 
 def _rope_at(x: jax.Array, pos: jax.Array) -> jax.Array:
@@ -61,13 +68,8 @@ def decode_step(params: dict, cache: jax.Array, token: jax.Array, pos: jax.Array
             cache, v[None, None], (i, 1, 0, pos, 0, 0)
         )
         keys, values = cache[i, 0], cache[i, 1]  # [b, max_len, H, hd]
-        logits = jnp.einsum("bshk,bthk->bhst", q, keys) / jnp.sqrt(
-            config.head_dim
-        ).astype(x.dtype)
         mask = (k_pos <= pos)[None, None, None, :]
-        logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
-        weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bhst,bthk->bshk", weights, values)
+        attn = masked_attention(q, keys, values, mask, config.head_dim)
         x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(x.dtype))
         x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
 

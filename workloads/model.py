@@ -130,6 +130,21 @@ def _rope(x: jax.Array) -> jax.Array:
     return apply_rope(x, rope_angles(jnp.arange(seq), head_dim))
 
 
+def masked_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array, head_dim: int
+) -> jax.Array:
+    """The scale/mask/float32-softmax attention core, [batch, seq, heads,
+    head_dim] layout, mask broadcastable to [batch, heads, s_q, s_k].
+    Single source shared by the dense forward and the KV-cached decode
+    (workloads/generate.py) so the two can never silently diverge."""
+    logits = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(head_dim).astype(
+        q.dtype
+    )
+    logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthk->bshk", weights, v)
+
+
 def _attention(
     x: jax.Array, layer: dict, config: ModelConfig, attention_fn=None
 ) -> jax.Array:
@@ -146,11 +161,8 @@ def _attention(
 
         out = flash_attention(q, k, v)
     else:
-        logits = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(config.head_dim).astype(x.dtype)
-        mask = jnp.tril(jnp.ones((seq, seq), bool))
-        logits = jnp.where(mask[None, None], logits.astype(jnp.float32), -1e30)
-        weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-        out = jnp.einsum("bhst,bthk->bshk", weights, v)
+        mask = jnp.tril(jnp.ones((seq, seq), bool))[None, None]
+        out = masked_attention(q, k, v, mask, config.head_dim)
     return jnp.einsum("bshk,hkd->bsd", out, layer["wo"].astype(x.dtype))
 
 
