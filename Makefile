@@ -4,14 +4,19 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test coverage bench busy-bench clean check fmt-check
+.PHONY: all native test test-all coverage bench busy-bench clean check fmt-check
 
 all: native
 
 native:
 	$(MAKE) -C native
 
+# Fast default: daemon-side suite (<60 s).  The JAX workload slice is marked
+# `slow` (XLA compile dominated, ~12 min CPU); `make test-all` / CI run it.
 test: native
+	$(PYTHON) -m pytest tests/ -q -m "not slow"
+
+test-all: native
 	$(PYTHON) -m pytest tests/ -q
 
 coverage: native
@@ -35,7 +40,7 @@ check: test
 # DOCKER_TARGETS).  `make image` builds the deployable plugin image.
 DOCKER ?= docker
 BUILDIMAGE ?= tpu-device-plugin-devel
-MAKE_TARGETS := native test coverage bench busy-bench check clean
+MAKE_TARGETS := native test test-all coverage bench busy-bench check clean
 
 .PHONY: .build-image image $(patsubst %,docker-%,$(MAKE_TARGETS))
 

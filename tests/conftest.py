@@ -19,3 +19,39 @@ except AttributeError:  # older jax: XLA_FLAGS alone handles it
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import re  # noqa: E402
+
+import pytest  # noqa: E402
+
+# Test modules that compile JAX programs are dominated by XLA compile time
+# (~12 min CPU for the full slice) and carry the `slow` marker, so `make test`
+# (-m "not slow") stays the sub-minute daemon suite; CI and `make test-all`
+# run everything.  Classification is by content — a module that imports jax or
+# the workloads package is slow — so new workload tests are picked up without
+# maintaining a name list.
+_FAST_DESPITE_JAX = {
+    # Drives subprocess pods with tiny matmul kernels; wall time is seconds.
+    "test_oversubscribe",
+}
+_JAX_IMPORT_RE = re.compile(r"^\s*(?:import|from)\s+(?:jax|workloads)\b", re.MULTILINE)
+_slow_file_cache: dict[str, bool] = {}
+
+
+def _is_slow_module(path: str) -> bool:
+    cached = _slow_file_cache.get(path)
+    if cached is None:
+        try:
+            with open(path, encoding="utf-8") as f:
+                cached = bool(_JAX_IMPORT_RE.search(f.read()))
+        except OSError:
+            cached = False
+        _slow_file_cache[path] = cached
+    return cached
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        name = item.module.__name__.rsplit(".", 1)[-1]
+        if name not in _FAST_DESPITE_JAX and _is_slow_module(str(item.fspath)):
+            item.add_marker(pytest.mark.slow)
