@@ -199,13 +199,20 @@ def busy_extras() -> dict:
             last_err = e
             continue
         value = agg["aggregate_busy_fraction"]
-        return {
+        extras = {
             "aggregate_chip_busy_fraction": round(value, 4),
             "busy_vs_baseline": round(value / BASELINE_BUSY_FRACTION, 4),
             "busy_pods": agg["pods"],
             "busy_chips": agg["chips"],
             "busy_platform": platform,
         }
+        if platform != candidates[0]:
+            # Loud marker: the preferred platform (the real chip) failed and
+            # this number was taken on a fallback — a consumer tracking
+            # busy_vs_baseline across runs must not mistake the platform
+            # downgrade for a real regression.
+            extras["busy_platform_fallback"] = True
+        return extras
     raise last_err if last_err else RuntimeError("no busy platform candidates")
 
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 from .backend import BackendInitError
 from .config import Flags
@@ -35,19 +36,29 @@ def _in_use(backend) -> dict:
         return {}
 
 
-import functools
+# Ambient slice metadata resolution can include a node-metadata HTTP probe
+# (2 s timeout), which must not run on every --watch tick — but a transient
+# failure (metadata outage at session start) must not latch for the whole
+# process either.  Cache successes forever; retry failures with a backoff.
+_SLICE_RETRY_SECS = 30.0
+_slice_cache: dict = {"resolved": False, "value": None, "next_retry": 0.0}
 
 
-@functools.lru_cache(maxsize=1)
 def _ambient_slice_info():
-    """Ambient slice metadata, resolved once per process: the resolution can
-    include a node-metadata HTTP probe (2 s timeout), which must not run on
-    every --watch tick."""
+    if _slice_cache["resolved"]:
+        return _slice_cache["value"]
+    now = time.monotonic()
+    if now < _slice_cache["next_retry"]:
+        return None
     try:
-        # Same resolution the daemon uses (incl. metadata fallback).
-        return slice_info_from_env()
+        # Same resolution the daemon uses (incl. metadata fallback).  None is
+        # a definitive answer ("not part of a declared slice") and cacheable.
+        _slice_cache["value"] = slice_info_from_env()
+        _slice_cache["resolved"] = True
+        return _slice_cache["value"]
     except SliceConfigError as e:
         print(f"tpu-info: ignoring ambient slice metadata: {e}", file=sys.stderr)
+        _slice_cache["next_retry"] = now + _SLICE_RETRY_SECS
         return None
 
 

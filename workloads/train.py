@@ -361,12 +361,21 @@ def main(argv=None) -> int:
             jax.block_until_ready(loss)
             jax.profiler.stop_trace()
             print(f"profile trace written to {args.profile_dir}")
-    except BaseException:
+    except BaseException as e:
         if args.profile_dir:
             try:
                 jax.profiler.stop_trace()
             except Exception:
                 pass  # the original exception is what matters
+        if ckpt and isinstance(e, Exception):
+            # Best-effort: finalize in-flight async saves so the most recent
+            # resume point survives a mid-loop failure.  Not on Ctrl-C /
+            # SystemExit — blocking in wait() there would stall the exit.
+            try:
+                ckpt.wait()
+                ckpt.close()
+            except Exception:
+                pass
         raise
     if ckpt:
         ckpt.wait()
