@@ -15,8 +15,8 @@ from tpu_device_plugin.strategy import (
 )
 
 
-def make_strategy(strategy_name, mgr, rc_text="", plugin_dir="/tmp/dp"):
-    cfg = Config(flags=Flags(topology_strategy=strategy_name, backend="fake"))
+def make_strategy(strategy_name, mgr, rc_text="", plugin_dir="/tmp/dp", **flag_kwargs):
+    cfg = Config(flags=Flags(topology_strategy=strategy_name, backend="fake", **flag_kwargs))
     rc = parse_resource_config(rc_text) if rc_text else ResourceConfig()
     return new_topology_strategy(
         cfg, rc, mgr, plugin_dir=plugin_dir, kubelet_socket="/tmp/dp/kubelet.sock"
@@ -79,10 +79,21 @@ def test_tray_strategy(two_trays):
     assert {a.id for a in plugin._advertised} == {"tray-0", "tray-1"}
 
 
-def test_tray_strategy_falls_back_to_chips():
+def test_tray_strategy_fails_loud_without_multichip_trays():
+    # Reference parity: `single` errors when the host cannot satisfy the
+    # requested granularity (mig-strategy.go:114-203); an operator who asked
+    # for trays must not silently get chips.
     mgr = FakeChipManager(n_chips=4, chips_per_tray=1)
     mgr.init()
     strategy = make_strategy("tray", mgr)
+    with pytest.raises(RuntimeError, match="no multi-chip trays"):
+        strategy.get_plugins()
+
+
+def test_tray_strategy_falls_back_to_chips_when_allowed():
+    mgr = FakeChipManager(n_chips=4, chips_per_tray=1)
+    mgr.init()
+    strategy = make_strategy("tray", mgr, tray_allow_chip_fallback=True)
     (plugin,) = strategy.get_plugins()
     plugin.initialize()
     assert {a.id for a in plugin._advertised} == {"tpu-0", "tpu-1", "tpu-2", "tpu-3"}

@@ -71,6 +71,11 @@ class Flags:
     # overlapping resource becomes schedulable again (the device-plugin API
     # has no deallocate signal).  0 disables expiry.
     mixed_claim_ttl_secs: float = 300.0
+    # Tray strategy on a host with no multi-chip trays is a misconfiguration
+    # and fails loudly by default (the reference's `single` strategy errors on
+    # non-uniform MIG, mig-strategy.go:114-203); set this to degrade to chip
+    # granularity with a log line instead.
+    tray_allow_chip_fallback: bool = False
     # Prometheus /metrics + /healthz HTTP port; 0 disables the endpoint.
     metrics_port: int = 0
     # Multi-host slice overrides (else read from TPU_TOPOLOGY /
@@ -117,6 +122,9 @@ FLAG_DEFS: list[FlagDef] = [
             "kubelet device-plugin socket directory (default: the kubelet standard path)"),
     FlagDef("mixed_claim_ttl_secs", "--mixed-claim-ttl-secs", "MIXED_CLAIM_TTL_SECS", float,
             "mixed strategy: seconds before a cross-view chip claim expires (0 = never)"),
+    FlagDef("tray_allow_chip_fallback", "--tray-allow-chip-fallback", "TRAY_ALLOW_CHIP_FALLBACK",
+            bool, "tray strategy: degrade to chip granularity on hosts without multi-chip "
+            "trays instead of failing"),
     FlagDef("metrics_port", "--metrics-port", "METRICS_PORT", int,
             "Prometheus /metrics + /healthz port (0 = disabled)"),
     FlagDef("slice_topology", "--slice-topology", "SLICE_TOPOLOGY", str,

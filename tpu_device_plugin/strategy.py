@@ -146,7 +146,19 @@ class TrayStrategy(TopologyStrategy):
     def get_plugins(self) -> list[TpuDevicePlugin]:
         units = tray_units(self.manager)
         if all(len(u.chips) <= 1 for u in units):
-            log.info("no multi-chip trays found; falling back to chip strategy")
+            # Fail loud by default, like the reference's `single` strategy on
+            # non-uniform MIG (mig-strategy.go:114-203): an operator who asked
+            # for tray granularity must not silently get chip granularity.
+            if not self.config.flags.tray_allow_chip_fallback:
+                raise RuntimeError(
+                    "tray strategy: no multi-chip trays on this host; use "
+                    "--topology-strategy=chip, or pass "
+                    "--tray-allow-chip-fallback to degrade to chip granularity"
+                )
+            log.warning(
+                "no multi-chip trays found; --tray-allow-chip-fallback set, "
+                "falling back to chip strategy"
+            )
             return ChipStrategy(
                 self.config,
                 self.resource_config,
