@@ -66,6 +66,30 @@ def test_helm_values_cover_wired_env_vars():
         )
 
 
+def test_helm_compat_with_cpumanager_toggle():
+    """The chart's compatWithCPUManager toggle (reference values.yaml +
+    templates/daemonset.yml:83-95) forces PASS_DEVICE_SPECS on; the TPU chart
+    never escalates to privileged (device access is just the /dev mount)."""
+    import yaml
+
+    text = open(HELM_DAEMONSET).read()
+    # The toggle must gate the PASS_DEVICE_SPECS value, forcing "true".
+    m = re.search(
+        r"PASS_DEVICE_SPECS\s*\n\s*value:\s*(.+)$", text, re.M
+    )
+    assert m, "PASS_DEVICE_SPECS not wired in helm daemonset"
+    assert ".Values.compatWithCPUManager" in m.group(1)
+    assert '"true"' in m.group(1)
+    # And it has a default so `helm template` renders out of the box.
+    with open(
+        os.path.join(REPO, "deployments", "helm", "tpu-device-plugin", "values.yaml")
+    ) as f:
+        values = yaml.safe_load(f)
+    assert values["compatWithCPUManager"] is False
+    assert values["trayAllowChipFallback"] is False
+    assert "privileged: true" not in text
+
+
 def test_packaging_make_targets_expand():
     """The per-distribution image targets (packaging.mk, reference analog
     deployments/container/{Makefile,multi-arch.mk,native-only.mk}) expand to
