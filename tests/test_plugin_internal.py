@@ -65,6 +65,115 @@ class TestClaimLedger:
         ledger.release(["c0"])
         assert len(calls) == 2
 
+    def test_live_claim_renews_past_ttl(self):
+        # A pod running longer than the TTL must never have its chips
+        # re-advertised through the other view (VERDICT weak #2).
+        clock = FakeClock()
+        ledger = ClaimLedger(ttl_secs=60, clock=clock)
+        ledger.set_liveness_probe(
+            lambda ids: {cid: True for cid in ids}, probe_interval_secs=0
+        )
+        ledger.claim("tray", ["c0"])
+        for _ in range(5):
+            clock.advance(45)  # sweep within each TTL window renews
+            assert ledger.sweep() is False
+        assert ledger.claimed_by_other("chip") == {"c0"}
+
+    def test_observed_exit_releases_within_probe_interval(self):
+        clock = FakeClock()
+        ledger = ClaimLedger(ttl_secs=600, clock=clock)
+        alive = {"c0": True}
+        ledger.set_liveness_probe(
+            lambda ids: {cid: alive.get(cid) for cid in ids},
+            grace_secs=60,
+            allow_release=True,
+            probe_interval_secs=0,
+        )
+        ledger.claim("tray", ["c0"])
+        clock.advance(5)
+        ledger.sweep()  # observed alive once (inside grace — renewal only)
+        alive["c0"] = False
+        clock.advance(5)
+        # Seen-alive claims release on observed exit even before grace.
+        assert ledger.sweep() is True
+        assert ledger.claimed_by_other("chip") == set()
+
+    def test_never_seen_alive_shielded_by_grace(self):
+        clock = FakeClock()
+        ledger = ClaimLedger(ttl_secs=600, clock=clock)
+        ledger.set_liveness_probe(
+            lambda ids: {cid: False for cid in ids},
+            grace_secs=60,
+            allow_release=True,
+            probe_interval_secs=0,
+        )
+        ledger.claim("tray", ["c0"])
+        clock.advance(30)
+        assert ledger.sweep() is False  # starting pod hasn't opened the chip yet
+        assert ledger.claimed_by_other("chip") == {"c0"}
+        clock.advance(31)
+        assert ledger.sweep() is True  # grace passed, still dead: release
+        assert ledger.claimed_by_other("chip") == set()
+
+    def test_observed_dead_without_release_flag_falls_back_to_ttl(self):
+        clock = FakeClock()
+        ledger = ClaimLedger(ttl_secs=60, clock=clock)
+        ledger.set_liveness_probe(
+            lambda ids: {cid: False for cid in ids},
+            grace_secs=0,
+            allow_release=False,
+            probe_interval_secs=0,
+        )
+        ledger.claim("tray", ["c0"])
+        clock.advance(30)
+        assert ledger.sweep() is False  # no early release without the flag
+        clock.advance(31)
+        assert ledger.sweep() is True  # TTL still applies
+
+    def test_unknown_liveness_uses_ttl(self):
+        clock = FakeClock()
+        ledger = ClaimLedger(ttl_secs=60, clock=clock)
+        ledger.set_liveness_probe(
+            lambda ids: {cid: None for cid in ids},
+            grace_secs=0,
+            allow_release=True,
+            probe_interval_secs=0,
+        )
+        ledger.claim("tray", ["c0"])
+        clock.advance(59)
+        assert ledger.sweep() is False
+        clock.advance(2)
+        assert ledger.sweep() is True
+
+    def test_probe_throttled_by_interval(self):
+        clock = FakeClock()
+        calls = []
+        ledger = ClaimLedger(ttl_secs=600, clock=clock)
+        ledger.set_liveness_probe(
+            lambda ids: calls.append(1) or {cid: True for cid in ids},
+            probe_interval_secs=10,
+        )
+        ledger.claim("tray", ["c0"])
+        for _ in range(5):
+            clock.advance(1)
+            ledger.sweep()
+        assert len(calls) == 1  # 5 sweeps in 5s -> one probe at 10s interval
+        clock.advance(10)
+        ledger.sweep()
+        assert len(calls) == 2
+
+    def test_broken_probe_does_not_break_sweep(self):
+        clock = FakeClock()
+        ledger = ClaimLedger(ttl_secs=60, clock=clock)
+
+        def bad_probe(ids):
+            raise OSError("proc walk failed")
+
+        ledger.set_liveness_probe(bad_probe, probe_interval_secs=0)
+        ledger.claim("tray", ["c0"])
+        clock.advance(61)
+        assert ledger.sweep() is True  # TTL path still works
+
     def test_sweep_notifies_all_listeners(self):
         # Regression: whichever plugin sweeps first must wake its siblings —
         # the sweeper is usually the plugin whose own view was never blocked.

@@ -99,6 +99,52 @@ def test_tray_strategy_falls_back_to_chips_when_allowed():
     assert {a.id for a in plugin._advertised} == {"tpu-0", "tpu-1", "tpu-2", "tpu-3"}
 
 
+class TestClaimLivenessProbe:
+    def test_open_count_positive_is_alive(self, v5e4, tmp_path):
+        from tpu_device_plugin.strategy import make_claim_liveness_probe
+
+        v5e4.set_in_use({0: 2, 1: 0, 2: 0, 3: 0})
+        probe = make_claim_liveness_probe(v5e4, str(tmp_path), counts_authoritative=True)
+        verdicts = probe(["tpu-0", "tpu-1"])
+        assert verdicts["tpu-0"] is True
+        assert verdicts["tpu-1"] is False  # authoritative zero, no flock
+
+    def test_zero_count_not_authoritative_is_unknown(self, v5e4, tmp_path):
+        # A namespace-local /proc walk returns confident zeros for other
+        # pods' handles; without hostPID those zeros must not read as death.
+        from tpu_device_plugin.strategy import make_claim_liveness_probe
+
+        v5e4.set_in_use({0: 0, 1: 0, 2: 0, 3: 0})
+        probe = make_claim_liveness_probe(v5e4, str(tmp_path), counts_authoritative=False)
+        assert probe(["tpu-0"]) == {"tpu-0": None}
+
+    def test_held_flock_outranks_zero_count(self, v5e4, tmp_path):
+        import fcntl
+        import os
+
+        from tpu_device_plugin.sharing import lease_path
+        from tpu_device_plugin.strategy import make_claim_liveness_probe
+
+        v5e4.set_in_use({0: 0, 1: 0, 2: 0, 3: 0})
+        probe = make_claim_liveness_probe(v5e4, str(tmp_path), counts_authoritative=True)
+        fd = os.open(lease_path(str(tmp_path), "tpu-0"), os.O_CREAT | os.O_RDWR, 0o666)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            verdicts = probe(["tpu-0", "tpu-1"])
+            # Lease-holding workload is alive even when the walk says 0.
+            assert verdicts["tpu-0"] is True
+            assert verdicts["tpu-1"] is False
+        finally:
+            os.close(fd)
+
+    def test_probe_unavailable_falls_to_unknown(self, v5e4, tmp_path):
+        from tpu_device_plugin.strategy import make_claim_liveness_probe
+
+        # {} = probe unavailable (native .so predates the call), never "idle".
+        probe = make_claim_liveness_probe(v5e4, str(tmp_path), counts_authoritative=True)
+        assert probe(["tpu-0"]) == {"tpu-0": None}
+
+
 def test_mixed_strategy_both_views_share_ledger(v5e4):
     strategy = make_strategy("mixed", v5e4)
     assert isinstance(strategy, MixedStrategy)

@@ -44,6 +44,7 @@ class FakeChipManager(ChipManager):
         self._id_prefix = id_prefix
         self._topology: Topology | None = None
         self._injected: "queue.Queue[HealthEvent]" = queue.Queue()
+        self._in_use: dict[int, int] = {}
         self.initialized = False
 
     # -- ChipManager contract -------------------------------------------------
@@ -88,12 +89,22 @@ class FakeChipManager(ChipManager):
             if event.all_chips or event.chip_id in watched:
                 events.put(event)
 
+    def chips_in_use(self) -> dict[int, int]:
+        """Scripted open-handle counts (the native tpuinfo_chips_in_use
+        analog); {} until a test scripts them — meaning "probe unavailable",
+        never "all idle" (matching backend/native.py:194-208)."""
+        return dict(self._in_use)
+
     # -- test/bench controls --------------------------------------------------
 
     def inject(self, chip_id: str, health: str = UNHEALTHY, code: int = 0) -> None:
         """Script a health transition; '' = all chips."""
         assert health in (HEALTHY, UNHEALTHY)
         self._injected.put(HealthEvent(chip_id=chip_id, health=health, code=code))
+
+    def set_in_use(self, counts: dict[int, int]) -> None:
+        """Script the full chip-index -> open-handle-count map."""
+        self._in_use = dict(counts)
 
     def _require_init(self) -> None:
         if not self.initialized or self._topology is None:

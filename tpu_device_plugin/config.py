@@ -71,6 +71,15 @@ class Flags:
     # overlapping resource becomes schedulable again (the device-plugin API
     # has no deallocate signal).  0 disables expiry.
     mixed_claim_ttl_secs: float = 300.0
+    # Mixed strategy: seconds a never-observed-alive claim is shielded from
+    # probe-driven early release (pod startup — image pull, container start,
+    # libtpu init — precedes the first device open).
+    mixed_claim_grace_secs: float = 60.0
+    # Allow the claim liveness probe to release claims whose workload is
+    # observed gone (device node open count == 0).  The /proc open-count
+    # probe only sees node-wide truth when the daemon shares the host PID
+    # namespace, so the helm chart ties this to hostPID.
+    claim_liveness_release: bool = False
     # Tray strategy on a host with no multi-chip trays is a misconfiguration
     # and fails loudly by default (the reference's `single` strategy errors on
     # non-uniform MIG, mig-strategy.go:114-203); set this to degrade to chip
@@ -122,6 +131,11 @@ FLAG_DEFS: list[FlagDef] = [
             "kubelet device-plugin socket directory (default: the kubelet standard path)"),
     FlagDef("mixed_claim_ttl_secs", "--mixed-claim-ttl-secs", "MIXED_CLAIM_TTL_SECS", float,
             "mixed strategy: seconds before a cross-view chip claim expires (0 = never)"),
+    FlagDef("mixed_claim_grace_secs", "--mixed-claim-grace-secs", "MIXED_CLAIM_GRACE_SECS", float,
+            "mixed strategy: startup grace before a claim may be released by the liveness probe"),
+    FlagDef("claim_liveness_release", "--claim-liveness-release", "CLAIM_LIVENESS_RELEASE", bool,
+            "release mixed-strategy claims when the workload is observed gone "
+            "(requires hostPID for node-wide /proc visibility)"),
     FlagDef("tray_allow_chip_fallback", "--tray-allow-chip-fallback", "TRAY_ALLOW_CHIP_FALLBACK",
             bool, "tray strategy: degrade to chip granularity on hosts without multi-chip "
             "trays instead of failing"),

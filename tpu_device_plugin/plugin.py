@@ -76,23 +76,72 @@ class CrashBudget:
         return self._count <= self._max
 
 
+# How often the claim sweep may invoke the liveness probe (a /proc walk +
+# flock probes); sweeps themselves run on every idle health-loop tick.
+CLAIM_PROBE_INTERVAL_SECS = 2.0
+
+
+@dataclass
+class _Claim:
+    resource: str
+    renewed: float  # last claim/renewal time; the TTL counts from here
+    born: float  # original Allocate time; the startup grace counts from here
+    seen_alive: bool = False  # workload observed alive at least once
+
+
 class ClaimLedger:
     """Cross-plugin chip-claim bookkeeping for the ``mixed`` strategy.
 
     When the same physical chips are visible through two resources (a whole
     tray and its individual chips), an Allocate through one resource claims
     the chips, and every *other* plugin marks its overlapping units Unhealthy
-    so the scheduler stops placing pods on them.  The device-plugin API has
-    no deallocate signal, so claims expire after ``ttl_secs`` (or are
-    released explicitly, e.g. by lease-liveness integration).
+    so the scheduler stops placing pods on them.
+
+    The device-plugin API has no deallocate signal (the gap the reference
+    never solved — server.go:259 FIXME territory), so release is driven by
+    *reality* when a liveness probe is wired (strategy.py
+    make_claim_liveness_probe: device-node open counts via
+    tpuinfo_chips_in_use + lease-flock probes):
+
+      * a chip whose workload is observably alive has its claim renewed, so
+        a pod running longer than the TTL never gets its silicon
+        re-advertised through the other view;
+      * a chip observed definitively dead past ``grace_secs`` is released
+        within a probe interval — if ``allow_release`` (the open-count probe
+        is only node-wide truth when the daemon shares the host PID
+        namespace, so the chart ties it to hostPID);
+      * chips with unknown liveness fall back to the blind TTL.
     """
 
     def __init__(self, ttl_secs: float | None = None, clock=time.monotonic):
         self._lock = threading.Lock()
-        self._claims: dict[str, tuple[str, float]] = {}  # chip_id -> (resource, when)
+        self._claims: dict[str, _Claim] = {}  # chip_id -> claim state
         self._listeners: list[Callable[[], None]] = []
         self._ttl = ttl_secs
         self._clock = clock
+        self._probe: Callable[[list[str]], dict[str, bool | None]] | None = None
+        self._probe_grace = 60.0
+        self._probe_release = False
+        self._probe_interval = CLAIM_PROBE_INTERVAL_SECS
+        self._last_probe = float("-inf")
+
+    def set_liveness_probe(
+        self,
+        probe: Callable[[list[str]], dict[str, bool | None]],
+        grace_secs: float = 60.0,
+        allow_release: bool = False,
+        probe_interval_secs: float = CLAIM_PROBE_INTERVAL_SECS,
+    ) -> None:
+        """Wire a liveness probe: ``probe(chip_ids)`` returns chip_id ->
+        True (workload observably alive), False (observably gone), or None
+        (unknown).  ``grace_secs`` shields fresh claims from early release
+        while their pod is still starting (image pull, container start,
+        libtpu init can precede the first device open by minutes)."""
+        with self._lock:
+            self._probe = probe
+            self._probe_grace = grace_secs
+            self._probe_release = allow_release
+            self._probe_interval = probe_interval_secs
 
     def subscribe(self, fn: Callable[[], None]) -> None:
         with self._lock:
@@ -102,7 +151,7 @@ class ClaimLedger:
         now = self._clock()
         with self._lock:
             for cid in chip_ids:
-                self._claims[cid] = (resource, now)
+                self._claims[cid] = _Claim(resource=resource, renewed=now, born=now)
             listeners = list(self._listeners)
         for fn in listeners:
             fn()
@@ -120,29 +169,58 @@ class ClaimLedger:
         with self._lock:
             return {
                 cid
-                for cid, (res, when) in self._claims.items()
-                if res != resource
-                and (self._ttl is None or now - when < self._ttl)
+                for cid, c in self._claims.items()
+                if c.resource != resource
+                and (self._ttl is None or now - c.renewed < self._ttl)
             }
 
     def sweep(self) -> bool:
-        """Drop expired claims; notifies ALL listeners when anything expired
-        so every plugin re-broadcasts (the sweeping plugin is usually the one
-        whose own view was never blocked — its siblings are the ones that
-        must recover)."""
-        if self._ttl is None:
-            return False
+        """Reconcile claims with reality (probe) and the TTL; notifies ALL
+        listeners when anything was dropped so every plugin re-broadcasts
+        (the sweeping plugin is usually the one whose own view was never
+        blocked — its siblings are the ones that must recover)."""
         now = self._clock()
+        verdicts: dict[str, bool | None] = {}
         with self._lock:
-            expired = [
-                cid for cid, (_, when) in self._claims.items() if now - when >= self._ttl
-            ]
-            for cid in expired:
-                del self._claims[cid]
-            listeners = list(self._listeners) if expired else []
+            probe = self._probe
+            due = probe is not None and now - self._last_probe >= self._probe_interval
+            claimed = list(self._claims) if due else []
+            if due:
+                self._last_probe = now
+        if claimed:
+            try:
+                verdicts = probe(claimed) or {}
+            except Exception as e:  # a broken probe must not take down sweeps
+                log.warning("claim liveness probe failed: %s", e)
+                verdicts = {}
+        dropped = []
+        with self._lock:
+            for cid, c in list(self._claims.items()):
+                alive = verdicts.get(cid)
+                if alive is True:
+                    # Observably running: renew, so a long-lived pod never
+                    # has its chips re-advertised through the other view.
+                    c.renewed = now
+                    c.seen_alive = True
+                elif (
+                    alive is False
+                    and self._probe_release
+                    # Startup shield: never early-release a claim whose pod
+                    # was never observed alive until grace has passed since
+                    # the claim (image pull / container start / libtpu init
+                    # precede the first device open).  Once seen alive, an
+                    # observed exit releases within one probe interval.
+                    and (c.seen_alive or now - c.born >= self._probe_grace)
+                ):
+                    del self._claims[cid]
+                    dropped.append(cid)
+                elif self._ttl is not None and now - c.renewed >= self._ttl:
+                    del self._claims[cid]
+                    dropped.append(cid)
+            listeners = list(self._listeners) if dropped else []
         for fn in listeners:
             fn()
-        return bool(expired)
+        return bool(dropped)
 
 
 @dataclass

@@ -82,3 +82,34 @@ def lease_mounts(lease_dir: str = DEFAULT_LEASE_DIR):
 
 def ensure_lease_dir(lease_dir: str = DEFAULT_LEASE_DIR) -> None:
     os.makedirs(lease_dir, exist_ok=True)
+
+
+def lease_path(lease_dir: str, chip_id: str) -> str:
+    """Host path of a chip's lease file.  The naming contract is shared with
+    the workload-side client (workloads.lease), which imports it from here."""
+    return os.path.join(lease_dir, f"chip-{chip_id.replace('/', '_')}.lock")
+
+
+def lease_held(chip_id: str, lease_dir: str = DEFAULT_LEASE_DIR) -> bool:
+    """True iff some process currently holds the chip's lease flock.
+
+    flock visibility is filesystem-level, so this works across PID
+    namespaces (unlike /proc open-handle counting).  False proves nothing:
+    exclusive pods never lease, and shared pods release between bursts.
+    """
+    import fcntl
+
+    path = lease_path(lease_dir, chip_id)
+    try:
+        fd = os.open(path, os.O_RDWR)
+    except OSError:
+        return False  # no lease file -> nobody ever leased this chip here
+    try:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except BlockingIOError:
+            return True
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        return False
+    finally:
+        os.close(fd)

@@ -37,6 +37,7 @@ from .config import (
     STRATEGY_TRAY,
 )
 from .device import Unit
+from . import sharing
 from .health import HealthFanout
 from .plugin import ClaimLedger, TpuDevicePlugin
 from .resource_config import ResourceConfig
@@ -51,6 +52,54 @@ TRAY_RESOURCE_KEY = "tpu-tray"
 
 def chip_units(manager: ChipManager) -> list[Unit]:
     return [Unit(id=c.id, chips=[c]) for c in manager.devices()]
+
+
+def make_claim_liveness_probe(
+    manager: ChipManager, lease_dir: str, counts_authoritative: bool = False
+):
+    """Liveness probe for the mixed-strategy ClaimLedger: chip_id -> True
+    (workload observably alive), False (observably gone), None (unknown).
+
+    Two signals:
+      * device-node open counts (tpuinfo_chips_in_use, one /proc walk).
+        A count > 0 always proves alive.  A count of 0 is only evidence of
+        death when ``counts_authoritative`` — the walk sees node-wide truth
+        only under hostPID; a namespace-local walk returns confident zeros
+        for other pods' handles.  {} means the probe is unavailable.
+      * lease flock held (filesystem-level, namespace-INDEPENDENT) — held
+        proves alive even when the /proc walk says 0; free proves nothing
+        (exclusive pods never lease, shared pods release between bursts).
+    """
+
+    def probe(chip_ids: list[str]) -> dict:
+        in_use: dict[int, int] = {}
+        fn = getattr(manager, "chips_in_use", None)
+        if callable(fn):
+            try:
+                in_use = fn() or {}
+            except Exception:
+                in_use = {}
+        try:
+            index_by_id = {c.id: c.index for c in manager.devices()}
+        except Exception:
+            index_by_id = {}
+        out: dict[str, bool | None] = {}
+        for cid in chip_ids:
+            idx = index_by_id.get(cid)
+            count = in_use.get(idx) if idx is not None else None
+            if count is not None and count > 0:
+                out[cid] = True
+            elif sharing.lease_held(cid, lease_dir):
+                # The flock outranks a zero count: a held lease is proof of
+                # life even when the walk is namespace-blind or undercounts.
+                out[cid] = True
+            elif count == 0 and counts_authoritative:
+                out[cid] = False
+            else:
+                out[cid] = None
+        return out
+
+    return probe
 
 
 def tray_units(manager: ChipManager) -> list[Unit]:
@@ -191,9 +240,23 @@ class MixedStrategy(TopologyStrategy):
 
     def get_plugins(self) -> list[TpuDevicePlugin]:
         # The device-plugin API has no deallocate signal, so cross-view
-        # claims expire after a TTL (lazily swept by the plugins' health
-        # loops) instead of lingering until daemon restart.
-        claims = ClaimLedger(ttl_secs=self.config.flags.mixed_claim_ttl_secs or None)
+        # claims are reconciled with reality: live workloads renew their
+        # claims (a pod outliving the TTL never gets double-allocated),
+        # observed exits release early, and unknowns fall back to the TTL
+        # (lazily swept by the plugins' health loops).
+        flags = self.config.flags
+        claims = ClaimLedger(ttl_secs=flags.mixed_claim_ttl_secs or None)
+        claims.set_liveness_probe(
+            make_claim_liveness_probe(
+                self.manager,
+                self.lease_dir,
+                # Zero open counts are only death evidence with node-wide
+                # /proc visibility; the chart ties this flag to hostPID.
+                counts_authoritative=flags.claim_liveness_release,
+            ),
+            grace_secs=flags.mixed_claim_grace_secs,
+            allow_release=flags.claim_liveness_release,
+        )
         chip_rc = self.resource_config.get(CHIP_RESOURCE_KEY)
         tray_rc = self.resource_config.get(TRAY_RESOURCE_KEY)
         chip_policy = new_best_effort_policy(self.manager.topology())

@@ -23,17 +23,17 @@ import os
 import time
 from contextlib import contextmanager
 
-from tpu_device_plugin.sharing import DEFAULT_LEASE_DIR, LEASE_DIR_ENV
+from tpu_device_plugin.sharing import (  # noqa: F401  (lease_path re-exported)
+    DEFAULT_LEASE_DIR,
+    LEASE_DIR_ENV,
+    lease_path,
+)
 
 
 def chip_ids_from_env() -> list[str]:
     """Chip ids the plugin granted this pod (from TPU_VISIBLE_CHIPS)."""
     raw = os.environ.get("TPU_VISIBLE_CHIPS", "")
     return [c for c in raw.split(",") if c]
-
-
-def lease_path(lease_dir: str, chip_id: str) -> str:
-    return os.path.join(lease_dir, f"chip-{chip_id.replace('/', '_')}.lock")
 
 
 @contextmanager
