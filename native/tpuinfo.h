@@ -54,8 +54,25 @@ typedef struct {
 /* Health-event codes (tpuinfo_health_event_t.code).  Deployments can
  * suppress individual codes via the DP_DISABLE_HEALTHCHECKS environment
  * variable, the contract the reference defines for XID codes
- * (cmd/nvidia-device-plugin/nvidia.go:31-38). */
+ * (cmd/nvidia-device-plugin/nvidia.go:31-38).  Events are per-CLASS
+ * transitions: each code flips healthy/unhealthy independently and the
+ * Python fan-out aggregates them into chip health downstream of its skip
+ * list (the reference's consumer-side XID filtering, nvidia.go:181-269). */
 #define TPUINFO_EVENT_NODE_LIVENESS 0 /* /dev/accel* vanished or reappeared */
+/* Device node present but open() fails with a hardware-ish errno
+ * (EIO/ENXIO/ENODEV/...): the chip is wedged while still enumerable.
+ * EBUSY/EACCES/EPERM are NOT failures (exclusively-held or unprobeable is
+ * not evidence of sickness).  Disable via TPUINFO_DISABLE_OPEN_PROBE=1. */
+#define TPUINFO_EVENT_OPEN_PROBE 1
+/* Driver chip-error counter (<sysfs>/device/tpu_error_count) rose above
+ * its baseline; recovers when the driver resets the counter.  Absent
+ * counter files leave the class inactive. */
+#define TPUINFO_EVENT_CHIP_ERROR_COUNTER 2
+/* Application-error counter (<sysfs>/device/tpu_app_error_count): faults
+ * attributable to the workload, not the silicon — the analog of the
+ * reference's application XIDs 13/31/43/45/68, skip-listed by default on
+ * the Python side (health.APPLICATION_ERROR_CODES). */
+#define TPUINFO_EVENT_APP_ERROR_COUNTER 3
 
 typedef struct {
   char chip_id[TPUINFO_ID_LEN]; /* "" = event applies to all chips */
@@ -99,6 +116,29 @@ int tpuinfo_chips_in_use(int32_t* counts, int max);
 /* Single-chip convenience over the same walk. index is the host-local
  * chip index. Returns >= 0 or a negative error. */
 int tpuinfo_chip_in_use(int index);
+
+#define TPUINFO_SOURCE_LEN 16
+
+/* Where topology coordinates and HBM capacities came from — "measured vs
+ * assumed", aggregated across chips (measured only when EVERY chip's value
+ * was).  The reference reads both from the hardware (nvml.go:592-658
+ * topology, nvidia.go:87-111 memory); TPU hosts don't always expose them,
+ * so discovery degrades explicitly instead of silently:
+ *   coords: "sysfs"    per-chip <sysfs>/device/tpu_coords "x,y,z"
+ *           "metadata" TPU_CHIPS_PER_HOST_BOUNDS platform grid (row-major)
+ *           "assumed"  synthesized from enumeration order
+ *   hbm:    "sysfs"    per-chip <sysfs>/device/tpu_hbm_bytes
+ *           "pci-bar"  largest PCI memory BAR >= 1 GiB (the HBM aperture)
+ *           "env"      TPUINFO_HBM_GIB override
+ *           "table"    per-generation constant table */
+typedef struct {
+  int32_t coords_measured; /* 1 = every chip's coords from sysfs/metadata */
+  int32_t hbm_measured;    /* 1 = every chip's HBM from sysfs/pci-bar */
+  char coords_source[TPUINFO_SOURCE_LEN];
+  char hbm_source[TPUINFO_SOURCE_LEN];
+} tpuinfo_provenance_t;
+
+int tpuinfo_get_provenance(tpuinfo_provenance_t* out);
 
 const char* tpuinfo_version(void);
 

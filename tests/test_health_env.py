@@ -119,3 +119,66 @@ def test_skip_codes_filter_events_but_not_liveness(monkeypatch):
     assert q.get(timeout=5).health == HEALTHY
     for sub in (q, q2):
         fanout.unsubscribe(sub)
+
+
+def test_application_error_code_skipped_by_default():
+    # tpu_app_error_count transitions (code 3) are workload faults, not sick
+    # silicon — skip-listed like the reference's application XIDs
+    # 13/31/43/45/68 (nvidia.go:193-199).
+    from tpu_device_plugin.health import APPLICATION_ERROR_CODES, EVENT_APP_ERROR_COUNTER
+
+    mgr = FakeChipManager(n_chips=2)
+    mgr.init()
+    fanout = HealthFanout(mgr)
+    q = fanout.subscribe()
+    mgr.inject("tpu-0", UNHEALTHY, code=EVENT_APP_ERROR_COUNTER)
+    with pytest.raises(queue.Empty):
+        q.get(timeout=0.5)
+    assert EVENT_APP_ERROR_COUNTER in APPLICATION_ERROR_CODES
+    fanout.unsubscribe(q)
+
+
+def test_per_class_aggregation_one_recovery_does_not_mask_another():
+    # Multi-class health: open-probe (1) and chip-error-counter (2) both
+    # fire; the chip recovers only when BOTH classes clear.
+    from tpu_device_plugin.health import EVENT_CHIP_ERROR_COUNTER, EVENT_OPEN_PROBE
+
+    mgr = FakeChipManager(n_chips=1)
+    mgr.init()
+    fanout = HealthFanout(mgr)
+    q = fanout.subscribe()
+
+    mgr.inject("tpu-0", UNHEALTHY, code=EVENT_OPEN_PROBE)
+    assert q.get(timeout=5).health == UNHEALTHY
+    mgr.inject("tpu-0", UNHEALTHY, code=EVENT_CHIP_ERROR_COUNTER)
+    with pytest.raises(queue.Empty):
+        q.get(timeout=0.4)  # already unhealthy: no duplicate transition
+    # One class recovers; the other is still active -> NO healthy event.
+    mgr.inject("tpu-0", HEALTHY, code=EVENT_OPEN_PROBE)
+    with pytest.raises(queue.Empty):
+        q.get(timeout=0.4)
+    # Second class clears -> aggregate recovery.
+    mgr.inject("tpu-0", HEALTHY, code=EVENT_CHIP_ERROR_COUNTER)
+    ev = q.get(timeout=5)
+    assert (ev.chip_id, ev.health) == ("tpu-0", HEALTHY)
+    fanout.unsubscribe(q)
+
+
+def test_skipped_class_never_joins_aggregate():
+    # A skipped class going unhealthy-then-healthy must not disturb the
+    # aggregate driven by real classes.
+    from tpu_device_plugin.health import EVENT_APP_ERROR_COUNTER, EVENT_NODE_LIVENESS
+
+    mgr = FakeChipManager(n_chips=1)
+    mgr.init()
+    fanout = HealthFanout(mgr)
+    q = fanout.subscribe()
+    mgr.inject("tpu-0", UNHEALTHY, code=EVENT_NODE_LIVENESS)
+    assert q.get(timeout=5).health == UNHEALTHY
+    mgr.inject("tpu-0", UNHEALTHY, code=EVENT_APP_ERROR_COUNTER)
+    mgr.inject("tpu-0", HEALTHY, code=EVENT_APP_ERROR_COUNTER)
+    with pytest.raises(queue.Empty):
+        q.get(timeout=0.4)  # still unhealthy via liveness; app noise ignored
+    mgr.inject("tpu-0", HEALTHY, code=EVENT_NODE_LIVENESS)
+    assert q.get(timeout=5).health == HEALTHY
+    fanout.unsubscribe(q)

@@ -46,8 +46,17 @@ def fake_tree(tmp_path):
 def native(lib_path, monkeypatch):
     from tpu_device_plugin.backend.native import NativeTpuInfo
 
-    monkeypatch.delenv("TPUINFO_ACCELERATOR_TYPE", raising=False)
-    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    # Isolate from any real TPU-host metadata in the test environment.
+    for var in (
+        "TPUINFO_ACCELERATOR_TYPE",
+        "TPU_ACCELERATOR_TYPE",
+        "TPU_CHIPS_PER_HOST_BOUNDS",
+        "TPUINFO_HBM_GIB",
+        "TPUINFO_WRAPAROUND",
+        "TPUINFO_CHIPS_PER_TRAY",
+        "TPUINFO_DISABLE_OPEN_PROBE",
+    ):
+        monkeypatch.delenv(var, raising=False)
     n = NativeTpuInfo(lib_path=lib_path)
     yield n
     n.shutdown()
@@ -169,6 +178,238 @@ def test_accelerator_type_detection(native, fake_tree, monkeypatch):
     # The fake tree's per-chip sysfs override (tpu_hbm_bytes = 16 GiB) takes
     # precedence over the v5p per-type default (95 GiB).
     assert chips[0].hbm_bytes == 16 << 30
+
+
+def wait_events(native, want: int = 1, timeout: float = 5.0):
+    deadline = time.monotonic() + timeout
+    events = []
+    while len(events) < want and time.monotonic() < deadline:
+        events += native.wait_health_events(timeout_ms=200)
+    return events
+
+
+class TestProvenance:
+    def test_fake_tree_hbm_measured_coords_assumed(self, native, fake_tree):
+        native.init(fake_tree)
+        p = native.provenance()
+        # tpu_hbm_bytes sysfs files exist per chip -> measured; no coordinate
+        # source -> synthesized from enumeration order, loudly "assumed".
+        assert p == {
+            "coords_measured": False,
+            "coords_source": "assumed",
+            "hbm_measured": True,
+            "hbm_source": "sysfs",
+        }
+        assert native.topology().provenance == p
+
+    def test_host_bounds_metadata_coords(self, native, fake_tree, monkeypatch):
+        # A v5e-4 host is physically a 2x2 mesh even though enumeration
+        # order suggests 4x1 (VERDICT missing #1): the platform grid from
+        # TPU_CHIPS_PER_HOST_BOUNDS is the measured layout.
+        monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+        native.init(fake_tree)
+        chips = native.chips()
+        assert [c.coords for c in chips] == [
+            (0, 0, 0),
+            (1, 0, 0),
+            (0, 1, 0),
+            (1, 1, 0),
+        ]
+        topo = native.topology()
+        assert topo.torus_shape == (2, 2, 1)
+        p = native.provenance()
+        assert p["coords_measured"] is True
+        assert p["coords_source"] == "metadata"
+
+    def test_host_bounds_mismatch_falls_back_to_assumed(
+        self, native, fake_tree, monkeypatch
+    ):
+        # Bounds that don't multiply out to the chip count are stale/foreign
+        # metadata and must not be trusted.
+        monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "4,2,1")
+        native.init(fake_tree)
+        assert native.provenance()["coords_source"] == "assumed"
+
+    def test_sysfs_coords_strongest(self, native, fake_tree, monkeypatch):
+        monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+        layout = {0: "0,0,0", 1: "0,1,0", 2: "1,0,0", 3: "1,1,0"}
+        for idx, coords in layout.items():
+            path = os.path.join(
+                fake_tree, "sys", "class", "accel", f"accel{idx}", "device", "tpu_coords"
+            )
+            with open(path, "w") as f:
+                f.write(coords + "\n")
+        native.init(fake_tree)
+        # Driver-provided coordinates win over the metadata grid (note the
+        # transposed layout vs row-major enumeration).
+        assert [c.coords for c in native.chips()] == [
+            (0, 0, 0),
+            (0, 1, 0),
+            (1, 0, 0),
+            (1, 1, 0),
+        ]
+        assert native.provenance()["coords_source"] == "sysfs"
+
+    def test_env_override_beats_pci_bar(self, native, tmp_path, monkeypatch):
+        # A deliberate operator override (e.g. under-advertising for
+        # headroom) must beat the BAR heuristic.
+        root = tmp_path / "envroot"
+        (root / "dev").mkdir(parents=True)
+        (root / "dev" / "accel0").write_text("")
+        dev_dir = root / "sys" / "class" / "accel" / "accel0" / "device"
+        dev_dir.mkdir(parents=True)
+        (dev_dir / "resource").write_text(
+            f"0x0000004000000000 0x{0x4000000000 + (1 << 34) - 1:016x} 0x0000000000140204\n"
+        )
+        monkeypatch.setenv("TPUINFO_HBM_GIB", "8")
+        native.init(str(root))
+        assert native.chips()[0].hbm_bytes == 8 << 30
+        assert native.provenance()["hbm_source"] == "env"
+
+    def test_offset_sysfs_coords_span_extents(self, native, fake_tree):
+        # Slice-global (offset) driver coordinates: the local mesh shape is
+        # the coordinate SPAN, not max+1.
+        layout = {0: "4,0,0", 1: "5,0,0", 2: "4,1,0", 3: "5,1,0"}
+        for idx, coords in layout.items():
+            path = os.path.join(
+                fake_tree, "sys", "class", "accel", f"accel{idx}", "device", "tpu_coords"
+            )
+            with open(path, "w") as f:
+                f.write(coords + "\n")
+        native.init(fake_tree)
+        assert native.topology().torus_shape == (2, 2, 1)
+
+    def test_hbm_from_pci_bar(self, native, tmp_path):
+        # No tpu_hbm_bytes attribute: the largest PCI memory BAR (the HBM
+        # aperture) is the measured capacity (reference reads device memory
+        # at enumeration, nvidia.go:87-111).
+        root = tmp_path / "barroot"
+        (root / "dev").mkdir(parents=True)
+        for i in range(2):
+            (root / "dev" / f"accel{i}").write_text("")
+            dev_dir = root / "sys" / "class" / "accel" / f"accel{i}" / "device"
+            dev_dir.mkdir(parents=True)
+            bar2 = (1 << 34) - 1  # 16 GiB aperture
+            (dev_dir / "resource").write_text(
+                "0x00000000a0000000 0x00000000a0ffffff 0x0000000000040200\n"
+                f"0x0000004000000000 0x{0x4000000000 + bar2:016x} 0x0000000000140204\n"
+                "0x0000000000000000 0x0000000000000000 0x0000000000000000\n"
+            )
+        native.init(str(root))
+        chips = native.chips()
+        assert chips[0].hbm_bytes == 1 << 34
+        p = native.provenance()
+        assert p["hbm_measured"] is True
+        assert p["hbm_source"] == "pci-bar"
+
+
+class TestHealthClasses:
+    def test_wedged_chip_open_probe_unhealthy_and_recovers(self, native, fake_tree):
+        from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+        from tpu_device_plugin.health import EVENT_OPEN_PROBE
+
+        native.init(fake_tree)
+        assert native.wait_health_events(timeout_ms=50) == []
+        # Wedge accel1: the node still enumerates (stat succeeds) but opening
+        # it fails (EISDIR stands in for EIO/ENXIO on real silicon).
+        node = os.path.join(fake_tree, "dev", "accel1")
+        os.remove(node)
+        os.mkdir(node)
+        events = wait_events(native)
+        assert [(e.chip_id, e.health, e.code) for e in events] == [
+            ("tpu-1", UNHEALTHY, EVENT_OPEN_PROBE)
+        ]
+        # Recovery: openable node again.
+        os.rmdir(node)
+        with open(node, "w"):
+            pass
+        events = wait_events(native)
+        assert [(e.chip_id, e.health, e.code) for e in events] == [
+            ("tpu-1", HEALTHY, EVENT_OPEN_PROBE)
+        ]
+
+    def test_chip_error_counter_latches_until_reset(self, native, fake_tree):
+        from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+        from tpu_device_plugin.health import EVENT_CHIP_ERROR_COUNTER
+
+        counter = os.path.join(
+            fake_tree, "sys", "class", "accel", "accel2", "device", "tpu_error_count"
+        )
+        with open(counter, "w") as f:
+            f.write("7\n")  # pre-existing errors: baselined, not a fault
+        native.init(fake_tree)
+        assert native.wait_health_events(timeout_ms=50) == []
+
+        with open(counter, "w") as f:
+            f.write("9\n")  # counter rose above baseline -> chip error
+        events = wait_events(native)
+        assert [(e.chip_id, e.health, e.code) for e in events] == [
+            ("tpu-2", UNHEALTHY, EVENT_CHIP_ERROR_COUNTER)
+        ]
+        # Latches: further scans emit nothing new while the counter stays up.
+        assert native.wait_health_events(timeout_ms=100) == []
+        # Driver reset (counter back to/below baseline) recovers.
+        with open(counter, "w") as f:
+            f.write("0\n")
+        events = wait_events(native)
+        assert [(e.chip_id, e.health, e.code) for e in events] == [
+            ("tpu-2", HEALTHY, EVENT_CHIP_ERROR_COUNTER)
+        ]
+
+    def test_app_error_counter_has_application_code(self, native, fake_tree):
+        from tpu_device_plugin.api.constants import UNHEALTHY
+        from tpu_device_plugin.health import (
+            APPLICATION_ERROR_CODES,
+            EVENT_APP_ERROR_COUNTER,
+        )
+
+        counter = os.path.join(
+            fake_tree, "sys", "class", "accel", "accel0", "device", "tpu_app_error_count"
+        )
+        with open(counter, "w") as f:
+            f.write("0\n")
+        native.init(fake_tree)
+        assert native.wait_health_events(timeout_ms=50) == []
+        with open(counter, "w") as f:
+            f.write("3\n")
+        events = wait_events(native)
+        assert [(e.chip_id, e.health, e.code) for e in events] == [
+            ("tpu-0", UNHEALTHY, EVENT_APP_ERROR_COUNTER)
+        ]
+        # The code is in the Python-side application skip list, so the
+        # fan-out will drop it rather than mark the chip Unhealthy.
+        assert events[0].code in APPLICATION_ERROR_CODES
+
+    def test_counter_appearing_after_init_baselines_on_first_sight(
+        self, native, fake_tree
+    ):
+        from tpu_device_plugin.api.constants import UNHEALTHY
+        from tpu_device_plugin.health import EVENT_CHIP_ERROR_COUNTER
+
+        native.init(fake_tree)  # no counter file exists yet
+        assert native.wait_health_events(timeout_ms=50) == []
+        counter = os.path.join(
+            fake_tree, "sys", "class", "accel", "accel3", "device", "tpu_error_count"
+        )
+        # Driver finishes boot after the daemon: the attribute appears with
+        # already-accumulated errors — baselined, NOT a fresh fault.
+        with open(counter, "w") as f:
+            f.write("3\n")
+        assert native.wait_health_events(timeout_ms=100) == []
+        with open(counter, "w") as f:
+            f.write("4\n")  # a NEW error past first-sight baseline
+        events = wait_events(native)
+        assert [(e.chip_id, e.health, e.code) for e in events] == [
+            ("tpu-3", UNHEALTHY, EVENT_CHIP_ERROR_COUNTER)
+        ]
+
+    def test_open_probe_disabled_by_env(self, native, fake_tree, monkeypatch):
+        monkeypatch.setenv("TPUINFO_DISABLE_OPEN_PROBE", "1")
+        native.init(fake_tree)
+        node = os.path.join(fake_tree, "dev", "accel1")
+        os.remove(node)
+        os.mkdir(node)
+        assert native.wait_health_events(timeout_ms=300) == []
 
 
 def test_chip_in_use_counts_open_handles(native, fake_tree):

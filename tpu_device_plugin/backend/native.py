@@ -20,8 +20,9 @@ from ..topology import Topology
 
 ENV_LIBRARY = "TPUINFO_LIBRARY"
 # Expected libtpuinfo ABI (native/tpuinfo.cc kVersion): major.minor pins the
-# struct layouts; the patch digit is free to drift.
-ABI_VERSION = "0.2.0"
+# struct layouts; the patch digit is free to drift (0.2.1 added the in-use
+# probes, 0.2.2 provenance + health classes 1-3 — all append-only).
+ABI_VERSION = "0.2.2"
 _ID_LEN = 64
 _PATH_LEN = 128
 _TYPE_LEN = 16
@@ -62,6 +63,18 @@ class _HealthEventStruct(ctypes.Structure):
         ("chip_id", ctypes.c_char * _ID_LEN),
         ("healthy", ctypes.c_int32),
         ("code", ctypes.c_int32),
+    ]
+
+
+_SOURCE_LEN = 16
+
+
+class _ProvenanceStruct(ctypes.Structure):
+    _fields_ = [
+        ("coords_measured", ctypes.c_int32),
+        ("hbm_measured", ctypes.c_int32),
+        ("coords_source", ctypes.c_char * _SOURCE_LEN),
+        ("hbm_source", ctypes.c_char * _SOURCE_LEN),
     ]
 
 
@@ -134,6 +147,9 @@ class NativeTpuInfo:
         if hasattr(lib, "tpuinfo_chip_in_use"):
             lib.tpuinfo_chip_in_use.argtypes = [ctypes.c_int]
             lib.tpuinfo_chip_in_use.restype = ctypes.c_int
+        if hasattr(lib, "tpuinfo_get_provenance"):
+            lib.tpuinfo_get_provenance.argtypes = [ctypes.POINTER(_ProvenanceStruct)]
+            lib.tpuinfo_get_provenance.restype = ctypes.c_int
 
     # ------------------------------------------------------------------ calls
 
@@ -177,10 +193,26 @@ class NativeTpuInfo:
             accelerator_type=t.accelerator_type.decode(),
             torus_shape=(t.torus_x, t.torus_y, t.torus_z),
             wraparound=bool(t.wraparound),
+            provenance=self.provenance(),
         )
         for chip in self.chips():
             topo.chips_by_id[chip.id] = chip
         return topo
+
+    def provenance(self) -> dict | None:
+        """Measured-vs-assumed provenance of coords/HBM discovery; None when
+        the loaded .so predates the call."""
+        if not hasattr(self._lib, "tpuinfo_get_provenance"):
+            return None
+        p = _ProvenanceStruct()
+        if self._lib.tpuinfo_get_provenance(ctypes.byref(p)) != 0:
+            return None
+        return {
+            "coords_measured": bool(p.coords_measured),
+            "hbm_measured": bool(p.hbm_measured),
+            "coords_source": p.coords_source.decode(),
+            "hbm_source": p.hbm_source.decode(),
+        }
 
     def chip_in_use(self, index: int) -> int | None:
         """Processes currently holding /dev/accel<index> open (lower bound

@@ -30,12 +30,20 @@ log = logging.getLogger(__name__)
 ENV_DISABLE_HEALTH_CHECKS = "DP_DISABLE_HEALTHCHECKS"
 _ALL_TOKENS = ("events", "xids")
 
+# Health-event classes emitted by the native layer (native/tpuinfo.h
+# TPUINFO_EVENT_*).  Each class flips healthy/unhealthy independently; the
+# fan-out aggregates active classes into chip health downstream of the skip
+# list.
+EVENT_NODE_LIVENESS = 0  # /dev/accel* vanished or reappeared
+EVENT_OPEN_PROBE = 1  # node enumerates but open() fails hardware-ish: wedged
+EVENT_CHIP_ERROR_COUNTER = 2  # driver tpu_error_count rose above baseline
+EVENT_APP_ERROR_COUNTER = 3  # workload-attributable tpu_app_error_count
+
 # Event codes that indicate a workload/application-level fault rather than a
 # sick chip — the analog of the reference's application-error XID skip list
-# (nvidia.go:193-199).  Node-liveness (code 0) is not in it: a vanished
-# device node is always chip-level.  Currently empty because the native
-# layer only emits liveness events; runtime error classes slot in here.
-APPLICATION_ERROR_CODES: frozenset = frozenset()
+# (nvidia.go:193-199, XIDs 13/31/43/45/68).  Node-liveness (code 0) is not
+# in it: a vanished device node is always chip-level.
+APPLICATION_ERROR_CODES: frozenset = frozenset({EVENT_APP_ERROR_COUNTER})
 
 
 def health_checks_disabled(value: str | None = None) -> bool:
@@ -91,10 +99,14 @@ class HealthFanout:
         # (reference: checkHealth entry, nvidia.go:182), even with several
         # plugins subscribing to the same fanout.
         self._disabled = False
-        # Last known health per chip: late subscribers (plugins start
-        # sequentially, each with its own serve+register latency) must not
-        # miss transitions that happened before they joined.
+        # Last known aggregate health per chip: late subscribers (plugins
+        # start sequentially, each with its own serve+register latency) must
+        # not miss transitions that happened before they joined.
         self._state: dict[str, str] = {}
+        # Active (non-skipped) unhealthy event classes per chip.  Events are
+        # per-CLASS transitions; a chip is Unhealthy while ANY class is
+        # active, so one class recovering must not mask another still firing.
+        self._active_codes: dict[str, set] = {}
 
     def subscribe(self) -> "queue.Queue[HealthEvent]":
         from .api.constants import HEALTHY
@@ -154,6 +166,8 @@ class HealthFanout:
         self._pump.start()
 
     def _run_pump(self) -> None:
+        from .api.constants import HEALTHY, UNHEALTHY
+
         while not self._stop.is_set():
             try:
                 event = self._central.get(timeout=0.2)
@@ -166,12 +180,26 @@ class HealthFanout:
                     event.chip_id or "all chips",
                 )
                 continue
+            # Per-class aggregation: the event flips ONE class; the chip is
+            # Unhealthy while any non-skipped class is active.  Forward only
+            # aggregate transitions so one class recovering can't mask
+            # another still firing (and identical re-fires stay quiet).
+            forwarded: list[HealthEvent] = []
             with self._lock:
-                if event.all_chips:
-                    for cid in self._chip_ids:
-                        self._state[cid] = event.health
-                else:
-                    self._state[event.chip_id] = event.health
-                subscribers = list(self._subscribers)
-            for q in subscribers:
-                q.put(event)
+                targets = self._chip_ids if event.all_chips else [event.chip_id]
+                for cid in targets:
+                    active = self._active_codes.setdefault(cid, set())
+                    if event.health == UNHEALTHY:
+                        active.add(event.code)
+                    else:
+                        active.discard(event.code)
+                    agg = UNHEALTHY if active else HEALTHY
+                    if self._state.get(cid, HEALTHY) != agg:
+                        self._state[cid] = agg
+                        forwarded.append(
+                            HealthEvent(chip_id=cid, health=agg, code=event.code)
+                        )
+                subscribers = list(self._subscribers) if forwarded else []
+            for fwd in forwarded:
+                for q in subscribers:
+                    q.put(fwd)

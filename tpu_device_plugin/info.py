@@ -82,6 +82,13 @@ def collect(flags: Flags, backend=None) -> dict:
             "accelerator_type": topo.accelerator_type,
             "torus_shape": list(topo.torus_shape),
             "n_chips": len(chips),
+            # Measured-vs-assumed discovery provenance (native backend only):
+            # whether coords/HBM came from the hardware/platform or a table.
+            **(
+                {"provenance": topo.provenance}
+                if getattr(topo, "provenance", None) is not None
+                else {}
+            ),
             "trays": {
                 str(tray): [c.id for c in members]
                 for tray, members in sorted(topo.trays().items())
@@ -122,6 +129,14 @@ def render(info: dict) -> str:
         f"ICI mesh {'x'.join(str(v) for v in info['torus_shape'])}, "
         f"{len(info['trays'])} tray(s)"
     ]
+    if "provenance" in info:
+        p = info["provenance"]
+        lines.append(
+            f"discovery: coords {'measured' if p['coords_measured'] else 'ASSUMED'}"
+            f" ({p['coords_source']}), "
+            f"hbm {'measured' if p['hbm_measured'] else 'ASSUMED'}"
+            f" ({p['hbm_source']})"
+        )
     if "slice" in info:
         s = info["slice"]
         lines.append(

@@ -49,6 +49,11 @@ class Topology:
     # Multi-host slice metadata (slice_topology.SliceInfo) when this host is
     # part of a declared slice; drives the global-slice container env.
     slice_info: object | None = None
+    # Discovery provenance (native backend): measured-vs-assumed for coords
+    # and HBM, e.g. {"coords_measured": True, "coords_source": "metadata",
+    # "hbm_measured": False, "hbm_source": "table"}.  None = backend doesn't
+    # report it (fake).
+    provenance: dict | None = None
 
     def coords_of(self, chip_id: str) -> tuple[int, int, int] | None:
         chip = self.chips_by_id.get(chip_id)
