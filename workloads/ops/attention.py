@@ -2,9 +2,13 @@
 
 The flagship workload's hot op, written for the hardware (see
 /opt/skills/guides/pallas_guide.md): the [seq, seq] score matrix never
-materialises in HBM — each q block streams over k/v blocks in VMEM with an
-online-softmax accumulator in float32, so HBM traffic is O(seq * d) instead
-of O(seq^2) and the matmuls stay on the MXU.
+materialises in HBM — and VMEM residency is O(block), not O(seq).  The
+k/v stream is part of the Pallas grid itself: the innermost grid dimension
+walks k/v blocks while a float32 online-softmax accumulator lives in VMEM
+scratch, persisting across those sequential iterations and re-initialising
+at each new q block.  HBM traffic is O(seq * d) instead of O(seq^2), the
+matmuls stay on the MXU, and long contexts (32k+) compile because no
+BlockSpec ever maps a whole sequence into VMEM.
 
 Differentiable via jax.custom_vjp: the kernel saves the per-row logsumexp,
 and the backward pass recomputes probabilities from (q, k, lse) — the
@@ -26,58 +30,89 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# Online-softmax running stats (m, l) are kept lane-broadcast at this width
+# in VMEM scratch: a [block_q] vector cannot tile the (8, 128) Mosaic
+# constraint, so the stats occupy a full lane dimension with every lane
+# holding the same value.
+_STATS_LANES = 128
+
+# Shared by all three kernels: batch*heads and the outer block axis fan out
+# across cores; the innermost axis is the sequential accumulation walk the
+# VMEM scratch carries state across.
+_SEQ_INNER_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=(
+        pltpu.GridDimensionSemantics.PARALLEL,
+        pltpu.GridDimensionSemantics.PARALLEL,
+        pltpu.GridDimensionSemantics.ARBITRARY,
+    ),
+)
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_q, block_k, seq_valid
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+    *, sm_scale, causal, block_q, block_k, seq_valid, n_k_blocks,
 ):
-    """One (batch*head, q-block) grid cell: stream k/v blocks with online
-    softmax.  Refs: q [block_q, d], k/v [seq_pad, d], o [block_q, d],
-    lse [block_q]."""
+    """One (batch*head, q-block, k-block) grid cell.  The k dimension is the
+    innermost (sequential) grid axis; (m, l, acc) persist in VMEM scratch
+    across its iterations and reset when a new q block begins.  Refs:
+    q [block_q, d], k/v [block_k, d], o [block_q, d], lse [block_q, 1],
+    scratch m/l [block_q, _STATS_LANES], acc [block_q, d]."""
     qi = pl.program_id(1)
-    seq_pad = k_ref.shape[0]
-    q = q_ref[:].astype(jnp.float32) * sm_scale
+    ki = pl.program_id(2)
 
-    q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    def body(kb, carry):
-        m_prev, l_prev, acc_prev = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    def _body():
+        q = q_ref[:].astype(jnp.float32) * sm_scale
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        k_ids = kb * block_k + jax.lax.broadcasted_iota(
+        k_ids = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
         mask = k_ids < seq_valid
         if causal:
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
             mask &= k_ids <= q_ids
         s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+
+        m_prev = m_ref[:]                                   # [bq, LANES]
+        l_prev = l_ref[:]
+        m_cur = jnp.max(s, axis=-1)[:, None]                # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)                  # lane-broadcast
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc_prev * alpha[:, None] + jnp.dot(
+        p = jnp.exp(s - m_new[:, :1])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)[:, None]
+        m_ref[:] = m_new
+        l_ref[:] = l_new
+        acc_ref[:] = acc_ref[:] * alpha[:, :1] + jnp.dot(
             p, v, preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
 
-    n_blocks = seq_pad // block_k
     if causal:
-        # Blocks fully above the diagonal contribute nothing: stop after the
-        # block containing this q block's last row.
-        n_blocks = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, n_blocks)
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, q_ref.shape[1]), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+        # A k block whose first row sits past this q block's last row is
+        # fully masked — skip its compute (the DMA still happens; the win
+        # is not doing the matmuls).
+        pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_body)
+    else:
+        _body()
 
-    l_safe = jnp.where(l > 0, l, 1.0)  # fully-masked (padded) rows
-    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # Trailing unit dim: Mosaic requires 2-D-tileable blocks, and a
-    # [block_q] block cannot tile the (8, 128) constraint on real TPUs.
-    lse_ref[:] = (m + jnp.log(l_safe))[:, None]
+    @pl.when(ki == n_k_blocks - 1)
+    def _finalize():
+        m = m_ref[:][:, :1]
+        l = l_ref[:][:, :1]
+        l_safe = jnp.where(l > 0, l, 1.0)  # fully-masked (padded) rows
+        o_ref[:] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[:] = m + jnp.log(l_safe)
 
 
 def _pad_seq(x, multiple):
@@ -105,6 +140,7 @@ def _flash_forward(q, k, v, causal, interpret, block_q, block_k):
         jnp.transpose(v, (0, 2, 1, 3)).reshape(batch * heads, seq, head_dim), block_k
     )
     seq_q_pad = qf.shape[1]
+    n_k_blocks = kf.shape[1] // block_k
 
     kernel = functools.partial(
         _flash_kernel,
@@ -113,23 +149,30 @@ def _flash_forward(q, k, v, causal, interpret, block_q, block_k):
         block_q=block_q,
         block_k=block_k,
         seq_valid=seq,
+        n_k_blocks=n_k_blocks,
     )
     out, lse = pl.pallas_call(
         kernel,
-        grid=(batch * heads, seq_q_pad // block_q),
+        grid=(batch * heads, seq_q_pad // block_q, n_k_blocks),
         in_specs=[
-            pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, kf.shape[1], head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, vf.shape[1], head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(qf.shape, q.dtype),
             jax.ShapeDtypeStruct((batch * heads, seq_q_pad, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # m
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # l
+            pltpu.VMEM((block_q, head_dim), jnp.float32),      # acc
+        ],
+        compiler_params=_SEQ_INNER_SEMANTICS,
         interpret=interpret,
     )(qf, kf, vf)
 
@@ -138,25 +181,32 @@ def _flash_forward(q, k, v, causal, interpret, block_q, block_k):
 
 
 def _flash_bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, sm_scale, causal, block_q, block_k, seq_valid,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
+    *, sm_scale, causal, block_q, block_k, seq_valid, n_k_blocks,
 ):
-    """One (batch*head, q-block) grid cell of the backward pass: accumulate
-    dq over k/v blocks.  p is recomputed from (q, k, lse) — the flash
-    recipe's recompute-don't-store backward, as a kernel."""
+    """One (batch*head, q-block, k-block) grid cell of the backward pass:
+    accumulate dq in VMEM scratch over the sequential k axis.  p is
+    recomputed from (q, k, lse) — the flash recipe's recompute-don't-store
+    backward, as a kernel."""
     qi = pl.program_id(1)
-    seq_k_pad = k_ref.shape[0]
-    q = q_ref[:].astype(jnp.float32)
-    do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:][:, 0]
-    delta = delta_ref[:][:, 0]
-    q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    ki = pl.program_id(2)
 
-    def body(kb, dq_acc):
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    def _body():
+        q = q_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:][:, 0]
+        delta = delta_ref[:][:, 0]
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-        k_ids = kb * block_k + jax.lax.broadcasted_iota(
+        q_ids = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_ids = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
         mask = (k_ids < seq_valid) & (q_ids < seq_valid)
@@ -167,53 +217,74 @@ def _flash_bwd_dq_kernel(
         p = jnp.exp(jnp.where(mask, s, NEG_INF) - lse[:, None]) * mask
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
-        return dq_acc + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_acc_ref[:] = dq_acc_ref[:] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32
+        )
 
-    n_blocks = seq_k_pad // block_k
     if causal:
-        n_blocks = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, n_blocks)
-    dq0 = jnp.zeros((block_q, q_ref.shape[1]), jnp.float32)
-    dq_ref[:] = jax.lax.fori_loop(0, n_blocks, body, dq0).astype(dq_ref.dtype)
+        pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_body)
+    else:
+        _body()
+
+    @pl.when(ki == n_k_blocks - 1)
+    def _finalize():
+        dq_ref[:] = dq_acc_ref[:].astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, sm_scale, causal, block_q, block_k, seq_valid,
+    dk_acc_ref, dv_acc_ref,
+    *, sm_scale, causal, block_q, block_k, seq_valid, n_q_blocks,
 ):
-    """One (batch*head, k-block) grid cell: accumulate dk/dv over q blocks,
-    starting at the diagonal when causal (earlier q blocks are fully
-    masked)."""
+    """One (batch*head, k-block, q-block) grid cell: accumulate dk/dv in
+    VMEM scratch over the sequential q axis, skipping q blocks fully above
+    the diagonal when causal."""
     ki = pl.program_id(1)
-    seq_q_pad = q_ref.shape[0]
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
-    k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    qi = pl.program_id(2)
 
-    def body(qb, carry):
-        dk_acc, dv_acc = carry
-        q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(qb * block_q, block_q), :][:, 0]
-        delta = delta_ref[pl.ds(qb * block_q, block_q), :][:, 0]
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    def _body():
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        q = q_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:][:, 0]
+        delta = delta_ref[:][:, 0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-        q_ids = qb * block_q + jax.lax.broadcasted_iota(
+        q_ids = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
+        )
+        k_ids = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
         )
         mask = (k_ids < seq_valid) & (q_ids < seq_valid)
         if causal:
             mask &= k_ids <= q_ids
         p = jnp.exp(jnp.where(mask, s, NEG_INF) - lse[:, None]) * mask
-        dv_acc = dv_acc + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dv_acc_ref[:] = dv_acc_ref[:] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32
+        )
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
-        dk_acc = dk_acc + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        return dk_acc, dv_acc
+        dk_acc_ref[:] = dk_acc_ref[:] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        )
 
-    start = (ki * block_k) // block_q if causal else 0
-    zeros = jnp.zeros((block_k, k_ref.shape[1]), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, seq_q_pad // block_q, body, (zeros, zeros))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    if causal:
+        # q blocks whose last row precedes this k block's first row are
+        # fully above the diagonal and contribute nothing.
+        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(_body)
+    else:
+        _body()
+
+    @pl.when(qi == n_q_blocks - 1)
+    def _finalize():
+        dk_ref[:] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
 def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q, block_k):
@@ -242,45 +313,54 @@ def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q, 
         dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1
     )[..., None]
 
+    n_q_blocks = seq_q_pad // block_q
+    n_k_blocks = seq_k_pad // block_k
     kwargs = dict(
         sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_valid=seq,
     )
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, **kwargs),
-        grid=(batch * heads, seq_q_pad // block_q),
+        functools.partial(_flash_bwd_dq_kernel, n_k_blocks=n_k_blocks, **kwargs),
+        grid=(batch * heads, n_q_blocks, n_k_blocks),
         in_specs=[
-            pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, seq_k_pad, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, seq_k_pad, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        compiler_params=_SEQ_INNER_SEMANTICS,
         interpret=interpret,
     )(qf, kf, vf, dof, lse_pad, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, **kwargs),
-        grid=(batch * heads, seq_k_pad // block_k),
+        functools.partial(_flash_bwd_dkv_kernel, n_q_blocks=n_q_blocks, **kwargs),
+        grid=(batch * heads, n_k_blocks, n_q_blocks),
         in_specs=[
-            pl.BlockSpec((None, seq_q_pad, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, block_k, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_k, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, seq_q_pad, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, seq_q_pad, 1), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, seq_q_pad, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_k, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_k, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(kf.shape, k.dtype),
             jax.ShapeDtypeStruct(vf.shape, v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        compiler_params=_SEQ_INNER_SEMANTICS,
         interpret=interpret,
     )(qf, kf, vf, dof, lse_pad, delta)
 
