@@ -232,3 +232,40 @@ class TestStatefulAllocator:
             alloc.free(got)
         # The pool is unchanged by the rejected free.
         assert alloc.remaining == ids(2)
+
+
+class TestLargeTableBounds:
+    """The greedy degrade must keep preferred-allocation latency bounded at
+    realistic device counts (SURVEY.md §3.5 hard part #5: the expensive
+    topology work happens here, never in Allocate)."""
+
+    def test_besteffort_64_chips_goes_greedy_and_stays_fast(self):
+        import time
+
+        topo = build_fake_topology(64, 4)
+        policy = BestEffortPolicy(topo)
+        t0 = time.perf_counter()
+        got = policy.allocate(ids(64), [], 8)
+        elapsed = time.perf_counter() - t0
+        assert len(got) == 8 and len(set(got)) == 8
+        # C(64,8) exhaustive would be ~4e9 candidate sets; the work budget
+        # must have kicked in.  2s is ~100x the expected greedy cost — a
+        # regression to exhaustive blows it by orders of magnitude.
+        assert elapsed < 2.0
+        # Greedy still packs an ICI-coherent set: all 8 from 2 trays.
+        trays = {int(g.split("-")[1]) // 4 for g in got}
+        assert len(trays) == 2
+
+    def test_replica_table_256_prioritize_stays_fast(self):
+        import time
+
+        from tpu_device_plugin.replica import prioritize_devices, replica_id
+
+        table = [
+            replica_id(f"tpu-{c}", r) for c in range(16) for r in range(16)
+        ]
+        t0 = time.perf_counter()
+        got = prioritize_devices(table, [], 16)
+        elapsed = time.perf_counter() - t0
+        assert len(got.devices) == 16
+        assert elapsed < 2.0

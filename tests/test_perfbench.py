@@ -1,0 +1,96 @@
+"""Harness tests for workloads/perfbench.py at the tiny scale.
+
+These validate structure and math, not performance: perf numbers from the
+CPU interpreter are meaningless, but the analytic-FLOPs accounting, the
+slope estimator, and the output schema bench.py merges must hold anywhere.
+"""
+
+import time
+
+import pytest
+
+from workloads.perfbench import (
+    BenchScale,
+    device_peak_flops,
+    measure_slope_secs,
+    train_step_flops,
+)
+
+
+def test_bench_scale_named():
+    full, tiny = BenchScale.named("full"), BenchScale.named("tiny")
+    assert full.seq > tiny.seq
+    with pytest.raises(ValueError):
+        BenchScale.named("nope")
+
+
+def test_train_step_flops_analytic():
+    from workloads.model import ModelConfig
+
+    config = ModelConfig(
+        vocab_size=100, d_model=8, n_heads=2, n_layers=3, d_ff=16,
+        max_seq_len=5,
+    )
+    batch = 2
+    s = 4  # max_seq_len - 1
+    tokens = batch * s
+    p_matmul = 3 * (4 * 8 * 8 + 2 * 8 * 16) + 8 * 100
+    expected = 3 * (2 * tokens * p_matmul + 3 * batch * 4 * s * s * 8 * 0.5)
+    assert train_step_flops(config, batch) == expected
+
+
+def test_device_peak_flops_unknown_is_none(monkeypatch):
+    # The CPU test platform has no TPU device kind -> None, so MFU is
+    # omitted instead of reported against a guessed peak.
+    assert device_peak_flops() is None
+
+
+def test_measure_slope_cancels_constant_overhead():
+    calls = []
+
+    def run_chain(n):
+        calls.append(n)
+        time.sleep(0.05 + n * 0.02)  # constant 50ms + 20ms/iter
+
+    secs = measure_slope_secs(run_chain, n_lo=2, n_hi=8, repeats=2,
+                              min_window_secs=0.05)
+    assert 0.015 < secs < 0.025  # slope recovers the per-iter cost
+    assert calls[0] == 2 and calls[1] == 8  # warm pass precedes timing
+
+
+def test_measure_slope_grows_until_window():
+    seen = []
+
+    def run_chain(n):
+        seen.append(n)
+        time.sleep(n * 0.004)
+
+    # 4ms/iter: the first (2, 8) round gives a 24ms window < 60ms, so the
+    # chain lengths must double at least once.
+    secs = measure_slope_secs(run_chain, n_lo=2, n_hi=8, repeats=1,
+                              min_window_secs=0.06)
+    assert max(seen) >= 16
+    assert 0.002 < secs < 0.006
+
+
+@pytest.mark.slow
+def test_perfbench_tiny_end_to_end():
+    """The whole suite runs on CPU at tiny scale and produces the schema
+    bench.py consumes (values are interpreter noise; only shape/keys and
+    basic sanity are asserted)."""
+    from workloads import perfbench
+
+    out = perfbench.run("tiny")
+    for key in (
+        "train_step_ms",
+        "train_tokens_per_sec",
+        "mfu",
+        "flash_vs_xla_speedup",
+        "flash_vs_xla_detail",
+        "decode_ms_per_token",
+        "decode_tokens_per_sec",
+    ):
+        assert key in out, key
+    assert out["mfu"] is None  # no TPU peak on the CPU test platform
+    assert out["train_step_ms"] >= 0
+    assert set(out["flash_vs_xla_detail"]) == {"128"}

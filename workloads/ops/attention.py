@@ -70,10 +70,12 @@ def _flash_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     def _body():
-        q = q_ref[:].astype(jnp.float32) * sm_scale
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        # Matmul inputs stay in the storage dtype (bf16 on the MXU's native
+        # fast path); only the accumulators and softmax math are float32.
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         k_ids = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
@@ -95,7 +97,7 @@ def _flash_kernel(
         m_ref[:] = m_new
         l_ref[:] = l_new
         acc_ref[:] = acc_ref[:] * alpha[:, :1] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
 
     if causal:
@@ -123,12 +125,21 @@ def _pad_seq(x, multiple):
     return x
 
 
+def _clamp_block(block: int, seq: int) -> int:
+    """Shrink a default block size for short sequences without losing
+    Mosaic tileability: the result is the requested block or the sequence
+    rounded up to a 128-sublane multiple, whichever is smaller.  A naive
+    min(block, seq) would make an unaligned sequence length (e.g. 300) the
+    literal block shape, which fails to tile on real hardware."""
+    return min(block, max(-(-seq // 128) * 128, 128))
+
+
 def _flash_forward(q, k, v, causal, interpret, block_q, block_k):
     """q/k/v: [batch, seq, heads, head_dim] -> (out, lse[batch*heads, seq_pad])."""
     batch, seq, heads, head_dim = q.shape
     sm_scale = 1.0 / (head_dim**0.5)
-    block_q = min(block_q, max(seq, 1))
-    block_k = min(block_k, max(seq, 1))
+    block_q = _clamp_block(block_q, seq)
+    block_k = _clamp_block(block_k, seq)
 
     qf = _pad_seq(
         jnp.transpose(q, (0, 2, 1, 3)).reshape(batch * heads, seq, head_dim), block_q
@@ -196,12 +207,12 @@ def _flash_bwd_dq_kernel(
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
 
     def _body():
-        q = q_ref[:].astype(jnp.float32)
-        do = do_ref[:].astype(jnp.float32)
+        q = q_ref[:]
+        do = do_ref[:]
         lse = lse_ref[:][:, 0]
         delta = delta_ref[:][:, 0]
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
+        k = k_ref[:]
+        v = v_ref[:]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         q_ids = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
@@ -218,7 +229,7 @@ def _flash_bwd_dq_kernel(
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
         dq_acc_ref[:] = dq_acc_ref[:] + jnp.dot(
-            ds, k, preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
         )
 
     if causal:
@@ -248,10 +259,10 @@ def _flash_bwd_dkv_kernel(
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
     def _body():
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        q = q_ref[:].astype(jnp.float32)
-        do = do_ref[:].astype(jnp.float32)
+        k = k_ref[:]
+        v = v_ref[:]
+        q = q_ref[:]
+        do = do_ref[:]
         lse = lse_ref[:][:, 0]
         delta = delta_ref[:][:, 0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
@@ -266,12 +277,12 @@ def _flash_bwd_dkv_kernel(
             mask &= k_ids <= q_ids
         p = jnp.exp(jnp.where(mask, s, NEG_INF) - lse[:, None]) * mask
         dv_acc_ref[:] = dv_acc_ref[:] + jnp.dot(
-            p.T, do, preferred_element_type=jnp.float32
+            p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
         )
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
         dk_acc_ref[:] = dk_acc_ref[:] + jnp.dot(
-            ds.T, q, preferred_element_type=jnp.float32
+            ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
         )
 
     if causal:
@@ -292,8 +303,8 @@ def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q, 
     _flash_forward."""
     batch, seq, heads, head_dim = q.shape
     sm_scale = 1.0 / (head_dim**0.5)
-    block_q = min(block_q, max(seq, 1))
-    block_k = min(block_k, max(seq, 1))
+    block_q = _clamp_block(block_q, seq)
+    block_k = _clamp_block(block_k, seq)
 
     def flat(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(batch * heads, seq, head_dim)
@@ -375,7 +386,10 @@ def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q, 
 
 
 def _default_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    # Device platform, not backend name: tunnelled/proxied TPU platforms
+    # present platform "tpu" on their devices and compile Pallas for real.
+    devices = jax.devices()
+    return not devices or devices[0].platform != "tpu"
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -385,8 +399,8 @@ def flash_attention(
     v,
     causal: bool = True,
     interpret: bool | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 512,
     bwd_impl: str = "pallas",
 ):
     """Scaled-dot-product attention, [batch, seq, heads, head_dim] layout.

@@ -1,0 +1,328 @@
+"""Throughput / MFU measurement for the flagship workloads.
+
+Converts the bench story from "correct and responsive" into "fast": times
+the full training step at a bench-scale model, computes MFU from the
+model's analytic FLOPs, races the Pallas flash-attention kernel against
+its own dense-XLA fallback across sequence lengths, and measures KV-cached
+decode throughput.  Consumed by bench.py (fields ``train_step_ms``,
+``mfu``, ``flash_vs_xla_speedup``, ``decode_tokens_per_sec``).
+
+Timing methodology — written for the tunnelled single-chip setup where
+``jax.block_until_ready`` does not synchronize with the remote device and
+a host readback carries a large constant round-trip cost: every
+measurement chains N data-dependent iterations on device, reads back one
+scalar, and reports the SLOPE between a small-N and large-N run.  The
+constant (dispatch + round-trip + readback) cancels in the subtraction;
+what remains is per-iteration device time.  The same method is applied to
+both sides of every comparison, so ratios are fair on any platform.
+
+Reference pendant: none — the reference publishes no benchmark numbers at
+all (SURVEY.md §6); this harness is the "measurement harness for the
+north-star metrics" of SURVEY.md §7 step 8, extended to useful-compute
+metrics.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .model import ModelConfig, init_params, loss_fn, masked_attention
+from .ops.attention import flash_attention
+
+# Peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
+# MFU is reported against these; an unknown kind yields mfu=None rather
+# than a number against a guessed peak.
+_PEAK_BF16_FLOPS = (
+    ("v5 lite", 197e12),  # v5e
+    ("v5e", 197e12),
+    ("v6 lite", 918e12),  # v6e / Trillium
+    ("v6e", 918e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),  # after v5 lite/v5e so plain "v5" means v5p
+    ("v4", 275e12),
+)
+
+
+def device_peak_flops() -> float | None:
+    kind = jax.devices()[0].device_kind.lower()
+    for marker, peak in _PEAK_BF16_FLOPS:
+        if marker in kind:
+            return peak
+    return None
+
+
+def measure_slope_secs(
+    run_chain,
+    n_lo: int,
+    n_hi: int,
+    repeats: int = 3,
+    min_window_secs: float = 0.25,
+    max_n: int = 4096,
+) -> float:
+    """Per-iteration seconds of ``run_chain(n)`` (which must execute n
+    data-dependent iterations ending in one host readback), via the
+    two-point slope; the best (minimum) of ``repeats`` attempts is kept to
+    shed scheduling noise.
+
+    The round-trip cost is NOISY as well as constant (shared tunnel), so
+    the estimate is the MEDIAN slope over ``repeats`` interleaved lo/hi
+    pairs, and the chain lengths double until the median (t_hi - t_lo)
+    window dwarfs that jitter — fast iterations need long chains before
+    the slope rises above it.  Each (n_lo, n_hi) pair is warmed untimed
+    first so per-length compilation never lands inside a timed window."""
+    import statistics
+
+    while True:
+        run_chain(n_lo)  # warm: compile + any one-time transfer
+        run_chain(n_hi)
+        slopes, windows = [], []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            run_chain(n_lo)
+            t_lo = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            run_chain(n_hi)
+            t_hi = time.perf_counter() - t0
+            slopes.append((t_hi - t_lo) / (n_hi - n_lo))
+            windows.append(t_hi - t_lo)
+        if statistics.median(windows) >= min_window_secs or n_hi >= max_n:
+            return max(statistics.median(slopes), 1e-9)
+        n_lo, n_hi = n_lo * 2, n_hi * 2
+
+
+@dataclass(frozen=True)
+class BenchScale:
+    """Shape set for the perf bench; ``full`` saturates a single v5e chip,
+    ``tiny`` exists so the harness itself is testable on CPU."""
+
+    d_model: int
+    n_heads: int
+    n_layers: int
+    d_ff: int
+    vocab: int
+    seq: int
+    batch: int
+    attn_heads: int
+    attn_seqs: tuple[int, ...]
+    decode_prompt: int
+    decode_lens: tuple[int, int]
+
+    @classmethod
+    def named(cls, name: str) -> "BenchScale":
+        if name == "full":
+            return cls(
+                d_model=1024, n_heads=8, n_layers=8, d_ff=4096, vocab=32768,
+                seq=2048, batch=8, attn_heads=8,
+                attn_seqs=(1024, 2048, 4096), decode_prompt=32,
+                decode_lens=(64, 512),
+            )
+        if name == "tiny":
+            # n_heads=4 so the tensor-parallel cut divides even on the
+            # 8-device (model_parallel=4) CPU test mesh.
+            return cls(
+                d_model=64, n_heads=4, n_layers=2, d_ff=128, vocab=256,
+                seq=128, batch=2, attn_heads=2,
+                attn_seqs=(128,), decode_prompt=4, decode_lens=(4, 12),
+            )
+        raise ValueError(f"unknown bench scale {name!r} (full|tiny)")
+
+
+def _model_config(scale: BenchScale) -> ModelConfig:
+    return ModelConfig(
+        vocab_size=scale.vocab,
+        d_model=scale.d_model,
+        n_heads=scale.n_heads,
+        n_layers=scale.n_layers,
+        d_ff=scale.d_ff,
+        max_seq_len=scale.seq,
+        attention_impl="flash",
+    )
+
+
+def train_step_flops(config: ModelConfig, batch: int) -> float:
+    """Analytic FLOPs of one training step (fwd + bwd counted as 3x the
+    forward matmul work — the standard accounting; the flash backward's
+    recompute means the hardware actually does slightly more, so the MFU
+    reported from this is conservative)."""
+    d, ff, s = config.d_model, config.d_ff, config.max_seq_len - 1
+    tokens = batch * s
+    # Weight matmuls touched per token (embed is a gather, not a matmul).
+    p_matmul = (
+        config.n_layers * (4 * d * d + 2 * d * ff) + d * config.vocab_size
+    )
+    fwd_dense = 2 * tokens * p_matmul
+    # Causal attention: q@k^T and p@v, 2*s*s*d MAC-pairs each, halved by
+    # the causal mask (and the kernel really does skip the masked blocks).
+    fwd_attn = config.n_layers * batch * (4 * s * s * d) * 0.5
+    return 3 * (fwd_dense + fwd_attn)
+
+
+def measure_train(scale: BenchScale) -> dict:
+    """Steady-state full-train-step time and MFU at the bench scale."""
+    import optax
+
+    from .train import make_mesh, make_train_state, synthetic_batch
+
+    config = _model_config(scale)
+    mesh = make_mesh()
+    (params, opt_state), optimizer = make_train_state(config, mesh)
+
+    from .train import make_sharded_train_step
+
+    step = make_sharded_train_step(
+        lambda p, t: loss_fn(p, t, config), mesh, optimizer
+    )
+    tokens = synthetic_batch(config, scale.batch)
+
+    state = [params, opt_state]
+
+    def chain(n: int) -> float:
+        for _ in range(n):
+            state[0], state[1], loss = step(state[0], state[1], tokens)
+        return float(loss)  # single readback; params chain on device
+
+    secs = measure_slope_secs(chain, n_lo=4, n_hi=12)
+    flops = train_step_flops(config, scale.batch)
+    peak = device_peak_flops()
+    step_tokens = scale.batch * (config.max_seq_len - 1)
+    return {
+        "train_step_ms": round(secs * 1000, 3),
+        "train_tokens_per_sec": round(step_tokens / secs, 1),
+        "train_step_flops": flops,
+        "mfu": round(flops / secs / peak, 4) if peak else None,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
+def _time_attention_grad(attn_fn, q, k, v) -> float:
+    """Per-call seconds of value+grad through ``attn_fn(q, k, v)``.
+
+    The whole n-iteration chain runs device-side in one ``lax.fori_loop``
+    dispatch (grad feeds back into q, so iterations cannot be elided or
+    overlapped), keeping per-dispatch tunnel jitter out of the window."""
+
+    def loss(q, k, v):
+        return attn_fn(q, k, v).astype(jnp.float32).sum()
+
+    grad_q = jax.grad(loss, argnums=0)
+    chains: dict[int, object] = {}
+
+    def run_chain(n: int) -> float:
+        if n not in chains:
+
+            @jax.jit
+            def chain(qq, k, v, _n=n):
+                def body(_, qq):
+                    return qq + 1e-6 * grad_q(qq, k, v).astype(qq.dtype)
+
+                return jax.lax.fori_loop(0, _n, body, qq)
+
+            chains[n] = chain
+        return float(chains[n](q, k, v)[0, 0, 0, 0])
+
+    return measure_slope_secs(run_chain, n_lo=4, n_hi=16)
+
+
+def measure_flash_vs_xla(scale: BenchScale) -> dict:
+    """flash_attention (Pallas fwd + Pallas bwd) vs the dense masked
+    XLA core it replaces, fwd+bwd, per sequence length.  Identical
+    chain/slope timing on both sides."""
+    head_dim = 128
+    results = {}
+    for seq in scale.attn_seqs:
+        key = jax.random.PRNGKey(seq)
+        q, k, v = (
+            jax.random.normal(
+                kk, (1, seq, scale.attn_heads, head_dim), jnp.bfloat16
+            )
+            for kk in jax.random.split(key, 3)
+        )
+
+        def dense(q, k, v):
+            mask = jnp.tril(jnp.ones((q.shape[1], q.shape[1]), bool))[None, None]
+            return masked_attention(q, k, v, mask, head_dim)
+
+        t_flash = _time_attention_grad(flash_attention, q, k, v)
+        t_dense = _time_attention_grad(dense, q, k, v)
+        results[seq] = {
+            "flash_ms": round(t_flash * 1000, 3),
+            "xla_ms": round(t_dense * 1000, 3),
+            "speedup": round(t_dense / t_flash, 3),
+        }
+    return results
+
+
+def measure_decode(scale: BenchScale) -> dict:
+    """KV-cached greedy decode throughput: tokens/s from the slope between
+    two generation lengths (prefill and constant costs cancel)."""
+    from .generate import generate
+
+    config = _model_config(scale)
+    # The cached decode path uses the dense core; attention_impl only
+    # affects the parallel forward.
+    params = init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (scale.batch, scale.decode_prompt), 0,
+        config.vocab_size, jnp.int32,
+    )
+    lo, hi = scale.decode_lens
+
+    def run(n_new: int) -> float:
+        out = generate(params, prompt, config, n_new)
+        return float(out[0, -1])
+
+    import statistics
+
+    run(lo)  # compile both lengths before timing
+    run(hi)
+    slopes = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run(lo)
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run(hi)
+        t_hi = time.perf_counter() - t0
+        slopes.append((t_hi - t_lo) / (hi - lo))
+    per_token = max(statistics.median(slopes), 1e-9)
+    return {
+        "decode_ms_per_token": round(per_token * 1000, 4),
+        "decode_tokens_per_sec": round(scale.batch / per_token, 1),
+    }
+
+
+def run(scale_name: str = "full") -> dict:
+    """The full perf suite as one flat dict (bench.py merges it into the
+    JSON line)."""
+    scale = BenchScale.named(scale_name)
+    out = measure_train(scale)
+    attn = measure_flash_vs_xla(scale)
+    # Headline speedup: the largest sequence length measured both ways —
+    # where the O(seq^2)-HBM dense path hurts most of what's measured.
+    top_seq = max(attn)
+    out["flash_vs_xla_speedup"] = attn[top_seq]["speedup"]
+    out["flash_vs_xla_seq"] = top_seq
+    out["flash_vs_xla_detail"] = {
+        str(s): r for s, r in sorted(attn.items())
+    }
+    out.update(measure_decode(scale))
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description="flagship perf / MFU bench")
+    parser.add_argument("--scale", default="full", choices=["full", "tiny"])
+    args = parser.parse_args(argv)
+    print(json.dumps(run(args.scale)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
