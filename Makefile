@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench busy-bench clean check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check fmt-check
 
 all: native
 
@@ -25,6 +25,12 @@ coverage: native
 
 bench: native
 	$(PYTHON) bench.py
+
+# Useful-compute bench alone (train-step MFU, flash-vs-XLA, decode tok/s).
+# Meaningful on a TPU host; SCALE=tiny exercises the harness anywhere.
+SCALE ?= full
+perf-bench:
+	$(PYTHON) -m workloads.perfbench --scale $(SCALE)
 
 # North-star measurement: 8 time-sliced pods on a 4-chip host (BASELINE.md).
 # Runs hardware-free on CPU; on a TPU host use PLATFORM=tpu.

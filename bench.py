@@ -253,7 +253,10 @@ def scale_extras() -> dict:
     import subprocess
 
     n_chips, hbm_gib = 16, 16
+    # "native" is the reported label; the Flags backend must be a
+    # validator-legal name ("tpu" == the TpuChipManager path).
     backend = "native"
+    flags_backend = "tpu"
     manager = None
     try:
         tmp = tempfile.mkdtemp(prefix="tpu-dp-bench-scale-")
@@ -282,13 +285,13 @@ def scale_extras() -> dict:
               file=sys.stderr)
         if manager is not None:
             manager.shutdown()
-        backend = "fake"
+        backend = flags_backend = "fake"
         manager = FakeChipManager(n_chips=n_chips, chips_per_tray=4,
                                   hbm_gib=hbm_gib)
         manager.init()
 
     with _plugin_harness(
-        manager, resource="google.com/tpu-mem-gb", backend=backend,
+        manager, resource="google.com/tpu-mem-gb", backend=flags_backend,
         # replicas=2 marks the plugin shared; auto_replicas overrides the
         # count with one replica per GiB of HBM.
         replicas=2, auto_replicas=True,

@@ -65,8 +65,7 @@ def measure_slope_secs(
 ) -> float:
     """Per-iteration seconds of ``run_chain(n)`` (which must execute n
     data-dependent iterations ending in one host readback), via the
-    two-point slope; the best (minimum) of ``repeats`` attempts is kept to
-    shed scheduling noise.
+    two-point slope.
 
     The round-trip cost is NOISY as well as constant (shared tunnel), so
     the estimate is the MEDIAN slope over ``repeats`` interleaved lo/hi
@@ -114,8 +113,10 @@ class BenchScale:
     @classmethod
     def named(cls, name: str) -> "BenchScale":
         if name == "full":
+            # ~470M params: measured best-MFU point among {1024, 2048} x
+            # {8, 16 layers} on a single v5e chip.
             return cls(
-                d_model=1024, n_heads=8, n_layers=8, d_ff=4096, vocab=32768,
+                d_model=2048, n_heads=16, n_layers=8, d_ff=8192, vocab=32768,
                 seq=2048, batch=8, attn_heads=8,
                 attn_seqs=(1024, 2048, 4096), decode_prompt=32,
                 decode_lens=(64, 512),
@@ -275,20 +276,11 @@ def measure_decode(scale: BenchScale) -> dict:
         out = generate(params, prompt, config, n_new)
         return float(out[0, -1])
 
-    import statistics
-
-    run(lo)  # compile both lengths before timing
-    run(hi)
-    slopes = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        run(lo)
-        t_lo = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        run(hi)
-        t_hi = time.perf_counter() - t0
-        slopes.append((t_hi - t_lo) / (hi - lo))
-    per_token = max(statistics.median(slopes), 1e-9)
+    # max_n pins the chain lengths: growing them would recompile and could
+    # push prompt+n_new past max_seq_len.
+    per_token = measure_slope_secs(
+        run, n_lo=lo, n_hi=hi, min_window_secs=0.0, max_n=hi
+    )
     return {
         "decode_ms_per_token": round(per_token * 1000, 4),
         "decode_tokens_per_sec": round(scale.batch / per_token, 1),
