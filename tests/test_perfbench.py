@@ -39,17 +39,26 @@ def test_train_step_flops_analytic():
     assert train_step_flops(config, batch) == expected
 
 
-def test_device_peak_flops_matches_platform():
+def test_device_peak_flops_table(monkeypatch):
     import jax
 
-    # On the CPU test platform (conftest forces it) the device kind is
-    # unknown -> None, so MFU is omitted instead of reported against a
-    # guessed peak; on a real TPU a positive peak must resolve.
-    peak = device_peak_flops()
-    if jax.devices()[0].platform == "tpu":
-        assert peak and peak > 0
-    else:
-        assert peak is None
+    class _Dev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    def fake_devices(kind):
+        monkeypatch.setattr(jax, "devices", lambda: [_Dev(kind)])
+
+    fake_devices("TPU v5 lite")
+    assert device_peak_flops() == 197e12
+    fake_devices("TPU v4")
+    assert device_peak_flops() == 275e12
+    # Unknown kinds (future generations) yield None so MFU is omitted
+    # instead of reported against a guessed peak.
+    fake_devices("TPU v99 hyperdrive")
+    assert device_peak_flops() is None
+    fake_devices("cpu")
+    assert device_peak_flops() is None
 
 
 def test_measure_slope_cancels_constant_overhead():

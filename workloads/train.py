@@ -86,22 +86,34 @@ def make_sharded_train_state(mesh: Mesh, init_fn, specs, optimizer=None, abstrac
     return init_jit(), optimizer
 
 
-def make_sharded_train_step(loss_fn, mesh: Mesh, optimizer):
-    """Generic full train step for a ``loss_fn(params, tokens)``: forward,
-    backward, optimizer update, jitted with donated state; tokens land
-    batch-sharded on "data"."""
-    data_sharding = NamedSharding(mesh, P("data", None))
+def make_sharded_train_step(loss_fn, mesh: Mesh, optimizer, batch_specs=None):
+    """Generic full train step for a ``loss_fn(params, *batch)``: forward,
+    backward, optimizer update, jitted with donated state.
+
+    ``batch_specs`` gives one PartitionSpec per batch argument; the default
+    is a single batch-on-"data" tokens array (the LM callers).  The vision
+    workload passes (images, labels) specs through the same helper."""
+    if batch_specs is None:
+        batch_specs = (P("data", None),)
+    batch_shardings = tuple(NamedSharding(mesh, s) for s in batch_specs)
 
     @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+    def train_step(params, opt_state, *batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    def step(params, opt_state, tokens):
-        tokens = jax.device_put(tokens, data_sharding)
-        return train_step(params, opt_state, tokens)
+    def step(params, opt_state, *batch):
+        if len(batch) != len(batch_shardings):
+            raise ValueError(
+                f"expected {len(batch_shardings)} batch arguments "
+                f"(one per batch_specs entry), got {len(batch)}"
+            )
+        placed = tuple(
+            jax.device_put(b, s) for b, s in zip(batch, batch_shardings)
+        )
+        return train_step(params, opt_state, *placed)
 
     return step
 
