@@ -74,3 +74,42 @@ def test_generate_single_scan_under_jit(params):
 def test_generate_rejects_empty_prompt(params):
     with pytest.raises(ValueError, match="at least one token"):
         generate(params, jnp.zeros((1, 0), jnp.int32), CONFIG, max_new_tokens=4)
+
+
+class TestGroupedQuery:
+    """GQA (n_kv_heads < n_heads): cached decode still matches the dense
+    forward exactly, and the cache is group-factor smaller."""
+
+    GQA = ModelConfig(
+        max_seq_len=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        dtype=jnp.float32,
+    )
+
+    @pytest.fixture(scope="class")
+    def gqa_params(self):
+        return init_params(self.GQA, jax.random.PRNGKey(0))
+
+    def test_cache_shrinks_by_group_factor(self):
+        cache = init_kv_cache(self.GQA, batch=2, max_len=8)
+        assert cache.shape[4] == 2  # kv heads, not n_heads
+
+    def test_cached_logits_match_dense_forward(self, gqa_params):
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 10), 0, self.GQA.vocab_size, jnp.int32
+        )
+        dense = forward(gqa_params, tokens, self.GQA)
+        cache = init_kv_cache(self.GQA, batch=2, max_len=10)
+        for pos in range(10):
+            logits, cache = decode_step(
+                gqa_params, cache, tokens[:, pos], jnp.int32(pos), self.GQA
+            )
+            np.testing.assert_allclose(
+                np.asarray(logits), np.asarray(dense[:, pos]), atol=2e-4,
+                err_msg=f"position {pos}",
+            )
+
+    def test_generate_runs(self, gqa_params):
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        out = generate(prompt=prompt, params=gqa_params, config=self.GQA,
+                       max_new_tokens=4)
+        assert out.shape == (1, 4)

@@ -61,6 +61,20 @@ def test_device_peak_flops_table(monkeypatch):
     assert device_peak_flops() is None
 
 
+def test_device_peak_resolves_on_real_tpu():
+    """On an actual TPU runner the device kind must be in the peak table —
+    otherwise MFU silently vanishes from the bench JSON.  (Production
+    degrades gracefully by design; the *test* is where drift gets loud.)"""
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        pytest.skip("no TPU on this runner")
+    assert device_peak_flops(), (
+        f"device kind {jax.devices()[0].device_kind!r} missing from "
+        "_PEAK_BF16_FLOPS"
+    )
+
+
 def test_measure_slope_cancels_constant_overhead():
     calls = []
 
@@ -113,3 +127,18 @@ def test_perfbench_tiny_end_to_end():
         assert out["mfu"] is None  # no known peak -> omitted, not guessed
     assert out["train_step_ms"] >= 0
     assert set(out["flash_vs_xla_detail"]) == {"128"}
+
+
+def test_train_step_flops_gqa_counts_smaller_kv():
+    from workloads.model import ModelConfig
+
+    base = dict(vocab_size=100, d_model=8, n_heads=4, n_layers=3, d_ff=16,
+                max_seq_len=5)
+    mha = ModelConfig(**base)
+    gqa = ModelConfig(**base, n_kv_heads=2)
+    # Same everything except the k/v projections, which halve.
+    diff = train_step_flops(mha, 2) - train_step_flops(gqa, 2)
+    s = 4
+    tokens = 2 * s
+    expected = 3 * 2 * tokens * 3 * (2 * 8 * (4 * 2) - 2 * 8 * (2 * 2))
+    assert diff == expected

@@ -109,3 +109,14 @@ def test_pipeline_rejects_bad_shapes():
     tokens = jnp.zeros((6, CONFIG.max_seq_len), jnp.int32)
     with pytest.raises(ValueError, match="n_microbatches"):
         pipeline_loss_fn(params, tokens, CONFIG, mesh, n_microbatches=4)
+
+
+def test_pipeline_specs_follow_gqa_tree():
+    import jax.numpy as jnp
+
+    from workloads.model import ModelConfig
+    from workloads.pipeline import pipeline_param_specs
+
+    gqa = ModelConfig(n_heads=4, n_kv_heads=2, dtype=jnp.float32)
+    specs = pipeline_param_specs(gqa)["stages"]
+    assert "wqkv" not in specs and {"wq", "wkv"} <= set(specs)

@@ -145,3 +145,63 @@ def test_busy_probe_aggregation(tmp_path, monkeypatch):
     agg = busy_probe.aggregate(report)
     assert agg["pods"] == 1
     assert agg["aggregate_busy_fraction"] > 0
+
+
+class TestGroupedQueryModel:
+    """GQA config (n_kv_heads < n_heads) through the full model: flash and
+    native cores agree, and the sharded train step runs on the mesh."""
+
+    def test_flash_and_native_forward_agree(self, jax_cpu):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from workloads.model import ModelConfig, forward, init_params
+
+        base = dict(
+            max_seq_len=16, n_layers=1, n_heads=4, n_kv_heads=2,
+            dtype=jnp.float32,
+        )
+        native = ModelConfig(**base, attention_impl="native")
+        flash = ModelConfig(**base, attention_impl="flash")
+        params = init_params(native, jax_cpu.random.PRNGKey(0))
+        tokens = jnp.arange(16, dtype=jnp.int32).reshape(2, 8) % native.vocab_size
+        np.testing.assert_allclose(
+            np.asarray(forward(params, tokens, native)),
+            np.asarray(forward(params, tokens, flash)),
+            atol=2e-4,
+        )
+
+    def test_param_tree_and_sharded_train_step(self, jax_cpu):
+        from jax.sharding import PartitionSpec as P
+
+        from workloads.model import ModelConfig
+        from workloads.train import (
+            make_mesh,
+            make_train_state,
+            make_train_step,
+            synthetic_batch,
+        )
+
+        config = ModelConfig(
+            max_seq_len=16, n_layers=1, n_heads=8, n_kv_heads=4
+        )
+        mesh = make_mesh(8)  # model_parallel=4 divides the 4 kv heads
+        (params, opt_state), optimizer = make_train_state(config, mesh)
+        layer = params["layers"][0]
+        assert "wqkv" not in layer
+        assert layer["wq"].sharding.spec == P(None, "model", None)
+        assert layer["wkv"].shape == (
+            config.d_model, 2, 4, config.head_dim
+        )
+        step = make_train_step(config, mesh, optimizer)
+        tokens = synthetic_batch(config, batch_size=8)
+        params, opt_state, loss = step(params, opt_state, tokens)
+        assert float(loss) > 0
+
+    def test_indivisible_kv_heads_rejected(self, jax_cpu):
+        import pytest as _pytest
+
+        from workloads.model import ModelConfig
+
+        with _pytest.raises(ValueError, match="must divide"):
+            ModelConfig(n_heads=4, n_kv_heads=3)

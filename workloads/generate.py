@@ -28,6 +28,7 @@ from .model import (
     _rmsnorm,
     apply_rope,
     masked_attention,
+    project_qkv,
     rope_angles,
 )
 
@@ -39,9 +40,11 @@ def _rope_at(x: jax.Array, pos: jax.Array) -> jax.Array:
 
 
 def init_kv_cache(config: ModelConfig, batch: int, max_len: int):
-    """Per-layer (k, v) buffers: [layers, 2, batch, max_len, heads, head_dim]."""
+    """Per-layer (k, v) buffers: [layers, 2, batch, max_len, kv_heads,
+    head_dim].  Under grouped-query attention kv_heads < n_heads and the
+    cache shrinks by the group factor — the point of GQA at serving time."""
     return jnp.zeros(
-        (config.n_layers, 2, batch, max_len, config.n_heads, config.head_dim),
+        (config.n_layers, 2, batch, max_len, config.kv_heads, config.head_dim),
         config.dtype,
     )
 
@@ -58,8 +61,7 @@ def decode_step(params: dict, cache: jax.Array, token: jax.Array, pos: jax.Array
 
     for i, layer in enumerate(params["layers"]):
         h = _rmsnorm(x, layer["ln1"])
-        qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(x.dtype))
-        q, k, v = qkv[0], qkv[1], qkv[2]  # [b, 1, H, hd]
+        q, k, v = project_qkv(h, layer)  # [b, 1, H|Hkv, hd]
         q, k = _rope_at(q, pos), _rope_at(k, pos)
         cache = jax.lax.dynamic_update_slice(
             cache, k[None, None], (i, 0, 0, pos, 0, 0)

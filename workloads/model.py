@@ -40,16 +40,31 @@ class ModelConfig:
     # (workloads/ops/attention.py) for the single-device hot path; compiles
     # to a real TPU kernel on hardware, interpret mode elsewhere.
     attention_impl: str = "native"
+    # Grouped-query attention: None = multi-head (kv heads == n_heads,
+    # parameter tree unchanged).  Setting a divisor of n_heads shares each
+    # k/v head across a group of query heads and shrinks the KV cache —
+    # the serving-era memory trade, supported end-to-end (flash kernel,
+    # dense core, cached decode).
+    n_kv_heads: int | None = None
 
     def __post_init__(self):
         if self.attention_impl not in ("native", "flash"):
             raise ValueError(
                 f"attention_impl must be 'native' or 'flash', got {self.attention_impl!r}"
             )
+        if self.n_kv_heads is not None and self.n_heads % self.n_kv_heads:
+            raise ValueError(
+                f"n_kv_heads ({self.n_kv_heads}) must divide n_heads "
+                f"({self.n_heads})"
+            )
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
 
 def init_params(config: ModelConfig, key: jax.Array) -> dict:
@@ -68,16 +83,27 @@ def init_params(config: ModelConfig, key: jax.Array) -> dict:
     }
     for i in range(config.n_layers):
         k = jax.random.split(keys[2 + i], 6)
-        params["layers"].append(
-            {
-                "ln1": jnp.ones((config.d_model,), jnp.float32),
-                "ln2": jnp.ones((config.d_model,), jnp.float32),
-                "wqkv": dense(k[0], (config.d_model, 3, config.n_heads, config.head_dim)),
-                "wo": dense(k[1], (config.n_heads, config.head_dim, config.d_model)),
-                "w_up": dense(k[2], (config.d_model, config.d_ff)),
-                "w_down": dense(k[3], (config.d_ff, config.d_model)),
-            }
-        )
+        layer = {
+            "ln1": jnp.ones((config.d_model,), jnp.float32),
+            "ln2": jnp.ones((config.d_model,), jnp.float32),
+            "wo": dense(k[1], (config.n_heads, config.head_dim, config.d_model)),
+            "w_up": dense(k[2], (config.d_model, config.d_ff)),
+            "w_down": dense(k[3], (config.d_ff, config.d_model)),
+        }
+        if config.kv_heads == config.n_heads:
+            # Multi-head: fused qkv projection (tree unchanged from the
+            # pre-GQA layout, so existing checkpoints keep loading).
+            layer["wqkv"] = dense(
+                k[0], (config.d_model, 3, config.n_heads, config.head_dim)
+            )
+        else:
+            layer["wq"] = dense(
+                k[0], (config.d_model, config.n_heads, config.head_dim)
+            )
+            layer["wkv"] = dense(
+                k[4], (config.d_model, 2, config.kv_heads, config.head_dim)
+            )
+        params["layers"].append(layer)
     return params
 
 
@@ -87,11 +113,18 @@ def param_specs(config: ModelConfig) -> dict:
     layer = {
         "ln1": P(),
         "ln2": P(),
-        "wqkv": P(None, None, "model", None),
         "wo": P("model", None, None),
         "w_up": P(None, "model"),
         "w_down": P("model", None),
     }
+    if config.kv_heads == config.n_heads:
+        layer["wqkv"] = P(None, None, "model", None)
+    else:
+        layer["wq"] = P(None, "model", None)
+        # kv heads are the scarce axis under GQA; shard them only when the
+        # "model" degree still divides them at mesh-build time (callers pick
+        # model_parallel accordingly), which P("model") expresses directly.
+        layer["wkv"] = P(None, None, "model", None)
     return {
         "embed": P(),
         "unembed": P(),
@@ -135,22 +168,53 @@ def masked_attention(
 ) -> jax.Array:
     """The scale/mask/float32-softmax attention core, [batch, seq, heads,
     head_dim] layout, mask broadcastable to [batch, heads, s_q, s_k].
-    Single source shared by the dense forward and the KV-cached decode
-    (workloads/generate.py) so the two can never silently diverge."""
-    logits = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(head_dim).astype(
-        q.dtype
-    )
-    logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+    k/v may carry fewer heads (grouped-query): each group of
+    heads//kv_heads query heads reads one shared k/v head, expressed as a
+    grouped einsum — no materialised repeat.  Single source shared by the
+    dense forward and the KV-cached decode (workloads/generate.py) so the
+    two can never silently diverge."""
+    scale = jnp.sqrt(head_dim).astype(q.dtype)
+    heads, kv_heads = q.shape[2], k.shape[2]
+    if heads == kv_heads:
+        logits = jnp.einsum("bshk,bthk->bhst", q, k) / scale
+        logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+        weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhst,bthk->bshk", weights, v)
+    group = heads // kv_heads
+    batch, s_q = q.shape[:2]
+    qg = q.reshape(batch, s_q, kv_heads, group, head_dim)
+    logits = jnp.einsum("bsngk,btnk->bngst", qg, k) / scale
+    # Honour the documented mask contract under grouping: a full per-head
+    # mask splits its heads axis into (kv_heads, group); a broadcastable
+    # (size-1) heads axis just gains the group dimension.
+    if mask.ndim >= 4 and mask.shape[1] == heads:
+        maskg = mask.reshape(
+            mask.shape[0], kv_heads, group, *mask.shape[2:]
+        )
+    else:
+        maskg = mask[:, :, None] if mask.ndim >= 4 else mask
+    logits = jnp.where(maskg, logits.astype(jnp.float32), -1e30)
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhst,bthk->bshk", weights, v)
+    out = jnp.einsum("bngst,btnk->bsngk", weights, v)
+    return out.reshape(batch, s_q, heads, head_dim)
+
+
+def project_qkv(x: jax.Array, layer: dict):
+    """(q, k, v) from either the fused MHA projection (wqkv) or the split
+    grouped-query pair (wq + wkv).  Shared with the cached decode path."""
+    if "wqkv" in layer:
+        qkv = jnp.einsum("bsd,dthk->tbshk", x, layer["wqkv"].astype(x.dtype))
+        return qkv[0], qkv[1], qkv[2]
+    q = jnp.einsum("bsd,dhk->bshk", x, layer["wq"].astype(x.dtype))
+    kv = jnp.einsum("bsd,dthk->tbshk", x, layer["wkv"].astype(x.dtype))
+    return q, kv[0], kv[1]
 
 
 def _attention(
     x: jax.Array, layer: dict, config: ModelConfig, attention_fn=None
 ) -> jax.Array:
     batch, seq, _ = x.shape
-    qkv = jnp.einsum("bsd,dthk->tbshk", x, layer["wqkv"].astype(x.dtype))
-    q, k, v = qkv[0], qkv[1], qkv[2]
+    q, k, v = project_qkv(x, layer)
     q, k = _rope(q), _rope(k)
     if attention_fn is not None:
         # Injected core (e.g. sequence-parallel ring attention bound to a

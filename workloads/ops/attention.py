@@ -134,21 +134,45 @@ def _clamp_block(block: int, seq: int) -> int:
     return min(block, max(-(-seq // 128) * 128, 128))
 
 
+def _kv_row(heads: int, kv_heads: int):
+    """Index-map helper for grouped-query attention: flattened q row
+    b = batch_i * heads + h reads flattened k/v row
+    batch_i * kv_heads + h // group.  With kv_heads == heads this is the
+    identity, and the k/v stream is shared across each q-head group with
+    no materialised repeat."""
+    group = heads // kv_heads
+    return lambda b: (b // heads) * kv_heads + (b % heads) // group
+
+
+def _check_gqa(heads: int, kv_heads: int) -> None:
+    if heads % kv_heads:
+        raise ValueError(
+            f"q heads ({heads}) must be a multiple of kv heads ({kv_heads})"
+        )
+
+
 def _flash_forward(q, k, v, causal, interpret, block_q, block_k):
-    """q/k/v: [batch, seq, heads, head_dim] -> (out, lse[batch*heads, seq_pad])."""
+    """q: [batch, seq, heads, head_dim]; k/v: [batch, seq, kv_heads,
+    head_dim] with kv_heads dividing heads (grouped-query attention; equal
+    is plain MHA) -> (out, lse[batch*heads, seq_pad])."""
     batch, seq, heads, head_dim = q.shape
+    kv_heads = k.shape[2]
+    _check_gqa(heads, kv_heads)
     sm_scale = 1.0 / (head_dim**0.5)
     block_q = _clamp_block(block_q, seq)
     block_k = _clamp_block(block_k, seq)
+    kv_row = _kv_row(heads, kv_heads)
 
     qf = _pad_seq(
         jnp.transpose(q, (0, 2, 1, 3)).reshape(batch * heads, seq, head_dim), block_q
     )
     kf = _pad_seq(
-        jnp.transpose(k, (0, 2, 1, 3)).reshape(batch * heads, seq, head_dim), block_k
+        jnp.transpose(k, (0, 2, 1, 3)).reshape(batch * kv_heads, seq, head_dim),
+        block_k,
     )
     vf = _pad_seq(
-        jnp.transpose(v, (0, 2, 1, 3)).reshape(batch * heads, seq, head_dim), block_k
+        jnp.transpose(v, (0, 2, 1, 3)).reshape(batch * kv_heads, seq, head_dim),
+        block_k,
     )
     seq_q_pad = qf.shape[1]
     n_k_blocks = kf.shape[1] // block_k
@@ -167,8 +191,12 @@ def _flash_forward(q, k, v, causal, interpret, block_q, block_k):
         grid=(batch * heads, seq_q_pad // block_q, n_k_blocks),
         in_specs=[
             pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec(
+                (None, block_k, head_dim), lambda b, i, j: (kv_row(b), j, 0)
+            ),
+            pl.BlockSpec(
+                (None, block_k, head_dim), lambda b, i, j: (kv_row(b), j, 0)
+            ),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
@@ -245,15 +273,18 @@ def _flash_bwd_dq_kernel(
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc_ref, dv_acc_ref,
-    *, sm_scale, causal, block_q, block_k, seq_valid, n_q_blocks,
+    *, sm_scale, causal, block_q, block_k, seq_valid, n_q_blocks, group,
 ):
-    """One (batch*head, k-block, q-block) grid cell: accumulate dk/dv in
-    VMEM scratch over the sequential q axis, skipping q blocks fully above
-    the diagonal when causal."""
+    """One (batch*kv_head, k-block, group*q-block) grid cell: accumulate
+    dk/dv in VMEM scratch over the sequential innermost axis, which walks
+    every (q-head-in-group, q-block) pair sharing this k/v head — grouped-
+    query attention sums each group's contributions here — skipping q
+    blocks fully above the diagonal when causal."""
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    j = pl.program_id(2)
+    qi = j % n_q_blocks  # q block within the current group member
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
@@ -292,7 +323,7 @@ def _flash_bwd_dkv_kernel(
     else:
         _body()
 
-    @pl.when(qi == n_q_blocks - 1)
+    @pl.when(j == group * n_q_blocks - 1)
     def _finalize():
         dk_ref[:] = dk_acc_ref[:].astype(dk_ref.dtype)
         dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
@@ -300,14 +331,21 @@ def _flash_bwd_dkv_kernel(
 
 def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q, block_k):
     """dq/dk/dv via the two backward kernels; same layout contract as
-    _flash_forward."""
+    _flash_forward (k/v may carry fewer heads — grouped-query)."""
     batch, seq, heads, head_dim = q.shape
+    kv_heads = k.shape[2]
+    _check_gqa(heads, kv_heads)
+    group = heads // kv_heads
+    kv_row = _kv_row(heads, kv_heads)
     sm_scale = 1.0 / (head_dim**0.5)
     block_q = _clamp_block(block_q, seq)
     block_k = _clamp_block(block_k, seq)
 
     def flat(x):
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(batch * heads, seq, head_dim)
+        n_heads = x.shape[2]
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(
+            batch * n_heads, seq, head_dim
+        )
 
     qf = _pad_seq(flat(q), block_q)
     dof = _pad_seq(flat(dout), block_q)
@@ -335,8 +373,12 @@ def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q, 
         grid=(batch * heads, n_q_blocks, n_k_blocks),
         in_specs=[
             pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec(
+                (None, block_k, head_dim), lambda b, i, j: (kv_row(b), j, 0)
+            ),
+            pl.BlockSpec(
+                (None, block_k, head_dim), lambda b, i, j: (kv_row(b), j, 0)
+            ),
             pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -348,16 +390,34 @@ def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q, 
         interpret=interpret,
     )(qf, kf, vf, dof, lse_pad, delta)
 
+    # dk/dv: one grid row per kv head; the innermost axis walks every
+    # (group member, q block) pair so the scratch accumulates the whole
+    # q-head group's contribution before writing this k block.
+    def q_row(b, j):
+        return (b // kv_heads) * heads + (b % kv_heads) * group + j // n_q_blocks
+
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, n_q_blocks=n_q_blocks, **kwargs),
-        grid=(batch * heads, n_k_blocks, n_q_blocks),
+        functools.partial(
+            _flash_bwd_dkv_kernel, n_q_blocks=n_q_blocks, group=group, **kwargs
+        ),
+        grid=(batch * kv_heads, n_k_blocks, group * n_q_blocks),
         in_specs=[
-            pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec(
+                (None, block_q, head_dim),
+                lambda b, i, j: (q_row(b, j), j % n_q_blocks, 0),
+            ),
             pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec(
+                (None, block_q, head_dim),
+                lambda b, i, j: (q_row(b, j), j % n_q_blocks, 0),
+            ),
+            pl.BlockSpec(
+                (None, block_q, 1), lambda b, i, j: (q_row(b, j), j % n_q_blocks, 0)
+            ),
+            pl.BlockSpec(
+                (None, block_q, 1), lambda b, i, j: (q_row(b, j), j % n_q_blocks, 0)
+            ),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
@@ -378,7 +438,7 @@ def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q, 
     def unflat(x, seq_len):
         return (
             x[:, :seq_len]
-            .reshape(batch, heads, seq_len, head_dim)
+            .reshape(batch, -1, seq_len, head_dim)
             .transpose(0, 2, 1, 3)
         )
 
@@ -404,6 +464,12 @@ def flash_attention(
     bwd_impl: str = "pallas",
 ):
     """Scaled-dot-product attention, [batch, seq, heads, head_dim] layout.
+
+    k/v may carry fewer heads than q (grouped-query attention): any
+    kv_heads dividing heads works, each group of heads//kv_heads q heads
+    reading one shared k/v head straight from the kernel grid's index maps
+    — no materialised repeat, so the HBM k/v traffic shrinks by the group
+    factor.
 
     ``interpret=None`` auto-selects interpret mode off-TPU so the same code
     runs in CPU tests and compiles to a real kernel on TPU hardware.
@@ -438,8 +504,16 @@ def _fwd(q, k, v, causal, interpret, block_q, block_k, bwd_impl):
 def _flash_backward_xla(q, k, v, out, dout, lse, causal):
     """Dense recompute backward in plain XLA: materialises [seq, seq] p, so
     only suitable when that fits comfortably — kept as the reference
-    implementation the Pallas kernels are pinned against."""
+    implementation the Pallas kernels are pinned against.  Grouped-query
+    k/v are materialised to full heads here (it is the *fallback*), with
+    dk/dv summed back over each group."""
     batch, seq, heads, head_dim = q.shape
+    kv_heads = k.shape[2]
+    _check_gqa(heads, kv_heads)
+    group = heads // kv_heads
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     sm_scale = 1.0 / (head_dim**0.5)
     f32 = jnp.float32
     qf, kf, vf, of, dof = (x.astype(f32) for x in (q, k, v, out, dout))
@@ -457,6 +531,9 @@ def _flash_backward_xla(q, k, v, out, dout, lse, causal):
     ds = p * (dp - delta[..., None]) * sm_scale
     dq = jnp.einsum("bhst,bthk->bshk", ds, kf)
     dk = jnp.einsum("bhst,bshk->bthk", ds, qf)
+    if group > 1:
+        dk = dk.reshape(batch, seq, kv_heads, group, head_dim).sum(axis=3)
+        dv = dv.reshape(batch, seq, kv_heads, group, head_dim).sum(axis=3)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

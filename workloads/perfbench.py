@@ -152,8 +152,12 @@ def train_step_flops(config: ModelConfig, batch: int) -> float:
     d, ff, s = config.d_model, config.d_ff, config.max_seq_len - 1
     tokens = batch * s
     # Weight matmuls touched per token (embed is a gather, not a matmul).
+    # q and output projections are d*d each; k/v shrink by the grouped-
+    # query ratio when n_kv_heads < n_heads.
+    kv_proj = 2 * d * (config.kv_heads * config.head_dim)
     p_matmul = (
-        config.n_layers * (4 * d * d + 2 * d * ff) + d * config.vocab_size
+        config.n_layers * (2 * d * d + kv_proj + 2 * d * ff)
+        + d * config.vocab_size
     )
     fwd_dense = 2 * tokens * p_matmul
     # Causal attention: q@k^T and p@v, 2*s*s*d MAC-pairs each, halved by

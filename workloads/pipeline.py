@@ -76,11 +76,15 @@ def pipeline_param_specs(config: ModelConfig) -> dict:
     layer = {
         "ln1": P("pipe"),
         "ln2": P("pipe"),
-        "wqkv": P("pipe"),
         "wo": P("pipe"),
         "w_up": P("pipe"),
         "w_down": P("pipe"),
     }
+    if config.kv_heads == config.n_heads:
+        layer["wqkv"] = P("pipe")
+    else:
+        layer["wq"] = P("pipe")
+        layer["wkv"] = P("pipe")
     return {"embed": P(), "unembed": P(), "stages": layer}
 
 

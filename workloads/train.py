@@ -218,6 +218,12 @@ def make_seq_parallel_train_step(
     from workloads.ops.ulysses import ulysses_attention
     from workloads.ops.usp import usp_attention
 
+    if config.kv_heads != config.n_heads:
+        raise ValueError(
+            "sequence-parallel attention does not support grouped-query "
+            f"configs yet (n_kv_heads={config.n_kv_heads}); the ring/"
+            "ulysses shardings assume equal q and k/v head counts"
+        )
     axis_names = set(mesh.axis_names)
     needed = {"seq_r", "seq_u"} if attention == "usp" else {"seq"}
     if attention in ("ring", "ulysses", "usp") and not needed <= axis_names:
