@@ -113,3 +113,98 @@ class TestGroupedQuery:
         out = generate(prompt=prompt, params=gqa_params, config=self.GQA,
                        max_new_tokens=4)
         assert out.shape == (1, 4)
+
+
+def test_generate_with_tensor_parallel_params():
+    """Serving under the training shardings: generate() consumes params
+    laid out by the tensor-parallel specs on the 8-device mesh and matches
+    the replicated result token-for-token."""
+    from workloads.train import make_mesh, make_train_state
+
+    config = ModelConfig(max_seq_len=32, n_layers=2, dtype=jnp.float32)
+    mesh = make_mesh(8)
+    (sharded_params, _), _ = make_train_state(config, mesh)
+    plain = init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(3), (2, 5), 0, config.vocab_size, jnp.int32
+    )
+    got = generate(sharded_params, prompt, config, max_new_tokens=6)
+    want = generate(plain, prompt, config, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestSampling:
+    """Temperature / top-k / nucleus sampling, all static-shape inside the
+    one-scan decode."""
+
+    def test_temperature_zero_is_greedy(self, params):
+        prompt = jnp.zeros((2, 4), jnp.int32)
+        greedy = generate(params, prompt, CONFIG, max_new_tokens=5)
+        also = generate(
+            params, prompt, CONFIG, max_new_tokens=5, temperature=0.0
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(also))
+
+    def test_sampling_is_seeded_and_varies(self, params):
+        prompt = jnp.zeros((2, 4), jnp.int32)
+        a = generate(params, prompt, CONFIG, max_new_tokens=8,
+                     temperature=1.0, rng=jax.random.PRNGKey(0))
+        b = generate(params, prompt, CONFIG, max_new_tokens=8,
+                     temperature=1.0, rng=jax.random.PRNGKey(0))
+        c = generate(params, prompt, CONFIG, max_new_tokens=8,
+                     temperature=5.0, rng=jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_requires_rng_when_sampling(self, params):
+        with pytest.raises(ValueError, match="requires an rng"):
+            generate(params, jnp.zeros((1, 4), jnp.int32), CONFIG,
+                     max_new_tokens=2, temperature=1.0)
+
+    def test_top_k_one_is_greedy(self, params):
+        prompt = jnp.zeros((2, 4), jnp.int32)
+        greedy = generate(params, prompt, CONFIG, max_new_tokens=6)
+        topk1 = generate(params, prompt, CONFIG, max_new_tokens=6,
+                         temperature=0.8, top_k=1,
+                         rng=jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
+
+    def test_sample_logits_top_k_masks(self):
+        from workloads.generate import sample_logits
+
+        logits = jnp.array([[3.0, 2.0, 1.0, 0.0]])
+        picks = {
+            int(sample_logits(logits, jax.random.PRNGKey(s), 1.0, 2, 1.0)[0])
+            for s in range(64)
+        }
+        assert picks <= {0, 1}  # only the top-2 survive the mask
+        assert len(picks) == 2
+
+    def test_sample_logits_top_p_nucleus(self):
+        from workloads.generate import sample_logits
+
+        # softmax ~ [0.64, 0.24, 0.09, 0.03]: p=0.5 keeps only token 0;
+        # p=0.7 keeps {0, 1}.
+        logits = jnp.array([[4.0, 3.0, 2.0, 1.0]])
+        only0 = {
+            int(sample_logits(logits, jax.random.PRNGKey(s), 1.0, 0, 0.5)[0])
+            for s in range(32)
+        }
+        assert only0 == {0}
+        both = {
+            int(sample_logits(logits, jax.random.PRNGKey(s), 1.0, 0, 0.7)[0])
+            for s in range(64)
+        }
+        assert both == {0, 1}
+
+
+def test_sampling_knobs_do_not_retrace(params):
+    """Varying temperature/top_k/top_p hits the jit cache — only the
+    greedy-vs-sampling switch compiles a second executable."""
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    generate(params, prompt, CONFIG, max_new_tokens=4,
+             temperature=0.7, top_k=10, top_p=0.9, rng=jax.random.PRNGKey(0))
+    before = generate._cache_size()
+    generate(params, prompt, CONFIG, max_new_tokens=4,
+             temperature=1.3, top_k=3, top_p=0.5, rng=jax.random.PRNGKey(1))
+    assert generate._cache_size() == before

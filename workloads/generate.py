@@ -79,30 +79,71 @@ def decode_step(params: dict, cache: jax.Array, token: jax.Array, pos: jax.Array
     return logits, cache
 
 
-@partial(jax.jit, static_argnames=("config", "max_new_tokens"))
-def generate(
+def sample_logits(
+    logits: jax.Array,
+    key: jax.Array | None,
+    temperature,
+    top_k,
+    top_p,
+) -> jax.Array:
+    """One sampling decision over [batch, vocab] float32 logits.
+
+    No key means greedy argmax.  With a key, ``temperature`` scales the
+    logits, ``top_k`` keeps only the k highest and ``top_p`` the smallest
+    nucleus whose softmax mass reaches p.  The knobs are TRACED values
+    (changing them does not recompile the decode scan): both truncations
+    reduce to thresholds read off one shared descending sort, expressed
+    as static-shape masking — never dynamic gathers — so the whole decode
+    stays one compiled scan.  Out-of-range knobs (top_k <= 0 or >= vocab,
+    top_p <= 0 or >= 1) disable their truncation."""
+    if key is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    vocab = logits.shape[-1]
+    # temperature ~ 0 degenerates to argmax through a very cold softmax.
+    logits = logits / jnp.maximum(jnp.float32(temperature), 1e-3)
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+
+    # top-k threshold: the k-th largest logit (one dynamic_slice into the
+    # shared sort), disabled -> -inf.
+    k_idx = jnp.clip(jnp.int32(top_k) - 1, 0, vocab - 1)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.broadcast_to(k_idx, (logits.shape[0], 1)), axis=-1
+    )[:, 0]
+    k_active = (jnp.int32(top_k) > 0) & (jnp.int32(top_k) < vocab)
+    k_cut = jnp.where(k_active, kth, -jnp.inf)
+
+    # nucleus threshold: smallest logit whose *preceding* cumulative mass
+    # is < p (the top token is always kept), disabled -> -inf.
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = jnp.roll(cum, 1, axis=-1).at[:, 0].set(0.0) < jnp.float32(top_p)
+    p_cut = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1)
+    p_active = (jnp.float32(top_p) > 0.0) & (jnp.float32(top_p) < 1.0)
+    p_cut = jnp.where(p_active, p_cut, -jnp.inf)
+
+    cutoff = jnp.maximum(k_cut, p_cut)[:, None]
+    logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("config", "max_new_tokens", "sampling"))
+def _generate_impl(
     params: dict,
     prompt: jax.Array,
     config: ModelConfig,
     max_new_tokens: int,
+    sampling: bool,
+    temperature,
+    top_k,
+    top_p,
+    rng: jax.Array,
 ):
-    """Greedy decode: prompt [batch, prompt_len] -> [batch, max_new_tokens].
-
-    Prefill and decode are one fused scan over positions 0..prompt_len+new-2;
-    within the prompt the scan consumes prompt tokens, beyond it the argmax
-    of the previous step (static shapes throughout)."""
     batch, prompt_len = prompt.shape
-    if prompt_len < 1:
-        raise ValueError("prompt must contain at least one token")
     total = prompt_len + max_new_tokens
-    if total > config.max_seq_len:
-        raise ValueError(
-            f"prompt_len + max_new_tokens = {total} exceeds "
-            f"max_seq_len {config.max_seq_len}"
-        )
     cache = init_kv_cache(config, batch, total)
     # Padded input stream: prompt then zeros (replaced by generated tokens).
     stream = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
+    keys = jax.random.split(rng, total - 1) if sampling else None
 
     def step(carry, pos):
         cache, prev_tok = carry
@@ -110,7 +151,13 @@ def generate(
         # previously generated one.
         tok = jnp.where(pos < prompt_len, stream[:, pos], prev_tok)
         logits, cache = decode_step(params, cache, tok, pos, config)
-        next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        next_tok = sample_logits(
+            logits,
+            keys[pos] if keys is not None else None,
+            temperature,
+            top_k,
+            top_p,
+        )
         return (cache, next_tok), next_tok
 
     (_, _), outs = jax.lax.scan(
@@ -118,6 +165,50 @@ def generate(
         (cache, jnp.zeros((batch,), jnp.int32)),
         jnp.arange(total - 1),
     )
-    # outs[p] = argmax after consuming position p; generated tokens are the
-    # predictions from positions prompt_len-1 .. total-2.
+    # outs[p] = the pick after consuming position p; generated tokens are
+    # the predictions from positions prompt_len-1 .. total-2.
     return jnp.transpose(outs, (1, 0))[:, prompt_len - 1 :]
+
+
+def generate(
+    params: dict,
+    prompt: jax.Array,
+    config: ModelConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    rng: jax.Array | None = None,
+):
+    """Decode: prompt [batch, prompt_len] -> [batch, max_new_tokens].
+
+    Greedy by default; ``temperature > 0`` samples (requires ``rng``),
+    optionally truncated by ``top_k`` and/or nucleus ``top_p``.  Only the
+    greedy-vs-sampling choice is a compile-time switch — the three knobs
+    are traced, so a serving loop varying them per request never
+    recompiles.  Prefill and decode are one fused scan over positions
+    0..prompt_len+new-2; within the prompt the scan consumes prompt
+    tokens, beyond it the previous step's pick (static shapes
+    throughout)."""
+    _, prompt_len = prompt.shape
+    if prompt_len < 1:
+        raise ValueError("prompt must contain at least one token")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 requires an rng key")
+    total = prompt_len + max_new_tokens
+    if total > config.max_seq_len:
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {total} exceeds "
+            f"max_seq_len {config.max_seq_len}"
+        )
+    sampling = rng is not None and temperature > 0.0
+    return _generate_impl(
+        params, prompt, config, max_new_tokens, sampling,
+        jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p),
+        rng if rng is not None else jax.random.PRNGKey(0),
+    )
+
+
+# The single-scan/no-retrace contract is pinned by tests through the
+# underlying jit cache.
+generate._cache_size = _generate_impl._cache_size

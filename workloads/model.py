@@ -52,10 +52,12 @@ class ModelConfig:
             raise ValueError(
                 f"attention_impl must be 'native' or 'flash', got {self.attention_impl!r}"
             )
-        if self.n_kv_heads is not None and self.n_heads % self.n_kv_heads:
+        if self.n_kv_heads is not None and (
+            self.n_kv_heads < 1 or self.n_heads % self.n_kv_heads
+        ):
             raise ValueError(
-                f"n_kv_heads ({self.n_kv_heads}) must divide n_heads "
-                f"({self.n_heads})"
+                f"n_kv_heads ({self.n_kv_heads}) must be a positive divisor "
+                f"of n_heads ({self.n_heads})"
             )
 
     @property
