@@ -1,0 +1,84 @@
+"""Weight-only int8 quantization (workloads/quant.py): roundtrip error,
+tree shape, and the quantized serving path end-to-end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from workloads.model import ModelConfig, init_params
+from workloads.quant import (
+    dequantize,
+    is_quantized,
+    quantize,
+    quantize_params,
+    tree_bytes,
+)
+
+CONFIG = ModelConfig(max_seq_len=32, n_layers=2, dtype=jnp.float32)
+
+
+def test_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+    q = quantize(w)
+    assert q["q8"].dtype == jnp.int8
+    # Symmetric int8: error per element <= half a quantization step.
+    step = np.asarray(q["scale"])
+    err = np.abs(np.asarray(dequantize(q)) - np.asarray(w))
+    assert (err <= step / 2 + 1e-7).all()
+
+
+def test_zero_channel_is_stable():
+    w = jnp.zeros((4, 8)).at[0].set(1.0)
+    q = quantize(w, axis=-1)
+    np.testing.assert_allclose(np.asarray(dequantize(q)), np.asarray(w))
+
+
+def test_quantize_params_tree_shape_and_bytes():
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    qparams = quantize_params(params)
+    layer = qparams["layers"][0]
+    assert is_quantized(layer["wqkv"]) and is_quantized(qparams["unembed"])
+    assert not is_quantized(layer["ln1"])
+    assert not is_quantized(qparams["embed"])
+    # Matmul weights dominate this tree; int8 + scales must land well
+    # under half the float32 original.
+    assert tree_bytes(qparams) < 0.5 * tree_bytes(params)
+
+
+def test_quantized_decode_logits_close_and_generate_runs():
+    from workloads.generate import decode_step, generate, init_kv_cache
+
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    qparams = quantize_params(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 8), 0, CONFIG.vocab_size, jnp.int32
+    )
+    cache_f = init_kv_cache(CONFIG, 2, 8)
+    cache_q = init_kv_cache(CONFIG, 2, 8)
+    for pos in range(8):
+        logits_f, cache_f = decode_step(
+            params, cache_f, tokens[:, pos], jnp.int32(pos), CONFIG
+        )
+        logits_q, cache_q = decode_step(
+            qparams, cache_q, tokens[:, pos], jnp.int32(pos), CONFIG
+        )
+        # int8 weights perturb logits by ~the quantization noise, far
+        # below the logits' own spread.
+        denom = float(np.abs(np.asarray(logits_f)).max()) or 1.0
+        rel = float(np.abs(np.asarray(logits_q - logits_f)).max()) / denom
+        assert rel < 0.08, (pos, rel)
+
+    out = generate(qparams, tokens[:, :4], CONFIG, max_new_tokens=6)
+    assert out.shape == (2, 6)
+    assert (np.asarray(out) >= 0).all()
+    assert (np.asarray(out) < CONFIG.vocab_size).all()
+
+
+def test_gqa_tree_quantizes():
+    gqa = ModelConfig(
+        max_seq_len=32, n_layers=1, n_heads=4, n_kv_heads=2,
+        dtype=jnp.float32,
+    )
+    qparams = quantize_params(init_params(gqa, jax.random.PRNGKey(0)))
+    layer = qparams["layers"][0]
+    assert is_quantized(layer["wq"]) and is_quantized(layer["wkv"])

@@ -203,5 +203,5 @@ class TestGroupedQueryModel:
 
         from workloads.model import ModelConfig
 
-        with _pytest.raises(ValueError, match="must divide"):
+        with _pytest.raises(ValueError, match="positive divisor"):
             ModelConfig(n_heads=4, n_kv_heads=3)

@@ -29,6 +29,7 @@ from .model import (
     apply_rope,
     masked_attention,
     project_qkv,
+    weight,
     rope_angles,
 )
 
@@ -72,10 +73,10 @@ def decode_step(params: dict, cache: jax.Array, token: jax.Array, pos: jax.Array
         keys, values = cache[i, 0], cache[i, 1]  # [b, max_len, H, hd]
         mask = (k_pos <= pos)[None, None, None, :]
         attn = masked_attention(q, keys, values, mask, config.head_dim)
-        x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(x.dtype))
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, weight(layer["wo"], x.dtype))
         x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
 
-    logits = x[:, 0].astype(jnp.float32) @ params["unembed"]
+    logits = x[:, 0].astype(jnp.float32) @ weight(params["unembed"], jnp.float32)
     return logits, cache
 
 

@@ -201,14 +201,26 @@ def masked_attention(
     return out.reshape(batch, s_q, heads, head_dim)
 
 
+def weight(entry, dtype) -> jax.Array:
+    """A weight leaf in compute dtype — transparently dequantizing the
+    int8 serving representation (workloads/quant.py): the convert+scale
+    happens after the (halved) HBM read and fuses into the consuming
+    matmul."""
+    from .quant import dequantize, is_quantized
+
+    if is_quantized(entry):
+        return dequantize(entry, dtype)
+    return entry.astype(dtype)
+
+
 def project_qkv(x: jax.Array, layer: dict):
     """(q, k, v) from either the fused MHA projection (wqkv) or the split
     grouped-query pair (wq + wkv).  Shared with the cached decode path."""
     if "wqkv" in layer:
-        qkv = jnp.einsum("bsd,dthk->tbshk", x, layer["wqkv"].astype(x.dtype))
+        qkv = jnp.einsum("bsd,dthk->tbshk", x, weight(layer["wqkv"], x.dtype))
         return qkv[0], qkv[1], qkv[2]
-    q = jnp.einsum("bsd,dhk->bshk", x, layer["wq"].astype(x.dtype))
-    kv = jnp.einsum("bsd,dthk->tbshk", x, layer["wkv"].astype(x.dtype))
+    q = jnp.einsum("bsd,dhk->bshk", x, weight(layer["wq"], x.dtype))
+    kv = jnp.einsum("bsd,dthk->tbshk", x, weight(layer["wkv"], x.dtype))
     return q, kv[0], kv[1]
 
 
@@ -229,12 +241,12 @@ def _attention(
     else:
         mask = jnp.tril(jnp.ones((seq, seq), bool))[None, None]
         out = masked_attention(q, k, v, mask, config.head_dim)
-    return jnp.einsum("bshk,hkd->bsd", out, layer["wo"].astype(x.dtype))
+    return jnp.einsum("bshk,hkd->bsd", out, weight(layer["wo"], x.dtype))
 
 
 def _mlp(x: jax.Array, layer: dict) -> jax.Array:
-    hidden = jax.nn.gelu(x @ layer["w_up"].astype(x.dtype))
-    return hidden @ layer["w_down"].astype(x.dtype)
+    hidden = jax.nn.gelu(x @ weight(layer["w_up"], x.dtype))
+    return hidden @ weight(layer["w_down"], x.dtype)
 
 
 def forward(
@@ -246,7 +258,7 @@ def forward(
         x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, config, attention_fn)
         x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
     # Final projection in float32 for a stable softmax/loss.
-    return (x.astype(jnp.float32) @ params["unembed"])
+    return x.astype(jnp.float32) @ weight(params["unembed"], jnp.float32)
 
 
 def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:

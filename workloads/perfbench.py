@@ -263,31 +263,51 @@ def measure_flash_vs_xla(scale: BenchScale) -> dict:
 
 def measure_decode(scale: BenchScale) -> dict:
     """KV-cached greedy decode throughput: tokens/s from the slope between
-    two generation lengths (prefill and constant costs cancel)."""
+    two generation lengths (prefill and constant costs cancel).  Measured
+    twice — full-precision weights and the int8 weight-only serving
+    representation (workloads/quant.py), whose halved-plus HBM weight
+    stream is the decode bottleneck."""
     from .generate import generate
+    from .quant import quantize_params
 
     config = _model_config(scale)
     # The cached decode path uses the dense core; attention_impl only
-    # affects the parallel forward.
-    params = init_params(config, jax.random.PRNGKey(0))
-    prompt = jax.random.randint(
-        jax.random.PRNGKey(1), (scale.batch, scale.decode_prompt), 0,
-        config.vocab_size, jnp.int32,
+    # affects the parallel forward.  Serving weights are the compute dtype
+    # (bf16), not the float32 training masters — otherwise the int8 A/B
+    # would measure against a 4-byte stream nothing serves from.
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype), init_params(config, jax.random.PRNGKey(0))
     )
     lo, hi = scale.decode_lens
 
-    def run(n_new: int) -> float:
-        out = generate(params, prompt, config, n_new)
-        return float(out[0, -1])
+    def time_decode(p, batch: int) -> float:
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, scale.decode_prompt), 0,
+            config.vocab_size, jnp.int32,
+        )
 
-    # max_n pins the chain lengths: growing them would recompile and could
-    # push prompt+n_new past max_seq_len.
-    per_token = measure_slope_secs(
-        run, n_lo=lo, n_hi=hi, min_window_secs=0.0, max_n=hi
-    )
+        def run(n_new: int) -> float:
+            out = generate(p, prompt, config, n_new)
+            return float(out[0, -1])
+
+        # max_n pins the chain lengths: growing them would recompile and
+        # could push prompt+n_new past max_seq_len.
+        return measure_slope_secs(
+            run, n_lo=lo, n_hi=hi, min_window_secs=0.0, max_n=hi
+        )
+
+    per_token = time_decode(params, scale.batch)
+    # The int8 A/B runs at batch 1, where every decode step is a pure
+    # weight stream: that is the regime the weight-only quantization
+    # exists for (at larger batches per-op overheads hide the saving).
+    lat_fp = time_decode(params, 1)
+    lat_int8 = time_decode(quantize_params(params), 1)
     return {
         "decode_ms_per_token": round(per_token * 1000, 4),
         "decode_tokens_per_sec": round(scale.batch / per_token, 1),
+        "decode_b1_ms_per_token": round(lat_fp * 1000, 4),
+        "decode_b1_ms_per_token_int8": round(lat_int8 * 1000, 4),
+        "decode_int8_speedup": round(lat_fp / lat_int8, 3),
     }
 
 

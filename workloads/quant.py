@@ -1,0 +1,81 @@
+"""Weight-only int8 quantization for serving.
+
+KV-cached decode is HBM-bandwidth-bound: every step streams the full
+weight set through the chip.  Storing the matmul weights as int8 with
+per-output-channel float scales halves that traffic versus bfloat16; the
+dequantize (convert + scale multiply) happens after the HBM read and
+fuses into the consuming matmul, so the compute path stays MXU-shaped.
+
+Quantized tensors are plain pytrees — ``{"q8": int8, "scale": f32}`` —
+so they ride jax.jit / shardings / checkpoints unchanged, and the model's
+weight reads (workloads/model.py ``weight()``) accept either
+representation.  Norm gains and the (gather-read) embedding stay in float.
+
+Reference pendant: none — the reference daemon has no model code; part of
+the JAX serving workloads (SURVEY.md §7 step 8).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# The pytree marker for a quantized leaf. weight() in model.py keys on it.
+QUANT_KEY = "q8"
+
+
+def quantize(w: jax.Array, axis=0) -> dict:
+    """Symmetric per-output-channel int8: scale = max|w| / 127 reduced
+    over ``axis`` — the CONTRACTION axis (or axes) of the consuming
+    matmul, so each output channel gets its own scale (kept with
+    keepdims, so dequant broadcasts back)."""
+    w = jnp.asarray(w)
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return {QUANT_KEY: q, "scale": scale}
+
+
+def dequantize(entry: dict, dtype=jnp.float32) -> jax.Array:
+    return entry[QUANT_KEY].astype(dtype) * entry["scale"].astype(dtype)
+
+
+def is_quantized(entry) -> bool:
+    return isinstance(entry, dict) and QUANT_KEY in entry
+
+
+# The per-layer matmul weights worth quantizing (the big HBM streams),
+# each with the contraction axis/axes of its consuming matmul — what the
+# scale is reduced over so it lands per output channel.
+_LAYER_WEIGHTS = {
+    "wqkv": 0,      # [d, 3, H, hd] contracts d
+    "wq": 0,        # [d, H, hd] contracts d
+    "wkv": 0,       # [d, 2, Hkv, hd] contracts d
+    "wo": (0, 1),   # [H, hd, d] contracts (H, hd)
+    "w_up": 0,      # [d, ff] contracts d
+    "w_down": 0,    # [ff, d] contracts ff
+}
+
+
+def quantize_params(params: dict) -> dict:
+    """The flagship model's parameter tree with every matmul weight
+    (layer projections + unembed) stored int8; ln gains and the embedding
+    table stay float (the embedding is a gather, not a matmul stream)."""
+    out = {k: v for k, v in params.items() if k not in ("layers", "unembed")}
+    out["unembed"] = quantize(params["unembed"], axis=0)  # [d, vocab]
+    out["layers"] = [
+        {
+            k: (quantize(v, axis=_LAYER_WEIGHTS[k]) if k in _LAYER_WEIGHTS else v)
+            for k, v in layer.items()
+        }
+        for layer in params["layers"]
+    ]
+    return out
+
+
+def tree_bytes(tree) -> int:
+    """Total parameter bytes of a pytree — compare a quantized tree
+    against its source to see the HBM saving."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(tree)
+    )
