@@ -78,10 +78,14 @@ def test_gradients_match_naive():
         )
 
 
-def test_jit_and_model_integration():
-    """flash path selected through the model config compiles under jit."""
+def test_jit_and_model_integration(monkeypatch):
+    """flash path selected through the model config compiles under jit.
+    The short-seq routing would send seq=16 to the dense core, so the
+    threshold is dropped to keep the kernel in the compiled path."""
+    import workloads.model as model_mod
     from workloads.model import ModelConfig, init_params, make_forward_fn
 
+    monkeypatch.setattr(model_mod, "_FLASH_MIN_SEQ", 1)
     config = ModelConfig(max_seq_len=32, attention_impl="flash")
     params = init_params(config, jax.random.PRNGKey(0))
     tokens = jnp.zeros((2, 16), jnp.int32)

@@ -1,0 +1,109 @@
+"""LoRA fine-tuning (workloads/lora.py): zero-init identity, frozen base,
+loss decrease, int8 base, CLI."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from workloads.lora import lora_init, make_lora_train_step, merge_lora
+from workloads.model import ModelConfig, forward, init_params
+
+CONFIG = ModelConfig(max_seq_len=16, n_layers=2, dtype=jnp.float32)
+
+
+def test_zero_init_is_identity():
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    adapters = lora_init(CONFIG, rank=4, key=jax.random.PRNGKey(1))
+    tokens = jnp.arange(16, dtype=jnp.int32).reshape(2, 8)
+    base = forward(params, tokens, CONFIG)
+    merged = forward(merge_lora(params, adapters), tokens, CONFIG)
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(base), atol=1e-5)
+
+
+def test_training_updates_only_adapters_and_loss_falls():
+    from workloads.train import make_mesh, synthetic_batch
+
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    adapters = lora_init(CONFIG, rank=4, key=jax.random.PRNGKey(1))
+    mesh = make_mesh()
+    optimizer = optax.adamw(1e-2)
+    opt_state = optimizer.init(adapters)
+    step = make_lora_train_step(CONFIG, mesh, optimizer, params)
+    tokens = synthetic_batch(CONFIG, 8, seed=0)
+    first = last = None
+    frozen_before = np.asarray(params["layers"][0]["wqkv"]).copy()
+    for _ in range(20):
+        adapters, opt_state, loss = step(adapters, opt_state, tokens)
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    assert last < first, (first, last)
+    # The base tree is untouched (it is never even an argument).
+    np.testing.assert_array_equal(
+        np.asarray(params["layers"][0]["wqkv"]), frozen_before
+    )
+    # b moved away from zero.
+    assert float(jnp.abs(adapters[0]["wqkv"]["b"]).max()) > 0
+
+
+def test_int8_base_merge_and_step():
+    from workloads.quant import quantize_params
+    from workloads.train import make_mesh, synthetic_batch
+
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    qbase = quantize_params(params)
+    adapters = lora_init(CONFIG, rank=2, key=jax.random.PRNGKey(1))
+    tokens = jnp.arange(16, dtype=jnp.int32).reshape(2, 8)
+    merged = forward(merge_lora(qbase, adapters), tokens, CONFIG)
+    assert merged.shape == (2, 8, CONFIG.vocab_size)
+
+    mesh = make_mesh()
+    optimizer = optax.adamw(1e-2)
+    step = make_lora_train_step(CONFIG, mesh, optimizer, qbase)
+    adapters, _, loss = step(adapters, optimizer.init(adapters),
+                             synthetic_batch(CONFIG, 8, seed=0))
+    assert np.isfinite(float(loss))
+
+
+def test_gqa_targets_wq_wkv():
+    gqa = ModelConfig(
+        max_seq_len=16, n_layers=1, n_heads=4, n_kv_heads=2,
+        dtype=jnp.float32,
+    )
+    adapters = lora_init(gqa, rank=2, key=jax.random.PRNGKey(0))
+    assert set(adapters[0]) == {"wq", "wkv", "wo"}
+
+
+def test_rank_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="rank"):
+        lora_init(CONFIG, rank=0, key=jax.random.PRNGKey(0))
+
+
+def test_cli_entry():
+    from workloads.lora import main
+
+    assert main(["--steps", "3", "--rank", "2", "--batch-size", "4",
+                 "--seq-len", "16"]) == 0
+    assert main(["--steps", "3", "--rank", "2", "--batch-size", "4",
+                 "--seq-len", "16", "--int8-base"]) == 0
+
+
+def test_merge_rejects_layer_count_mismatch():
+    import pytest
+
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    adapters = lora_init(CONFIG, rank=2, key=jax.random.PRNGKey(1))
+    with pytest.raises(ValueError, match="mismatch"):
+        merge_lora(params, adapters[:1])
+
+
+def test_merge_dtype_follows_base():
+    params = jax.tree.map(
+        lambda w: w.astype(jnp.bfloat16), init_params(CONFIG, jax.random.PRNGKey(0))
+    )
+    adapters = lora_init(CONFIG, rank=2, key=jax.random.PRNGKey(1))
+    merged = merge_lora(params, adapters)
+    assert merged["layers"][0]["wqkv"].dtype == jnp.bfloat16

@@ -151,11 +151,15 @@ class TestGroupedQueryModel:
     """GQA config (n_kv_heads < n_heads) through the full model: flash and
     native cores agree, and the sharded train step runs on the mesh."""
 
-    def test_flash_and_native_forward_agree(self, jax_cpu):
+    def test_flash_and_native_forward_agree(self, jax_cpu, monkeypatch):
         import jax.numpy as jnp
         import numpy as np
 
+        import workloads.model as model_mod
         from workloads.model import ModelConfig, forward, init_params
+
+        # Keep the kernel in the path despite the short-seq dense routing.
+        monkeypatch.setattr(model_mod, "_FLASH_MIN_SEQ", 1)
 
         base = dict(
             max_seq_len=16, n_layers=1, n_heads=4, n_kv_heads=2,
@@ -205,3 +209,18 @@ class TestGroupedQueryModel:
 
         with _pytest.raises(ValueError, match="positive divisor"):
             ModelConfig(n_heads=4, n_kv_heads=3)
+
+
+def test_flash_config_routes_short_seq_to_dense(jax_cpu):
+    """attention_impl="flash" at short seq uses the dense core (measured
+    faster below the crossover) unless the score matrix would exceed the
+    memory cap — pinned by checking the jaxpr for the pallas call."""
+    import jax.numpy as jnp
+
+    from workloads.model import ModelConfig, forward, init_params
+
+    config = ModelConfig(max_seq_len=32, attention_impl="flash")
+    params = init_params(config, jax_cpu.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    jaxpr = str(jax_cpu.make_jaxpr(lambda p, t: forward(p, t, config))(params, tokens))
+    assert "pallas_call" not in jaxpr  # short seq -> dense core

@@ -1,0 +1,187 @@
+"""LoRA (low-rank adaptation) fine-tuning for the flagship transformer.
+
+The fine-tuning counterpart of workloads/train.py: the base parameters
+stay frozen (and may even be the int8 serving representation —
+workloads/quant.py), and only rank-r adapter factors train.  Written the
+JAX way: adapters are a separate pytree, the merge ``w + a @ b`` happens
+functionally inside the jitted step, and ``jax.grad`` over the adapter
+tree alone gives frozen-base training for free — no parameter flags, no
+module surgery.  Optimizer state lives only for the adapters, so the
+fine-tune memory footprint is the base weights plus O(rank) — the reason
+LoRA fits where full fine-tuning does not.
+
+Reference pendant: none — the reference daemon has no model code; part of
+the JAX workload suite (SURVEY.md §7 step 8).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .model import ModelConfig, loss_fn, weight
+
+# Which layer weights get adapters; their (fan_in, fan_out) split comes
+# from the contraction-axis table quant.py owns (one source of truth for
+# the weight layouts).
+_TARGETS = ("wqkv", "wq", "wkv", "wo")
+
+
+def _fans(name: str, shape: tuple) -> tuple[int, int]:
+    from .quant import CONTRACTION_AXES
+
+    axes = CONTRACTION_AXES[name]
+    axes = (axes,) if isinstance(axes, int) else axes
+    fan_in = fan_out = 1
+    for i, s in enumerate(shape):
+        if i in axes:
+            fan_in *= s
+        else:
+            fan_out *= s
+    return fan_in, fan_out
+
+
+def lora_init(
+    config: ModelConfig, rank: int, key: jax.Array, targets=_TARGETS
+) -> list:
+    """Adapter pytree: per layer, per target weight, ``{"a": [fan_in, r],
+    "b": [r, fan_out]}``.  b starts at zero — the adapted model is exactly
+    the base model at step 0 (the standard LoRA init)."""
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    from .model import init_params
+
+    shapes = jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))
+    adapters = []
+    for li, layer in enumerate(shapes["layers"]):
+        entry = {}
+        for name in targets:
+            if name not in layer:
+                continue
+            fan_in, fan_out = _fans(name, layer[name].shape)
+            key, ka = jax.random.split(key)
+            entry[name] = {
+                "a": jax.random.normal(ka, (fan_in, rank), jnp.float32)
+                * (1.0 / fan_in**0.5),
+                "b": jnp.zeros((rank, fan_out), jnp.float32),
+            }
+        adapters.append(entry)
+    return adapters
+
+
+def merge_lora(
+    params: dict, adapters: list, alpha: float = 1.0, dtype=None
+) -> dict:
+    """The base tree with each adapted weight replaced by
+    ``w + alpha * (a @ b)`` (dequantizing int8 bases on the fly).  Runs
+    inside jit — gradients through the merge reach only a and b.
+
+    The merged copy materialises in ``dtype`` (default: the base leaf's
+    own floating dtype, float32 for int8 leaves) — merging a bf16 base in
+    float32 would double the transient weight memory for nothing."""
+    if len(adapters) != len(params["layers"]):
+        raise ValueError(
+            f"adapter/layer count mismatch: {len(adapters)} adapters for "
+            f"{len(params['layers'])} layers"
+        )
+    out = {k: v for k, v in params.items() if k != "layers"}
+    layers = []
+    for layer, entry in zip(params["layers"], adapters):
+        new = dict(layer)
+        for name, ab in entry.items():
+            leaf = layer[name]
+            target = dtype
+            if target is None:
+                leaf_dtype = getattr(leaf, "dtype", None)
+                target = (
+                    leaf_dtype
+                    if leaf_dtype is not None
+                    and jnp.issubdtype(leaf_dtype, jnp.floating)
+                    else jnp.float32
+                )
+            w = weight(leaf, target)
+            # The low-rank product stays float32 for accuracy; only the
+            # merged sum lands in the target dtype.
+            delta = ((ab["a"] @ ab["b"]).reshape(w.shape) * alpha)
+            new[name] = (w.astype(jnp.float32) + delta).astype(target)
+        layers.append(new)
+    out["layers"] = layers
+    return out
+
+
+def make_lora_train_step(
+    config: ModelConfig, mesh, optimizer, base_params, alpha: float = 1.0
+):
+    """Jitted fine-tune step: (adapters, opt_state, tokens) ->
+    (adapters, opt_state, loss).  ``base_params`` is closed over and
+    donated nothing — it never changes; only the adapter tree and its
+    optimizer state update."""
+    from .train import make_sharded_train_step
+
+    def adapter_loss(adapters, tokens):
+        merged = merge_lora(base_params, adapters, alpha, dtype=config.dtype)
+        return loss_fn(merged, tokens, config)
+
+    return make_sharded_train_step(adapter_loss, mesh, optimizer)
+
+
+def main(argv=None) -> int:
+    """``python -m workloads.lora --steps 30 --rank 8`` — LoRA fine-tune
+    of the flagship on synthetic data, optionally from an int8 base."""
+    import argparse
+
+    import optax
+
+    parser = argparse.ArgumentParser(description="LoRA fine-tune")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--rank", type=int, default=8)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument("--int8-base", action="store_true",
+                        help="freeze the base in the int8 serving format")
+    args = parser.parse_args(argv)
+    if args.steps < 1:
+        parser.error("--steps must be >= 1")
+
+    from .model import init_params
+    from .train import make_mesh, make_sharded_train_state, synthetic_batch
+
+    config = ModelConfig(max_seq_len=args.seq_len)
+    mesh = make_mesh()
+    base = init_params(config, jax.random.PRNGKey(0))
+    if args.int8_base:
+        from .quant import quantize_params
+
+        base = quantize_params(base)
+    optimizer = optax.adamw(1e-3)
+    from jax.sharding import PartitionSpec as P
+
+    adapters_shape = jax.eval_shape(
+        lambda: lora_init(config, args.rank, jax.random.PRNGKey(1))
+    )
+    specs = jax.tree.map(lambda _: P(), adapters_shape)
+    (adapters, opt_state), optimizer = make_sharded_train_state(
+        mesh,
+        lambda: lora_init(config, args.rank, jax.random.PRNGKey(1)),
+        specs,
+        optimizer=optimizer,
+    )
+    step = make_lora_train_step(config, mesh, optimizer, base)
+    first = last = None
+    for s in range(1, args.steps + 1):
+        tokens = synthetic_batch(config, args.batch_size, seed=s)
+        adapters, opt_state, loss = step(adapters, opt_state, tokens)
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+        if s % 10 == 0 or s == args.steps:
+            print(f"step {s}: loss={last:.4f}")
+    print(
+        f"done: steps={args.steps} rank={args.rank} "
+        f"int8_base={args.int8_base} loss {first:.4f} -> {last:.4f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -224,6 +224,15 @@ def project_qkv(x: jax.Array, layer: dict):
     return q, kv[0], kv[1]
 
 
+# Routing constants for attention_impl="flash", from the perf bench's
+# measured crossover on v5e (workloads/perfbench.py flash_vs_xla_detail):
+# the dense XLA core wins below ~2k tokens where the quadratic term is
+# still cheap — but only while its [batch, heads, seq, seq] float32 score
+# matrix stays small enough not to pressure HBM.
+_FLASH_MIN_SEQ = 2048
+_DENSE_SCORE_BYTES_CAP = 256 << 20
+
+
 def _attention(
     x: jax.Array, layer: dict, config: ModelConfig, attention_fn=None
 ) -> jax.Array:
@@ -234,11 +243,17 @@ def _attention(
         # Injected core (e.g. sequence-parallel ring attention bound to a
         # mesh — workloads/train.py make_seq_parallel_train_step).
         out = attention_fn(q, k, v)
-    elif config.attention_impl == "flash":
+    elif config.attention_impl == "flash" and (
+        seq >= _FLASH_MIN_SEQ
+        or 4 * batch * config.n_heads * seq * seq > _DENSE_SCORE_BYTES_CAP
+    ):
         from workloads.ops import flash_attention
 
         out = flash_attention(q, k, v)
     else:
+        # Short sequences (static shapes — this routing is trace-time):
+        # the dense core is faster than the kernel here and the score
+        # matrix is bounded by the cap above.
         mask = jnp.tril(jnp.ones((seq, seq), bool))[None, None]
         out = masked_attention(q, k, v, mask, config.head_dim)
     return jnp.einsum("bshk,hkd->bsd", out, weight(layer["wo"], x.dtype))

@@ -46,8 +46,10 @@ def is_quantized(entry) -> bool:
 
 # The per-layer matmul weights worth quantizing (the big HBM streams),
 # each with the contraction axis/axes of its consuming matmul — what the
-# scale is reduced over so it lands per output channel.
-_LAYER_WEIGHTS = {
+# scale is reduced over so it lands per output channel.  Single source of
+# the per-weight contraction layout; LoRA's fan computation
+# (workloads/lora.py) derives from it too.
+CONTRACTION_AXES = {
     "wqkv": 0,      # [d, 3, H, hd] contracts d
     "wq": 0,        # [d, H, hd] contracts d
     "wkv": 0,       # [d, 2, Hkv, hd] contracts d
@@ -55,6 +57,7 @@ _LAYER_WEIGHTS = {
     "w_up": 0,      # [d, ff] contracts d
     "w_down": 0,    # [ff, d] contracts ff
 }
+_LAYER_WEIGHTS = CONTRACTION_AXES
 
 
 def quantize_params(params: dict) -> dict:
