@@ -123,3 +123,25 @@ def test_train_cli_profile_dir_writes_trace(tmp_path):
     for root, _, files in os.walk(tmp_path / "trace"):
         found += [f for f in files if "trace" in f or f.endswith(".pb")]
     assert found, "no trace artifacts written"
+
+
+def test_gqa_tree_roundtrips(tmp_path):
+    """The grouped-query parameter tree (split wq/wkv leaves) saves and
+    restores onto the sharded mesh like the fused MHA tree."""
+    gqa = ModelConfig(
+        max_seq_len=16, n_layers=1, n_heads=8, n_kv_heads=4,
+        dtype=jnp.float32,
+    )
+    mesh = make_mesh(8)  # model_parallel=4 divides the 4 kv heads
+    (params, opt_state), optimizer = make_train_state(gqa, mesh)
+    ckpt = TrainCheckpointer(str(tmp_path / "gqa"))
+    ckpt.save(1, (params, opt_state))
+    ckpt.wait()
+    abstract, _ = make_train_state(gqa, mesh, abstract=True)
+    restored = ckpt.restore_latest(like=abstract)
+    r_params, _ = restored
+    np.testing.assert_array_equal(
+        np.asarray(r_params["layers"][0]["wkv"]),
+        np.asarray(params["layers"][0]["wkv"]),
+    )
+    ckpt.close()

@@ -1,0 +1,97 @@
+"""Speculative decoding (workloads/speculative.py): lossless vs the
+target's own greedy decode, with fewer target passes when the draft
+agrees."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.speculative import speculative_generate
+
+TARGET = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+DRAFT = ModelConfig(
+    max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def models():
+    return (
+        init_params(TARGET, jax.random.PRNGKey(0)),
+        init_params(DRAFT, jax.random.PRNGKey(7)),
+    )
+
+
+def test_matches_target_greedy_exactly(models):
+    """The whole point: a random (often-disagreeing) draft must still
+    reproduce the target's greedy output token-for-token."""
+    target_params, draft_params = models
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (1, 6), 0, TARGET.vocab_size, jnp.int32
+    )
+    want = generate(target_params, prompt, TARGET, max_new_tokens=20)
+    got, rounds = speculative_generate(
+        target_params, draft_params, prompt, TARGET, DRAFT,
+        max_new_tokens=20, gamma=3,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert 1 <= rounds <= 20
+
+
+def test_self_draft_accepts_everything(models):
+    """Draft == target: every proposal is accepted, so each round commits
+    gamma+1 tokens and the round count collapses."""
+    target_params, _ = models
+    prompt = jnp.ones((1, 4), jnp.int32)
+    max_new, gamma = 17, 3
+    want = generate(target_params, prompt, TARGET, max_new_tokens=max_new)
+    got, rounds = speculative_generate(
+        target_params, target_params, prompt, TARGET, TARGET,
+        max_new_tokens=max_new, gamma=gamma,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # Prefill commits 1; each round then commits gamma+1 = 4.
+    assert rounds == 1 + -(-(max_new - 1) // (gamma + 1))
+
+
+@pytest.mark.parametrize("gamma", [1, 2, 5])
+def test_gamma_sweep_stays_lossless(models, gamma):
+    target_params, draft_params = models
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    want = generate(target_params, prompt, TARGET, max_new_tokens=12)
+    got, _ = speculative_generate(
+        target_params, draft_params, prompt, TARGET, DRAFT,
+        max_new_tokens=12, gamma=gamma,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_validation(models):
+    target_params, draft_params = models
+    with pytest.raises(ValueError, match="batch-1"):
+        speculative_generate(
+            target_params, draft_params, jnp.zeros((2, 4), jnp.int32),
+            TARGET, DRAFT, max_new_tokens=4,
+        )
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(
+            target_params, draft_params, jnp.zeros((1, 4), jnp.int32),
+            TARGET, DRAFT, max_new_tokens=4, gamma=0,
+        )
+    with pytest.raises(ValueError, match="exceeds"):
+        speculative_generate(
+            target_params, draft_params, jnp.zeros((1, 4), jnp.int32),
+            TARGET, DRAFT, max_new_tokens=60,
+        )
+    small_vocab = ModelConfig(max_seq_len=64, vocab_size=128,
+                              dtype=jnp.float32)
+    with pytest.raises(ValueError, match="vocabulary"):
+        speculative_generate(
+            target_params, init_params(small_vocab, jax.random.PRNGKey(0)),
+            jnp.zeros((1, 4), jnp.int32), TARGET, small_vocab,
+            max_new_tokens=4,
+        )

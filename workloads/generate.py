@@ -34,12 +34,6 @@ from .model import (
 )
 
 
-def _rope_at(x: jax.Array, pos: jax.Array) -> jax.Array:
-    """Rotary embedding for single-position vectors, sharing the model's
-    frequency/rotation core.  x: [batch, 1, heads, head_dim]; pos: scalar."""
-    return apply_rope(x, rope_angles(jnp.asarray(pos)[None], x.shape[-1]))
-
-
 def init_kv_cache(config: ModelConfig, batch: int, max_len: int):
     """Per-layer (k, v) buffers: [layers, 2, batch, max_len, kv_heads,
     head_dim].  Under grouped-query attention kv_heads < n_heads and the
@@ -50,34 +44,53 @@ def init_kv_cache(config: ModelConfig, batch: int, max_len: int):
     )
 
 
-def decode_step(params: dict, cache: jax.Array, token: jax.Array, pos: jax.Array,
-                config: ModelConfig):
-    """One token through the cached model.
+def decode_block(params: dict, cache: jax.Array, tokens: jax.Array,
+                 pos: jax.Array, config: ModelConfig):
+    """A block of ``s`` consecutive tokens through the cached model in ONE
+    forward — the prefill/verification primitive (speculative decoding
+    scores a whole draft block this way; ``decode_step`` is its s=1 case).
 
-    token: [batch] int32 (the token at position ``pos``); returns
-    (logits [batch, vocab], updated cache)."""
-    x = params["embed"].astype(config.dtype)[token][:, None, :]  # [b, 1, d]
+    tokens: [batch, s] int32 occupying positions ``pos .. pos+s-1``;
+    returns (logits [batch, s, vocab], updated cache) where logits[:, i]
+    predicts the token after position pos+i."""
+    batch, s = tokens.shape
+    x = params["embed"].astype(config.dtype)[tokens]  # [b, s, d]
     max_len = cache.shape[3]
     k_pos = jnp.arange(max_len)
+    angles = rope_angles(pos + jnp.arange(s), config.head_dim)
+    # Row i may attend to cache positions <= pos+i (its own slot included:
+    # the block's k/v land in the cache before attention reads it).
+    mask = (
+        k_pos[None, :] <= (pos + jnp.arange(s))[:, None]
+    )[None, None]  # [1, 1, s, max_len]
 
     for i, layer in enumerate(params["layers"]):
         h = _rmsnorm(x, layer["ln1"])
-        q, k, v = project_qkv(h, layer)  # [b, 1, H|Hkv, hd]
-        q, k = _rope_at(q, pos), _rope_at(k, pos)
+        q, k, v = project_qkv(h, layer)  # [b, s, H|Hkv, hd]
+        q, k = apply_rope(q, angles), apply_rope(k, angles)
         cache = jax.lax.dynamic_update_slice(
             cache, k[None, None], (i, 0, 0, pos, 0, 0)
         )
         cache = jax.lax.dynamic_update_slice(
             cache, v[None, None], (i, 1, 0, pos, 0, 0)
         )
-        keys, values = cache[i, 0], cache[i, 1]  # [b, max_len, H, hd]
-        mask = (k_pos <= pos)[None, None, None, :]
+        keys, values = cache[i, 0], cache[i, 1]  # [b, max_len, Hkv, hd]
         attn = masked_attention(q, keys, values, mask, config.head_dim)
         x = x + jnp.einsum("bshk,hkd->bsd", attn, weight(layer["wo"], x.dtype))
         x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
 
-    logits = x[:, 0].astype(jnp.float32) @ weight(params["unembed"], jnp.float32)
+    logits = x.astype(jnp.float32) @ weight(params["unembed"], jnp.float32)
     return logits, cache
+
+
+def decode_step(params: dict, cache: jax.Array, token: jax.Array, pos: jax.Array,
+                config: ModelConfig):
+    """One token through the cached model.
+
+    token: [batch] int32 (the token at position ``pos``); returns
+    (logits [batch, vocab], updated cache)."""
+    logits, cache = decode_block(params, cache, token[:, None], pos, config)
+    return logits[:, 0], cache
 
 
 def sample_logits(

@@ -16,6 +16,8 @@ the JAX workload suite (SURVEY.md §7 step 8).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -99,11 +101,12 @@ def merge_lora(
                     and jnp.issubdtype(leaf_dtype, jnp.floating)
                     else jnp.float32
                 )
-            w = weight(leaf, target)
-            # The low-rank product stays float32 for accuracy; only the
-            # merged sum lands in the target dtype.
-            delta = ((ab["a"] @ ab["b"]).reshape(w.shape) * alpha)
-            new[name] = (w.astype(jnp.float32) + delta).astype(target)
+            # Dequantize/read the base at float32 so the sum happens at
+            # full precision; only the merged result lands in the target
+            # dtype (reading at bf16 first would round before the add).
+            w = weight(leaf, jnp.float32)
+            delta = (ab["a"] @ ab["b"]).reshape(w.shape) * alpha
+            new[name] = (w + delta).astype(target)
         layers.append(new)
     out["layers"] = layers
     return out
@@ -113,16 +116,33 @@ def make_lora_train_step(
     config: ModelConfig, mesh, optimizer, base_params, alpha: float = 1.0
 ):
     """Jitted fine-tune step: (adapters, opt_state, tokens) ->
-    (adapters, opt_state, loss).  ``base_params`` is closed over and
-    donated nothing — it never changes; only the adapter tree and its
-    optimizer state update."""
-    from .train import make_sharded_train_step
+    (adapters, opt_state, loss).  The frozen base rides as a runtime jit
+    ARGUMENT, not a closure — closed-over arrays become compile-time
+    constants, bloating compilation and duplicating the base weights in
+    the executable, exactly the memory LoRA exists to save.  Only the
+    adapter tree and its optimizer state are donated."""
+    import optax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
 
-    def adapter_loss(adapters, tokens):
-        merged = merge_lora(base_params, adapters, alpha, dtype=config.dtype)
-        return loss_fn(merged, tokens, config)
+    data_sharding = NamedSharding(mesh, P("data", None))
 
-    return make_sharded_train_step(adapter_loss, mesh, optimizer)
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def lora_step(adapters, opt_state, base, tokens):
+        def adapter_loss(adapters):
+            merged = merge_lora(base, adapters, alpha, dtype=config.dtype)
+            return loss_fn(merged, tokens, config)
+
+        loss, grads = jax.value_and_grad(adapter_loss)(adapters)
+        updates, opt_state = optimizer.update(grads, opt_state, adapters)
+        adapters = optax.apply_updates(adapters, updates)
+        return adapters, opt_state, loss
+
+    def step(adapters, opt_state, tokens):
+        tokens = jax.device_put(tokens, data_sharding)
+        return lora_step(adapters, opt_state, base_params, tokens)
+
+    return step
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,174 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+Decode latency at batch 1 is bound by streaming the target's weights once
+per token; speculative decoding streams them once per ROUND instead — the
+draft proposes ``gamma`` tokens autoregressively (cheap weights), the
+target scores the whole block in ONE cached forward
+(workloads/generate.py ``decode_block``), and the longest prefix whose
+greedy picks agree is committed plus one corrected token.  Output is the
+target's greedy decode (lossless): every committed token is the target's
+own argmax given its committed prefix — scored by the block forward.  A
+numerics caveat: block- and single-step forwards reassociate their
+matmuls differently, so a near-tied argmax can flip relative to
+token-by-token ``generate`` (and self-draft acceptance can dip below
+100%) — rare in float32, more visible in bfloat16 on hardware.  The
+committed stream is always the target's own block-scored greedy; the
+exact-match tests pin the behavior on the deterministic CPU test
+platform.
+
+Written for XLA the same way generate() is: one ``lax.while_loop`` under
+jit, fixed-size buffers, ``gamma`` static, all indexing via
+dynamic-slice.  Stale cache entries past the commit point are harmless —
+attention masks by position, and later rounds overwrite them before any
+mask admits them.
+
+Batch 1 only: acceptance lengths diverge per batch row, which is a
+paging/continuous-batching concern out of scope here.
+
+Reference pendant: none — the reference daemon has no model code; part of
+the JAX serving workloads (SURVEY.md §7 step 8).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .generate import decode_block, decode_step, init_kv_cache
+from .model import ModelConfig
+
+
+@partial(
+    jax.jit,
+    static_argnames=("target_config", "draft_config", "max_new_tokens", "gamma"),
+)
+def _speculative_impl(
+    target_params: dict,
+    draft_params: dict,
+    prompt: jax.Array,
+    target_config: ModelConfig,
+    draft_config: ModelConfig,
+    max_new_tokens: int,
+    gamma: int,
+):
+    batch, prompt_len = prompt.shape
+    max_len = prompt_len + max_new_tokens + gamma + 1  # room for overshoot
+    t_cache = init_kv_cache(target_config, batch, max_len)
+    d_cache = init_kv_cache(draft_config, batch, max_len)
+
+    # Prefill both caches on the prompt; the target's last-row logits give
+    # the first committed token.
+    t_logits, t_cache = decode_block(
+        target_params, t_cache, prompt, jnp.int32(0), target_config
+    )
+    _, d_cache = decode_block(
+        draft_params, d_cache, prompt, jnp.int32(0), draft_config
+    )
+    first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+
+    out = jnp.zeros((batch, max_new_tokens + gamma + 1), jnp.int32)
+    out = out.at[:, 0].set(first)
+
+    def cond(state):
+        _, _, _, _, n_out, rounds = state
+        return n_out < max_new_tokens
+
+    def body(state):
+        t_cache, d_cache, cur, out, n_out, rounds = state
+        # ``cur`` (the latest committed token) sits at position pos:
+        pos = prompt_len + n_out - 1
+
+        # Draft gamma tokens autoregressively from cur.  The scan runs one
+        # extra step so the FINAL draft token's k/v also lands in the
+        # draft cache: on a fully-accepted round that token is committed
+        # at pos+gamma, a position later masks admit — without the extra
+        # write it would stay a zero hole and silently degrade every
+        # subsequent draft (and with it the acceptance rate).
+        def draft_one(carry, i):
+            d_cache, tok = carry
+            logits, d_cache = decode_step(
+                draft_params, d_cache, tok, pos + i, draft_config
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (d_cache, nxt), nxt
+
+        (d_cache, _), proposals = jax.lax.scan(
+            draft_one, (d_cache, cur), jnp.arange(gamma + 1)
+        )
+        drafts = jnp.transpose(proposals, (1, 0))[:, :gamma]  # [batch=1, gamma]
+
+        # Target scores [cur, d_1..d_gamma] in one forward: logits[:, i]
+        # is the target's pick after ...cur, d_1..d_i.
+        block = jnp.concatenate([cur[:, None], drafts], axis=1)
+        t_logits, t_cache = decode_block(
+            target_params, t_cache, block, pos, target_config
+        )
+        picks = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [1, g+1]
+
+        # Longest agreeing prefix: n = #{i : drafts[j] == picks[j-1]
+        # for all j <= i}; commit drafts[:n] then picks[n] (the target's
+        # correction, or its bonus token after a fully accepted block).
+        agree = drafts == picks[:, :-1]
+        n = jnp.argmin(
+            jnp.concatenate([agree, jnp.zeros((1, 1), bool)], axis=1)[0]
+        ).astype(jnp.int32)
+        committed = jnp.concatenate(
+            [drafts, jnp.zeros((1, 1), jnp.int32)], axis=1
+        )
+        committed = committed.at[0, n].set(picks[0, n])
+
+        # Write the n+1 committed tokens; clamp the buffer index so the
+        # overshoot beyond max_new lands in the scratch tail.
+        def write(j, out):
+            idx = jnp.minimum(n_out + j, out.shape[1] - 1)
+            val = jnp.where(j <= n, committed[0, j], out[0, idx])
+            return out.at[0, idx].set(val)
+
+        out = jax.lax.fori_loop(0, gamma + 1, write, out)
+        cur = committed[0, n][None]
+        return (t_cache, d_cache, cur, out, n_out + n + 1, rounds + 1)
+
+    state = (t_cache, d_cache, first, out, jnp.int32(1), jnp.int32(1))
+    *_, out, n_out, rounds = jax.lax.while_loop(cond, body, state)
+    return out[:, :max_new_tokens], rounds
+
+
+def speculative_generate(
+    target_params: dict,
+    draft_params: dict,
+    prompt: jax.Array,
+    target_config: ModelConfig,
+    draft_config: ModelConfig,
+    max_new_tokens: int,
+    gamma: int = 4,
+):
+    """Greedy speculative decode.  Returns (tokens [1, max_new_tokens],
+    rounds) — ``rounds`` counts target forward passes (including the one
+    committed-token-per-round floor), the speedup lever: rounds approaches
+    max_new_tokens/(gamma+1) when the draft agrees, max_new_tokens when it
+    never does."""
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            "speculative decoding is batch-1 (acceptance lengths diverge "
+            f"across rows); got batch {prompt.shape[0]}"
+        )
+    if prompt.shape[1] < 1:
+        raise ValueError("prompt must contain at least one token")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if target_config.vocab_size != draft_config.vocab_size:
+        raise ValueError("target and draft must share a vocabulary")
+    total = prompt.shape[1] + max_new_tokens + gamma + 1
+    for name, config in (("target", target_config), ("draft", draft_config)):
+        if total > config.max_seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens + gamma overshoot = {total} "
+                f"exceeds {name} max_seq_len {config.max_seq_len}"
+            )
+    tokens, rounds = _speculative_impl(
+        target_params, draft_params, prompt, target_config, draft_config,
+        max_new_tokens, gamma,
+    )
+    return tokens, int(rounds)
