@@ -185,3 +185,78 @@ class TestGroupedQuery:
         _, k, v = make_qkv(heads=3, seed=1)
         with pytest.raises(ValueError, match="multiple of kv heads"):
             flash_attention(q, k, v, True, True)
+
+
+class TestSlidingWindow:
+    """Causal sliding-window attention: row i attends [i-window+1, i]."""
+
+    @staticmethod
+    def banded_ref(q, k, v, window):
+        head_dim = q.shape[-1]
+        seq = q.shape[1]
+        s = jnp.einsum(
+            "bshk,bthk->bhst", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) / np.sqrt(head_dim)
+        ids = jnp.arange(seq)
+        mask = (ids[None, :] <= ids[:, None]) & (
+            ids[None, :] > ids[:, None] - window
+        )
+        s = jnp.where(mask[None, None], s, -1e30)
+        w = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhst,bthk->bshk", w, v.astype(jnp.float32)).astype(
+            q.dtype
+        )
+
+    @pytest.mark.parametrize("window", [1, 16, 40])
+    def test_forward_matches_banded_reference(self, window):
+        q, k, v = make_qkv(seq=96)
+        out = flash_attention(q, k, v, True, True, 32, 32, window=window)
+        expected = self.banded_ref(q, k, v, window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=2e-5)
+
+    @pytest.mark.parametrize("bwd_impl", ["pallas", "xla"])
+    def test_gradients_match_banded_reference(self, bwd_impl):
+        window = 24
+        q, k, v = make_qkv(seq=96)
+
+        def loss_flash(q, k, v):
+            return (
+                flash_attention(q, k, v, True, True, 32, 32, bwd_impl,
+                                window) ** 2
+            ).sum()
+
+        def loss_ref(q, k, v):
+            return (self.banded_ref(q, k, v, window) ** 2).sum()
+
+        got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, g, w in zip("dq dk dv".split(), got, want):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), atol=5e-4, err_msg=name
+            )
+
+    def test_window_one_attends_self_only(self):
+        q, k, v = make_qkv(seq=32)
+        out = flash_attention(q, k, v, True, True, 32, 32, window=1)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(v), atol=2e-5
+        )
+
+    def test_window_with_gqa(self):
+        q, _, _ = make_qkv(heads=4, seq=64)
+        _, k, v = make_qkv(heads=2, seq=64, seed=1)
+        group = 2
+        out = flash_attention(q, k, v, True, True, 32, 32, window=16)
+        expected = self.banded_ref(
+            q, jnp.repeat(k, group, axis=2), jnp.repeat(v, group, axis=2), 16
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=2e-5)
+
+    def test_validation(self):
+        q, k, v = make_qkv(seq=16)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, False, True, window=8)
+        with pytest.raises(ValueError, match="window"):
+            flash_attention(q, k, v, True, True, window=0)

@@ -208,3 +208,27 @@ def test_sampling_knobs_do_not_retrace(params):
     generate(params, prompt, CONFIG, max_new_tokens=4,
              temperature=1.3, top_k=3, top_p=0.5, rng=jax.random.PRNGKey(1))
     assert generate._cache_size() == before
+
+
+def test_sliding_window_decode_matches_dense_forward():
+    """A windowed config: the cached decode's banded mask reproduces the
+    dense forward's windowed logits at every position."""
+    config = ModelConfig(
+        max_seq_len=32, n_layers=2, attention_window=4, dtype=jnp.float32
+    )
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 12), 0, config.vocab_size, jnp.int32
+    )
+    dense = forward(params, tokens, config)
+    from workloads.generate import decode_step, init_kv_cache
+
+    cache = init_kv_cache(config, 2, 12)
+    for pos in range(12):
+        logits, cache = decode_step(
+            params, cache, tokens[:, pos], jnp.int32(pos), config
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(dense[:, pos]), atol=2e-4,
+            err_msg=f"position {pos}",
+        )

@@ -82,3 +82,26 @@ def test_gqa_tree_quantizes():
     qparams = quantize_params(init_params(gqa, jax.random.PRNGKey(0)))
     layer = qparams["layers"][0]
     assert is_quantized(layer["wq"]) and is_quantized(layer["wkv"])
+
+
+def test_quantized_tree_checkpoints_roundtrip(tmp_path):
+    """The int8 serving tree (plain pytree of q8/scale leaves) rides the
+    orbax checkpointer unchanged — a quantized model can be shipped as a
+    checkpoint."""
+    from workloads.checkpoint import TrainCheckpointer
+
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    qparams = quantize_params(params)
+    ckpt = TrainCheckpointer(str(tmp_path / "q"))
+    ckpt.save(1, qparams)
+    ckpt.wait()
+    like = jax.tree.map(
+        lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype), qparams
+    )
+    restored = ckpt.restore_latest(like=like)
+    assert restored["layers"][0]["wqkv"]["q8"].dtype == jnp.int8
+    np.testing.assert_array_equal(
+        np.asarray(restored["layers"][0]["wqkv"]["q8"]),
+        np.asarray(qparams["layers"][0]["wqkv"]["q8"]),
+    )
+    ckpt.close()

@@ -45,24 +45,32 @@ def init_kv_cache(config: ModelConfig, batch: int, max_len: int):
 
 
 def decode_block(params: dict, cache: jax.Array, tokens: jax.Array,
-                 pos: jax.Array, config: ModelConfig):
+                 pos: jax.Array, config: ModelConfig, unembed: str = "all"):
     """A block of ``s`` consecutive tokens through the cached model in ONE
     forward — the prefill/verification primitive (speculative decoding
     scores a whole draft block this way; ``decode_step`` is its s=1 case).
 
     tokens: [batch, s] int32 occupying positions ``pos .. pos+s-1``;
     returns (logits [batch, s, vocab], updated cache) where logits[:, i]
-    predicts the token after position pos+i."""
+    predicts the token after position pos+i.
+
+    ``unembed`` controls the final full-vocab projection — the expensive
+    matmul of a long prefill: "all" (every row), "last" ([batch, 1,
+    vocab], what prompt prefill actually needs), or "none" (cache-fill
+    only, logits is None)."""
     batch, s = tokens.shape
     x = params["embed"].astype(config.dtype)[tokens]  # [b, s, d]
     max_len = cache.shape[3]
     k_pos = jnp.arange(max_len)
     angles = rope_angles(pos + jnp.arange(s), config.head_dim)
     # Row i may attend to cache positions <= pos+i (its own slot included:
-    # the block's k/v land in the cache before attention reads it).
-    mask = (
-        k_pos[None, :] <= (pos + jnp.arange(s))[:, None]
-    )[None, None]  # [1, 1, s, max_len]
+    # the block's k/v land in the cache before attention reads it),
+    # bounded below by the sliding window when the config sets one.
+    row_pos = (pos + jnp.arange(s))[:, None]
+    mask = k_pos[None, :] <= row_pos
+    if config.attention_window is not None:
+        mask &= k_pos[None, :] > row_pos - config.attention_window
+    mask = mask[None, None]  # [1, 1, s, max_len]
 
     for i, layer in enumerate(params["layers"]):
         h = _rmsnorm(x, layer["ln1"])
@@ -79,6 +87,12 @@ def decode_block(params: dict, cache: jax.Array, tokens: jax.Array,
         x = x + jnp.einsum("bshk,hkd->bsd", attn, weight(layer["wo"], x.dtype))
         x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
 
+    if unembed == "none":
+        return None, cache
+    if unembed == "last":
+        x = x[:, -1:]
+    elif unembed != "all":
+        raise ValueError(f"unembed must be 'all', 'last' or 'none', got {unembed!r}")
     logits = x.astype(jnp.float32) @ weight(params["unembed"], jnp.float32)
     return logits, cache
 

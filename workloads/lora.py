@@ -16,8 +16,6 @@ the JAX workload suite (SURVEY.md §7 step 8).
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 
@@ -116,33 +114,19 @@ def make_lora_train_step(
     config: ModelConfig, mesh, optimizer, base_params, alpha: float = 1.0
 ):
     """Jitted fine-tune step: (adapters, opt_state, tokens) ->
-    (adapters, opt_state, loss).  The frozen base rides as a runtime jit
-    ARGUMENT, not a closure — closed-over arrays become compile-time
-    constants, bloating compilation and duplicating the base weights in
-    the executable, exactly the memory LoRA exists to save.  Only the
-    adapter tree and its optimizer state are donated."""
-    import optax
-    from jax.sharding import NamedSharding
-    from jax.sharding import PartitionSpec as P
+    (adapters, opt_state, loss).  The frozen base rides through the shared
+    train-step helper's ``frozen`` channel — a runtime jit argument, never
+    donated, never a closure constant; only the adapter tree and its
+    optimizer state update."""
+    from .train import make_sharded_train_step
 
-    data_sharding = NamedSharding(mesh, P("data", None))
+    def adapter_loss(adapters, base, tokens):
+        merged = merge_lora(base, adapters, alpha, dtype=config.dtype)
+        return loss_fn(merged, tokens, config)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def lora_step(adapters, opt_state, base, tokens):
-        def adapter_loss(adapters):
-            merged = merge_lora(base, adapters, alpha, dtype=config.dtype)
-            return loss_fn(merged, tokens, config)
-
-        loss, grads = jax.value_and_grad(adapter_loss)(adapters)
-        updates, opt_state = optimizer.update(grads, opt_state, adapters)
-        adapters = optax.apply_updates(adapters, updates)
-        return adapters, opt_state, loss
-
-    def step(adapters, opt_state, tokens):
-        tokens = jax.device_put(tokens, data_sharding)
-        return lora_step(adapters, opt_state, base_params, tokens)
-
-    return step
+    return make_sharded_train_step(
+        adapter_loss, mesh, optimizer, frozen=base_params
+    )
 
 
 def main(argv=None) -> int:

@@ -46,6 +46,12 @@ class ModelConfig:
     # the serving-era memory trade, supported end-to-end (flash kernel,
     # dense core, cached decode).
     n_kv_heads: int | None = None
+    # Sliding-window attention: None = full causal span.  A positive
+    # window bounds each token's attention to the last ``window``
+    # positions — the long-context serving pattern, honoured by the flash
+    # kernel (with block-level compute skip), the dense core, and the
+    # cached decode.
+    attention_window: int | None = None
 
     def __post_init__(self):
         if self.attention_impl not in ("native", "flash"):
@@ -58,6 +64,10 @@ class ModelConfig:
             raise ValueError(
                 f"n_kv_heads ({self.n_kv_heads}) must be a positive divisor "
                 f"of n_heads ({self.n_heads})"
+            )
+        if self.attention_window is not None and self.attention_window < 1:
+            raise ValueError(
+                f"attention_window must be >= 1, got {self.attention_window}"
             )
 
     @property
@@ -249,13 +259,16 @@ def _attention(
     ):
         from workloads.ops import flash_attention
 
-        out = flash_attention(q, k, v)
+        out = flash_attention(q, k, v, window=config.attention_window)
     else:
         # Short sequences (static shapes — this routing is trace-time):
         # the dense core is faster than the kernel here and the score
         # matrix is bounded by the cap above.
-        mask = jnp.tril(jnp.ones((seq, seq), bool))[None, None]
-        out = masked_attention(q, k, v, mask, config.head_dim)
+        mask = jnp.tril(jnp.ones((seq, seq), bool))
+        if config.attention_window is not None:
+            ids = jnp.arange(seq)
+            mask &= ids[None, :] > ids[:, None] - config.attention_window
+        out = masked_attention(q, k, v, mask[None, None], config.head_dim)
     return jnp.einsum("bshk,hkd->bsd", out, weight(layer["wo"], x.dtype))
 
 

@@ -53,7 +53,7 @@ _SEQ_INNER_SEMANTICS = pltpu.CompilerParams(
 
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-    *, sm_scale, causal, block_q, block_k, seq_valid, n_k_blocks,
+    *, sm_scale, causal, block_q, block_k, seq_valid, n_k_blocks, window,
 ):
     """One (batch*head, q-block, k-block) grid cell.  The k dimension is the
     innermost (sequential) grid axis; (m, l, acc) persist in VMEM scratch
@@ -85,6 +85,9 @@ def _flash_kernel(
                 jnp.int32, (block_q, block_k), 0
             )
             mask &= k_ids <= q_ids
+            if window is not None:
+                # Sliding window: row i sees only [i-window+1, i].
+                mask &= k_ids > q_ids - window
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]                                   # [bq, LANES]
@@ -101,10 +104,14 @@ def _flash_kernel(
         )
 
     if causal:
-        # A k block whose first row sits past this q block's last row is
-        # fully masked — skip its compute (the DMA still happens; the win
-        # is not doing the matmuls).
-        pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_body)
+        # A k block fully past this q block's last row — or, with a
+        # sliding window, fully before its first row's window start — is
+        # all masked: skip its compute (the DMA still happens; the win is
+        # not doing the matmuls).
+        live = ki * block_k <= (qi + 1) * block_q - 1
+        if window is not None:
+            live &= ki * block_k + block_k - 1 > qi * block_q - window
+        pl.when(live)(_body)
     else:
         _body()
 
@@ -151,7 +158,7 @@ def _check_gqa(heads: int, kv_heads: int) -> None:
         )
 
 
-def _flash_forward(q, k, v, causal, interpret, block_q, block_k):
+def _flash_forward(q, k, v, causal, interpret, block_q, block_k, window=None):
     """q: [batch, seq, heads, head_dim]; k/v: [batch, seq, kv_heads,
     head_dim] with kv_heads dividing heads (grouped-query attention; equal
     is plain MHA) -> (out, lse[batch*heads, seq_pad])."""
@@ -185,6 +192,7 @@ def _flash_forward(q, k, v, causal, interpret, block_q, block_k):
         block_k=block_k,
         seq_valid=seq,
         n_k_blocks=n_k_blocks,
+        window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -221,7 +229,7 @@ def _flash_forward(q, k, v, causal, interpret, block_q, block_k):
 
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
-    *, sm_scale, causal, block_q, block_k, seq_valid, n_k_blocks,
+    *, sm_scale, causal, block_q, block_k, seq_valid, n_k_blocks, window,
 ):
     """One (batch*head, q-block, k-block) grid cell of the backward pass:
     accumulate dq in VMEM scratch over the sequential k axis.  p is
@@ -251,6 +259,8 @@ def _flash_bwd_dq_kernel(
         mask = (k_ids < seq_valid) & (q_ids < seq_valid)
         if causal:
             mask &= k_ids <= q_ids
+            if window is not None:
+                mask &= k_ids > q_ids - window
         # Explicit zeroing (not just s=-inf): padded q rows carry lse=-inf,
         # where exp(s - lse) would otherwise produce 1, not 0.
         p = jnp.exp(jnp.where(mask, s, NEG_INF) - lse[:, None]) * mask
@@ -261,7 +271,10 @@ def _flash_bwd_dq_kernel(
         )
 
     if causal:
-        pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_body)
+        live = ki * block_k <= (qi + 1) * block_q - 1
+        if window is not None:
+            live &= ki * block_k + block_k - 1 > qi * block_q - window
+        pl.when(live)(_body)
     else:
         _body()
 
@@ -274,6 +287,7 @@ def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc_ref, dv_acc_ref,
     *, sm_scale, causal, block_q, block_k, seq_valid, n_q_blocks, group,
+    window,
 ):
     """One (batch*kv_head, k-block, group*q-block) grid cell: accumulate
     dk/dv in VMEM scratch over the sequential innermost axis, which walks
@@ -306,6 +320,8 @@ def _flash_bwd_dkv_kernel(
         mask = (k_ids < seq_valid) & (q_ids < seq_valid)
         if causal:
             mask &= k_ids <= q_ids
+            if window is not None:
+                mask &= k_ids > q_ids - window
         p = jnp.exp(jnp.where(mask, s, NEG_INF) - lse[:, None]) * mask
         dv_acc_ref[:] = dv_acc_ref[:] + jnp.dot(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
@@ -318,8 +334,13 @@ def _flash_bwd_dkv_kernel(
 
     if causal:
         # q blocks whose last row precedes this k block's first row are
-        # fully above the diagonal and contribute nothing.
-        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(_body)
+        # fully above the diagonal and contribute nothing; with a sliding
+        # window, q blocks whose first row starts past the window of this
+        # k block's last id contribute nothing either.
+        live = (qi + 1) * block_q - 1 >= ki * block_k
+        if window is not None:
+            live &= qi * block_q <= ki * block_k + block_k - 1 + window - 1
+        pl.when(live)(_body)
     else:
         _body()
 
@@ -329,7 +350,8 @@ def _flash_bwd_dkv_kernel(
         dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
-def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q, block_k):
+def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q,
+                           block_k, window=None):
     """dq/dk/dv via the two backward kernels; same layout contract as
     _flash_forward (k/v may carry fewer heads — grouped-query)."""
     batch, seq, heads, head_dim = q.shape
@@ -366,7 +388,7 @@ def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q, 
     n_k_blocks = seq_k_pad // block_k
     kwargs = dict(
         sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_valid=seq,
+        block_q=block_q, block_k=block_k, seq_valid=seq, window=window,
     )
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, n_k_blocks=n_k_blocks, **kwargs),
@@ -452,7 +474,7 @@ def _default_interpret() -> bool:
     return not devices or devices[0].platform != "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(
     q,
     k,
@@ -462,6 +484,7 @@ def flash_attention(
     block_q: int = 256,
     block_k: int = 512,
     bwd_impl: str = "pallas",
+    window: int | None = None,
 ):
     """Scaled-dot-product attention, [batch, seq, heads, head_dim] layout.
 
@@ -478,11 +501,23 @@ def flash_attention(
     or "xla" (dense recompute in fused XLA einsums; fine at short seq).
     """
     _check_bwd_impl(bwd_impl)
+    _check_window(window, causal)
     out, _ = _flash_forward(
         q, k, v, causal, _default_interpret() if interpret is None else interpret,
-        block_q, block_k,
+        block_q, block_k, window,
     )
     return out
+
+
+def _check_window(window, causal: bool) -> None:
+    """Sliding windows are a causal construct here (the serving pattern);
+    validated eagerly so a bad config fails at the call site."""
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("window requires causal=True")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
 
 
 def _check_bwd_impl(bwd_impl: str) -> None:
@@ -492,16 +527,17 @@ def _check_bwd_impl(bwd_impl: str) -> None:
         raise ValueError(f"bwd_impl must be 'pallas' or 'xla', got {bwd_impl!r}")
 
 
-def _fwd(q, k, v, causal, interpret, block_q, block_k, bwd_impl):
+def _fwd(q, k, v, causal, interpret, block_q, block_k, bwd_impl, window):
     _check_bwd_impl(bwd_impl)
+    _check_window(window, causal)
     out, lse = _flash_forward(
         q, k, v, causal, _default_interpret() if interpret is None else interpret,
-        block_q, block_k,
+        block_q, block_k, window,
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_backward_xla(q, k, v, out, dout, lse, causal):
+def _flash_backward_xla(q, k, v, out, dout, lse, causal, window=None):
     """Dense recompute backward in plain XLA: materialises [seq, seq] p, so
     only suitable when that fits comfortably — kept as the reference
     implementation the Pallas kernels are pinned against.  Grouped-query
@@ -521,6 +557,9 @@ def _flash_backward_xla(q, k, v, out, dout, lse, causal):
     s = jnp.einsum("bshk,bthk->bhst", qf, kf) * sm_scale
     if causal:
         mask = jnp.tril(jnp.ones((seq, seq), bool))
+        if window is not None:
+            ids = jnp.arange(seq)
+            mask &= ids[None, :] > ids[:, None] - window
         s = jnp.where(mask[None, None], s, NEG_INF)
     lse_b = lse.reshape(batch, heads, seq)
     p = jnp.exp(s - lse_b[..., None])
@@ -537,17 +576,17 @@ def _flash_backward_xla(q, k, v, out, dout, lse, causal):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _bwd(causal, interpret, block_q, block_k, bwd_impl, residuals, dout):
+def _bwd(causal, interpret, block_q, block_k, bwd_impl, window, residuals, dout):
     """Flash backward: recompute p from (q, k, lse) instead of storing the
     [seq, seq] probability matrix — as blocked Pallas kernels by default,
     dense XLA einsums with bwd_impl="xla"."""
     q, k, v, out, lse = residuals
     if bwd_impl == "xla":
-        return _flash_backward_xla(q, k, v, out, dout, lse, causal)
+        return _flash_backward_xla(q, k, v, out, dout, lse, causal, window)
     return _flash_backward_pallas(
         q, k, v, out, dout, lse, causal,
         _default_interpret() if interpret is None else interpret,
-        block_q, block_k,
+        block_q, block_k, window,
     )
 
 

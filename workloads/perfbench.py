@@ -168,8 +168,6 @@ def train_step_flops(config: ModelConfig, batch: int) -> float:
 
 def measure_train(scale: BenchScale) -> dict:
     """Steady-state full-train-step time and MFU at the bench scale."""
-    import optax
-
     from .train import make_mesh, make_train_state, synthetic_batch
 
     config = _model_config(scale)

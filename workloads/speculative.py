@@ -58,13 +58,16 @@ def _speculative_impl(
     t_cache = init_kv_cache(target_config, batch, max_len)
     d_cache = init_kv_cache(draft_config, batch, max_len)
 
-    # Prefill both caches on the prompt; the target's last-row logits give
-    # the first committed token.
+    # Prefill both caches on the prompt; only the target's last row needs
+    # the full-vocab unembed (the draft's prefill is cache-fill only) —
+    # prompt_len * vocab logits nobody reads are skipped.
     t_logits, t_cache = decode_block(
-        target_params, t_cache, prompt, jnp.int32(0), target_config
+        target_params, t_cache, prompt, jnp.int32(0), target_config,
+        unembed="last",
     )
     _, d_cache = decode_block(
-        draft_params, d_cache, prompt, jnp.int32(0), draft_config
+        draft_params, d_cache, prompt, jnp.int32(0), draft_config,
+        unembed="none",
     )
     first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
 
@@ -119,10 +122,11 @@ def _speculative_impl(
         )
         committed = committed.at[0, n].set(picks[0, n])
 
-        # Write the n+1 committed tokens; clamp the buffer index so the
-        # overshoot beyond max_new lands in the scratch tail.
+        # Write the n+1 committed tokens.  No bounds clamp is needed: the
+        # buffer carries a gamma+1 scratch tail precisely so the largest
+        # possible write (n_out = max_new-1, j = gamma) lands inside it.
         def write(j, out):
-            idx = jnp.minimum(n_out + j, out.shape[1] - 1)
+            idx = n_out + j
             val = jnp.where(j <= n, committed[0, j], out[0, idx])
             return out.at[0, idx].set(val)
 

@@ -86,20 +86,30 @@ def make_sharded_train_state(mesh: Mesh, init_fn, specs, optimizer=None, abstrac
     return init_jit(), optimizer
 
 
-def make_sharded_train_step(loss_fn, mesh: Mesh, optimizer, batch_specs=None):
+def make_sharded_train_step(
+    loss_fn, mesh: Mesh, optimizer, batch_specs=None, frozen=None
+):
     """Generic full train step for a ``loss_fn(params, *batch)``: forward,
     backward, optimizer update, jitted with donated state.
 
     ``batch_specs`` gives one PartitionSpec per batch argument; the default
     is a single batch-on-"data" tokens array (the LM callers).  The vision
-    workload passes (images, labels) specs through the same helper."""
+    workload passes (images, labels) specs through the same helper.
+
+    ``frozen`` is an optional pytree of non-trained arrays (e.g. LoRA's
+    base weights) delivered to ``loss_fn(params, frozen, *batch)`` as a
+    runtime jit ARGUMENT — never donated, never closed over (closure
+    constants bloat compilation and duplicate the arrays in the
+    executable)."""
     if batch_specs is None:
         batch_specs = (P("data", None),)
     batch_shardings = tuple(NamedSharding(mesh, s) for s in batch_specs)
+    has_frozen = frozen is not None
 
     @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, *batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+    def train_step(params, opt_state, frozen_args, *batch):
+        args = (frozen_args, *batch) if has_frozen else batch
+        loss, grads = jax.value_and_grad(loss_fn)(params, *args)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -113,7 +123,7 @@ def make_sharded_train_step(loss_fn, mesh: Mesh, optimizer, batch_specs=None):
         placed = tuple(
             jax.device_put(b, s) for b, s in zip(batch, batch_shardings)
         )
-        return train_step(params, opt_state, *placed)
+        return train_step(params, opt_state, frozen, *placed)
 
     return step
 
