@@ -1,0 +1,158 @@
+"""Paged KV cache (workloads/paged.py): exact parity with the contiguous
+cache, page accounting, prefix sharing, exhaustion."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.generate import decode_step, init_kv_cache
+from workloads.model import ModelConfig, init_params
+from workloads.paged import (
+    PagePool,
+    init_page_pool_array,
+    paged_decode_step,
+    table_array,
+)
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CONFIG, jax.random.PRNGKey(0))
+
+
+def test_paged_decode_matches_contiguous(params):
+    """Token-by-token logits through the paged pool equal the contiguous
+    cache exactly."""
+    batch, steps, page_size = 2, 12, 4
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, steps), 0, CONFIG.vocab_size, jnp.int32
+    )
+    ctrl = PagePool(n_pages=16, page_size=page_size)
+    for b in range(batch):
+        ctrl.allocate(b, 1)
+    pool = init_page_pool_array(CONFIG, 16, page_size)
+    contiguous = init_kv_cache(CONFIG, batch, steps)
+
+    max_pages = ctrl.pages_needed(steps)
+    for pos in range(steps):
+        for b in range(batch):
+            ctrl.extend(b, pos + 1)
+        tables = table_array([ctrl.tables[b] for b in range(batch)], max_pages)
+        want, contiguous = decode_step(
+            params, contiguous, tokens[:, pos], jnp.int32(pos), CONFIG
+        )
+        got, pool = paged_decode_step(
+            params, pool, tables, tokens[:, pos], jnp.int32(pos), CONFIG
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-4,
+            err_msg=f"position {pos}",
+        )
+
+
+def test_on_demand_allocation_uses_fewer_pages():
+    ctrl = PagePool(n_pages=100, page_size=4)
+    ctrl.allocate("a", 6)  # 2 pages, not max_len/4
+    assert ctrl.used_pages == 2
+    ctrl.extend("a", 9)
+    assert ctrl.used_pages == 3
+    ctrl.release("a")
+    assert ctrl.used_pages == 0
+
+
+def test_prefix_fork_shares_full_pages():
+    ctrl = PagePool(n_pages=100, page_size=4)
+    parent = ctrl.allocate("parent", 10)  # 3 pages, last partially full
+    child = ctrl.fork("parent", "child", shared_tokens=8)  # page boundary
+    assert child == parent[:2]
+    assert ctrl.used_pages == 3  # no new physical pages for the child
+    ctrl.extend("child", 12)  # child grows its own tail
+    assert ctrl.used_pages == 4
+    # Shared pages survive the parent's release, die with the child's.
+    ctrl.release("parent")
+    assert ctrl.used_pages == 3
+    ctrl.release("child")
+    assert ctrl.used_pages == 0
+
+
+def test_fork_off_page_boundary_fails_loud():
+    # A partial tail page cannot be shared: silently dropping it would
+    # leave mask-admitted positions with zero k/v in the child.
+    ctrl = PagePool(n_pages=100, page_size=4)
+    ctrl.allocate("parent", 10)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="page boundary"):
+        ctrl.fork("parent", "child", shared_tokens=10)
+
+
+def test_forked_sequences_decode_like_independent_ones(params):
+    """Two sequences sharing prompt pages produce the same logits as two
+    fully independent caches fed the same history."""
+    page_size = 4
+    prompt_len, steps = 8, 4
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(2), (1, prompt_len), 0, CONFIG.vocab_size, jnp.int32
+    )
+    # Reference: contiguous, batch 2, identical histories diverging after
+    # the prompt.
+    div = jax.random.randint(
+        jax.random.PRNGKey(3), (2, steps), 0, CONFIG.vocab_size, jnp.int32
+    )
+    history = jnp.concatenate([jnp.tile(prompt, (2, 1)), div], axis=1)
+    contiguous = init_kv_cache(CONFIG, 2, prompt_len + steps)
+    want = []
+    for pos in range(prompt_len + steps):
+        logits, contiguous = decode_step(
+            params, contiguous, history[:, pos], jnp.int32(pos), CONFIG
+        )
+        want.append(logits)
+
+    # Paged: one parent consumes the prompt, the child forks and both
+    # consume their divergent tails in lockstep (batch axis = [parent,
+    # child]).
+    ctrl = PagePool(n_pages=32, page_size=page_size)
+    pool = init_page_pool_array(CONFIG, 32, page_size)
+    ctrl.allocate(0, 1)
+    for pos in range(prompt_len):
+        ctrl.extend(0, pos + 1)
+        tables = table_array([ctrl.tables[0]], ctrl.pages_needed(prompt_len))
+        _, pool = paged_decode_step(
+            params, pool, tables, prompt[:, pos], jnp.int32(pos), CONFIG
+        )
+    ctrl.fork(0, 1, shared_tokens=prompt_len)
+    # The fork shares only FULL pages; the parent's partial tail page (if
+    # any) must be re-filled for the child.  prompt_len == 2*page_size
+    # here, so every prompt page is full and shared.
+    assert ctrl.used_pages == ctrl.pages_needed(prompt_len)
+
+    total = prompt_len + steps
+    max_pages = ctrl.pages_needed(total)
+    got = []
+    for pos in range(prompt_len, total):
+        for b in (0, 1):
+            ctrl.extend(b, pos + 1)
+        tables = table_array(
+            [ctrl.tables[0], ctrl.tables[1]], max_pages
+        )
+        logits, pool = paged_decode_step(
+            params, pool, tables, div[:, pos - prompt_len], jnp.int32(pos),
+            CONFIG,
+        )
+        got.append(logits)
+
+    for i, (g, w) in enumerate(zip(got, want[prompt_len:])):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), atol=2e-4,
+            err_msg=f"divergent step {i}",
+        )
+
+
+def test_pool_exhaustion_fails_loud():
+    ctrl = PagePool(n_pages=2, page_size=4)
+    ctrl.allocate("a", 8)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        ctrl.allocate("b", 4)

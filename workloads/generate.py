@@ -58,6 +58,12 @@ def decode_block(params: dict, cache: jax.Array, tokens: jax.Array,
     matmul of a long prefill: "all" (every row), "last" ([batch, 1,
     vocab], what prompt prefill actually needs), or "none" (cache-fill
     only, logits is None)."""
+    if unembed not in ("all", "last", "none"):
+        # Eager, pre-trace validation (repo convention: a typo fails at
+        # the call site, not after tracing the whole layer stack).
+        raise ValueError(
+            f"unembed must be 'all', 'last' or 'none', got {unembed!r}"
+        )
     batch, s = tokens.shape
     x = params["embed"].astype(config.dtype)[tokens]  # [b, s, d]
     max_len = cache.shape[3]
@@ -91,8 +97,6 @@ def decode_block(params: dict, cache: jax.Array, tokens: jax.Array,
         return None, cache
     if unembed == "last":
         x = x[:, -1:]
-    elif unembed != "all":
-        raise ValueError(f"unembed must be 'all', 'last' or 'none', got {unembed!r}")
     logits = x.astype(jnp.float32) @ weight(params["unembed"], jnp.float32)
     return logits, cache
 

@@ -251,7 +251,15 @@ def _attention(
     q, k = _rope(q), _rope(k)
     if attention_fn is not None:
         # Injected core (e.g. sequence-parallel ring attention bound to a
-        # mesh — workloads/train.py make_seq_parallel_train_step).
+        # mesh — workloads/train.py make_seq_parallel_train_step).  The
+        # injected cores compute full causal spans; silently training
+        # full-span while serving windowed would be a train/serve
+        # mismatch, so a windowed config fails loudly here.
+        if config.attention_window is not None:
+            raise ValueError(
+                "attention_window is not supported with an injected "
+                "attention_fn (ring/ulysses/usp compute full causal spans)"
+            )
         out = attention_fn(q, k, v)
     elif config.attention_impl == "flash" and (
         seq >= _FLASH_MIN_SEQ

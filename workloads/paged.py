@@ -1,0 +1,182 @@
+"""Paged KV cache: block-table memory management for serving.
+
+A contiguous KV cache reserves ``batch * max_len`` slots up front; serving
+many sequences of different lengths wastes most of them.  Here the cache
+is a POOL of fixed-size pages plus a per-sequence page table — the
+vLLM-style layout, expressed the JAX way: the pool and tables are plain
+arrays with static shapes, the device-side decode gathers each sequence's
+pages by table lookup, and page allocation/free is host-side Python
+between steps (it is control plane, not compute).
+
+Two serving wins fall out of the layout:
+  * allocation on demand — a sequence holds pages for the tokens it has
+    actually produced, not for ``max_len``;
+  * shared prefixes — sequences with a common prompt REFERENCE the same
+    physical pages (read-only; a diverging sequence writes into fresh
+    pages from its fork point), so an N-way fan-out of one prompt stores
+    the prompt's k/v once.
+
+The decode path reuses the model's cached-attention core: gathered pages
+form the [batch, padded_len, kv_heads, head_dim] view masked by true
+sequence length, so logits are bit-comparable with the contiguous cache
+(pinned by tests).
+
+Reference pendant: none — the reference daemon has no model code; part of
+the JAX serving workloads (SURVEY.md §7 step 8).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .generate import decode_block
+from .model import ModelConfig
+
+
+@dataclass
+class PagePool:
+    """Host-side control plane: which physical pages are free, and each
+    sequence's page table.  Device state lives in ``pages`` (the pool
+    array) owned by the caller; this class only hands out indices."""
+
+    n_pages: int
+    page_size: int
+    free: list = field(init=False)
+    tables: dict = field(init=False, default_factory=dict)  # seq_id -> [int]
+    refcounts: dict = field(init=False, default_factory=dict)  # page -> int
+
+    def __post_init__(self):
+        self.free = list(range(self.n_pages - 1, -1, -1))
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def allocate(self, seq_id, n_tokens: int) -> list:
+        """A fresh table covering ``n_tokens`` positions."""
+        need = self.pages_needed(n_tokens)
+        if len(self.free) < need:
+            raise RuntimeError(
+                f"page pool exhausted: need {need}, free {len(self.free)}"
+            )
+        table = [self.free.pop() for _ in range(need)]
+        for p in table:
+            self.refcounts[p] = 1
+        self.tables[seq_id] = table
+        return table
+
+    def extend(self, seq_id, n_tokens: int) -> list:
+        """Grow ``seq_id``'s table to cover ``n_tokens`` positions."""
+        table = self.tables[seq_id]
+        while len(table) < self.pages_needed(n_tokens):
+            if not self.free:
+                raise RuntimeError("page pool exhausted")
+            page = self.free.pop()
+            self.refcounts[page] = 1
+            table.append(page)
+        return table
+
+    def fork(self, parent_id, child_id, shared_tokens: int) -> list:
+        """A child sequence sharing the parent's pages for the prefix of
+        ``shared_tokens`` positions (read-only sharing).
+
+        ``shared_tokens`` must land exactly on a page boundary: a partial
+        tail page cannot be shared (the child would write into it) and
+        silently dropping it would leave admitted-by-mask positions with
+        zero k/v — so anything else fails loudly."""
+        if shared_tokens % self.page_size:
+            raise ValueError(
+                f"fork point {shared_tokens} is not a multiple of "
+                f"page_size {self.page_size}: a partial tail page cannot "
+                "be shared — fork at a page boundary (and replay the "
+                "remainder into the child)"
+            )
+        parent = self.tables[parent_id]
+        full_pages = shared_tokens // self.page_size
+        shared = parent[:full_pages]
+        for p in shared:
+            self.refcounts[p] += 1
+        self.tables[child_id] = list(shared)
+        return self.tables[child_id]
+
+    def release(self, seq_id) -> None:
+        for p in self.tables.pop(seq_id):
+            self.refcounts[p] -= 1
+            if self.refcounts[p] == 0:
+                del self.refcounts[p]
+                self.free.append(p)
+
+    @property
+    def used_pages(self) -> int:
+        return self.n_pages - len(self.free)
+
+
+def init_page_pool_array(
+    config: ModelConfig, n_pages: int, page_size: int
+) -> jax.Array:
+    """The device-side pool: [layers, 2, n_pages, page_size, kv_heads,
+    head_dim]."""
+    return jnp.zeros(
+        (
+            config.n_layers, 2, n_pages, page_size,
+            config.kv_heads, config.head_dim,
+        ),
+        config.dtype,
+    )
+
+
+def table_array(tables: list[list[int]], max_pages: int) -> jax.Array:
+    """Stack host tables into a padded [batch, max_pages] int32 array
+    (padding pages are never admitted by the length mask)."""
+    out = []
+    for t in tables:
+        if len(t) > max_pages:
+            raise ValueError(f"table length {len(t)} exceeds {max_pages}")
+        out.append(t + [0] * (max_pages - len(t)))
+    return jnp.asarray(out, jnp.int32)
+
+
+def _gathered_view(pool: jax.Array, tables: jax.Array):
+    """[layers, 2, batch, max_pages*page_size, kv_heads, head_dim] view of
+    each sequence's pages, via one gather per call."""
+    gathered = pool[:, :, tables]  # [L, 2, b, max_pages, ps, Hkv, hd]
+    length, two, batch, n_pg, ps, kvh, hd = gathered.shape
+    return gathered.reshape(length, two, batch, n_pg * ps, kvh, hd)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(1,))
+def paged_decode_step(
+    params: dict,
+    pool: jax.Array,
+    tables: jax.Array,
+    token: jax.Array,
+    pos: jax.Array,
+    config: ModelConfig,
+):
+    """One token through the paged cache.
+
+    pool: the page array; tables: [batch, max_pages] int32; token:
+    [batch] int32 at position ``pos`` (all sequences step in lockstep —
+    per-row positions are a continuous-batching concern out of scope).
+    Returns (logits [batch, vocab], updated pool); the pool argument is
+    DONATED (the update aliases in place — without donation XLA copies the
+    whole pool every token), so callers must rebind it.
+
+    The step runs attention over the gathered page view through the same
+    decode core as the contiguous cache, then scatters the new k/v back
+    into each sequence's current page."""
+    view = _gathered_view(pool, tables)
+    logits, view = decode_block(params, view, token[:, None], pos, config)
+
+    # Scatter the slot written at ``pos`` in the view back to the pool:
+    # page = tables[b, pos // page_size], slot = pos % page_size.
+    page_size = pool.shape[3]
+    page_idx = tables[:, pos // page_size]  # [batch]
+    slot = pos % page_size
+    written = jax.lax.dynamic_slice_in_dim(view, pos, 1, axis=3)
+    # written: [L, 2, b, 1, Hkv, hd] -> scatter per batch row.
+    pool = pool.at[:, :, page_idx, slot].set(written[:, :, :, 0])
+    return logits[:, 0], pool
