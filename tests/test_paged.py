@@ -83,9 +83,7 @@ def test_fork_off_page_boundary_fails_loud():
     # leave mask-admitted positions with zero k/v in the child.
     ctrl = PagePool(n_pages=100, page_size=4)
     ctrl.allocate("parent", 10)
-    import pytest as _pytest
-
-    with _pytest.raises(ValueError, match="page boundary"):
+    with pytest.raises(ValueError, match="page boundary"):
         ctrl.fork("parent", "child", shared_tokens=10)
 
 
@@ -156,3 +154,12 @@ def test_pool_exhaustion_fails_loud():
     ctrl.allocate("a", 8)
     with pytest.raises(RuntimeError, match="exhausted"):
         ctrl.allocate("b", 4)
+
+
+def test_double_allocate_fails_loud():
+    ctrl = PagePool(n_pages=8, page_size=4)
+    ctrl.allocate("a", 4)
+    with pytest.raises(ValueError, match="already holds"):
+        ctrl.allocate("a", 4)
+    ctrl.release("a")
+    ctrl.allocate("a", 4)  # fine after release

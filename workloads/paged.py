@@ -57,6 +57,11 @@ class PagePool:
 
     def allocate(self, seq_id, n_tokens: int) -> list:
         """A fresh table covering ``n_tokens`` positions."""
+        if seq_id in self.tables:
+            raise ValueError(
+                f"sequence {seq_id!r} already holds a table — release it "
+                "first (silently replacing it would leak its pages)"
+            )
         need = self.pages_needed(n_tokens)
         if len(self.free) < need:
             raise RuntimeError(
@@ -87,6 +92,11 @@ class PagePool:
         tail page cannot be shared (the child would write into it) and
         silently dropping it would leave admitted-by-mask positions with
         zero k/v — so anything else fails loudly."""
+        if child_id in self.tables:
+            raise ValueError(
+                f"sequence {child_id!r} already holds a table — release it "
+                "first (silently replacing it would leak its pages)"
+            )
         if shared_tokens % self.page_size:
             raise ValueError(
                 f"fork point {shared_tokens} is not a multiple of "
@@ -145,6 +155,46 @@ def _gathered_view(pool: jax.Array, tables: jax.Array):
     gathered = pool[:, :, tables]  # [L, 2, b, max_pages, ps, Hkv, hd]
     length, two, batch, n_pg, ps, kvh, hd = gathered.shape
     return gathered.reshape(length, two, batch, n_pg * ps, kvh, hd)
+
+
+@partial(
+    jax.jit, static_argnames=("config", "prompt_len"), donate_argnums=(1,)
+)
+def paged_prefill(
+    params: dict,
+    pool: jax.Array,
+    tables: jax.Array,
+    prompts: jax.Array,
+    config: ModelConfig,
+    prompt_len: int,
+):
+    """Prefill a batch of prompts into the paged pool in one block forward.
+
+    prompts: [batch, prompt_len] at positions 0..prompt_len-1 (tables must
+    already cover them).  Returns (last_logits [batch, vocab], pool); the
+    pool is DONATED.  Only the last row is unembedded — prefill needs one
+    next-token prediction, not prompt_len * vocab logits."""
+    view = _gathered_view(pool, tables)
+    logits, view = decode_block(
+        params, view, prompts, jnp.int32(0), config, unembed="last"
+    )
+    # ONE scatter writes the prompt-covering pages back.  Only the first
+    # ceil(prompt_len/page_size) table columns participate: those are real
+    # pages by construction, while PADDING columns alias page 0 — writing
+    # them would race the stale gathered copy against fresh k/v (scatter
+    # order is unspecified).  Duplicates among the real columns only arise
+    # from shared-prefix tables, whose bytes are identical, so they are
+    # safe.
+    length, two, batch2, flat, kvh, hd = view.shape
+    page_size = pool.shape[3]
+    prefill_pages = -(-prompt_len // page_size)
+    paged_view = view.reshape(
+        length, two, batch2, flat // page_size, page_size, kvh, hd
+    )
+    pool = pool.at[:, :, tables[:, :prefill_pages]].set(
+        paged_view[:, :, :, :prefill_pages]
+    )
+    return logits[:, 0], pool
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnums=(1,))

@@ -1,0 +1,184 @@
+"""A minimal serving loop tying the serving stack together.
+
+One process, one chip, many requests: prompts arrive, prefill runs as one
+cached block forward, decode steps run the whole active batch in lockstep
+through the paged KV cache, finished sequences release their pages, and
+sampling is per-request (traced knobs — no recompiles between requests).
+The flagship serving features compose here end-to-end: grouped-query
+attention (smaller pages), int8 weight-only bases (halved weight stream),
+paged memory with on-demand allocation, and temperature/top-k/top-p.
+
+This is the example-pod entry for a shared-TPU inference service; the
+scheduler-facing story (admission, leases) is unchanged from
+``pod-inference.yml`` — this module is about what happens *inside* the
+pod.
+
+Deliberately lockstep (all active sequences share one position counter,
+padded prompts): per-row positions are continuous batching, whose
+scheduling complexity belongs in a dedicated server, not an example.
+
+Reference pendant: none — the reference daemon has no model code; part of
+the JAX serving workloads (SURVEY.md §7 step 8).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .generate import sample_logits
+from .model import ModelConfig, init_params
+from .paged import (
+    PagePool,
+    paged_decode_step,
+    paged_prefill,
+    table_array,
+)
+
+
+def serve_batch(
+    params: dict,
+    config: ModelConfig,
+    prompts: jax.Array,
+    max_new_tokens: int,
+    ctrl: PagePool,
+    pool: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    rng: jax.Array | None = None,
+):
+    """One admission batch through the paged cache: prefill as a single
+    block forward, then lockstep decode steps; pages are allocated on
+    demand and released when the batch retires.  Returns
+    (tokens [batch, max_new], pool) — the pool is donated through and
+    must be rebound by the caller."""
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 requires an rng key")
+    batch, prompt_len = prompts.shape
+    total = prompt_len + max_new_tokens
+    max_pages = ctrl.pages_needed(total)
+    for b in range(batch):
+        ctrl.allocate(("serve", b), prompt_len)
+    try:
+        tables = table_array(
+            [ctrl.tables[("serve", b)] for b in range(batch)], max_pages
+        )
+        logits, pool = paged_prefill(
+            params, pool, tables, prompts, config, prompt_len
+        )
+        keys = (
+            jax.random.split(rng, max_new_tokens)
+            if rng is not None and temperature > 0.0
+            else [None] * max_new_tokens
+        )
+        tok = sample_logits(logits, keys[0], temperature, top_k, top_p)
+        out = [tok]
+        for step in range(1, max_new_tokens):
+            pos = prompt_len + step - 1
+            for b in range(batch):
+                ctrl.extend(("serve", b), pos + 1)
+            tables = table_array(
+                [ctrl.tables[("serve", b)] for b in range(batch)], max_pages
+            )
+            logits, pool = paged_decode_step(
+                params, pool, tables, tok, jnp.int32(pos), config
+            )
+            tok = sample_logits(logits, keys[step], temperature, top_k, top_p)
+            out.append(tok)
+    finally:
+        for b in range(batch):
+            if ("serve", b) in ctrl.tables:
+                ctrl.release(("serve", b))
+    return jnp.stack(out, axis=1), pool
+
+
+def main(argv=None) -> int:
+    """``python -m workloads.serve --requests 12 --batch 4`` — run a
+    stream of synthetic requests through the serving stack and report
+    tokens/s."""
+    import argparse
+    import time
+
+    parser = argparse.ArgumentParser(description="serving loop example")
+    parser.add_argument("--requests", type=int, default=12)
+    parser.add_argument("--batch", type=int, default=4)
+    parser.add_argument("--prompt-len", type=int, default=16)
+    parser.add_argument("--max-new-tokens", type=int, default=64)
+    parser.add_argument("--temperature", type=float, default=0.8)
+    parser.add_argument("--top-k", type=int, default=50)
+    parser.add_argument("--top-p", type=float, default=0.95)
+    parser.add_argument("--int8", action="store_true",
+                        help="serve int8 weight-only quantized weights")
+    parser.add_argument("--kv-heads", type=int, default=None,
+                        help="grouped-query kv heads (default: n_heads)")
+    args = parser.parse_args(argv)
+    if args.requests < 1 or args.batch < 1:
+        parser.error("--requests and --batch must be >= 1")
+
+    config = ModelConfig(
+        d_model=512, n_heads=8, n_layers=4, d_ff=2048, vocab_size=8192,
+        max_seq_len=args.prompt_len + args.max_new_tokens,
+        n_kv_heads=args.kv_heads,
+    )
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype),
+        init_params(config, jax.random.PRNGKey(0)),
+    )
+    if args.int8:
+        from .quant import quantize_params
+
+        params = quantize_params(params)
+
+    from .paged import init_page_pool_array
+
+    # Pool sized for one admission batch plus slack; across batches the
+    # same physical pages recycle through the free list.
+    page_size = 16
+    total = args.prompt_len + args.max_new_tokens
+    ctrl = PagePool(
+        n_pages=2 * args.batch * (-(-total // page_size)),
+        page_size=page_size,
+    )
+    pool = init_page_pool_array(config, ctrl.n_pages, page_size)
+
+    key = jax.random.PRNGKey(42)
+    served = 0
+    generated_tokens = 0
+    t0 = None
+    batches = -(-args.requests // args.batch)
+    for b in range(batches):
+        n = min(args.batch, args.requests - served)
+        key, k_prompt, k_sample = jax.random.split(key, 3)
+        prompts = jax.random.randint(
+            k_prompt, (n, args.prompt_len), 0, config.vocab_size, jnp.int32
+        )
+        out, pool = serve_batch(
+            params, config, prompts, args.max_new_tokens, ctrl, pool,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, rng=k_sample,
+        )
+        jax.block_until_ready(out)
+        if t0 is None:
+            # Steady-state throughput: the first batch pays compilation.
+            t0 = time.perf_counter()
+        else:
+            generated_tokens += n * args.max_new_tokens
+        served += n
+        print(
+            f"batch {b}: served {n} requests "
+            f"(pages in use after retire: {ctrl.used_pages})",
+            flush=True,
+        )
+    elapsed = time.perf_counter() - t0 if t0 is not None else 0.0
+    rate = generated_tokens / elapsed if elapsed > 0 and generated_tokens else 0.0
+    print(
+        f"done: {served} requests, steady-state ≈ {rate:.0f} tok/s "
+        f"(int8={args.int8}, kv_heads={config.kv_heads}, "
+        f"pool={ctrl.n_pages} pages)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
