@@ -52,14 +52,23 @@ _SEQ_INNER_SEMANTICS = pltpu.CompilerParams(
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-    *, sm_scale, causal, block_q, block_k, seq_valid, n_k_blocks, window,
+    q_ref, k_ref, v_ref, *rest,
+    sm_scale, causal, block_q, block_k, seq_valid, n_k_blocks, window,
+    segmented,
 ):
     """One (batch*head, q-block, k-block) grid cell.  The k dimension is the
     innermost (sequential) grid axis; (m, l, acc) persist in VMEM scratch
     across its iterations and reset when a new q block begins.  Refs:
     q [block_q, d], k/v [block_k, d], o [block_q, d], lse [block_q, 1],
-    scratch m/l [block_q, _STATS_LANES], acc [block_q, d]."""
+    scratch m/l [block_q, _STATS_LANES], acc [block_q, d].  With
+    ``segmented``, two extra int32 refs (seg_q [block_q, 1], seg_k
+    [block_k, 1]) precede the outputs and rows only attend within their
+    own segment — sequence packing."""
+    if segmented:
+        seg_q_ref, seg_k_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        seg_q_ref = seg_k_ref = None
+        o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -88,6 +97,8 @@ def _flash_kernel(
             if window is not None:
                 # Sliding window: row i sees only [i-window+1, i].
                 mask &= k_ids > q_ids - window
+        if segmented:
+            mask &= seg_q_ref[:] == seg_k_ref[:].T  # [bq,1] vs [1,bk]
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]                                   # [bq, LANES]
@@ -132,6 +143,16 @@ def _pad_seq(x, multiple):
     return x
 
 
+def _pad_segments(segment_ids, seq_pad: int) -> jax.Array:
+    """[batch, seq] int32 -> [batch, seq_pad, 1], padded with -1 so padded
+    positions match no real segment."""
+    batch, seq = segment_ids.shape
+    segs = segment_ids.astype(jnp.int32)
+    if seq_pad > seq:
+        segs = jnp.pad(segs, ((0, 0), (0, seq_pad - seq)), constant_values=-1)
+    return segs[:, :, None]
+
+
 def _clamp_block(block: int, seq: int) -> int:
     """Shrink a default block size for short sequences without losing
     Mosaic tileability: the result is the requested block or the sequence
@@ -158,7 +179,8 @@ def _check_gqa(heads: int, kv_heads: int) -> None:
         )
 
 
-def _flash_forward(q, k, v, causal, interpret, block_q, block_k, window=None):
+def _flash_forward(q, k, v, causal, interpret, block_q, block_k, window=None,
+                   segment_ids=None):
     """q: [batch, seq, heads, head_dim]; k/v: [batch, seq, kv_heads,
     head_dim] with kv_heads dividing heads (grouped-query attention; equal
     is plain MHA) -> (out, lse[batch*heads, seq_pad])."""
@@ -183,6 +205,32 @@ def _flash_forward(q, k, v, causal, interpret, block_q, block_k, window=None):
     )
     seq_q_pad = qf.shape[1]
     n_k_blocks = kf.shape[1] // block_k
+    segmented = segment_ids is not None
+
+    in_specs = [
+        pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec(
+            (None, block_k, head_dim), lambda b, i, j: (kv_row(b), j, 0)
+        ),
+        pl.BlockSpec(
+            (None, block_k, head_dim), lambda b, i, j: (kv_row(b), j, 0)
+        ),
+    ]
+    operands = [qf, kf, vf]
+    if segmented:
+        # Per-position document ids, shared across heads: [batch, seq, 1]
+        # padded with -1 (matches nothing).  The q and k streams read the
+        # same array through their own block index maps.
+        segs = _pad_segments(segment_ids, max(qf.shape[1], kf.shape[1]))
+        in_specs += [
+            pl.BlockSpec(
+                (None, block_q, 1), lambda b, i, j, H=heads: (b // H, i, 0)
+            ),
+            pl.BlockSpec(
+                (None, block_k, 1), lambda b, i, j, H=heads: (b // H, j, 0)
+            ),
+        ]
+        operands += [segs, segs]
 
     kernel = functools.partial(
         _flash_kernel,
@@ -193,19 +241,12 @@ def _flash_forward(q, k, v, causal, interpret, block_q, block_k, window=None):
         seq_valid=seq,
         n_k_blocks=n_k_blocks,
         window=window,
+        segmented=segmented,
     )
     out, lse = pl.pallas_call(
         kernel,
         grid=(batch * heads, seq_q_pad // block_q, n_k_blocks),
-        in_specs=[
-            pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec(
-                (None, block_k, head_dim), lambda b, i, j: (kv_row(b), j, 0)
-            ),
-            pl.BlockSpec(
-                (None, block_k, head_dim), lambda b, i, j: (kv_row(b), j, 0)
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -221,20 +262,26 @@ def _flash_forward(q, k, v, causal, interpret, block_q, block_k, window=None):
         ],
         compiler_params=_SEQ_INNER_SEMANTICS,
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*operands)
 
     out = out[:, :seq].reshape(batch, heads, seq, head_dim).transpose(0, 2, 1, 3)
     return out, lse[:, :seq, 0]
 
 
 def _flash_bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
-    *, sm_scale, causal, block_q, block_k, seq_valid, n_k_blocks, window,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    sm_scale, causal, block_q, block_k, seq_valid, n_k_blocks, window,
+    segmented,
 ):
     """One (batch*head, q-block, k-block) grid cell of the backward pass:
     accumulate dq in VMEM scratch over the sequential k axis.  p is
     recomputed from (q, k, lse) — the flash recipe's recompute-don't-store
     backward, as a kernel."""
+    if segmented:
+        seg_q_ref, seg_k_ref, dq_ref, dq_acc_ref = rest
+    else:
+        seg_q_ref = seg_k_ref = None
+        dq_ref, dq_acc_ref = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -261,6 +308,8 @@ def _flash_bwd_dq_kernel(
             mask &= k_ids <= q_ids
             if window is not None:
                 mask &= k_ids > q_ids - window
+        if segmented:
+            mask &= seg_q_ref[:] == seg_k_ref[:].T
         # Explicit zeroing (not just s=-inf): padded q rows carry lse=-inf,
         # where exp(s - lse) would otherwise produce 1, not 0.
         p = jnp.exp(jnp.where(mask, s, NEG_INF) - lse[:, None]) * mask
@@ -284,16 +333,20 @@ def _flash_bwd_dq_kernel(
 
 
 def _flash_bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc_ref, dv_acc_ref,
-    *, sm_scale, causal, block_q, block_k, seq_valid, n_q_blocks, group,
-    window,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    sm_scale, causal, block_q, block_k, seq_valid, n_q_blocks, group,
+    window, segmented,
 ):
     """One (batch*kv_head, k-block, group*q-block) grid cell: accumulate
     dk/dv in VMEM scratch over the sequential innermost axis, which walks
     every (q-head-in-group, q-block) pair sharing this k/v head — grouped-
     query attention sums each group's contributions here — skipping q
     blocks fully above the diagonal when causal."""
+    if segmented:
+        seg_q_ref, seg_k_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
+    else:
+        seg_q_ref = seg_k_ref = None
+        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
     ki = pl.program_id(1)
     j = pl.program_id(2)
     qi = j % n_q_blocks  # q block within the current group member
@@ -322,6 +375,8 @@ def _flash_bwd_dkv_kernel(
             mask &= k_ids <= q_ids
             if window is not None:
                 mask &= k_ids > q_ids - window
+        if segmented:
+            mask &= seg_q_ref[:] == seg_k_ref[:].T
         p = jnp.exp(jnp.where(mask, s, NEG_INF) - lse[:, None]) * mask
         dv_acc_ref[:] = dv_acc_ref[:] + jnp.dot(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
@@ -351,7 +406,7 @@ def _flash_bwd_dkv_kernel(
 
 
 def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q,
-                           block_k, window=None):
+                           block_k, window=None, segment_ids=None):
     """dq/dk/dv via the two backward kernels; same layout contract as
     _flash_forward (k/v may carry fewer heads — grouped-query)."""
     batch, seq, heads, head_dim = q.shape
@@ -386,31 +441,46 @@ def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q,
 
     n_q_blocks = seq_q_pad // block_q
     n_k_blocks = seq_k_pad // block_k
+    segmented = segment_ids is not None
     kwargs = dict(
         sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_valid=seq, window=window,
+        segmented=segmented,
     )
+    dq_in_specs = [
+        pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec(
+            (None, block_k, head_dim), lambda b, i, j: (kv_row(b), j, 0)
+        ),
+        pl.BlockSpec(
+            (None, block_k, head_dim), lambda b, i, j: (kv_row(b), j, 0)
+        ),
+        pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+    ]
+    dq_operands = [qf, kf, vf, dof, lse_pad, delta]
+    if segmented:
+        segs = _pad_segments(segment_ids, max(seq_q_pad, seq_k_pad))
+        dq_in_specs += [
+            pl.BlockSpec(
+                (None, block_q, 1), lambda b, i, j, H=heads: (b // H, i, 0)
+            ),
+            pl.BlockSpec(
+                (None, block_k, 1), lambda b, i, j, H=heads: (b // H, j, 0)
+            ),
+        ]
+        dq_operands += [segs, segs]
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, n_k_blocks=n_k_blocks, **kwargs),
         grid=(batch * heads, n_q_blocks, n_k_blocks),
-        in_specs=[
-            pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec(
-                (None, block_k, head_dim), lambda b, i, j: (kv_row(b), j, 0)
-            ),
-            pl.BlockSpec(
-                (None, block_k, head_dim), lambda b, i, j: (kv_row(b), j, 0)
-            ),
-            pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
         compiler_params=_SEQ_INNER_SEMANTICS,
         interpret=interpret,
-    )(qf, kf, vf, dof, lse_pad, delta)
+    )(*dq_operands)
 
     # dk/dv: one grid row per kv head; the innermost axis walks every
     # (group member, q block) pair so the scratch accumulates the whole
@@ -418,29 +488,47 @@ def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q,
     def q_row(b, j):
         return (b // kv_heads) * heads + (b % kv_heads) * group + j // n_q_blocks
 
+    dkv_in_specs = [
+        pl.BlockSpec(
+            (None, block_q, head_dim),
+            lambda b, i, j: (q_row(b, j), j % n_q_blocks, 0),
+        ),
+        pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec(
+            (None, block_q, head_dim),
+            lambda b, i, j: (q_row(b, j), j % n_q_blocks, 0),
+        ),
+        pl.BlockSpec(
+            (None, block_q, 1), lambda b, i, j: (q_row(b, j), j % n_q_blocks, 0)
+        ),
+        pl.BlockSpec(
+            (None, block_q, 1), lambda b, i, j: (q_row(b, j), j % n_q_blocks, 0)
+        ),
+    ]
+    dkv_operands = [qf, kf, vf, dof, lse_pad, delta]
+    if segmented:
+        # Batch-row index for segments: q rows flatten over q HEADS, k
+        # rows over KV heads; both collapse to the same [batch, seq] ids.
+        dkv_in_specs += [
+            pl.BlockSpec(
+                (None, block_q, 1),
+                lambda b, i, j, H=heads: (
+                    q_row(b, j) // H, j % n_q_blocks, 0
+                ),
+            ),
+            pl.BlockSpec(
+                (None, block_k, 1),
+                lambda b, i, j, Hkv=kv_heads: (b // Hkv, i, 0),
+            ),
+        ]
+        dkv_operands += [segs, segs]
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, n_q_blocks=n_q_blocks, group=group, **kwargs
         ),
         grid=(batch * kv_heads, n_k_blocks, group * n_q_blocks),
-        in_specs=[
-            pl.BlockSpec(
-                (None, block_q, head_dim),
-                lambda b, i, j: (q_row(b, j), j % n_q_blocks, 0),
-            ),
-            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec(
-                (None, block_q, head_dim),
-                lambda b, i, j: (q_row(b, j), j % n_q_blocks, 0),
-            ),
-            pl.BlockSpec(
-                (None, block_q, 1), lambda b, i, j: (q_row(b, j), j % n_q_blocks, 0)
-            ),
-            pl.BlockSpec(
-                (None, block_q, 1), lambda b, i, j: (q_row(b, j), j % n_q_blocks, 0)
-            ),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
@@ -455,7 +543,7 @@ def _flash_backward_pallas(q, k, v, out, dout, lse, causal, interpret, block_q,
         ],
         compiler_params=_SEQ_INNER_SEMANTICS,
         interpret=interpret,
-    )(qf, kf, vf, dof, lse_pad, delta)
+    )(*dkv_operands)
 
     def unflat(x, seq_len):
         return (
@@ -485,6 +573,7 @@ def flash_attention(
     block_k: int = 512,
     bwd_impl: str = "pallas",
     window: int | None = None,
+    segment_ids=None,
 ):
     """Scaled-dot-product attention, [batch, seq, heads, head_dim] layout.
 
@@ -502,11 +591,25 @@ def flash_attention(
     """
     _check_bwd_impl(bwd_impl)
     _check_window(window, causal)
+    _check_segment_ids(segment_ids, q)
     out, _ = _flash_forward(
         q, k, v, causal, _default_interpret() if interpret is None else interpret,
-        block_q, block_k, window,
+        block_q, block_k, window, segment_ids,
     )
     return out
+
+
+def _check_segment_ids(segment_ids, q) -> None:
+    """Eager shape validation: a silently padded-or-clamped mismatch would
+    produce wrong attention, not an error."""
+    if segment_ids is None:
+        return
+    expected = (q.shape[0], q.shape[1])
+    if tuple(segment_ids.shape) != expected:
+        raise ValueError(
+            f"segment_ids shape {tuple(segment_ids.shape)} must be "
+            f"[batch, seq] = {expected}"
+        )
 
 
 def _check_window(window, causal: bool) -> None:
@@ -527,17 +630,20 @@ def _check_bwd_impl(bwd_impl: str) -> None:
         raise ValueError(f"bwd_impl must be 'pallas' or 'xla', got {bwd_impl!r}")
 
 
-def _fwd(q, k, v, causal, interpret, block_q, block_k, bwd_impl, window):
+def _fwd(q, k, v, causal, interpret, block_q, block_k, bwd_impl, window,
+         segment_ids):
     _check_bwd_impl(bwd_impl)
     _check_window(window, causal)
+    _check_segment_ids(segment_ids, q)
     out, lse = _flash_forward(
         q, k, v, causal, _default_interpret() if interpret is None else interpret,
-        block_q, block_k, window,
+        block_q, block_k, window, segment_ids,
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, out, lse, segment_ids)
 
 
-def _flash_backward_xla(q, k, v, out, dout, lse, causal, window=None):
+def _flash_backward_xla(q, k, v, out, dout, lse, causal, window=None,
+                        segment_ids=None):
     """Dense recompute backward in plain XLA: materialises [seq, seq] p, so
     only suitable when that fits comfortably — kept as the reference
     implementation the Pallas kernels are pinned against.  Grouped-query
@@ -555,12 +661,15 @@ def _flash_backward_xla(q, k, v, out, dout, lse, causal, window=None):
     qf, kf, vf, of, dof = (x.astype(f32) for x in (q, k, v, out, dout))
 
     s = jnp.einsum("bshk,bthk->bhst", qf, kf) * sm_scale
+    mask = jnp.ones((1, seq, seq), bool)
     if causal:
-        mask = jnp.tril(jnp.ones((seq, seq), bool))
+        mask = mask & jnp.tril(jnp.ones((seq, seq), bool))
         if window is not None:
             ids = jnp.arange(seq)
-            mask &= ids[None, :] > ids[:, None] - window
-        s = jnp.where(mask[None, None], s, NEG_INF)
+            mask = mask & (ids[None, :] > ids[:, None] - window)
+    if segment_ids is not None:
+        mask = mask & (segment_ids[:, :, None] == segment_ids[:, None, :])
+    s = jnp.where(mask[:, None], s, NEG_INF)
     lse_b = lse.reshape(batch, heads, seq)
     p = jnp.exp(s - lse_b[..., None])
 
@@ -579,15 +688,20 @@ def _flash_backward_xla(q, k, v, out, dout, lse, causal, window=None):
 def _bwd(causal, interpret, block_q, block_k, bwd_impl, window, residuals, dout):
     """Flash backward: recompute p from (q, k, lse) instead of storing the
     [seq, seq] probability matrix — as blocked Pallas kernels by default,
-    dense XLA einsums with bwd_impl="xla"."""
-    q, k, v, out, lse = residuals
+    dense XLA einsums with bwd_impl="xla".  segment_ids is a
+    non-differentiable primal: its cotangent is None."""
+    q, k, v, out, lse, segment_ids = residuals
     if bwd_impl == "xla":
-        return _flash_backward_xla(q, k, v, out, dout, lse, causal, window)
-    return _flash_backward_pallas(
-        q, k, v, out, dout, lse, causal,
-        _default_interpret() if interpret is None else interpret,
-        block_q, block_k, window,
-    )
+        dq, dk, dv = _flash_backward_xla(
+            q, k, v, out, dout, lse, causal, window, segment_ids
+        )
+    else:
+        dq, dk, dv = _flash_backward_pallas(
+            q, k, v, out, dout, lse, causal,
+            _default_interpret() if interpret is None else interpret,
+            block_q, block_k, window, segment_ids,
+        )
+    return dq, dk, dv, None
 
 
 flash_attention.defvjp(_fwd, _bwd)
