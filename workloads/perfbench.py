@@ -237,13 +237,7 @@ def measure_flash_vs_xla(scale: BenchScale) -> dict:
     head_dim = 128
     results = {}
     for seq in scale.attn_seqs:
-        key = jax.random.PRNGKey(seq)
-        q, k, v = (
-            jax.random.normal(
-                kk, (1, seq, scale.attn_heads, head_dim), jnp.bfloat16
-            )
-            for kk in jax.random.split(key, 3)
-        )
+        q, k, v = _rand_qkv(seq, scale.attn_heads, head_dim)
 
         def dense(q, k, v):
             mask = jnp.tril(jnp.ones((q.shape[1], q.shape[1]), bool))[None, None]
@@ -257,6 +251,38 @@ def measure_flash_vs_xla(scale: BenchScale) -> dict:
             "speedup": round(t_dense / t_flash, 3),
         }
     return results
+
+
+def _rand_qkv(seq: int, heads: int, head_dim: int = 128, dtype=jnp.bfloat16):
+    key = jax.random.PRNGKey(seq)
+    return tuple(
+        jax.random.normal(kk, (1, seq, heads, head_dim), dtype)
+        for kk in jax.random.split(key, 3)
+    )
+
+
+def measure_window(scale: BenchScale) -> dict:
+    """Sliding-window block-skip win: flash fwd+bwd at TWICE the longest
+    attn_seqs length (the long-context regime windows exist for), full
+    span vs a window of 1/8th the sequence."""
+    seq = max(scale.attn_seqs) * 2
+    window = max(seq // 8, 128)
+    q, k, v = _rand_qkv(seq, scale.attn_heads)
+
+    def timed(w):
+        return _time_attention_grad(
+            lambda q, k, v: flash_attention(q, k, v, True, window=w), q, k, v
+        )
+
+    t_full = timed(None)
+    t_win = timed(window)
+    return {
+        "window_seq": seq,
+        "window_size": window,
+        "flash_full_ms": round(t_full * 1000, 3),
+        "flash_window_ms": round(t_win * 1000, 3),
+        "flash_window_speedup": round(t_full / t_win, 3),
+    }
 
 
 def measure_decode(scale: BenchScale) -> dict:
@@ -323,6 +349,7 @@ def run(scale_name: str = "full") -> dict:
     out["flash_vs_xla_detail"] = {
         str(s): r for s, r in sorted(attn.items())
     }
+    out.update(measure_window(scale))
     out.update(measure_decode(scale))
     return out
 
