@@ -91,11 +91,14 @@ def _plugin_harness(manager, *, resource: str, backend: str, replicas: int = 0,
 
 
 def _p50_p99(samples: list[float]) -> tuple[float, float]:
+    # Ceil-based rank: with n samples the p99 is the smallest value with
+    # at least 99% of the mass at or below it (a floor-based rank
+    # systematically underestimates on small sample lists).
+    import math
+
     ordered = sorted(samples)
-    return (
-        statistics.median(ordered),
-        ordered[int(len(ordered) * 0.99) - 1],
-    )
+    rank = min(len(ordered) - 1, math.ceil(0.99 * len(ordered)) - 1)
+    return statistics.median(ordered), ordered[rank]
 
 
 def run_bench() -> dict:

@@ -1,5 +1,6 @@
 """Paged KV cache (workloads/paged.py): exact parity with the contiguous
-cache, page accounting, prefix sharing, exhaustion."""
+cache through the Pallas paged-attention kernel, per-row positions,
+ragged prefill, page accounting, prefix sharing, exhaustion."""
 
 import jax
 import jax.numpy as jnp
@@ -10,8 +11,9 @@ from workloads.generate import decode_step, init_kv_cache
 from workloads.model import ModelConfig, init_params
 from workloads.paged import (
     PagePool,
-    init_page_pool_array,
+    init_page_pools,
     paged_decode_step,
+    paged_prefill,
     table_array,
 )
 
@@ -23,9 +25,22 @@ def params():
     return init_params(CONFIG, jax.random.PRNGKey(0))
 
 
+def _lockstep_reference(params, config, tokens):
+    """Contiguous-cache logits for a [batch, steps] token stream."""
+    batch, steps = tokens.shape
+    cache = init_kv_cache(config, batch, steps)
+    out = []
+    for pos in range(steps):
+        logits, cache = decode_step(
+            params, cache, tokens[:, pos], jnp.int32(pos), config
+        )
+        out.append(logits)
+    return out
+
+
 def test_paged_decode_matches_contiguous(params):
-    """Token-by-token logits through the paged pool equal the contiguous
-    cache exactly."""
+    """Token-by-token logits through the paged pools equal the contiguous
+    cache."""
     batch, steps, page_size = 2, 12, 4
     tokens = jax.random.randint(
         jax.random.PRNGKey(1), (batch, steps), 0, CONFIG.vocab_size, jnp.int32
@@ -33,24 +48,190 @@ def test_paged_decode_matches_contiguous(params):
     ctrl = PagePool(n_pages=16, page_size=page_size)
     for b in range(batch):
         ctrl.allocate(b, 1)
-    pool = init_page_pool_array(CONFIG, 16, page_size)
-    contiguous = init_kv_cache(CONFIG, batch, steps)
+    pools = init_page_pools(CONFIG, 16, page_size)
+    want = _lockstep_reference(params, CONFIG, tokens)
 
     max_pages = ctrl.pages_needed(steps)
     for pos in range(steps):
         for b in range(batch):
             ctrl.extend(b, pos + 1)
         tables = table_array([ctrl.tables[b] for b in range(batch)], max_pages)
-        want, contiguous = decode_step(
-            params, contiguous, tokens[:, pos], jnp.int32(pos), CONFIG
-        )
-        got, pool = paged_decode_step(
-            params, pool, tables, tokens[:, pos], jnp.int32(pos), CONFIG
+        got, pools = paged_decode_step(
+            params, pools, tables, tokens[:, pos], jnp.int32(pos), CONFIG
         )
         np.testing.assert_allclose(
-            np.asarray(got), np.asarray(want), atol=2e-4,
+            np.asarray(got), np.asarray(want[pos]), atol=2e-4,
             err_msg=f"position {pos}",
         )
+
+
+@pytest.mark.parametrize(
+    "config",
+    [
+        ModelConfig(max_seq_len=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                    dtype=jnp.float32),
+        ModelConfig(max_seq_len=64, n_layers=2, attention_window=5,
+                    dtype=jnp.float32),
+    ],
+    ids=["gqa", "window"],
+)
+def test_paged_decode_matches_contiguous_variants(config):
+    """Grouped-query and sliding-window configs hold the same parity."""
+    params = init_params(config, jax.random.PRNGKey(0))
+    batch, steps, page_size = 2, 11, 4
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, steps), 0, config.vocab_size, jnp.int32
+    )
+    ctrl = PagePool(n_pages=16, page_size=page_size)
+    for b in range(batch):
+        ctrl.allocate(b, steps)
+    pools = init_page_pools(config, 16, page_size)
+    want = _lockstep_reference(params, config, tokens)
+    tables = table_array(
+        [ctrl.tables[b] for b in range(batch)], ctrl.pages_needed(steps)
+    )
+    for pos in range(steps):
+        got, pools = paged_decode_step(
+            params, pools, tables, tokens[:, pos], jnp.int32(pos), config
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want[pos]), atol=2e-4,
+            err_msg=f"position {pos}",
+        )
+
+
+def test_per_row_positions_match_lockstep(params):
+    """Rows at DIFFERENT depths decode in one call: feeding the same
+    per-row histories through per-row positions gives each row the same
+    logits as its own lockstep run — the continuous-batching contract."""
+    page_size = 4
+    depths = [3, 9]  # row 0 starts at position 3, row 1 at position 9
+    steps = 4
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(5), (2, 16), 0, CONFIG.vocab_size, jnp.int32
+    )
+    # Reference: each row alone, contiguous cache, its own positions.
+    want_rows = []
+    for r, d in enumerate(depths):
+        cache = init_kv_cache(CONFIG, 1, d + steps)
+        for pos in range(d + steps):
+            logits, cache = decode_step(
+                params, cache, tokens[r : r + 1, pos], jnp.int32(pos), CONFIG
+            )
+            if pos >= d:
+                want_rows.append((r, pos, logits))
+
+    # Paged: seed each row's history with per-row decode steps, then step
+    # both rows together with per-row positions.
+    ctrl = PagePool(n_pages=32, page_size=page_size)
+    pools = init_page_pools(CONFIG, 32, page_size)
+    max_pages = ctrl.pages_needed(max(depths) + steps)
+    for r, d in enumerate(depths):
+        ctrl.allocate(r, d + steps)
+    tables = table_array([ctrl.tables[0], ctrl.tables[1]], max_pages)
+    # Seed histories one row at a time (the other row writes to its own
+    # future positions' pages, which is harmless: positions are per-row).
+    for r, d in enumerate(depths):
+        for pos in range(d):
+            _, pools = paged_decode_step(
+                params, pools, tables[r : r + 1], tokens[r : r + 1, pos],
+                jnp.int32(pos), CONFIG,
+            )
+    got = {}
+    positions = np.asarray(depths, np.int32)
+    for s in range(steps):
+        tok = jnp.asarray(
+            [tokens[r, int(positions[r])] for r in range(2)], jnp.int32
+        )
+        logits, pools = paged_decode_step(
+            params, pools, tables, tok, jnp.asarray(positions), CONFIG
+        )
+        for r in range(2):
+            got[(r, int(positions[r]))] = logits[r : r + 1]
+        positions += 1
+
+    for r, pos, want in want_rows:
+        np.testing.assert_allclose(
+            np.asarray(got[(r, pos)]), np.asarray(want), atol=2e-4,
+            err_msg=f"row {r} position {pos}",
+        )
+
+
+def test_ragged_prefill_matches_contiguous(params):
+    """One compiled prefill handles rows of different true lengths: each
+    row's next-token logits equal its own contiguous-cache run, and
+    padded positions never corrupt allocated pages."""
+    page_size = 4
+    bucket = 12
+    lengths = [5, 12, 1]
+    prompts_np = np.zeros((3, bucket), np.int32)
+    rng = np.random.default_rng(0)
+    for r, n in enumerate(lengths):
+        prompts_np[r, :n] = rng.integers(0, CONFIG.vocab_size, n)
+    ctrl = PagePool(n_pages=32, page_size=page_size)
+    pools = init_page_pools(CONFIG, 32, page_size)
+    for r, n in enumerate(lengths):
+        ctrl.allocate(r, n)
+    tables = table_array(
+        [ctrl.tables[r] for r in range(3)], ctrl.pages_needed(bucket),
+        fill=ctrl.trash,
+    )
+    logits, pools = paged_prefill(
+        params, pools, tables, jnp.asarray(prompts_np),
+        jnp.asarray(lengths, jnp.int32), CONFIG,
+    )
+    for r, n in enumerate(lengths):
+        cache = init_kv_cache(CONFIG, 1, n)
+        for pos in range(n):
+            want, cache = decode_step(
+                params, cache, jnp.asarray(prompts_np[r : r + 1, pos]),
+                jnp.int32(pos), CONFIG,
+            )
+        np.testing.assert_allclose(
+            np.asarray(logits[r]), np.asarray(want[0]), atol=2e-4,
+            err_msg=f"row {r} (true length {n})",
+        )
+    # Decode continues per-row from the ragged prefill.
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    for r, n in enumerate(lengths):
+        ctrl.extend(r, n + 1)
+    tables = table_array(
+        [ctrl.tables[r] for r in range(3)], ctrl.pages_needed(bucket + 1),
+        fill=ctrl.trash,
+    )
+    step_logits, pools = paged_decode_step(
+        params, pools, tables, tok, jnp.asarray(lengths, jnp.int32), CONFIG
+    )
+    assert np.all(np.isfinite(np.asarray(step_logits)))
+
+
+def test_prefill_padding_never_writes_other_pages(params):
+    """Padding table columns (whatever their value — here the dangerous
+    default 0) must not be written by a ragged prefill: the scatter is
+    redirected to the trash page, so another sequence's physical page 0
+    keeps its bytes."""
+    page_size = 4
+    ctrl = PagePool(n_pages=16, page_size=page_size)
+    pools = init_page_pools(CONFIG, 16, page_size)
+    victim = ctrl.allocate("victim", 4)
+    assert victim == [0]  # the free list hands out page 0 first
+    k_pages, v_pages = pools
+    sentinel_k = jnp.full_like(k_pages[:, :, 0], 7.25)
+    sentinel_v = jnp.full_like(v_pages[:, :, 0], -3.5)
+    pools = (
+        k_pages.at[:, :, 0].set(sentinel_k),
+        v_pages.at[:, :, 0].set(sentinel_v),
+    )
+    # One row, true length 2 (1 real page), bucket 8 (2 prefill columns):
+    # the second column pads with the DEFAULT fill 0 == the victim's page.
+    ctrl.allocate("row", 2)
+    tables = table_array([ctrl.tables["row"]], 2)
+    prompts = jnp.zeros((1, 8), jnp.int32).at[0, :2].set(jnp.asarray([5, 6]))
+    _, pools = paged_prefill(
+        params, pools, tables, prompts, jnp.asarray([2], jnp.int32), CONFIG
+    )
+    np.testing.assert_array_equal(np.asarray(pools[0][:, :, 0]), np.asarray(sentinel_k))
+    np.testing.assert_array_equal(np.asarray(pools[1][:, :, 0]), np.asarray(sentinel_v))
 
 
 def test_on_demand_allocation_uses_fewer_pages():
@@ -101,30 +282,23 @@ def test_forked_sequences_decode_like_independent_ones(params):
         jax.random.PRNGKey(3), (2, steps), 0, CONFIG.vocab_size, jnp.int32
     )
     history = jnp.concatenate([jnp.tile(prompt, (2, 1)), div], axis=1)
-    contiguous = init_kv_cache(CONFIG, 2, prompt_len + steps)
-    want = []
-    for pos in range(prompt_len + steps):
-        logits, contiguous = decode_step(
-            params, contiguous, history[:, pos], jnp.int32(pos), CONFIG
-        )
-        want.append(logits)
+    want = _lockstep_reference(params, CONFIG, history)
 
     # Paged: one parent consumes the prompt, the child forks and both
     # consume their divergent tails in lockstep (batch axis = [parent,
     # child]).
     ctrl = PagePool(n_pages=32, page_size=page_size)
-    pool = init_page_pool_array(CONFIG, 32, page_size)
+    pools = init_page_pools(CONFIG, 32, page_size)
     ctrl.allocate(0, 1)
     for pos in range(prompt_len):
         ctrl.extend(0, pos + 1)
         tables = table_array([ctrl.tables[0]], ctrl.pages_needed(prompt_len))
-        _, pool = paged_decode_step(
-            params, pool, tables, prompt[:, pos], jnp.int32(pos), CONFIG
+        _, pools = paged_decode_step(
+            params, pools, tables, prompt[:, pos], jnp.int32(pos), CONFIG
         )
     ctrl.fork(0, 1, shared_tokens=prompt_len)
-    # The fork shares only FULL pages; the parent's partial tail page (if
-    # any) must be re-filled for the child.  prompt_len == 2*page_size
-    # here, so every prompt page is full and shared.
+    # The fork shares only FULL pages; prompt_len == 2*page_size here, so
+    # every prompt page is full and shared.
     assert ctrl.used_pages == ctrl.pages_needed(prompt_len)
 
     total = prompt_len + steps
@@ -136,8 +310,8 @@ def test_forked_sequences_decode_like_independent_ones(params):
         tables = table_array(
             [ctrl.tables[0], ctrl.tables[1]], max_pages
         )
-        logits, pool = paged_decode_step(
-            params, pool, tables, div[:, pos - prompt_len], jnp.int32(pos),
+        logits, pools = paged_decode_step(
+            params, pools, tables, div[:, pos - prompt_len], jnp.int32(pos),
             CONFIG,
         )
         got.append(logits)

@@ -56,13 +56,17 @@ def decode_block(params: dict, cache: jax.Array, tokens: jax.Array,
 
     ``unembed`` controls the final full-vocab projection — the expensive
     matmul of a long prefill: "all" (every row), "last" ([batch, 1,
-    vocab], what prompt prefill actually needs), or "none" (cache-fill
-    only, logits is None)."""
-    if unembed not in ("all", "last", "none"):
+    vocab], what prompt prefill actually needs), "hidden" (no projection;
+    returns the final hidden states [batch, s, d_model] so a caller with
+    per-row true lengths can gather one row each before unembedding —
+    the ragged-prompt prefill path), or "none" (cache-fill only, logits
+    is None)."""
+    if unembed not in ("all", "last", "none", "hidden"):
         # Eager, pre-trace validation (repo convention: a typo fails at
         # the call site, not after tracing the whole layer stack).
         raise ValueError(
-            f"unembed must be 'all', 'last' or 'none', got {unembed!r}"
+            f"unembed must be 'all', 'last', 'hidden' or 'none', got "
+            f"{unembed!r}"
         )
     batch, s = tokens.shape
     x = params["embed"].astype(config.dtype)[tokens]  # [b, s, d]
@@ -95,6 +99,8 @@ def decode_block(params: dict, cache: jax.Array, tokens: jax.Array,
 
     if unembed == "none":
         return None, cache
+    if unembed == "hidden":
+        return x, cache
     if unembed == "last":
         x = x[:, -1:]
     logits = x.astype(jnp.float32) @ weight(params["unembed"], jnp.float32)

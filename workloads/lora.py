@@ -32,6 +32,15 @@ def _fans(name: str, shape: tuple) -> tuple[int, int]:
 
     axes = CONTRACTION_AXES[name]
     axes = (axes,) if isinstance(axes, int) else axes
+    # merge_lora reshapes (a @ b) [fan_in, fan_out] straight onto w's
+    # shape, which is only correct while the contraction axes are exactly
+    # the leading axes; a future layout violating that must fail here,
+    # not scramble the adapter delta.
+    if tuple(axes) != tuple(range(len(axes))):
+        raise ValueError(
+            f"LoRA requires {name}'s contraction axes to be its leading "
+            f"axes, got {tuple(axes)} for shape {shape}"
+        )
     fan_in = fan_out = 1
     for i, s in enumerate(shape):
         if i in axes:

@@ -689,7 +689,9 @@ def _bwd(causal, interpret, block_q, block_k, bwd_impl, window, residuals, dout)
     """Flash backward: recompute p from (q, k, lse) instead of storing the
     [seq, seq] probability matrix — as blocked Pallas kernels by default,
     dense XLA einsums with bwd_impl="xla".  segment_ids is a
-    non-differentiable primal: its cotangent is None."""
+    non-differentiable primal: its cotangent is the float0 symbolic zero
+    (the type custom_vjp documents for integer primals — a bare None only
+    works by tolerance, fragile across JAX upgrades)."""
     q, k, v, out, lse, segment_ids = residuals
     if bwd_impl == "xla":
         dq, dk, dv = _flash_backward_xla(
@@ -701,7 +703,12 @@ def _bwd(causal, interpret, block_q, block_k, bwd_impl, window, residuals, dout)
             _default_interpret() if interpret is None else interpret,
             block_q, block_k, window, segment_ids,
         )
-    return dq, dk, dv, None
+    d_seg = (
+        None
+        if segment_ids is None
+        else jax.custom_derivatives.zero_from_primal(segment_ids)
+    )
+    return dq, dk, dv, d_seg
 
 
 flash_attention.defvjp(_fwd, _bwd)

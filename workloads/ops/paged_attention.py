@@ -1,0 +1,221 @@
+"""Paged decode attention as a Pallas TPU kernel.
+
+The serving hot op: one new query token per sequence attends over that
+sequence's KV history stored in non-contiguous fixed-size pages.  The
+block table is a SCALAR-PREFETCH argument — the kernel's k/v BlockSpec
+index maps look the physical page up from the table while the grid walks
+logical pages, so the pages stream HBM->VMEM directly.  Nothing gathers
+the paged cache into a contiguous view first: per-token HBM traffic is
+the live pages only, which is what makes paging a *throughput* feature
+rather than just an allocation-on-demand feature.
+
+Three properties carry the serving wins:
+  * per-row lengths — each sequence attends over its own history length,
+    so a batch of sequences at different positions decodes in one call
+    (continuous batching's compute path);
+  * dead-page DMA elision — for grid steps past a row's last live page
+    (or before its sliding-window start) the index map CLAMPS to the
+    nearest live page: Pallas skips the copy when consecutive grid steps
+    map to the same block, so short rows in a long-table batch cost only
+    their own pages' bandwidth;
+  * grouped-query layout — the grid fans out over (batch * kv_heads) and
+    each kernel instance computes the whole q-head group against one
+    shared k/v stream, mirroring workloads/ops/attention.py.
+
+The online-softmax accumulator lives in VMEM scratch across the
+sequential page walk, exactly like the flash kernel's k-block walk.
+
+Reference pendant: none — the reference daemon has no model code; this
+is the perf bar VERDICT.md round 2 set (paged decode >= contiguous
+decode throughput).  Interpret mode runs the same kernel on CPU for
+tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import NEG_INF, _STATS_LANES, _check_gqa, _default_interpret
+
+
+def _paged_decode_kernel(
+    tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+    m_ref, l_ref, acc_ref,
+    *, sm_scale, page_size, kv_heads, n_page_steps, window,
+):
+    """One (batch*kv_head, logical-page) grid cell.  The page axis is the
+    innermost (sequential) walk; (m, l, acc) persist in VMEM scratch
+    across it and reset when a new row begins.  Refs: q [group, hd],
+    k/v [page_size, hd] (the physical page the index map selected),
+    o [group, hd], scratch m/l [group, _STATS_LANES], acc [group, hd]."""
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    length = lengths_ref[bh // kv_heads]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _body():
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        k_ids = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], page_size), 1
+        )
+        mask = k_ids < length
+        if window is not None:
+            # The single query sits at position length-1; it sees only
+            # the last ``window`` positions [length-window, length-1].
+            mask &= k_ids >= length - window
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:]                       # [group, LANES]
+        l_prev = l_ref[:]
+        m_cur = jnp.max(s, axis=-1)[:, None]
+        m_new = jnp.maximum(m_prev, m_cur)      # lane-broadcast
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1)[:, None]
+        m_ref[:] = m_new
+        acc_ref[:] = acc_ref[:] * alpha[:, :1] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+
+    # A page fully past the row's length — or fully before its window
+    # start — contributes nothing; its compute is skipped here and its
+    # DMA is skipped by the index-map clamp (same-block revisits copy
+    # nothing).
+    live = j * page_size < length
+    if window is not None:
+        live &= (j + 1) * page_size > length - window
+    pl.when(live)(_body)
+
+    @pl.when(j == n_page_steps - 1)
+    def _finalize():
+        l = l_ref[:][:, :1]
+        l_safe = jnp.where(l > 0, l, 1.0)  # fully-dead rows (empty slots)
+        o_ref[:] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    layer: int = 0,
+    window: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Decode attention over a paged KV cache.
+
+    q: [batch, heads, head_dim] — the current token's queries;
+    k_pages/v_pages: [layers, kv_heads, n_pages, page_size, head_dim]
+    (the whole pool rides in so no XLA slice materialises a copy —
+    ``layer`` is folded into the BlockSpec index maps);
+    tables: [batch, max_pages] int32 physical page ids (padding entries
+    are never admitted: they sit past ``lengths`` and their DMA is
+    elided);
+    lengths: [batch] int32, the number of valid cache positions per row
+    (the query's own k/v must already be written at position length-1).
+
+    kv_heads may be fewer than heads (grouped-query); heads must divide
+    evenly.  Returns [batch, heads, head_dim].
+
+    Hardware notes: head_dim should be a multiple of 128 and page_size a
+    multiple of 8 for clean Mosaic tiling at speed (any sizes work in
+    interpret mode; Mosaic pads small operands on hardware).
+    """
+    batch, heads, head_dim = q.shape
+    layers, kv_heads, n_pages, page_size, hd2 = k_pages.shape
+    if hd2 != head_dim:
+        raise ValueError(
+            f"head_dim mismatch: q has {head_dim}, pages have {hd2}"
+        )
+    if v_pages.shape != k_pages.shape:
+        raise ValueError(
+            f"k/v page pools disagree: {k_pages.shape} vs {v_pages.shape}"
+        )
+    if not (0 <= layer < layers):
+        raise ValueError(f"layer {layer} out of range [0, {layers})")
+    if tables.shape[0] != batch or lengths.shape != (batch,):
+        raise ValueError(
+            f"tables {tables.shape} / lengths {lengths.shape} do not match "
+            f"batch {batch}"
+        )
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    _check_gqa(heads, kv_heads)
+    group = heads // kv_heads
+    max_pages = tables.shape[1]
+    sm_scale = 1.0 / (head_dim**0.5)
+    if interpret is None:
+        interpret = _default_interpret()
+
+    # [batch, heads, hd] -> [batch*kv_heads, group, hd]; head h maps to
+    # kv head h // group — the same grouping convention as the flash
+    # kernel and the dense grouped core.
+    qf = q.reshape(batch * kv_heads, group, head_dim)
+
+    def kv_map(bh, j, tables_ref, lengths_ref):
+        b = bh // kv_heads
+        h = bh % kv_heads
+        length = lengths_ref[b]
+        last = (length - 1) // page_size
+        j_eff = jnp.minimum(j, last)
+        if window is not None:
+            # Pages fully before the window start clamp forward to the
+            # first live page, so their DMA is elided too.
+            first = jnp.maximum(length - window, 0) // page_size
+            j_eff = jnp.maximum(j_eff, jnp.minimum(first, last))
+        return (layer, h, tables_ref[b, j_eff], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch * kv_heads, max_pages),
+        in_specs=[
+            pl.BlockSpec(
+                (None, group, head_dim), lambda bh, j, t, l: (bh, 0, 0)
+            ),
+            pl.BlockSpec((None, None, None, page_size, head_dim), kv_map),
+            pl.BlockSpec((None, None, None, page_size, head_dim), kv_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, group, head_dim), lambda bh, j, t, l: (bh, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, _STATS_LANES), jnp.float32),  # m
+            pltpu.VMEM((group, _STATS_LANES), jnp.float32),  # l
+            pltpu.VMEM((group, head_dim), jnp.float32),      # acc
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel,
+            sm_scale=sm_scale,
+            page_size=page_size,
+            kv_heads=kv_heads,
+            n_page_steps=max_pages,
+            window=window,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                pltpu.GridDimensionSemantics.PARALLEL,
+                pltpu.GridDimensionSemantics.ARBITRARY,
+            ),
+        ),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), qf, k_pages, v_pages)
+    return out.reshape(batch, heads, head_dim)

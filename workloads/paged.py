@@ -4,22 +4,35 @@ A contiguous KV cache reserves ``batch * max_len`` slots up front; serving
 many sequences of different lengths wastes most of them.  Here the cache
 is a POOL of fixed-size pages plus a per-sequence page table — the
 vLLM-style layout, expressed the JAX way: the pool and tables are plain
-arrays with static shapes, the device-side decode gathers each sequence's
-pages by table lookup, and page allocation/free is host-side Python
-between steps (it is control plane, not compute).
+arrays with static shapes, page allocation/free is host-side Python
+between steps (it is control plane, not compute), and the decode step
+runs a Pallas kernel (workloads/ops/paged_attention.py) whose BlockSpec
+index maps read the physical pages straight from the scalar-prefetched
+block table — no gathered contiguous copy of the cache ever
+materialises, so per-token HBM traffic is the live pages only.
 
-Two serving wins fall out of the layout:
+Three serving wins fall out of the layout:
   * allocation on demand — a sequence holds pages for the tokens it has
     actually produced, not for ``max_len``;
   * shared prefixes — sequences with a common prompt REFERENCE the same
     physical pages (read-only; a diverging sequence writes into fresh
     pages from its fork point), so an N-way fan-out of one prompt stores
-    the prompt's k/v once.
+    the prompt's k/v once;
+  * per-row positions — every device-side entry point takes [batch]
+    positions/lengths, so sequences at different depths decode in ONE
+    call: the compute path continuous batching needs (workloads/serve.py
+    drives it).
 
-The decode path reuses the model's cached-attention core: gathered pages
-form the [batch, padded_len, kv_heads, head_dim] view masked by true
-sequence length, so logits are bit-comparable with the contiguous cache
-(pinned by tests).
+Logits are numerically identical to the contiguous cache (pinned by
+tests against workloads/generate.py decode_step).
+
+Pool layout: two arrays (k, v), each
+``[layers, kv_heads, n_pages + 1, page_size, head_dim]`` — kv_heads
+outermost so one page for one head is a contiguous [page_size, head_dim]
+DMA block.  The extra LAST page is a sacrificial TRASH page: table
+padding entries point at it, so scatters from padded prompt positions or
+unoccupied batch slots land somewhere harmless (reads never see it —
+per-row lengths mask it out and its DMA is elided by the kernel).
 
 Reference pendant: none — the reference daemon has no model code; part of
 the JAX serving workloads (SURVEY.md §7 step 8).
@@ -33,15 +46,25 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from .generate import decode_block
-from .model import ModelConfig
+from .generate import decode_block, sample_logits
+from .model import (
+    ModelConfig,
+    _mlp,
+    _rmsnorm,
+    project_qkv,
+    rope_angles,
+    weight,
+)
+from .ops.paged_attention import paged_attention
 
 
 @dataclass
 class PagePool:
     """Host-side control plane: which physical pages are free, and each
-    sequence's page table.  Device state lives in ``pages`` (the pool
-    array) owned by the caller; this class only hands out indices."""
+    sequence's page table.  Device state lives in the pool arrays owned
+    by the caller; this class only hands out indices (0 .. n_pages-1 —
+    the device arrays' extra trash page at index ``n_pages`` is never
+    allocated)."""
 
     n_pages: int
     page_size: int
@@ -51,6 +74,12 @@ class PagePool:
 
     def __post_init__(self):
         self.free = list(range(self.n_pages - 1, -1, -1))
+
+    @property
+    def trash(self) -> int:
+        """The sacrificial page index in the DEVICE arrays (which hold
+        n_pages + 1 pages): table padding should point here."""
+        return self.n_pages
 
     def pages_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
@@ -124,109 +153,242 @@ class PagePool:
         return self.n_pages - len(self.free)
 
 
-def init_page_pool_array(
+def init_page_pools(
     config: ModelConfig, n_pages: int, page_size: int
-) -> jax.Array:
-    """The device-side pool: [layers, 2, n_pages, page_size, kv_heads,
-    head_dim]."""
-    return jnp.zeros(
-        (
-            config.n_layers, 2, n_pages, page_size,
-            config.kv_heads, config.head_dim,
-        ),
-        config.dtype,
+) -> tuple[jax.Array, jax.Array]:
+    """The device-side (k, v) pools, each [layers, kv_heads, n_pages + 1,
+    page_size, head_dim].  The last page is the TRASH page (see module
+    docstring); PagePool(n_pages, ...) manages the first n_pages."""
+    shape = (
+        config.n_layers, config.kv_heads, n_pages + 1, page_size,
+        config.head_dim,
     )
+    return jnp.zeros(shape, config.dtype), jnp.zeros(shape, config.dtype)
 
 
-def table_array(tables: list[list[int]], max_pages: int) -> jax.Array:
-    """Stack host tables into a padded [batch, max_pages] int32 array
-    (padding pages are never admitted by the length mask)."""
+def table_array(
+    tables: list[list[int]], max_pages: int, fill: int = 0
+) -> jax.Array:
+    """Stack host tables into a padded [batch, max_pages] int32 array.
+
+    ``fill`` pads short tables.  Reads never touch padding (the per-row
+    length mask excludes it and the kernel elides its DMA) and
+    paged_prefill redirects its own padding-column writes to the trash
+    page, so the default is safe everywhere a row's real pages cover its
+    positions; rows that are PARKED with positions outside their table
+    (empty serve slots) must fill with the pool's trash index."""
     out = []
     for t in tables:
         if len(t) > max_pages:
             raise ValueError(f"table length {len(t)} exceeds {max_pages}")
-        out.append(t + [0] * (max_pages - len(t)))
+        out.append(list(t) + [fill] * (max_pages - len(t)))
     return jnp.asarray(out, jnp.int32)
 
 
-def _gathered_view(pool: jax.Array, tables: jax.Array):
-    """[layers, 2, batch, max_pages*page_size, kv_heads, head_dim] view of
-    each sequence's pages, via one gather per call."""
-    gathered = pool[:, :, tables]  # [L, 2, b, max_pages, ps, Hkv, hd]
-    length, two, batch, n_pg, ps, kvh, hd = gathered.shape
-    return gathered.reshape(length, two, batch, n_pg * ps, kvh, hd)
+def _rope_rows(x: jax.Array, angles: jax.Array) -> jax.Array:
+    """Rotate x [batch, 1, heads, head_dim] by PER-ROW angles
+    [batch, head_dim//2] — the per-row-position counterpart of
+    model.apply_rope (same frequency formula via model.rope_angles)."""
+    half = x.shape[-1] // 2
+    cos = jnp.cos(angles)[:, None, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, None, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
-@partial(
-    jax.jit, static_argnames=("config", "prompt_len"), donate_argnums=(1,)
-)
-def paged_prefill(
+def _decode_core(
     params: dict,
-    pool: jax.Array,
+    pools: tuple[jax.Array, jax.Array],
     tables: jax.Array,
-    prompts: jax.Array,
+    token: jax.Array,
+    positions: jax.Array,
     config: ModelConfig,
-    prompt_len: int,
 ):
-    """Prefill a batch of prompts into the paged pool in one block forward.
+    """One token per row through the paged cache: write the new k/v into
+    each row's current page, then run the paged-attention kernel over the
+    row's live pages.  positions: [batch] int32, each row's own position
+    (the numerics mirror generate.decode_block token-for-token — pinned
+    by tests)."""
+    k_pages, v_pages = pools
+    batch = token.shape[0]
+    page_size = k_pages.shape[3]
+    row = jnp.arange(batch)
+    page = tables[row, positions // page_size]  # [batch]
+    slot = positions % page_size
+    lengths = positions + 1
+    angles = rope_angles(positions, config.head_dim)  # [batch, half]
 
-    prompts: [batch, prompt_len] at positions 0..prompt_len-1 (tables must
-    already cover them).  Returns (last_logits [batch, vocab], pool); the
-    pool is DONATED.  Only the last row is unembedded — prefill needs one
-    next-token prediction, not prompt_len * vocab logits."""
-    view = _gathered_view(pool, tables)
-    logits, view = decode_block(
-        params, view, prompts, jnp.int32(0), config, unembed="last"
-    )
-    # ONE scatter writes the prompt-covering pages back.  Only the first
-    # ceil(prompt_len/page_size) table columns participate: those are real
-    # pages by construction, while PADDING columns alias page 0 — writing
-    # them would race the stale gathered copy against fresh k/v (scatter
-    # order is unspecified).  Duplicates among the real columns only arise
-    # from shared-prefix tables, whose bytes are identical, so they are
-    # safe.
-    length, two, batch2, flat, kvh, hd = view.shape
-    page_size = pool.shape[3]
-    prefill_pages = -(-prompt_len // page_size)
-    paged_view = view.reshape(
-        length, two, batch2, flat // page_size, page_size, kvh, hd
-    )
-    pool = pool.at[:, :, tables[:, :prefill_pages]].set(
-        paged_view[:, :, :, :prefill_pages]
-    )
-    return logits[:, 0], pool
+    x = params["embed"].astype(config.dtype)[token][:, None]  # [b, 1, d]
+    for i, layer in enumerate(params["layers"]):
+        h = _rmsnorm(x, layer["ln1"])
+        q, k, v = project_qkv(h, layer)  # [b, 1, H|Hkv, hd]
+        q, k = _rope_rows(q, angles), _rope_rows(k, angles)
+        # Scatter this token's k/v into each row's current page slot.
+        # (The int layer index and the [batch] page/slot arrays are
+        # separated by the head slice, so the advanced-index result dims
+        # lead: the target is [batch, kv_heads, head_dim].)
+        k_pages = k_pages.at[i, :, page, slot].set(k[:, 0])
+        v_pages = v_pages.at[i, :, page, slot].set(v[:, 0])
+        attn = paged_attention(
+            q[:, 0], k_pages, v_pages, tables, lengths,
+            layer=i, window=config.attention_window,
+        )
+        x = x + jnp.einsum(
+            "bhk,hkd->bd", attn, weight(layer["wo"], x.dtype)
+        )[:, None]
+        x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
+    logits = x[:, 0].astype(jnp.float32) @ weight(params["unembed"], jnp.float32)
+    return logits, (k_pages, v_pages)
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnums=(1,))
 def paged_decode_step(
     params: dict,
-    pool: jax.Array,
+    pools: tuple[jax.Array, jax.Array],
     tables: jax.Array,
     token: jax.Array,
-    pos: jax.Array,
+    positions: jax.Array,
     config: ModelConfig,
 ):
     """One token through the paged cache.
 
-    pool: the page array; tables: [batch, max_pages] int32; token:
-    [batch] int32 at position ``pos`` (all sequences step in lockstep —
-    per-row positions are a continuous-batching concern out of scope).
-    Returns (logits [batch, vocab], updated pool); the pool argument is
-    DONATED (the update aliases in place — without donation XLA copies the
-    whole pool every token), so callers must rebind it.
+    pools: (k_pages, v_pages) from init_page_pools; tables:
+    [batch, max_pages] int32; token: [batch] int32; positions: scalar
+    (lockstep) or [batch] int32 — each row's token sits at its own
+    position, so a batch of sequences at different depths steps in one
+    call.  Returns (logits [batch, vocab], updated pools); the pools are
+    DONATED (the scatter aliases in place — without donation XLA copies
+    the whole pool every token), so callers must rebind them."""
+    positions = jnp.broadcast_to(
+        jnp.asarray(positions, jnp.int32), token.shape
+    )
+    return _decode_core(params, pools, tables, token, positions, config)
 
-    The step runs attention over the gathered page view through the same
-    decode core as the contiguous cache, then scatters the new k/v back
-    into each sequence's current page."""
-    view = _gathered_view(pool, tables)
-    logits, view = decode_block(params, view, token[:, None], pos, config)
 
-    # Scatter the slot written at ``pos`` in the view back to the pool:
-    # page = tables[b, pos // page_size], slot = pos % page_size.
-    page_size = pool.shape[3]
-    page_idx = tables[:, pos // page_size]  # [batch]
-    slot = pos % page_size
-    written = jax.lax.dynamic_slice_in_dim(view, pos, 1, axis=3)
-    # written: [L, 2, b, 1, Hkv, hd] -> scatter per batch row.
-    pool = pool.at[:, :, page_idx, slot].set(written[:, :, :, 0])
-    return logits[:, 0], pool
+@partial(
+    jax.jit,
+    static_argnames=("config", "chunk", "sampling"),
+    donate_argnums=(1,),
+)
+def paged_decode_chunk(
+    params: dict,
+    pools: tuple[jax.Array, jax.Array],
+    tables: jax.Array,
+    token: jax.Array,
+    positions: jax.Array,
+    occupancy: jax.Array,
+    rng: jax.Array,
+    temperature,
+    top_k,
+    top_p,
+    config: ModelConfig,
+    chunk: int,
+    sampling: bool,
+):
+    """``chunk`` decode steps in ONE dispatch (a lax.scan): between page
+    boundaries the block tables cannot change, so the host only needs to
+    intervene every ``page_size`` tokens — this is what keeps the paged
+    path's dispatch rate at the contiguous scan's level instead of one
+    round-trip per token.
+
+    token/positions: [batch] — each row's current token and its position
+    (per-row, NOT lockstep).  occupancy: [batch] bool — rows marked False
+    are parked: their position freezes and their (all-trash) table
+    swallows the dead scatter, so admission/retire between chunks never
+    recompiles (shapes are static, occupancy is data).  tables must
+    already cover positions + chunk tokens for occupied rows.
+
+    Returns (tokens [batch, chunk], pools); pools are DONATED."""
+    keys = jax.random.split(rng, chunk)
+
+    def body(carry, key):
+        pools, tok, pos = carry
+        logits, pools = _decode_core(params, pools, tables, tok, pos, config)
+        nxt = sample_logits(
+            logits, key if sampling else None, temperature, top_k, top_p
+        )
+        pos = jnp.where(occupancy, pos + 1, pos)
+        tok = jnp.where(occupancy, nxt, tok)
+        return (pools, tok, pos), nxt
+
+    positions = jnp.broadcast_to(jnp.asarray(positions, jnp.int32), token.shape)
+    (pools, _, _), toks = jax.lax.scan(
+        body, (pools, token, positions), keys
+    )
+    return jnp.transpose(toks, (1, 0)), pools
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(1,))
+def paged_prefill(
+    params: dict,
+    pools: tuple[jax.Array, jax.Array],
+    tables: jax.Array,
+    prompts: jax.Array,
+    lengths: jax.Array,
+    config: ModelConfig,
+):
+    """Prefill a batch of fresh prompts into the paged pools in one block
+    forward.
+
+    prompts: [batch, P] right-padded to the (static) bucket length P;
+    lengths: [batch] int32 true lengths (1..P) — per-row, so ragged
+    admissions share one compiled prefill.  Rows start at position 0 and
+    their tables must cover their own ceil(length / page_size) real
+    pages within the first ceil(P / page_size) columns; whatever pads
+    the remaining columns is IGNORED — padded positions' k/v are
+    redirected to the TRASH page here, so a default-filled table can
+    never corrupt another sequence's physical page.
+
+    Returns (next-token logits [batch, vocab] — each row's last TRUE
+    position — and the updated pools).  Pools are DONATED.  Only the
+    gathered prompt pages round-trip HBM (one gather + one scatter per
+    admission, O(prompt) — the per-token path never gathers)."""
+    k_pages, v_pages = pools
+    batch, P = prompts.shape
+    page_size = k_pages.shape[3]
+    prefill_pages = -(-P // page_size)
+    # Columns beyond each row's true pages hold caller padding of
+    # unknowable meaning; route them to the sacrificial trash page
+    # (always the pools' last page by construction) before they are
+    # ever written.  Reads are unaffected: the length mask and the
+    # kernel's DMA clamp already exclude them.
+    trash = k_pages.shape[2] - 1
+    real_pages = (lengths.astype(jnp.int32) + page_size - 1) // page_size
+    col = jnp.arange(prefill_pages)[None, :]
+    t_pp = jnp.where(
+        col < real_pages[:, None], tables[:, :prefill_pages], trash
+    )
+
+    # Gathered view of just the prompt-covering pages, in decode_block's
+    # contiguous-cache layout [L, 2, b, pp*ps, Hkv, hd].
+    def view_of(pool):
+        g = pool[:, :, t_pp]  # [L, Hkv, b, pp, ps, hd]
+        g = jnp.transpose(g, (0, 2, 3, 4, 1, 5))
+        return g.reshape(
+            g.shape[0], batch, prefill_pages * page_size, *g.shape[4:]
+        )
+
+    view = jnp.stack([view_of(k_pages), view_of(v_pages)], axis=1)
+    hidden, view = decode_block(
+        params, view, prompts, jnp.int32(0), config, unembed="hidden"
+    )
+    # Per-row last true hidden row -> one next-token prediction each.
+    idx = (lengths - 1).astype(jnp.int32)[:, None, None]
+    h_last = jnp.take_along_axis(
+        hidden, jnp.broadcast_to(idx, (batch, 1, hidden.shape[-1])), axis=1
+    )
+    logits = h_last[:, 0].astype(jnp.float32) @ weight(
+        params["unembed"], jnp.float32
+    )
+
+    # ONE scatter writes the prompt-covering pages back.  Duplicate table
+    # entries among rows only arise from shared-prefix forks (identical
+    # bytes) or trash padding (garbage by contract), so scatter order
+    # does not matter.
+    pv = view.reshape(
+        view.shape[0], 2, batch, prefill_pages, page_size, *view.shape[4:]
+    )
+    pv = jnp.transpose(pv, (0, 1, 5, 2, 3, 4, 6))  # [L, 2, Hkv, b, pp, ps, hd]
+    k_pages = k_pages.at[:, :, t_pp].set(pv[:, 0])
+    v_pages = v_pages.at[:, :, t_pp].set(pv[:, 1])
+    return logits, (k_pages, v_pages)

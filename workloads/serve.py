@@ -1,21 +1,30 @@
-"""A minimal serving loop tying the serving stack together.
+"""A serving engine with continuous batching over the paged KV cache.
 
-One process, one chip, many requests: prompts arrive, prefill runs as one
-cached block forward, decode steps run the whole active batch in lockstep
-through the paged KV cache, finished sequences release their pages, and
-sampling is per-request (traced knobs — no recompiles between requests).
+One process, one chip, many requests: ``ServeEngine`` holds a fixed set
+of batch SLOTS (static shapes — nothing ever recompiles as traffic
+changes), admits pending requests into free slots with a per-row prefill,
+decodes every occupied slot in page-size CHUNKS (one device dispatch per
+chunk, not per token), and retires finished sequences mid-stream — a new
+request takes over the slot at the next chunk boundary instead of waiting
+for the whole batch to drain.  That slot turnover is continuous batching,
+and it is what makes a mixed-length request stream sustain higher
+throughput than lockstep admission batches (pinned by tests).
+
+The compute path is per-row throughout: per-row positions, per-row
+lengths in the Pallas paged-attention kernel, per-row true-length logits
+out of the shared prefill.  Occupancy is DATA (a bool mask), not shape:
+empty slots park with a frozen position and an all-trash page table, so
+admission and retirement never retrace.
+
 The flagship serving features compose here end-to-end: grouped-query
-attention (smaller pages), int8 weight-only bases (halved weight stream),
-paged memory with on-demand allocation, and temperature/top-k/top-p.
+attention (smaller pages), int8 weight-only bases (halved weight
+stream), paged memory with on-demand allocation, and
+temperature/top-k/top-p sampling (traced knobs).
 
-This is the example-pod entry for a shared-TPU inference service; the
-scheduler-facing story (admission, leases) is unchanged from
-``pod-inference.yml`` — this module is about what happens *inside* the
-pod.
-
-Deliberately lockstep (all active sequences share one position counter,
-padded prompts): per-row positions are continuous batching, whose
-scheduling complexity belongs in a dedicated server, not an example.
+``serve_batch`` remains as the LOCKSTEP baseline (admit a whole batch,
+decode to the common max, retire together) — both the simplest way to
+serve a uniform batch and the comparison point the engine's throughput
+win is measured against.
 
 Reference pendant: none — the reference daemon has no model code; part of
 the JAX serving workloads (SURVEY.md §7 step 8).
@@ -23,17 +32,291 @@ the JAX serving workloads (SURVEY.md §7 step 8).
 
 from __future__ import annotations
 
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .generate import sample_logits
 from .model import ModelConfig, init_params
 from .paged import (
     PagePool,
+    init_page_pools,
+    paged_decode_chunk,
     paged_decode_step,
     paged_prefill,
     table_array,
 )
+
+
+@dataclass
+class Request:
+    """One sequence through the engine.  ``tokens`` accumulates generated
+    tokens (the prompt is not echoed); ``done`` flips at ``max_new_tokens``
+    or on ``eos_token``."""
+
+    rid: str
+    prompt: list[int]
+    max_new_tokens: int
+    eos_token: int | None = None
+    tokens: list[int] = field(default_factory=list)
+    done: bool = False
+
+
+class ServeEngine:
+    """Continuous-batching serving engine over the paged KV cache.
+
+    Static once constructed: ``slots`` batch rows, a ``prompt_bucket``
+    prefill width, a ``chunk`` decode length, and a page pool.  Exactly
+    three programs compile (prefill, chunk, first-token sampler) no
+    matter how requests arrive, finish, or interleave.
+    """
+
+    def __init__(
+        self,
+        params: dict,
+        config: ModelConfig,
+        *,
+        slots: int = 4,
+        page_size: int = 16,
+        n_pages: int | None = None,
+        prompt_bucket: int | None = None,
+        chunk: int | None = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        rng: jax.Array | None = None,
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.params, self.config = params, config
+        self.page_size = page_size
+        self.chunk = chunk or page_size
+        self.prompt_bucket = prompt_bucket or min(
+            config.max_seq_len, 2 * page_size
+        )
+        if self.prompt_bucket > config.max_seq_len:
+            raise ValueError(
+                f"prompt_bucket {self.prompt_bucket} exceeds max_seq_len "
+                f"{config.max_seq_len}"
+            )
+        # Chunks may overshoot a request's retirement point by up to
+        # chunk-1 positions (retirement is detected at the chunk
+        # boundary), so tables and the position range cover it.
+        self.max_pages = -(-(config.max_seq_len + self.chunk) // page_size)
+        n_pages = n_pages if n_pages is not None else slots * self.max_pages
+        self.ctrl = PagePool(n_pages=n_pages, page_size=page_size)
+        self.pools = init_page_pools(config, n_pages, page_size)
+        self.slots = slots
+        self.temperature = float(temperature)
+        self.top_k, self.top_p = top_k, top_p
+        self.sampling = self.temperature > 0.0
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        trash = self.ctrl.trash
+        self._tables = np.full((slots, self.max_pages), trash, np.int32)
+        self._positions = np.zeros(slots, np.int32)
+        self._tokens = np.zeros(slots, np.int32)
+        self._occupied = np.zeros(slots, bool)
+        self._slot_req: dict[int, Request] = {}
+        self.pending: deque[Request] = deque()
+        self._ids = itertools.count()
+        # Page-budget backpressure: pages are COMMITTED at admission for a
+        # request's worst-case lifetime (prompt + generation + chunk
+        # overshoot) and released at retirement, so ctrl.allocate/extend
+        # can never raise mid-stream — a request that does not fit yet
+        # simply waits in the queue for retirements to free budget.
+        # Physical pages are still held on demand; only admission is
+        # worst-case gated.
+        self._committed_pages = 0
+        self._slot_commit: dict[int, int] = {}
+        # Telemetry for benchmarking and tests.
+        self.chunks_run = 0
+        self.generated_tokens = 0
+
+        sampling = self.sampling
+
+        @jax.jit
+        def first_token(logits, key, temperature, top_k, top_p):
+            return sample_logits(
+                logits, key if sampling else None, temperature, top_k, top_p
+            )
+
+        self._first_token = first_token
+
+    # ---- submission -----------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int | None = None,
+        *,
+        eos_token: int | None = None,
+        rid: str | None = None,
+    ) -> str:
+        prompt = [int(t) for t in prompt]
+        if not 1 <= len(prompt) <= self.prompt_bucket:
+            raise ValueError(
+                f"prompt length {len(prompt)} must be in [1, "
+                f"{self.prompt_bucket}] (the engine's prompt bucket)"
+            )
+        if max_new_tokens is None:
+            max_new_tokens = self.config.max_seq_len - len(prompt)
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if len(prompt) + max_new_tokens > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq_len {self.config.max_seq_len}"
+            )
+        if self._worst_case_pages(len(prompt), max_new_tokens) > self.ctrl.n_pages:
+            raise ValueError(
+                f"request needs up to "
+                f"{self._worst_case_pages(len(prompt), max_new_tokens)} pages "
+                f"but the pool holds {self.ctrl.n_pages} — it could never be "
+                "admitted"
+            )
+        rid = rid if rid is not None else f"req-{next(self._ids)}"
+        req = Request(rid, prompt, max_new_tokens, eos_token)
+        self.pending.append(req)
+        return rid
+
+    # ---- engine internals ----------------------------------------------
+
+    def _next_key(self) -> jax.Array:
+        self._rng, key = jax.random.split(self._rng)
+        return key
+
+    def _seq_id(self, slot: int, req: Request):
+        return ("slot", slot, req.rid)
+
+    def _worst_case_pages(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Pages a request can hold over its whole lifetime: its final
+        position after the last chunk is prompt_len +
+        ceil((max_new_tokens - 1) / chunk) * chunk (retirement is
+        detected at chunk boundaries, so the position overshoots by up
+        to chunk - 1)."""
+        chunks = -(-(max_new_tokens - 1) // self.chunk)
+        return self.ctrl.pages_needed(prompt_len + chunks * self.chunk)
+
+    def _retire(self, slot: int) -> Request:
+        req = self._slot_req.pop(slot)
+        self.ctrl.release(self._seq_id(slot, req))
+        self._committed_pages -= self._slot_commit.pop(slot)
+        self._occupied[slot] = False
+        self._tables[slot] = self.ctrl.trash
+        self._positions[slot] = 0
+        self._tokens[slot] = 0
+        return req
+
+    def _admit(self) -> list[Request]:
+        """Fill free slots from the pending queue: allocate pages for the
+        true prompt, prefill (one compiled batch-1 call per admission),
+        sample the first token.  Returns requests that finished AT
+        admission (max_new_tokens == 1 or instant EOS)."""
+        finished = []
+        for slot in range(self.slots):
+            if self._occupied[slot] or not self.pending:
+                continue
+            need = self._worst_case_pages(
+                len(self.pending[0].prompt), self.pending[0].max_new_tokens
+            )
+            if self._committed_pages + need > self.ctrl.n_pages:
+                # Not enough uncommitted budget yet; admission is FIFO
+                # (no queue-jumping by smaller requests — starvation-free
+                # beats marginally fuller slots).
+                break
+            req = self.pending.popleft()
+            seq = self._seq_id(slot, req)
+            n = len(req.prompt)
+            self.ctrl.allocate(seq, n)
+            table = table_array(
+                [self.ctrl.tables[seq]], self.max_pages, fill=self.ctrl.trash
+            )
+            prompt = np.zeros((1, self.prompt_bucket), np.int32)
+            prompt[0, :n] = req.prompt
+            logits, self.pools = paged_prefill(
+                self.params, self.pools, table, jnp.asarray(prompt),
+                jnp.asarray([n], jnp.int32), self.config,
+            )
+            tok = int(
+                self._first_token(
+                    logits, self._next_key(),
+                    jnp.float32(self.temperature), jnp.int32(self.top_k),
+                    jnp.float32(self.top_p),
+                )[0]
+            )
+            req.tokens.append(tok)
+            self.generated_tokens += 1
+            if req.max_new_tokens == 1 or tok == req.eos_token:
+                req.done = True
+                self.ctrl.release(seq)
+                finished.append(req)
+                continue
+            self._slot_req[slot] = req
+            self._occupied[slot] = True
+            self._committed_pages += need
+            self._slot_commit[slot] = need
+            self._tables[slot, : len(self.ctrl.tables[seq])] = self.ctrl.tables[seq]
+            self._positions[slot] = n
+            self._tokens[slot] = tok
+        return finished
+
+    def step(self) -> list[Request]:
+        """One engine iteration: admit into free slots, decode one chunk
+        for every occupied slot, retire finished requests.  Returns the
+        requests that finished during this step."""
+        finished = self._admit()
+        if not self._occupied.any():
+            return finished
+        # Page coverage for the whole chunk, allocated on demand.
+        for slot, req in self._slot_req.items():
+            seq = self._seq_id(slot, req)
+            table = self.ctrl.extend(seq, int(self._positions[slot]) + self.chunk)
+            self._tables[slot, : len(table)] = table
+
+        toks, self.pools = paged_decode_chunk(
+            self.params, self.pools,
+            jnp.asarray(self._tables), jnp.asarray(self._tokens),
+            jnp.asarray(self._positions), jnp.asarray(self._occupied),
+            self._next_key(), jnp.float32(self.temperature),
+            jnp.int32(self.top_k), jnp.float32(self.top_p),
+            config=self.config, chunk=self.chunk, sampling=self.sampling,
+        )
+        toks = np.asarray(toks)  # the host sync point: tokens stream out
+        self.chunks_run += 1
+        for slot in list(self._slot_req):
+            req = self._slot_req[slot]
+            for tok in toks[slot]:
+                req.tokens.append(int(tok))
+                self.generated_tokens += 1
+                if int(tok) == req.eos_token or (
+                    len(req.tokens) >= req.max_new_tokens
+                ):
+                    req.done = True
+                    break
+            self._positions[slot] += self.chunk
+            self._tokens[slot] = toks[slot, -1]
+            if req.done:
+                finished.append(self._retire(slot))
+        return finished
+
+    @property
+    def idle(self) -> bool:
+        return not self.pending and not self._occupied.any()
+
+    def run(self) -> dict[str, list[int]]:
+        """Drive step() until every submitted request has finished;
+        returns {rid: generated tokens}."""
+        out = {}
+        while not self.idle:
+            for req in self.step():
+                out[req.rid] = req.tokens
+        return out
 
 
 def serve_batch(
@@ -42,17 +325,17 @@ def serve_batch(
     prompts: jax.Array,
     max_new_tokens: int,
     ctrl: PagePool,
-    pool: jax.Array,
+    pools,
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
     rng: jax.Array | None = None,
 ):
-    """One admission batch through the paged cache: prefill as a single
-    block forward, then lockstep decode steps; pages are allocated on
-    demand and released when the batch retires.  Returns
-    (tokens [batch, max_new], pool) — the pool is donated through and
-    must be rebound by the caller."""
+    """LOCKSTEP baseline: one admission batch through the paged cache —
+    prefill as a single block forward, then per-token decode steps; pages
+    are allocated on demand and released when the whole batch retires.
+    Returns (tokens [batch, max_new], pools) — the pools are donated
+    through and must be rebound by the caller."""
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 requires an rng key")
     batch, prompt_len = prompts.shape
@@ -62,10 +345,12 @@ def serve_batch(
         ctrl.allocate(("serve", b), prompt_len)
     try:
         tables = table_array(
-            [ctrl.tables[("serve", b)] for b in range(batch)], max_pages
+            [ctrl.tables[("serve", b)] for b in range(batch)], max_pages,
+            fill=ctrl.trash,
         )
-        logits, pool = paged_prefill(
-            params, pool, tables, prompts, config, prompt_len
+        lengths = jnp.full((batch,), prompt_len, jnp.int32)
+        logits, pools = paged_prefill(
+            params, pools, tables, prompts, lengths, config
         )
         keys = (
             jax.random.split(rng, max_new_tokens)
@@ -79,10 +364,11 @@ def serve_batch(
             for b in range(batch):
                 ctrl.extend(("serve", b), pos + 1)
             tables = table_array(
-                [ctrl.tables[("serve", b)] for b in range(batch)], max_pages
+                [ctrl.tables[("serve", b)] for b in range(batch)], max_pages,
+                fill=ctrl.trash,
             )
-            logits, pool = paged_decode_step(
-                params, pool, tables, tok, jnp.int32(pos), config
+            logits, pools = paged_decode_step(
+                params, pools, tables, tok, jnp.int32(pos), config
             )
             tok = sample_logits(logits, keys[step], temperature, top_k, top_p)
             out.append(tok)
@@ -90,19 +376,19 @@ def serve_batch(
         for b in range(batch):
             if ("serve", b) in ctrl.tables:
                 ctrl.release(("serve", b))
-    return jnp.stack(out, axis=1), pool
+    return jnp.stack(out, axis=1), pools
 
 
 def main(argv=None) -> int:
-    """``python -m workloads.serve --requests 12 --batch 4`` — run a
-    stream of synthetic requests through the serving stack and report
-    tokens/s."""
+    """``python -m workloads.serve --requests 12 --slots 4`` — run a
+    stream of synthetic mixed-length requests through the continuous-
+    batching engine and report tokens/s."""
     import argparse
     import time
 
-    parser = argparse.ArgumentParser(description="serving loop example")
+    parser = argparse.ArgumentParser(description="serving engine example")
     parser.add_argument("--requests", type=int, default=12)
-    parser.add_argument("--batch", type=int, default=4)
+    parser.add_argument("--slots", type=int, default=4)
     parser.add_argument("--prompt-len", type=int, default=16)
     parser.add_argument("--max-new-tokens", type=int, default=64)
     parser.add_argument("--temperature", type=float, default=0.8)
@@ -113,8 +399,8 @@ def main(argv=None) -> int:
     parser.add_argument("--kv-heads", type=int, default=None,
                         help="grouped-query kv heads (default: n_heads)")
     args = parser.parse_args(argv)
-    if args.requests < 1 or args.batch < 1:
-        parser.error("--requests and --batch must be >= 1")
+    if args.requests < 1 or args.slots < 1:
+        parser.error("--requests and --slots must be >= 1")
 
     config = ModelConfig(
         d_model=512, n_heads=8, n_layers=4, d_ff=2048, vocab_size=8192,
@@ -130,52 +416,41 @@ def main(argv=None) -> int:
 
         params = quantize_params(params)
 
-    from .paged import init_page_pool_array
-
-    # Pool sized for one admission batch plus slack; across batches the
-    # same physical pages recycle through the free list.
-    page_size = 16
-    total = args.prompt_len + args.max_new_tokens
-    ctrl = PagePool(
-        n_pages=2 * args.batch * (-(-total // page_size)),
-        page_size=page_size,
+    engine = ServeEngine(
+        params, config, slots=args.slots, page_size=16,
+        prompt_bucket=args.prompt_len,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        rng=jax.random.PRNGKey(42),
     )
-    pool = init_page_pool_array(config, ctrl.n_pages, page_size)
+    key = jax.random.PRNGKey(7)
+    for i in range(args.requests):
+        key, k_prompt, k_len = jax.random.split(key, 3)
+        plen = int(jax.random.randint(k_len, (), 1, args.prompt_len + 1))
+        prompt = jax.random.randint(
+            k_prompt, (plen,), 0, config.vocab_size, jnp.int32
+        )
+        # Mixed lengths: the stream the engine's slot turnover exists for.
+        new = max(1, args.max_new_tokens // (1 + i % 3))
+        engine.submit([int(t) for t in prompt], new)
 
-    key = jax.random.PRNGKey(42)
-    served = 0
-    generated_tokens = 0
-    t0 = None
-    batches = -(-args.requests // args.batch)
-    for b in range(batches):
-        n = min(args.batch, args.requests - served)
-        key, k_prompt, k_sample = jax.random.split(key, 3)
-        prompts = jax.random.randint(
-            k_prompt, (n, args.prompt_len), 0, config.vocab_size, jnp.int32
-        )
-        out, pool = serve_batch(
-            params, config, prompts, args.max_new_tokens, ctrl, pool,
-            temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p, rng=k_sample,
-        )
-        jax.block_until_ready(out)
-        if t0 is None:
-            # Steady-state throughput: the first batch pays compilation.
-            t0 = time.perf_counter()
-        else:
-            generated_tokens += n * args.max_new_tokens
-        served += n
-        print(
-            f"batch {b}: served {n} requests "
-            f"(pages in use after retire: {ctrl.used_pages})",
-            flush=True,
-        )
-    elapsed = time.perf_counter() - t0 if t0 is not None else 0.0
-    rate = generated_tokens / elapsed if elapsed > 0 and generated_tokens else 0.0
+    # Warm the three compiled programs on the first step, then time the
+    # rest against a wall clock whose endpoints are REAL host readbacks
+    # (engine.step returns host tokens each chunk, so its internal sync
+    # is already a readback, not block_until_ready).
+    engine.step()
+    tokens_before = engine.generated_tokens
+    t0 = time.perf_counter()
+    while not engine.idle:
+        engine.step()
+    elapsed = time.perf_counter() - t0
+    generated = engine.generated_tokens - tokens_before
+    rate = generated / elapsed if elapsed > 0 and generated else 0.0
     print(
-        f"done: {served} requests, steady-state ≈ {rate:.0f} tok/s "
+        f"done: {args.requests} requests, {engine.generated_tokens} tokens, "
+        f"{engine.chunks_run} chunks, steady-state ≈ {rate:.0f} tok/s "
         f"(int8={args.int8}, kv_heads={config.kv_heads}, "
-        f"pool={ctrl.n_pages} pages)"
+        f"pool={engine.ctrl.n_pages} pages, "
+        f"pages in use after drain: {engine.ctrl.used_pages})"
     )
     return 0
 
