@@ -198,6 +198,9 @@ def test_engine_validates_submissions():
         engine.submit(list(range(9)), 4)
     with pytest.raises(ValueError, match="max_seq_len"):
         engine.submit([1, 2], CONFIG.max_seq_len)
+    engine.submit([1, 2], 4, rid="dup")
+    with pytest.raises(ValueError, match="already in flight"):
+        engine.submit([3, 4], 4, rid="dup")
 
 
 def test_cli_entry():

@@ -1,0 +1,118 @@
+"""Tensor-parallel serving (workloads/tp_serve.py) on the 8-device CPU
+mesh: TP cached decode and the TP serving engine emit exactly the
+single-device tokens; invalid meshes fail loudly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+from workloads.tp_serve import make_tp_generate, make_tp_serve_programs
+from workloads.train import make_mesh
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+
+
+def _params(config):
+    return init_params(config, jax.random.PRNGKey(0))
+
+
+def test_tp_generate_matches_single_device():
+    """dp x tp decode emits the single-device greedy tokens exactly."""
+    mesh = make_mesh(8, model_parallel=4)  # ("data", "model") = (2, 4)
+    params = _params(CONFIG)
+    prompts = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 8), 0, CONFIG.vocab_size, jnp.int32
+    )
+    tp_gen = make_tp_generate(CONFIG, mesh)
+    got = tp_gen(params, prompts, 12)
+    want = generate(params, prompts, CONFIG, max_new_tokens=12)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_generate_gqa_shards_kv_heads():
+    """Grouped-query decode under tensor parallelism: the kv-heads axis
+    (the scarce one) carries the model cut."""
+    config = ModelConfig(
+        max_seq_len=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        dtype=jnp.float32,
+    )
+    mesh = make_mesh(8, model_parallel=2)  # kv_heads=2 shards over mp=2
+    params = _params(config)
+    prompts = jax.random.randint(
+        jax.random.PRNGKey(2), (4, 6), 0, config.vocab_size, jnp.int32
+    )
+    got = make_tp_generate(config, mesh)(params, prompts, 10)
+    want = generate(params, prompts, config, max_new_tokens=10)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_generate_rejects_indivisible_heads():
+    config = ModelConfig(max_seq_len=64, n_layers=2, n_heads=4, n_kv_heads=2)
+    mesh = make_mesh(8, model_parallel=4)  # 4 does not divide kv_heads=2
+    with pytest.raises(ValueError, match="kv_heads"):
+        make_tp_generate(config, mesh)
+
+
+def test_tp_serve_programs_require_data_degree_one():
+    mesh = make_mesh(8, model_parallel=4)  # data degree 2
+    with pytest.raises(ValueError, match="data degree 1"):
+        make_tp_serve_programs(CONFIG, mesh, chunk=4, sampling=False)
+
+
+def test_tp_engine_matches_generate():
+    """The continuous-batching engine over a model-parallel mesh serves
+    exactly the single-device tokens — sharded pools, shard_mapped
+    kernel, mixed-length stream and all."""
+    mesh = make_mesh(4, model_parallel=4)  # ("data", "model") = (1, 4)
+    params = _params(CONFIG)
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=12, chunk=4,
+        mesh=mesh,
+    )
+    rng = np.random.default_rng(5)
+    requests = []
+    for _ in range(4):
+        plen = int(rng.integers(3, 11))
+        requests.append(
+            (list(rng.integers(0, CONFIG.vocab_size, plen)), int(rng.integers(2, 20)))
+        )
+    rids = [engine.submit(p, n) for p, n in requests]
+    served = engine.run()
+    for rid, (prompt, new) in zip(rids, requests):
+        want = generate(
+            params, jnp.asarray([prompt], jnp.int32), CONFIG,
+            max_new_tokens=new,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(served[rid]), np.asarray(want[0]),
+            err_msg=f"{rid} (prompt {len(prompt)}, new {new})",
+        )
+    assert engine.ctrl.used_pages == 0
+
+
+def test_tp_engine_gqa_window_stream():
+    """GQA + sliding window through the TP engine drains and matches the
+    single-device engine's greedy tokens."""
+    config = ModelConfig(
+        max_seq_len=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        attention_window=8, dtype=jnp.float32,
+    )
+    mesh = make_mesh(2, model_parallel=2)
+    params = _params(config)
+    kwargs = dict(slots=2, page_size=4, prompt_bucket=8, chunk=4)
+    requests = [([1, 2, 3, 4], 10), ([5, 6], 6), ([7, 8, 9], 12)]
+
+    single = ServeEngine(params, config, **kwargs)
+    for p, n in requests:
+        single.submit(p, n, rid=f"r{len(p)}-{n}")
+    want = single.run()
+
+    tp = ServeEngine(params, config, mesh=mesh, **kwargs)
+    for p, n in requests:
+        tp.submit(p, n, rid=f"r{len(p)}-{n}")
+    got = tp.run()
+    assert got == want

@@ -203,12 +203,19 @@ def _decode_core(
     token: jax.Array,
     positions: jax.Array,
     config: ModelConfig,
+    attention_fn=None,
 ):
     """One token per row through the paged cache: write the new k/v into
     each row's current page, then run the paged-attention kernel over the
     row's live pages.  positions: [batch] int32, each row's own position
     (the numerics mirror generate.decode_block token-for-token — pinned
-    by tests)."""
+    by tests).
+
+    ``attention_fn(q, k_pages, v_pages, tables, lengths, layer)``
+    overrides the attention op — the tensor-parallel path
+    (workloads/tp_serve.py) injects the kernel wrapped in a shard_map
+    over the model axis; everything else here partitions under plain
+    XLA sharding."""
     k_pages, v_pages = pools
     batch = token.shape[0]
     page_size = k_pages.shape[3]
@@ -229,10 +236,13 @@ def _decode_core(
         # lead: the target is [batch, kv_heads, head_dim].)
         k_pages = k_pages.at[i, :, page, slot].set(k[:, 0])
         v_pages = v_pages.at[i, :, page, slot].set(v[:, 0])
-        attn = paged_attention(
-            q[:, 0], k_pages, v_pages, tables, lengths,
-            layer=i, window=config.attention_window,
-        )
+        if attention_fn is None:
+            attn = paged_attention(
+                q[:, 0], k_pages, v_pages, tables, lengths,
+                layer=i, window=config.attention_window,
+            )
+        else:
+            attn = attention_fn(q[:, 0], k_pages, v_pages, tables, lengths, i)
         x = x + jnp.einsum(
             "bhk,hkd->bd", attn, weight(layer["wo"], x.dtype)
         )[:, None]
@@ -299,11 +309,25 @@ def paged_decode_chunk(
     already cover positions + chunk tokens for occupied rows.
 
     Returns (tokens [batch, chunk], pools); pools are DONATED."""
+    return _chunk_core(
+        params, pools, tables, token, positions, occupancy, rng,
+        temperature, top_k, top_p, config, chunk, sampling,
+    )
+
+
+def _chunk_core(
+    params, pools, tables, token, positions, occupancy, rng,
+    temperature, top_k, top_p, config, chunk, sampling, attention_fn=None,
+):
+    """paged_decode_chunk's body, un-jitted so the tensor-parallel path
+    can re-jit it with explicit shardings and an injected attention op."""
     keys = jax.random.split(rng, chunk)
 
     def body(carry, key):
         pools, tok, pos = carry
-        logits, pools = _decode_core(params, pools, tables, tok, pos, config)
+        logits, pools = _decode_core(
+            params, pools, tables, tok, pos, config, attention_fn
+        )
         nxt = sample_logits(
             logits, key if sampling else None, temperature, top_k, top_p
         )
@@ -343,6 +367,13 @@ def paged_prefill(
     position — and the updated pools).  Pools are DONATED.  Only the
     gathered prompt pages round-trip HBM (one gather + one scatter per
     admission, O(prompt) — the per-token path never gathers)."""
+    return _prefill_core(params, pools, tables, prompts, lengths, config)
+
+
+def _prefill_core(params, pools, tables, prompts, lengths, config):
+    """paged_prefill's body, un-jitted so the tensor-parallel path can
+    re-jit it with explicit shardings (the dense block forward inside
+    partitions under plain XLA sharding — no kernel, no shard_map)."""
     k_pages, v_pages = pools
     batch, P = prompts.shape
     page_size = k_pages.shape[3]

@@ -74,6 +74,12 @@ class ServeEngine:
     prefill width, a ``chunk`` decode length, and a page pool.  Exactly
     three programs compile (prefill, chunk, first-token sampler) no
     matter how requests arrive, finish, or interleave.
+
+    Pass ``mesh`` (a ("data", "model") Mesh with data degree 1) to serve
+    tensor-parallel across chips: params and page pools shard over the
+    model axis via workloads/tp_serve.py, and the paged-attention kernel
+    runs per-shard inside a shard_map.  Everything else — the scheduling
+    loop, page accounting, request API — is identical.
     """
 
     def __init__(
@@ -90,6 +96,7 @@ class ServeEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         rng: jax.Array | None = None,
+        mesh=None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -147,6 +154,21 @@ class ServeEngine:
             )
 
         self._first_token = first_token
+        if mesh is None:
+            self._prefill = partial(paged_prefill, config=self.config)
+            self._chunk = partial(
+                paged_decode_chunk, config=self.config, chunk=self.chunk,
+                sampling=self.sampling,
+            )
+        else:
+            from .tp_serve import make_tp_serve_programs, shard_serving_state
+
+            self._prefill, self._chunk = make_tp_serve_programs(
+                self.config, mesh, chunk=self.chunk, sampling=self.sampling
+            )
+            self.params, self.pools = shard_serving_state(
+                self.params, self.pools, self.config, mesh
+            )
 
     # ---- submission -----------------------------------------------------
 
@@ -181,6 +203,13 @@ class ServeEngine:
                 "admitted"
             )
         rid = rid if rid is not None else f"req-{next(self._ids)}"
+        in_flight = {r.rid for r in self.pending} | {
+            r.rid for r in self._slot_req.values()
+        }
+        if rid in in_flight:
+            # Loud at the call site: a duplicate would silently overwrite
+            # one request's tokens in run()'s {rid: tokens} result.
+            raise ValueError(f"request id {rid!r} is already in flight")
         req = Request(rid, prompt, max_new_tokens, eos_token)
         self.pending.append(req)
         return rid
@@ -239,9 +268,9 @@ class ServeEngine:
             )
             prompt = np.zeros((1, self.prompt_bucket), np.int32)
             prompt[0, :n] = req.prompt
-            logits, self.pools = paged_prefill(
+            logits, self.pools = self._prefill(
                 self.params, self.pools, table, jnp.asarray(prompt),
-                jnp.asarray([n], jnp.int32), self.config,
+                jnp.asarray([n], jnp.int32),
             )
             tok = int(
                 self._first_token(
@@ -279,13 +308,12 @@ class ServeEngine:
             table = self.ctrl.extend(seq, int(self._positions[slot]) + self.chunk)
             self._tables[slot, : len(table)] = table
 
-        toks, self.pools = paged_decode_chunk(
+        toks, self.pools = self._chunk(
             self.params, self.pools,
             jnp.asarray(self._tables), jnp.asarray(self._tokens),
             jnp.asarray(self._positions), jnp.asarray(self._occupied),
             self._next_key(), jnp.float32(self.temperature),
             jnp.int32(self.top_k), jnp.float32(self.top_p),
-            config=self.config, chunk=self.chunk, sampling=self.sampling,
         )
         toks = np.asarray(toks)  # the host sync point: tokens stream out
         self.chunks_run += 1
