@@ -85,7 +85,7 @@ def test_jit_and_model_integration(monkeypatch):
     import workloads.model as model_mod
     from workloads.model import ModelConfig, init_params, make_forward_fn
 
-    monkeypatch.setattr(model_mod, "_FLASH_MIN_SEQ", 1)
+    monkeypatch.setattr(model_mod, "flash_min_seq", lambda: 1)
     config = ModelConfig(max_seq_len=32, attention_impl="flash")
     params = init_params(config, jax.random.PRNGKey(0))
     tokens = jnp.zeros((2, 16), jnp.int32)

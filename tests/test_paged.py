@@ -216,11 +216,11 @@ def test_prefill_padding_never_writes_other_pages(params):
     victim = ctrl.allocate("victim", 4)
     assert victim == [0]  # the free list hands out page 0 first
     k_pages, v_pages = pools
-    sentinel_k = jnp.full_like(k_pages[:, :, 0], 7.25)
-    sentinel_v = jnp.full_like(v_pages[:, :, 0], -3.5)
+    sentinel_k = jnp.full_like(k_pages[:, 0], 7.25)
+    sentinel_v = jnp.full_like(v_pages[:, 0], -3.5)
     pools = (
-        k_pages.at[:, :, 0].set(sentinel_k),
-        v_pages.at[:, :, 0].set(sentinel_v),
+        k_pages.at[:, 0].set(sentinel_k),
+        v_pages.at[:, 0].set(sentinel_v),
     )
     # One row, true length 2 (1 real page), bucket 8 (2 prefill columns):
     # the second column pads with the DEFAULT fill 0 == the victim's page.
@@ -230,8 +230,8 @@ def test_prefill_padding_never_writes_other_pages(params):
     _, pools = paged_prefill(
         params, pools, tables, prompts, jnp.asarray([2], jnp.int32), CONFIG
     )
-    np.testing.assert_array_equal(np.asarray(pools[0][:, :, 0]), np.asarray(sentinel_k))
-    np.testing.assert_array_equal(np.asarray(pools[1][:, :, 0]), np.asarray(sentinel_v))
+    np.testing.assert_array_equal(np.asarray(pools[0][:, 0]), np.asarray(sentinel_k))
+    np.testing.assert_array_equal(np.asarray(pools[1][:, 0]), np.asarray(sentinel_v))
 
 
 def test_on_demand_allocation_uses_fewer_pages():

@@ -121,8 +121,14 @@ def test_perfbench_tiny_end_to_end():
         "flash_vs_xla_detail",
         "decode_ms_per_token",
         "decode_tokens_per_sec",
+        "paged_decode_tokens_per_sec",
+        "paged_vs_contiguous_decode",
+        "serve_tokens_per_sec",
+        "serve_requests_per_sec",
+        "serve_pool_peak_fraction",
     ):
         assert key in out, key
+    assert 0.0 < out["serve_pool_peak_fraction"] <= 1.0
     if jax.devices()[0].platform != "tpu":
         assert out["mfu"] is None  # no known peak -> omitted, not guessed
     assert out["train_step_ms"] >= 0

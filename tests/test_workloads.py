@@ -132,6 +132,43 @@ class TestLease:
             assert os.path.exists(tmp_path / "chip-tpu-0.lock")
             assert os.path.exists(tmp_path / "chip-tpu-1.lock")
 
+    def test_hold_claim_leases(self, tmp_path, monkeypatch):
+        """Lifetime declaration: no-op without the env, flocks taken and
+        held (observable via claim_lease_state) with it, idempotent, and
+        SHARED — time-sliced siblings on one chip all hold at once and
+        the chip reads alive until the LAST of them exits."""
+        import fcntl
+
+        from tpu_device_plugin.sharing import claim_lease_path, claim_lease_state
+        from workloads import lease
+
+        monkeypatch.setenv("TPU_VISIBLE_CHIPS", "tpu-0,tpu-1")
+        monkeypatch.delenv("TPU_CLAIM_LEASE_DIR", raising=False)
+        assert lease.hold_claim_leases() == 0  # non-mixed: no env, no-op
+
+        monkeypatch.setenv("TPU_CLAIM_LEASE_DIR", str(tmp_path))
+        held = lease.hold_claim_leases()
+        try:
+            assert held == 2
+            assert claim_lease_state("tpu-0", str(tmp_path)) is True
+            assert claim_lease_state("tpu-1", str(tmp_path)) is True
+            # Idempotent: the second call already declares these chips.
+            assert lease.hold_claim_leases() == 0
+            assert claim_lease_state("tpu-9", str(tmp_path)) is None
+            # A sibling's shared flock composes with ours (no blocking).
+            sib = os.open(claim_lease_path(str(tmp_path), "tpu-0"), os.O_RDWR)
+            fcntl.flock(sib, fcntl.LOCK_SH)
+        finally:
+            for fd in lease._claim_fds:
+                os.close(fd)
+            lease._claim_fds.clear()
+            lease._claim_paths.clear()
+        # One sibling still alive: the chip still reads alive.
+        assert claim_lease_state("tpu-0", str(tmp_path)) is True
+        os.close(sib)
+        # The LAST holder's exit reads as observed death.
+        assert claim_lease_state("tpu-0", str(tmp_path)) is False
+
 
 def test_busy_probe_aggregation(tmp_path, monkeypatch):
     from workloads import busy_probe
@@ -159,7 +196,7 @@ class TestGroupedQueryModel:
         from workloads.model import ModelConfig, forward, init_params
 
         # Keep the kernel in the path despite the short-seq dense routing.
-        monkeypatch.setattr(model_mod, "_FLASH_MIN_SEQ", 1)
+        monkeypatch.setattr(model_mod, "flash_min_seq", lambda: 1)
 
         base = dict(
             max_seq_len=16, n_layers=1, n_heads=4, n_kv_heads=2,
@@ -224,3 +261,25 @@ def test_flash_config_routes_short_seq_to_dense(jax_cpu):
     tokens = jnp.zeros((2, 16), jnp.int32)
     jaxpr = str(jax_cpu.make_jaxpr(lambda p, t: forward(p, t, config))(params, tokens))
     assert "pallas_call" not in jaxpr  # short seq -> dense core
+
+
+def test_flash_crossover_consults_device_kind(jax_cpu, monkeypatch):
+    """The flash/dense crossover is a per-device-kind measurement, not a
+    constant: known kinds read their measured row, unknown kinds (future
+    generations, CPU test hosts) fall back to the default instead of a
+    guess — and the bench sweep is the documented way to add a row."""
+    import workloads.model as model_mod
+
+    class _Dev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    def fake_devices(kind):
+        monkeypatch.setattr(model_mod.jax, "devices", lambda: [_Dev(kind)])
+
+    fake_devices("TPU v5 lite")
+    assert model_mod.flash_min_seq() == 2048  # measured v5e value
+    fake_devices("TPU v99 hyperdrive")
+    assert model_mod.flash_min_seq() == model_mod._FLASH_MIN_SEQ_DEFAULT
+    fake_devices("cpu")
+    assert model_mod.flash_min_seq() == model_mod._FLASH_MIN_SEQ_DEFAULT

@@ -616,6 +616,11 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
         # claims blocking the other mixed view for the full TTL.
         if self._claims is not None and allocated_chips:
             self._claims.claim(self.resource_name, allocated_chips)
+            # Fresh slate for the claim-lease evidence: a predecessor's
+            # stale (unheld) claim file must not read as the NEW pod's
+            # death once its grace passes.  Held files (live time-sliced
+            # siblings) are left alone.
+            sharing.clear_stale_claim_leases(allocated_chips, self._lease_dir)
         return response
 
     def _allocate_one(self, requested_ids: list[str]):
@@ -651,10 +656,13 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
                     host_path=DEVICE_LIST_AS_VOLUME_MOUNTS_HOST_PATH,
                 )
         for key, value in sharing.container_env(
-            chips, shared=self.shared, lease_dir=self._lease_dir
+            chips, shared=self.shared, lease_dir=self._lease_dir,
+            # Mixed-strategy allocations carry the claim-lease dir so the
+            # workload can declare its lifetime (hostPID-free release).
+            claim_lease=self._claims is not None,
         ).items():
             container.envs[key] = value
-        if self.shared:
+        if self.shared or self._claims is not None:
             for cpath, hpath, ro in sharing.lease_mounts(self._lease_dir):
                 container.mounts.add(container_path=cpath, host_path=hpath, read_only=ro)
         # Multi-host slice membership: containers get the global-slice env

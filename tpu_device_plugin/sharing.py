@@ -29,6 +29,12 @@ from .device import Chip
 DEFAULT_LEASE_DIR = "/var/run/tpu-device-plugin/leases"
 LEASE_DIR_ENV = "TPU_SHARED_LEASE_DIR"
 SHARED_ENV = "TPU_DEVICE_PLUGIN_SHARED"
+# Mixed-strategy claim lease: a per-chip flock a workload HOLDS FOR ITS
+# WHOLE LIFETIME (workloads.lease.hold_claim_leases) so the daemon's
+# ClaimLedger can observe its exit across PID namespaces — flock
+# visibility is filesystem-level, so this is the release signal that
+# works with the chart's default ``hostPID: false``.
+CLAIM_LEASE_DIR_ENV = "TPU_CLAIM_LEASE_DIR"
 
 
 def process_bounds(chips: list[Chip]) -> tuple[str, str] | None:
@@ -57,8 +63,17 @@ def process_bounds(chips: list[Chip]) -> tuple[str, str] | None:
     return ",".join(str(b) for b in box), "1,1,1"
 
 
-def container_env(chips: list[Chip], shared: bool, lease_dir: str = DEFAULT_LEASE_DIR) -> dict[str, str]:
-    """libtpu/JAX environment for a container granted ``chips``."""
+def container_env(
+    chips: list[Chip],
+    shared: bool,
+    lease_dir: str = DEFAULT_LEASE_DIR,
+    claim_lease: bool = False,
+) -> dict[str, str]:
+    """libtpu/JAX environment for a container granted ``chips``.
+
+    ``claim_lease`` (mixed strategy) additionally points the workload at
+    the claim-lease directory so it can declare its lifetime via
+    workloads.lease.hold_claim_leases — the hostPID-free release path."""
     indices = sorted(c.index for c in chips)
     env = {
         "TPU_VISIBLE_DEVICES": ",".join(str(i) for i in indices),
@@ -71,6 +86,8 @@ def container_env(chips: list[Chip], shared: bool, lease_dir: str = DEFAULT_LEAS
         env[SHARED_ENV] = "1"
         env["TPU_ALLOW_MULTIPLE_LIBTPU_LOAD"] = "1"
         env[LEASE_DIR_ENV] = lease_dir
+    if claim_lease:
+        env[CLAIM_LEASE_DIR_ENV] = lease_dir
     return env
 
 
@@ -88,6 +105,68 @@ def lease_path(lease_dir: str, chip_id: str) -> str:
     """Host path of a chip's lease file.  The naming contract is shared with
     the workload-side client (workloads.lease), which imports it from here."""
     return os.path.join(lease_dir, f"chip-{chip_id.replace('/', '_')}.lock")
+
+
+def claim_lease_path(lease_dir: str, chip_id: str) -> str:
+    """Host path of a chip's lifetime claim lease (distinct from the
+    cooperative time-slice lease: this one is held from workload start to
+    exit, not per burst)."""
+    return os.path.join(lease_dir, f"claim-{chip_id.replace('/', '_')}.lock")
+
+
+def claim_lease_state(chip_id: str, lease_dir: str = DEFAULT_LEASE_DIR):
+    """Tri-state lifetime evidence for the ClaimLedger's probe:
+
+      * True  — the claim flock is HELD: at least one declaring workload
+        is alive (holders take SHARED flocks, so time-sliced siblings on
+        one chip all count; the probe's exclusive attempt fails while
+        any of them lives).
+      * False — the claim file EXISTS but nobody holds it: every
+        workload that declared itself on this chip has exited (flocks
+        drop with the process).  Death evidence that needs no hostPID.
+      * None  — no claim file: no workload ever declared itself (a
+        non-cooperative image); prove nothing.  The plugin removes
+        STALE claim files at Allocate so a predecessor's file can never
+        condemn a non-cooperative successor.
+
+    The momentary exclusive probe can race a workload's own acquisition;
+    the workload side (workloads.lease.hold_claim_leases) therefore
+    acquires with a BLOCKING shared flock, which simply waits out the
+    probe's microsecond hold.
+    """
+    import fcntl
+
+    path = claim_lease_path(lease_dir, chip_id)
+    try:
+        fd = os.open(path, os.O_RDWR)
+    except OSError:
+        return None
+    try:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except BlockingIOError:
+            return True
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        return False
+    finally:
+        os.close(fd)
+
+
+def clear_stale_claim_leases(chip_ids: list[str], lease_dir: str = DEFAULT_LEASE_DIR) -> None:
+    """Remove STALE (existing but unheld) claim-lease files at Allocate
+    time: each new claim starts from ``None`` (nothing declared) so a
+    previous workload's leftover file cannot read as the NEW workload's
+    death.  A HELD file is left strictly alone — on a time-sliced chip it
+    is a live sibling's declaration, and the newcomer will share the same
+    inode.  (The check-then-unlink window is a bounded race: losing it
+    can only cost an early-release signal, degrading that chip to the
+    TTL fallback, never releasing a live claim by itself.)"""
+    for cid in chip_ids:
+        if claim_lease_state(cid, lease_dir) is False:
+            try:
+                os.unlink(claim_lease_path(lease_dir, cid))
+            except OSError:
+                pass
 
 
 def lease_held(chip_id: str, lease_dir: str = DEFAULT_LEASE_DIR) -> bool:

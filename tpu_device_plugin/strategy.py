@@ -60,7 +60,7 @@ def make_claim_liveness_probe(
     """Liveness probe for the mixed-strategy ClaimLedger: chip_id -> True
     (workload observably alive), False (observably gone), None (unknown).
 
-    Two signals:
+    Three signals:
       * device-node open counts (tpuinfo_chips_in_use, one /proc walk).
         A count > 0 always proves alive.  A count of 0 is only evidence of
         death when ``counts_authoritative`` — the walk sees node-wide truth
@@ -68,7 +68,13 @@ def make_claim_liveness_probe(
         for other pods' handles.  {} means the probe is unavailable.
       * lease flock held (filesystem-level, namespace-INDEPENDENT) — held
         proves alive even when the /proc walk says 0; free proves nothing
-        (exclusive pods never lease, shared pods release between bursts).
+        (shared pods release between bursts).
+      * CLAIM lease (filesystem-level too): workloads hold a per-chip
+        lifetime flock (workloads.lease.hold_claim_leases).  Held proves
+        alive; a claim FILE left unheld proves the declaring workload
+        exited — the death evidence that works under the chart's default
+        ``hostPID: false``; no file proves nothing (non-cooperative
+        image; the plugin cleared stale files at Allocate).
     """
 
     def probe(chip_ids: list[str]) -> dict:
@@ -87,12 +93,17 @@ def make_claim_liveness_probe(
         for cid in chip_ids:
             idx = index_by_id.get(cid)
             count = in_use.get(idx) if idx is not None else None
+            claim = sharing.claim_lease_state(cid, lease_dir)
             if count is not None and count > 0:
                 out[cid] = True
-            elif sharing.lease_held(cid, lease_dir):
-                # The flock outranks a zero count: a held lease is proof of
-                # life even when the walk is namespace-blind or undercounts.
+            elif claim is True or sharing.lease_held(cid, lease_dir):
+                # A held flock outranks a zero count: proof of life even
+                # when the /proc walk is namespace-blind or undercounts.
                 out[cid] = True
+            elif claim is False:
+                # The workload declared itself on this chip and its flock
+                # has dropped: it exited.  Trustworthy without hostPID.
+                out[cid] = False
             elif count == 0 and counts_authoritative:
                 out[cid] = False
             else:
@@ -255,7 +266,12 @@ class MixedStrategy(TopologyStrategy):
                 counts_authoritative=flags.claim_liveness_release,
             ),
             grace_secs=flags.mixed_claim_grace_secs,
-            allow_release=flags.claim_liveness_release,
+            # Release on observed death is always safe to allow: the probe
+            # only returns False from evidence valid in its configuration —
+            # a dropped claim-lease flock (trustworthy in any namespace,
+            # the default-chart path) or zero open counts (gated above on
+            # hostPID-backed visibility).
+            allow_release=True,
         )
         chip_rc = self.resource_config.get(CHIP_RESOURCE_KEY)
         tray_rc = self.resource_config.get(TRAY_RESOURCE_KEY)

@@ -51,10 +51,14 @@ def make_burst_fn(
 
     x = jnp.ones((matrix_dim, matrix_dim), jnp.bfloat16)
     compiled = jax.jit(chained).lower(x).compile()
+    # Synchronization is a real host READBACK, not block_until_ready: on
+    # the tunnelled single-chip target block_until_ready does not wait for
+    # the device, which would turn every busy/calibration number into a
+    # dispatch-rate measurement.
     with timed_section():
-        compiled(x).block_until_ready()  # warm-up: exclude one-time dispatch costs
+        float(compiled(x)[0, 0])  # warm-up: exclude one-time dispatch costs
         t0 = time.monotonic()
-        compiled(x).block_until_ready()
+        float(compiled(x)[0, 0])
         step_secs = max(time.monotonic() - t0, 1e-6)
     steps_per_burst = max(int(target_burst_secs / step_secs), 1)
 
@@ -62,13 +66,80 @@ def make_burst_fn(
         result = x
         for _ in range(steps_per_burst):
             result = compiled(result)
-        result.block_until_ready()
+        float(result[0, 0])  # host readback = the synchronization point
 
     return burst
 
 
-def run_probe(duration_secs: float, report_path: str | None, matrix_dim: int = 1024) -> dict:
-    burst = make_burst_fn(matrix_dim=matrix_dim, timed_section=lease.chip_lease)
+def make_train_burst_fn(target_burst_secs: float = 0.25, timed_section=nullcontext):
+    """A compute burst that is USEFUL work: full training steps of the
+    flagship transformer at a tiny scale (forward, backward, Adam), so
+    the oversubscription harness can report aggregate tokens/s — useful
+    throughput under time-slicing — next to raw chip-busy occupancy.
+
+    Returns (burst, tokens_per_burst).  Same calibration/AOT-compile
+    discipline as make_burst_fn: only the single timed calibration step
+    runs under the chip lease."""
+    from .model import ModelConfig
+    from .train import make_mesh, make_train_state, make_train_step, synthetic_batch
+
+    config = ModelConfig(
+        d_model=256, n_heads=4, n_layers=2, d_ff=1024, vocab_size=2048,
+        max_seq_len=128,
+    )
+    batch = 8
+    mesh = make_mesh(1)
+    (params, opt_state), optimizer = make_train_state(config, mesh)
+    step = make_train_step(config, mesh, optimizer)
+    tokens = synthetic_batch(config, batch)
+    # AOT compile OUTSIDE the chip lease (same discipline as
+    # make_burst_fn): a multi-second fwd+bwd+Adam compile inside the
+    # lease would starve siblings already in their measured windows.
+    compiled = step.aot_compile(params, opt_state, tokens)
+    tokens_per_step = batch * (config.max_seq_len - 1)
+    state = [params, opt_state]
+
+    with timed_section():
+        # Warm-up + calibration; float(loss) is a REAL host readback (see
+        # make_burst_fn — block_until_ready does not synchronize on the
+        # tunnelled chip).
+        state[0], state[1], loss = compiled(state[0], state[1], tokens)
+        float(loss)
+        t0 = time.monotonic()
+        state[0], state[1], loss = compiled(state[0], state[1], tokens)
+        float(loss)
+        step_secs = max(time.monotonic() - t0, 1e-6)
+    steps_per_burst = max(int(target_burst_secs / step_secs), 1)
+
+    def burst():
+        loss = None
+        for _ in range(steps_per_burst):
+            state[0], state[1], loss = compiled(state[0], state[1], tokens)
+        float(loss)  # host readback = the synchronization point
+
+    return burst, steps_per_burst * tokens_per_step
+
+
+def run_probe(
+    duration_secs: float,
+    report_path: str | None,
+    matrix_dim: int = 1024,
+    workload: str = "matmul",
+) -> dict:
+    """One pod's measured window.  workload="matmul" keeps the original
+    occupancy burst; "train" runs flagship train steps and adds a
+    ``tokens`` count to the row so the aggregate can report useful
+    throughput."""
+    lease.hold_claim_leases()  # mixed-strategy lifetime declaration
+    if workload == "train":
+        burst, tokens_per_burst = make_train_burst_fn(
+            timed_section=lease.chip_lease
+        )
+    elif workload == "matmul":
+        burst = make_burst_fn(matrix_dim=matrix_dim, timed_section=lease.chip_lease)
+        tokens_per_burst = 0
+    else:
+        raise ValueError(f"workload must be 'matmul' or 'train', got {workload!r}")
     stats = lease.run_leased_bursts(burst, duration_secs)
     stats.update(
         {
@@ -78,6 +149,8 @@ def run_probe(duration_secs: float, report_path: str | None, matrix_dim: int = 1
             "t_end": time.time(),
         }
     )
+    if tokens_per_burst:
+        stats["tokens"] = stats["bursts"] * tokens_per_burst
     if report_path:
         with open(report_path, "a") as f:
             f.write(json.dumps(stats) + "\n")
@@ -135,7 +208,7 @@ def aggregate(report_path: str) -> dict:
         chip_fractions[chip] = min(busy / max(window, 1e-9), 1.0)
     wall = max(r["wall_secs"] for r in rows)
     busy = sum(r["busy_secs"] for r in rows)
-    return {
+    out = {
         "pods": len(rows),
         "chips": len(per_chip),
         "wall_secs": wall,
@@ -143,6 +216,14 @@ def aggregate(report_path: str) -> dict:
         "per_chip_busy_fraction": chip_fractions,
         "aggregate_busy_fraction": sum(chip_fractions.values()) / len(chip_fractions),
     }
+    tokens = sum(r.get("tokens", 0) for r in rows)
+    if tokens:
+        # Useful throughput under time-slicing: total train tokens over
+        # the longest pod window — the number occupancy alone can fake
+        # but this cannot.
+        out["tokens"] = tokens
+        out["aggregate_tokens_per_sec"] = round(tokens / max(wall, 1e-9), 1)
+    return out
 
 
 def main(argv=None) -> int:
@@ -150,6 +231,9 @@ def main(argv=None) -> int:
     parser.add_argument("--duration", type=float, default=10.0)
     parser.add_argument("--report", default="")
     parser.add_argument("--matrix-dim", type=int, default=1024)
+    parser.add_argument("--workload", default="matmul", choices=["matmul", "train"],
+                        help="burst content: occupancy matmuls or flagship "
+                        "train steps (reports tokens)")
     parser.add_argument("--aggregate", action="store_true",
                         help="aggregate an existing report instead of probing")
     args = parser.parse_args(argv)
@@ -169,7 +253,9 @@ def main(argv=None) -> int:
     if args.aggregate:
         print(json.dumps(aggregate(args.report)))
         return 0
-    stats = run_probe(args.duration, args.report or None, args.matrix_dim)
+    stats = run_probe(
+        args.duration, args.report or None, args.matrix_dim, args.workload
+    )
     print(json.dumps(stats))
     return 0
 

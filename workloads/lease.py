@@ -24,8 +24,10 @@ import time
 from contextlib import contextmanager
 
 from tpu_device_plugin.sharing import (  # noqa: F401  (lease_path re-exported)
+    CLAIM_LEASE_DIR_ENV,
     DEFAULT_LEASE_DIR,
     LEASE_DIR_ENV,
+    claim_lease_path,
     lease_path,
 )
 
@@ -34,6 +36,53 @@ def chip_ids_from_env() -> list[str]:
     """Chip ids the plugin granted this pod (from TPU_VISIBLE_CHIPS)."""
     raw = os.environ.get("TPU_VISIBLE_CHIPS", "")
     return [c for c in raw.split(",") if c]
+
+
+# fds of lifetime claim leases, held until process exit (the kernel drops
+# the flocks with the fds — crash-safe by construction), and the paths
+# they cover (idempotence).
+_claim_fds: list[int] = []
+_claim_paths: set[str] = set()
+
+
+def hold_claim_leases(
+    chip_ids: list[str] | None = None, lease_dir: str | None = None
+) -> int:
+    """Declare this workload's lifetime to the device-plugin daemon.
+
+    Under the mixed strategy the daemon's ClaimLedger needs to observe
+    workload exits to release cross-view chip claims; with the chart's
+    default ``hostPID: false`` it cannot see other namespaces' /proc, so
+    the contract is filesystem-level: take a per-chip flock here and hold
+    it until the process exits.  The daemon reads held = alive, dropped =
+    exited (released within one probe interval), and treats workloads
+    that never call this as unknown (their claims fall back to the TTL).
+
+    The flock is SHARED: every pod time-sliced onto a chip holds its own
+    shared lock on the same file, the daemon's probe takes a momentary
+    exclusive lock to test for holders, and acquisition here BLOCKS —
+    which only ever waits out that probe's microsecond hold, never a
+    sibling (shared locks compose).
+
+    No-op (returns 0) when TPU_CLAIM_LEASE_DIR is absent — non-mixed
+    deployments inject no claim-lease env.  Idempotent per process.
+    Returns the number of flocks newly taken."""
+    lease_dir = lease_dir or os.environ.get(CLAIM_LEASE_DIR_ENV, "")
+    if not lease_dir:
+        return 0
+    chip_ids = sorted(chip_ids if chip_ids is not None else chip_ids_from_env())
+    os.makedirs(lease_dir, exist_ok=True)
+    taken = 0
+    for cid in chip_ids:
+        path = claim_lease_path(lease_dir, cid)
+        if path in _claim_paths:
+            continue  # this process already declares this chip
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o666)
+        fcntl.flock(fd, fcntl.LOCK_SH)
+        _claim_fds.append(fd)
+        _claim_paths.add(path)
+        taken += 1
+    return taken
 
 
 @contextmanager

@@ -234,13 +234,38 @@ def project_qkv(x: jax.Array, layer: dict):
     return q, kv[0], kv[1]
 
 
-# Routing constants for attention_impl="flash", from the perf bench's
-# measured crossover on v5e (workloads/perfbench.py flash_vs_xla_detail):
-# the dense XLA core wins below ~2k tokens where the quadratic term is
-# still cheap — but only while its [batch, heads, seq, seq] float32 score
-# matrix stays small enough not to pressure HBM.
-_FLASH_MIN_SEQ = 2048
+# Routing thresholds for attention_impl="flash": the dense XLA core wins
+# below the crossover sequence length where the quadratic term is still
+# cheap — but only while its [batch, heads, seq, seq] float32 score
+# matrix stays small enough not to pressure HBM.  The crossover is a
+# HARDWARE property (compute/bandwidth balance moves per generation), so
+# it is a per-device-kind table of MEASURED values from the perf bench's
+# flash_vs_xla_detail sweep (workloads/perfbench.py) — on v5e, flash is
+# 0.3x dense at seq 1024 and 1.6x at 2048 (BENCH_r02).  Kinds not yet
+# measured fall back to the v5e value rather than a guess dressed up as
+# data; re-run `python -m workloads.perfbench` on a new generation and
+# add its row.
+_FLASH_MIN_SEQ_BY_KIND = (
+    ("v5 lite", 2048),  # v5e, measured
+    ("v5e", 2048),
+)
+_FLASH_MIN_SEQ_DEFAULT = 2048
 _DENSE_SCORE_BYTES_CAP = 256 << 20
+
+
+def flash_min_seq() -> int:
+    """The flash/dense crossover for the device this process runs on.
+    Consulted at trace time by _attention; unknown kinds (including CPU
+    test runs, where the routing is exercised but not perf-relevant) use
+    the default."""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except RuntimeError:  # no backend — routing still needs an answer
+        return _FLASH_MIN_SEQ_DEFAULT
+    for marker, crossover in _FLASH_MIN_SEQ_BY_KIND:
+        if marker in kind:
+            return crossover
+    return _FLASH_MIN_SEQ_DEFAULT
 
 
 def _attention(
@@ -262,7 +287,7 @@ def _attention(
             )
         out = attention_fn(q, k, v)
     elif config.attention_impl == "flash" and (
-        seq >= _FLASH_MIN_SEQ
+        seq >= flash_min_seq()
         or 4 * batch * config.n_heads * seq * seq > _DENSE_SCORE_BYTES_CAP
     ):
         from workloads.ops import flash_attention

@@ -9,6 +9,16 @@ the paged cache into a contiguous view first: per-token HBM traffic is
 the live pages only, which is what makes paging a *throughput* feature
 rather than just an allocation-on-demand feature.
 
+Grid and layout are chosen for DMA efficiency (measured on v5e):
+  * pages are [kv_heads, page_size, head_dim] with the head axis INSIDE
+    the page, so one page is ONE contiguous DMA block — and the head
+    axis leads, so the kernel's kv-head-batched dots need no transpose
+    (Mosaic requires batch dims at the same operand index);
+  * a grid cell is (batch row, logical page) and computes ALL query
+    heads against the page — not one cell per (row, kv head), which
+    costs ~16x the grid overhead and splinters each page into per-head
+    strided reads.
+
 Three properties carry the serving wins:
   * per-row lengths — each sequence attends over its own history length,
     so a batch of sequences at different positions decodes in one call
@@ -18,9 +28,8 @@ Three properties carry the serving wins:
     nearest live page: Pallas skips the copy when consecutive grid steps
     map to the same block, so short rows in a long-table batch cost only
     their own pages' bandwidth;
-  * grouped-query layout — the grid fans out over (batch * kv_heads) and
-    each kernel instance computes the whole q-head group against one
-    shared k/v stream, mirroring workloads/ops/attention.py.
+  * grouped-query layout — each group of heads//kv_heads query heads
+    reads its shared k/v head once from the page block.
 
 The online-softmax accumulator lives in VMEM scratch across the
 sequential page walk, exactly like the flash kernel's k-block walk.
@@ -48,14 +57,15 @@ def _paged_decode_kernel(
     m_ref, l_ref, acc_ref,
     *, sm_scale, page_size, kv_heads, n_page_steps, window,
 ):
-    """One (batch*kv_head, logical-page) grid cell.  The page axis is the
+    """One (batch row, logical page) grid cell.  The page axis is the
     innermost (sequential) walk; (m, l, acc) persist in VMEM scratch
-    across it and reset when a new row begins.  Refs: q [group, hd],
-    k/v [page_size, hd] (the physical page the index map selected),
-    o [group, hd], scratch m/l [group, _STATS_LANES], acc [group, hd]."""
-    bh = pl.program_id(0)
+    across it and reset when a new row begins.  Refs: q [heads, hd],
+    k/v [kv_heads, page_size, hd] (the physical page the index map
+    selected), o [heads, hd], scratch m/l [heads, _STATS_LANES],
+    acc [heads, hd]."""
+    b = pl.program_id(0)
     j = pl.program_id(1)
-    length = lengths_ref[bh // kv_heads]
+    length = lengths_ref[b]
 
     @pl.when(j == 0)
     def _init():
@@ -64,12 +74,18 @@ def _paged_decode_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     def _body():
-        q = q_ref[:]
-        k = k_ref[:]
+        heads, head_dim = q_ref.shape
+        group = heads // kv_heads
+        q = q_ref[:].reshape(kv_heads, group, head_dim)
+        k = k_ref[:]  # [kv_heads, ps, hd]
         v = v_ref[:]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        # Per-kv-head batched contraction: s[n, g, t] = q[n, g, :]·k[n, t, :]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # [kv_heads, group, ps]
         k_ids = j * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (q.shape[0], page_size), 1
+            jnp.int32, (1, 1, page_size), 2
         )
         mask = k_ids < length
         if window is not None:
@@ -77,8 +93,9 @@ def _paged_decode_kernel(
             # the last ``window`` positions [length-window, length-1].
             mask &= k_ids >= length - window
         s = jnp.where(mask, s, NEG_INF)
+        s = s.reshape(heads, page_size)
 
-        m_prev = m_ref[:]                       # [group, LANES]
+        m_prev = m_ref[:]                       # [heads, LANES]
         l_prev = l_ref[:]
         m_cur = jnp.max(s, axis=-1)[:, None]
         m_new = jnp.maximum(m_prev, m_cur)      # lane-broadcast
@@ -86,9 +103,13 @@ def _paged_decode_kernel(
         p = jnp.exp(s - m_new[:, :1])
         l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1)[:, None]
         m_ref[:] = m_new
-        acc_ref[:] = acc_ref[:] * alpha[:, :1] + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32
-        )
+        # acc[n, g, :] += p[n, g, :] @ v[n, :, :]
+        pv = jax.lax.dot_general(
+            p.reshape(kv_heads, group, page_size).astype(v.dtype), v,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [kv_heads, group, hd]
+        acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv.reshape(heads, head_dim)
 
     # A page fully past the row's length — or fully before its window
     # start — contributes nothing; its compute is skipped here and its
@@ -120,7 +141,7 @@ def paged_attention(
     """Decode attention over a paged KV cache.
 
     q: [batch, heads, head_dim] — the current token's queries;
-    k_pages/v_pages: [layers, kv_heads, n_pages, page_size, head_dim]
+    k_pages/v_pages: [layers, n_pages, kv_heads, page_size, head_dim]
     (the whole pool rides in so no XLA slice materialises a copy —
     ``layer`` is folded into the BlockSpec index maps);
     tables: [batch, max_pages] int32 physical page ids (padding entries
@@ -133,11 +154,12 @@ def paged_attention(
     evenly.  Returns [batch, heads, head_dim].
 
     Hardware notes: head_dim should be a multiple of 128 and page_size a
-    multiple of 8 for clean Mosaic tiling at speed (any sizes work in
-    interpret mode; Mosaic pads small operands on hardware).
+    multiple of 8 (16 for bf16) for clean Mosaic tiling at speed (any
+    sizes work in interpret mode; Mosaic pads small operands on
+    hardware).
     """
     batch, heads, head_dim = q.shape
-    layers, kv_heads, n_pages, page_size, hd2 = k_pages.shape
+    layers, n_pages, kv_heads, page_size, hd2 = k_pages.shape
     if hd2 != head_dim:
         raise ValueError(
             f"head_dim mismatch: q has {head_dim}, pages have {hd2}"
@@ -156,20 +178,12 @@ def paged_attention(
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
     _check_gqa(heads, kv_heads)
-    group = heads // kv_heads
     max_pages = tables.shape[1]
     sm_scale = 1.0 / (head_dim**0.5)
     if interpret is None:
         interpret = _default_interpret()
 
-    # [batch, heads, hd] -> [batch*kv_heads, group, hd]; head h maps to
-    # kv head h // group — the same grouping convention as the flash
-    # kernel and the dense grouped core.
-    qf = q.reshape(batch * kv_heads, group, head_dim)
-
-    def kv_map(bh, j, tables_ref, lengths_ref):
-        b = bh // kv_heads
-        h = bh % kv_heads
+    def kv_map(b, j, tables_ref, lengths_ref):
         length = lengths_ref[b]
         last = (length - 1) // page_size
         j_eff = jnp.minimum(j, last)
@@ -178,25 +192,29 @@ def paged_attention(
             # first live page, so their DMA is elided too.
             first = jnp.maximum(length - window, 0) // page_size
             j_eff = jnp.maximum(j_eff, jnp.minimum(first, last))
-        return (layer, h, tables_ref[b, j_eff], 0, 0)
+        return (layer, tables_ref[b, j_eff], 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(batch * kv_heads, max_pages),
+        grid=(batch, max_pages),
         in_specs=[
             pl.BlockSpec(
-                (None, group, head_dim), lambda bh, j, t, l: (bh, 0, 0)
+                (None, heads, head_dim), lambda b, j, t, l: (b, 0, 0)
             ),
-            pl.BlockSpec((None, None, None, page_size, head_dim), kv_map),
-            pl.BlockSpec((None, None, None, page_size, head_dim), kv_map),
+            pl.BlockSpec(
+                (None, None, kv_heads, page_size, head_dim), kv_map
+            ),
+            pl.BlockSpec(
+                (None, None, kv_heads, page_size, head_dim), kv_map
+            ),
         ],
         out_specs=pl.BlockSpec(
-            (None, group, head_dim), lambda bh, j, t, l: (bh, 0, 0)
+            (None, heads, head_dim), lambda b, j, t, l: (b, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((group, _STATS_LANES), jnp.float32),  # m
-            pltpu.VMEM((group, _STATS_LANES), jnp.float32),  # l
-            pltpu.VMEM((group, head_dim), jnp.float32),      # acc
+            pltpu.VMEM((heads, _STATS_LANES), jnp.float32),  # m
+            pltpu.VMEM((heads, _STATS_LANES), jnp.float32),  # l
+            pltpu.VMEM((heads, head_dim), jnp.float32),      # acc
         ],
     )
     out = pl.pallas_call(
@@ -209,7 +227,7 @@ def paged_attention(
             window=window,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=(
                 pltpu.GridDimensionSemantics.PARALLEL,
@@ -217,5 +235,5 @@ def paged_attention(
             ),
         ),
         interpret=interpret,
-    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), qf, k_pages, v_pages)
-    return out.reshape(batch, heads, head_dim)
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), q, k_pages, v_pages)
+    return out

@@ -119,6 +119,7 @@ def run(
     duration_secs: float = 8.0,
     matrix_dim: int = 512,
     platform: str | None = None,
+    workload: str = "matmul",
 ) -> dict:
     from tpu_device_plugin.api import pb, rpc
     from workloads import busy_probe
@@ -167,6 +168,8 @@ def run(
                             str(duration_secs),
                             "--matrix-dim",
                             str(matrix_dim),
+                            "--workload",
+                            workload,
                             "--report",
                             report,
                         ],
@@ -231,6 +234,9 @@ def main(argv=None) -> int:
     parser.add_argument("--pods", type=int, default=8)
     parser.add_argument("--duration", type=float, default=8.0)
     parser.add_argument("--matrix-dim", type=int, default=512)
+    parser.add_argument("--workload", default="matmul", choices=["matmul", "train"],
+                        help="pod burst content; 'train' reports aggregate "
+                        "useful tokens/s next to the busy fraction")
     parser.add_argument(
         "--platform",
         default=None,
@@ -245,6 +251,7 @@ def main(argv=None) -> int:
         duration_secs=args.duration,
         matrix_dim=args.matrix_dim,
         platform=args.platform,
+        workload=args.workload,
     )
     value = agg["aggregate_busy_fraction"]
     print(

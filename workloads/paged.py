@@ -27,12 +27,14 @@ Logits are numerically identical to the contiguous cache (pinned by
 tests against workloads/generate.py decode_step).
 
 Pool layout: two arrays (k, v), each
-``[layers, kv_heads, n_pages + 1, page_size, head_dim]`` — kv_heads
-outermost so one page for one head is a contiguous [page_size, head_dim]
-DMA block.  The extra LAST page is a sacrificial TRASH page: table
-padding entries point at it, so scatters from padded prompt positions or
-unoccupied batch slots land somewhere harmless (reads never see it —
-per-row lengths mask it out and its DMA is elided by the kernel).
+``[layers, n_pages + 1, kv_heads, page_size, head_dim]`` — the head axis
+INSIDE the page, so one page (all heads) is one contiguous DMA block and
+one kernel grid cell computes every head of a row (see
+workloads/ops/paged_attention.py).  The extra LAST page is a sacrificial
+TRASH page: table padding entries point at it, so writes from padded
+prompt positions or unoccupied batch slots land somewhere harmless
+(reads never see it — per-row lengths mask it out and its DMA is elided
+by the kernel).
 
 Reference pendant: none — the reference daemon has no model code; part of
 the JAX serving workloads (SURVEY.md §7 step 8).
@@ -71,6 +73,9 @@ class PagePool:
     free: list = field(init=False)
     tables: dict = field(init=False, default_factory=dict)  # seq_id -> [int]
     refcounts: dict = field(init=False, default_factory=dict)  # page -> int
+    # High-water mark of concurrently-held pages — what a bench reports
+    # to show memory ∝ tokens actually held, not ∝ worst case.
+    peak_used: int = field(init=False, default=0)
 
     def __post_init__(self):
         self.free = list(range(self.n_pages - 1, -1, -1))
@@ -100,6 +105,7 @@ class PagePool:
         for p in table:
             self.refcounts[p] = 1
         self.tables[seq_id] = table
+        self.peak_used = max(self.peak_used, self.used_pages)
         return table
 
     def extend(self, seq_id, n_tokens: int) -> list:
@@ -111,6 +117,7 @@ class PagePool:
             page = self.free.pop()
             self.refcounts[page] = 1
             table.append(page)
+        self.peak_used = max(self.peak_used, self.used_pages)
         return table
 
     def fork(self, parent_id, child_id, shared_tokens: int) -> list:
@@ -156,11 +163,11 @@ class PagePool:
 def init_page_pools(
     config: ModelConfig, n_pages: int, page_size: int
 ) -> tuple[jax.Array, jax.Array]:
-    """The device-side (k, v) pools, each [layers, kv_heads, n_pages + 1,
-    page_size, head_dim].  The last page is the TRASH page (see module
-    docstring); PagePool(n_pages, ...) manages the first n_pages."""
+    """The device-side (k, v) pools, each [layers, n_pages + 1,
+    kv_heads, page_size, head_dim].  The last page is the TRASH page (see
+    module docstring); PagePool(n_pages, ...) manages the first n_pages."""
     shape = (
-        config.n_layers, config.kv_heads, n_pages + 1, page_size,
+        config.n_layers, n_pages + 1, config.kv_heads, page_size,
         config.head_dim,
     )
     return jnp.zeros(shape, config.dtype), jnp.zeros(shape, config.dtype)
@@ -194,6 +201,22 @@ def _rope_rows(x: jax.Array, angles: jax.Array) -> jax.Array:
     sin = jnp.sin(angles)[:, None, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _write_slots(
+    pool: jax.Array, layer: int, page: jax.Array, slot: jax.Array,
+    new: jax.Array,
+) -> jax.Array:
+    """Write new[b] ([batch, kv_heads, head_dim]) into
+    pool[layer, page[b], :, slot[b]] row by row via dynamic_update_slice
+    (in-place on a donated/carried pool; see _decode_core)."""
+    for b in range(new.shape[0]):
+        pool = jax.lax.dynamic_update_slice(
+            pool,
+            new[b][None, None, :, None].astype(pool.dtype),
+            (layer, page[b], 0, slot[b], 0),
+        )
+    return pool
 
 
 def _decode_core(
@@ -230,12 +253,13 @@ def _decode_core(
         h = _rmsnorm(x, layer["ln1"])
         q, k, v = project_qkv(h, layer)  # [b, 1, H|Hkv, hd]
         q, k = _rope_rows(q, angles), _rope_rows(k, angles)
-        # Scatter this token's k/v into each row's current page slot.
-        # (The int layer index and the [batch] page/slot arrays are
-        # separated by the head slice, so the advanced-index result dims
-        # lead: the target is [batch, kv_heads, head_dim].)
-        k_pages = k_pages.at[i, :, page, slot].set(k[:, 0])
-        v_pages = v_pages.at[i, :, page, slot].set(v[:, 0])
+        # Write this token's k/v into each row's current page slot with
+        # per-row dynamic_update_slice, NOT an advanced-index scatter:
+        # XLA aliases dus on a loop-carried buffer in place (the standard
+        # KV-cache pattern), while a gather/scatter op may copy the whole
+        # pool every layer — measured at ~6x the entire step cost.
+        k_pages = _write_slots(k_pages, i, page, slot, k[:, 0])
+        v_pages = _write_slots(v_pages, i, page, slot, v[:, 0])
         if attention_fn is None:
             attn = paged_attention(
                 q[:, 0], k_pages, v_pages, tables, lengths,
@@ -383,7 +407,7 @@ def _prefill_core(params, pools, tables, prompts, lengths, config):
     # (always the pools' last page by construction) before they are
     # ever written.  Reads are unaffected: the length mask and the
     # kernel's DMA clamp already exclude them.
-    trash = k_pages.shape[2] - 1
+    trash = k_pages.shape[1] - 1
     real_pages = (lengths.astype(jnp.int32) + page_size - 1) // page_size
     col = jnp.arange(prefill_pages)[None, :]
     t_pp = jnp.where(
@@ -393,8 +417,8 @@ def _prefill_core(params, pools, tables, prompts, lengths, config):
     # Gathered view of just the prompt-covering pages, in decode_block's
     # contiguous-cache layout [L, 2, b, pp*ps, Hkv, hd].
     def view_of(pool):
-        g = pool[:, :, t_pp]  # [L, Hkv, b, pp, ps, hd]
-        g = jnp.transpose(g, (0, 2, 3, 4, 1, 5))
+        g = pool[:, t_pp]  # [L, b, pp, Hkv, ps, hd]
+        g = jnp.transpose(g, (0, 1, 2, 4, 3, 5))  # [L, b, pp, ps, Hkv, hd]
         return g.reshape(
             g.shape[0], batch, prefill_pages * page_size, *g.shape[4:]
         )
@@ -418,8 +442,8 @@ def _prefill_core(params, pools, tables, prompts, lengths, config):
     # does not matter.
     pv = view.reshape(
         view.shape[0], 2, batch, prefill_pages, page_size, *view.shape[4:]
-    )
-    pv = jnp.transpose(pv, (0, 1, 5, 2, 3, 4, 6))  # [L, 2, Hkv, b, pp, ps, hd]
-    k_pages = k_pages.at[:, :, t_pp].set(pv[:, 0])
-    v_pages = v_pages.at[:, :, t_pp].set(pv[:, 1])
+    )  # [L, 2, b, pp, ps, Hkv, hd]
+    pv = jnp.transpose(pv, (0, 1, 2, 3, 5, 4, 6))  # [L, 2, b, pp, Hkv, ps, hd]
+    k_pages = k_pages.at[:, t_pp].set(pv[:, 0])
+    v_pages = v_pages.at[:, t_pp].set(pv[:, 1])
     return logits, (k_pages, v_pages)

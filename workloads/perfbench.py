@@ -109,6 +109,8 @@ class BenchScale:
     attn_seqs: tuple[int, ...]
     decode_prompt: int
     decode_lens: tuple[int, int]
+    page_size: int
+    serve_chunks: tuple[int, int]
 
     @classmethod
     def named(cls, name: str) -> "BenchScale":
@@ -119,7 +121,7 @@ class BenchScale:
                 d_model=2048, n_heads=16, n_layers=8, d_ff=8192, vocab=32768,
                 seq=2048, batch=8, attn_heads=8,
                 attn_seqs=(1024, 2048, 4096), decode_prompt=32,
-                decode_lens=(64, 512),
+                decode_lens=(64, 512), page_size=64, serve_chunks=(1, 8),
             )
         if name == "tiny":
             # n_heads=4 so the tensor-parallel cut divides even on the
@@ -128,6 +130,7 @@ class BenchScale:
                 d_model=64, n_heads=4, n_layers=2, d_ff=128, vocab=256,
                 seq=128, batch=2, attn_heads=2,
                 attn_seqs=(128,), decode_prompt=4, decode_lens=(4, 12),
+                page_size=4, serve_chunks=(1, 3),
             )
         raise ValueError(f"unknown bench scale {name!r} (full|tiny)")
 
@@ -335,6 +338,142 @@ def measure_decode(scale: BenchScale) -> dict:
     }
 
 
+def measure_paged_decode(scale: BenchScale) -> dict:
+    """Paged chunked decode (Pallas block-table kernel, one dispatch per
+    page-size chunk) vs the contiguous scan decode at the same batch —
+    the VERDICT round-2 bar: paged must not cost throughput for its
+    allocation-on-demand win.  Greedy, same weights/dtype discipline as
+    measure_decode; per-token seconds from the slope over CHUNK counts
+    (prefill and constant dispatch costs cancel)."""
+    import numpy as np
+
+    from .paged import (
+        PagePool,
+        init_page_pools,
+        paged_decode_chunk,
+        paged_prefill,
+        table_array,
+    )
+
+    config = _model_config(scale)
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype), init_params(config, jax.random.PRNGKey(0))
+    )
+    batch, ps = scale.batch, scale.page_size
+    chunk = ps
+    lo, hi = scale.serve_chunks
+    prompt_len = scale.decode_prompt
+    max_pages = -(-(prompt_len + 1 + hi * chunk) // ps)
+    n_pages = batch * max_pages
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, config.vocab_size,
+        jnp.int32,
+    )
+    lengths = jnp.full((batch,), prompt_len, jnp.int32)
+    occupancy = jnp.ones((batch,), bool)
+    key = jax.random.PRNGKey(2)
+
+    def run_chunks(n_chunks: int) -> float:
+        ctrl = PagePool(n_pages=n_pages, page_size=ps)
+        pools = init_page_pools(config, n_pages, ps)
+        for b in range(batch):
+            ctrl.allocate(b, prompt_len)
+        tables = table_array(
+            [ctrl.tables[b] for b in range(batch)], max_pages, fill=ctrl.trash
+        )
+        logits, pools = paged_prefill(
+            params, pools, tables, prompt, lengths, config
+        )
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        positions = np.full(batch, prompt_len, np.int64)
+        for _ in range(n_chunks):
+            for b in range(batch):
+                ctrl.extend(b, int(positions[b]) + chunk)
+            tables = table_array(
+                [ctrl.tables[b] for b in range(batch)], max_pages,
+                fill=ctrl.trash,
+            )
+            toks, pools = paged_decode_chunk(
+                params, pools, tables, tok,
+                jnp.asarray(positions, jnp.int32), occupancy, key,
+                jnp.float32(0.0), jnp.int32(0), jnp.float32(1.0),
+                config=config, chunk=chunk, sampling=False,
+            )
+            tok = toks[:, -1]
+            positions += chunk
+        return float(tok[0])
+
+    secs_per_chunk = measure_slope_secs(
+        run_chunks, n_lo=lo, n_hi=hi, min_window_secs=0.0, max_n=hi
+    )
+    per_token = secs_per_chunk / chunk
+    return {
+        "paged_decode_ms_per_token": round(per_token * 1000, 4),
+        "paged_decode_tokens_per_sec": round(batch / per_token, 1),
+        "paged_page_size": ps,
+    }
+
+
+def measure_serve(scale: BenchScale) -> dict:
+    """The COMPOSED serving path on the chip: the continuous-batching
+    engine end-to-end — paged pools, Pallas paged attention, int8
+    weight-only bases, temperature/top-k/top-p sampling, per-chunk host
+    readbacks and page accounting included.  Slope over chunk counts, so
+    admission/prefill/compile constants cancel and what remains is the
+    sustained serve loop."""
+    from .quant import quantize_params
+    from .serve import ServeEngine
+
+    config_kw = dict(
+        vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
+        n_layers=scale.n_layers, d_ff=scale.d_ff,
+    )
+    batch, ps = scale.batch, scale.page_size
+    chunk = ps
+    lo, hi = scale.serve_chunks
+    prompt_len = scale.decode_prompt
+    from .model import ModelConfig as _MC
+
+    config = _MC(
+        **config_kw, max_seq_len=prompt_len + 1 + hi * chunk
+    )
+    params = quantize_params(
+        jax.tree.map(
+            lambda w: w.astype(config.dtype),
+            init_params(config, jax.random.PRNGKey(0)),
+        )
+    )
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(1), (prompt_len,), 0, config.vocab_size, jnp.int32
+    )]
+    peak_fraction = [0.0]
+
+    def run_chunks(n_chunks: int) -> float:
+        engine = ServeEngine(
+            params, config, slots=batch, page_size=ps, chunk=chunk,
+            prompt_bucket=prompt_len, temperature=0.8, top_k=50, top_p=0.95,
+            rng=jax.random.PRNGKey(3),
+        )
+        for _ in range(batch):
+            engine.submit(prompt, 1 + n_chunks * chunk)
+        engine.run()
+        peak_fraction[0] = engine.ctrl.peak_used / engine.ctrl.n_pages
+        return float(engine.generated_tokens)
+
+    secs_per_chunk = measure_slope_secs(
+        run_chunks, n_lo=lo, n_hi=hi, min_window_secs=0.0, max_n=hi
+    )
+    per_token = secs_per_chunk / chunk
+    tokens_per_sec = batch / per_token
+    request_tokens = 1 + hi * chunk
+    return {
+        "serve_tokens_per_sec": round(tokens_per_sec, 1),
+        "serve_requests_per_sec": round(tokens_per_sec / request_tokens, 3),
+        "serve_request_tokens": request_tokens,
+        "serve_pool_peak_fraction": round(peak_fraction[0], 4),
+    }
+
+
 def run(scale_name: str = "full") -> dict:
     """The full perf suite as one flat dict (bench.py merges it into the
     JSON line)."""
@@ -351,6 +490,13 @@ def run(scale_name: str = "full") -> dict:
     }
     out.update(measure_window(scale))
     out.update(measure_decode(scale))
+    out.update(measure_paged_decode(scale))
+    # Paged-vs-contiguous: the round-2 VERDICT bar (>= 1.0 means paging
+    # costs nothing for its on-demand-allocation and prefix-sharing wins).
+    out["paged_vs_contiguous_decode"] = round(
+        out["paged_decode_tokens_per_sec"] / out["decode_tokens_per_sec"], 3
+    )
+    out.update(measure_serve(scale))
     return out
 
 

@@ -430,6 +430,10 @@ def main(argv=None) -> int:
     if args.requests < 1 or args.slots < 1:
         parser.error("--requests and --slots must be >= 1")
 
+    from . import lease
+
+    lease.hold_claim_leases()  # mixed-strategy lifetime declaration
+
     config = ModelConfig(
         d_model=512, n_heads=8, n_layers=4, d_ff=2048, vocab_size=8192,
         max_seq_len=args.prompt_len + args.max_new_tokens,

@@ -106,9 +106,9 @@ def make_tp_generate(config: ModelConfig, mesh: Mesh):
     return tp_generate
 
 
-# Pool sharding: [layers, KV_HEADS, pages, page_size, head_dim] — the
+# Pool sharding: [layers, pages, KV_HEADS, page_size, head_dim] — the
 # kv-heads axis is the tensor-parallel cut, mirroring the cache above.
-_POOL_SPEC = P(None, "model", None, None, None)
+_POOL_SPEC = P(None, None, "model", None, None)
 
 
 def _tp_paged_attention(config: ModelConfig, mesh: Mesh):

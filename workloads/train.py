@@ -125,6 +125,27 @@ def make_sharded_train_step(
         )
         return train_step(params, opt_state, frozen, *placed)
 
+    def aot_compile(params, opt_state, *batch):
+        """Compile the step WITHOUT executing it (jit's .lower().compile())
+        and return a callable with the same (params, opt_state, *batch)
+        signature.  For callers that must not touch the device before a
+        scheduling point — e.g. the busy probe compiles before taking the
+        cooperative chip lease, so a multi-second compile never starves
+        time-sliced siblings."""
+        placed = tuple(
+            jax.device_put(b, s) for b, s in zip(batch, batch_shardings)
+        )
+        compiled = train_step.lower(params, opt_state, frozen, *placed).compile()
+
+        def run(params, opt_state, *batch):
+            placed = tuple(
+                jax.device_put(b, s) for b, s in zip(batch, batch_shardings)
+            )
+            return compiled(params, opt_state, frozen, *placed)
+
+        return run
+
+    step.aot_compile = aot_compile
     return step
 
 
@@ -331,6 +352,13 @@ def main(argv=None) -> int:
         "daemon-injected slice env; ignored on single-host containers)",
     )
     args = parser.parse_args(argv)
+
+    # Mixed-strategy pods declare their lifetime so the daemon releases
+    # cross-view chip claims the moment this process exits (no-op when
+    # the claim-lease env is absent).
+    from . import lease
+
+    lease.hold_claim_leases()
 
     # Multi-host slice container? Wire jax.distributed from the env the
     # device plugin injected at Allocate time; no-op on a single host.

@@ -132,6 +132,10 @@ def main(argv=None) -> int:
     if args.steps < 1:
         parser.error("--steps must be >= 1")
 
+    from . import lease
+
+    lease.hold_claim_leases()  # mixed-strategy lifetime declaration
+
     from .train import make_sharded_train_state
 
     config = VisionConfig()
