@@ -205,6 +205,14 @@ int64_t LargestPciBar(const std::string& root, int index) {
 // (driver truth), explicit TPUINFO_HBM_GIB operator override (deliberate
 // under/over-advertising must beat any heuristic), PCI BAR aperture
 // (hardware-derived), generation table (assumption of last resort).
+//
+// PROVENANCE NOTE (round-3 probe, docs/discovery-probe-axon-v5e.json):
+// the "tpu_hbm_bytes" attribute name is a best-effort first tier that no
+// real driver has been observed to expose — the probed bench host
+// surfaces no accel sysfs class at all; the tiers that resolved there
+// are the TPU_ACCELERATOR_TYPE env contract and the JAX/libtpu runtime
+// (TPU_DP_RUNTIME_PROBE overlay, backend/tpu.py).  Treat sysfs here as
+// speculative-until-confirmed, NOT as the expected common path.
 int64_t HbmBytes(const std::string& root, int index, const std::string& accel_type,
                  bool* measured, std::string* source) {
   int64_t v;
@@ -260,6 +268,10 @@ bool ParseTriple(const std::string& s, int32_t out[3]) {
 
 // Per-chip ICI coordinates from the driver: <sysfs>/device/tpu_coords as
 // "x,y,z".  The strongest coordinate source when a driver provides it.
+// PROVENANCE NOTE: like tpu_hbm_bytes above, this attribute name is
+// speculative — the probed environments resolve coords from the
+// host-bounds metadata tier or the runtime overlay instead (see
+// docs/discovery-probe-axon-v5e.json).
 bool SysfsCoords(const std::string& root, int index, int32_t out[3]) {
   std::string s;
   std::string p = JoinRoot(root, "/sys/class/accel/accel") +

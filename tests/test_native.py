@@ -157,6 +157,78 @@ def test_tpu_chip_manager_end_to_end(lib_path, fake_tree):
         mgr.shutdown()
 
 
+def test_runtime_probe_overlays_weak_provenance(lib_path, fake_tree, monkeypatch):
+    """TPU_DP_RUNTIME_PROBE=1: runtime-measured coords/HBM replace
+    assumed/table values (this fake tree has no tpu_coords, so coords are
+    assumed) and the provenance records the upgrade; a failing probe
+    degrades to the native view."""
+    from tpu_device_plugin.backend import tpu as tpu_backend
+    from tpu_device_plugin.backend.tpu import TpuChipManager
+
+    monkeypatch.setenv(tpu_backend.RUNTIME_PROBE_ENV, "1")
+    runtime_devices = [
+        {
+            "id": i, "platform": "tpu", "device_kind": "TPU v5 lite",
+            "coords": [i, 1, 0], "hbm_bytes_limit": 15 << 30,
+        }
+        for i in range(4)
+    ]
+    monkeypatch.setattr(
+        "tpu_device_plugin.probe_discovery.probe_runtime",
+        lambda: {"available": True, "devices": runtime_devices},
+    )
+    mgr = TpuChipManager(driver_root=fake_tree, lib_path=lib_path)
+    mgr.init()
+    try:
+        prov = mgr.topology().provenance
+        assert prov["coords_source"] == "runtime" and prov["coords_measured"]
+        # HBM was MEASURED from sysfs (stronger than table) — the runtime
+        # overlay must not touch it.
+        assert prov["hbm_source"] != "runtime"
+        devs = mgr.devices()
+        assert [tuple(c.coords) for c in devs] == [(i, 1, 0) for i in range(4)]
+        assert all(c.hbm_gib == 16 for c in devs)  # sysfs value kept
+        assert mgr.topology().chips_by_id["tpu-2"].coords == (2, 1, 0)
+    finally:
+        mgr.shutdown()
+
+    # Probe failure: native view survives untouched.
+    monkeypatch.setattr(
+        "tpu_device_plugin.probe_discovery.probe_runtime",
+        lambda: {"available": False, "error": "no devices"},
+    )
+    mgr2 = TpuChipManager(driver_root=fake_tree, lib_path=lib_path)
+    mgr2.init()
+    try:
+        assert mgr2.topology().provenance["coords_source"] != "runtime"
+    finally:
+        mgr2.shutdown()
+
+
+def test_probe_discovery_tool_on_fake_tree(lib_path, fake_tree, monkeypatch):
+    """The operator probe CLI reports the tiers that resolve under a
+    given driver root (here: dev nodes + sysfs + native; no env, no
+    metadata, no runtime requested)."""
+    monkeypatch.setenv("TPUINFO_LIBRARY", lib_path)
+    monkeypatch.setenv("TPU_SKIP_MDS_QUERY", "1")
+    for var in ("TPU_ACCELERATOR_TYPE", "TPU_CHIPS_PER_HOST_BOUNDS"):
+        monkeypatch.delenv(var, raising=False)
+    from tpu_device_plugin.probe_discovery import run_probe
+
+    report = run_probe(driver_root=fake_tree)
+    assert report["dev_nodes"]["available"]
+    assert report["sysfs"]["available"]
+    assert report["sysfs"]["devices"]["accel0"]["tpu_hbm_bytes"] == str(16 << 30)
+    assert report["sysfs"]["devices"]["accel0"]["tpu_coords"] is None
+    assert report["native"]["available"]
+    assert report["native"]["n_chips"] == 4
+    assert report["metadata_server"] == {
+        "available": False, "skipped": "TPU_SKIP_MDS_QUERY set",
+    }
+    assert "env" not in report["resolved_tiers"]
+    assert set(report["resolved_tiers"]) >= {"dev_nodes", "sysfs", "native"}
+
+
 def test_tpu_chip_manager_chipless_node_fails_init(lib_path, tmp_path):
     from tpu_device_plugin.backend import BackendInitError
     from tpu_device_plugin.backend.tpu import TpuChipManager

@@ -11,6 +11,7 @@ failOnInitError policy decides what happens next.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 
@@ -18,6 +19,18 @@ from ..device import Chip, HealthEvent
 from ..topology import Topology
 from . import BackendInitError, ChipManager
 from .native import NativeTpuInfo, NativeUnavailableError
+
+# Opt-in runtime discovery tier: when "1", init() runs a throwaway
+# SUBPROCESS that initialises the JAX/libtpu runtime once, and overlays
+# its measured per-chip coords / HBM limits wherever the native tiers
+# only reached "assumed"/"table" provenance.  Off by default because it
+# momentarily opens the chips (the subprocess exits immediately, but a
+# workload racing that window would fail its exclusive open).  The probe
+# record for this project's environments lives in docs/ (see
+# tpu_device_plugin/probe_discovery.py).
+RUNTIME_PROBE_ENV = "TPU_DP_RUNTIME_PROBE"
+# Provenance tiers that runtime measurements outrank.
+_WEAK_SOURCES = ("assumed", "table")
 
 
 class TpuChipManager(ChipManager):
@@ -44,6 +57,43 @@ class TpuChipManager(ChipManager):
                 f"no TPU chips found under {self._driver_root!r}/dev"
             )
         self._topology = self._native.topology()
+        if os.environ.get(RUNTIME_PROBE_ENV) == "1":
+            self._apply_runtime_probe()
+
+    def _apply_runtime_probe(self) -> None:
+        """Overlay runtime-measured coords/HBM onto weakly-sourced native
+        discovery (see RUNTIME_PROBE_ENV).  Runtime devices map to chips
+        in enumeration order — both sides enumerate the host's chips in
+        device-index order.  Any failure degrades to the native view."""
+        from ..probe_discovery import probe_runtime
+
+        result = probe_runtime()
+        if not result.get("available"):
+            logging.getLogger(__name__).warning(
+                "runtime discovery probe unavailable (%s); keeping native "
+                "provenance", result.get("error", "no TPU devices"),
+            )
+            return
+        by_index = {
+            i: d for i, d in enumerate(
+                d for d in result["devices"] if d["platform"] == "tpu"
+            )
+        }
+        prov = dict(self._topology.provenance or {})
+        chips = sorted(self._topology.chips_by_id.values(), key=lambda c: c.index)
+        coords_weak = prov.get("coords_source") in _WEAK_SOURCES
+        hbm_weak = prov.get("hbm_source") in _WEAK_SOURCES
+        for pos, chip in enumerate(chips):
+            dev = by_index.get(pos)
+            if dev is None:
+                continue
+            if coords_weak and len(dev.get("coords") or []) == 3:
+                chip.coords = tuple(dev["coords"])
+                prov.update(coords_measured=True, coords_source="runtime")
+            if hbm_weak and dev.get("hbm_bytes_limit"):
+                chip.hbm_bytes = int(dev["hbm_bytes_limit"])
+                prov.update(hbm_measured=True, hbm_source="runtime")
+        self._topology.provenance = prov or None
 
     def shutdown(self) -> None:
         if self._native is not None:
@@ -53,7 +103,10 @@ class TpuChipManager(ChipManager):
 
     def devices(self) -> list[Chip]:
         self._require_init()
-        return self._native.chips()
+        # The topology's chip objects, not a fresh native enumeration: the
+        # runtime-probe overlay (when enabled) patched these in place, and
+        # serving one set keeps devices()/topology() consistent.
+        return sorted(self._topology.chips_by_id.values(), key=lambda c: c.index)
 
     def topology(self) -> Topology:
         self._require_init()

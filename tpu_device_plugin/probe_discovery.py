@@ -1,0 +1,243 @@
+"""Probe every TPU discovery surface on THIS host and report provenance.
+
+The daemon's discovery stack is tiered (native/tpuinfo.cc): device nodes
+from /dev, attributes from sysfs, host-shape contracts from the Cloud TPU
+VM environment/metadata server, and a spec table as the floor.  Which
+tier actually resolves is a property of the HOST (bare-metal TPU VM, GKE
+node, tunnelled dev box...), so this tool walks all of them and prints
+one JSON report — the committed artifacts in docs/ record what resolved
+on the environments the project has touched, and an operator can run it
+anywhere the daemon misbehaves:
+
+    python -m tpu_device_plugin.probe_discovery [--runtime] [--driver-root /]
+
+``--runtime`` additionally spawns a SUBPROCESS that initialises the JAX
+TPU runtime and reports device kind/coords/memory (then exits, releasing
+the chips — the probing process itself never touches the runtime, for
+the same reason the daemon must not: libtpu ownership is exclusive).
+
+Reference pendant: none — the reference trusts NVML for everything
+(vendor/.../nvml/nvml.go:592-658); TPU hosts have no single NVML, hence
+the tiers and this prober.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+# The exact sysfs attribute names native/tpuinfo.cc reads (tier 1).
+SYSFS_ATTRS = (
+    "numa_node",
+    "tpu_coords",
+    "tpu_hbm_bytes",
+    "tpu_error_count",
+    "tpu_app_error_count",
+)
+# Cloud TPU VM environment contracts (tier 2) + local tunnel markers.
+ENV_KEYS = (
+    "TPU_ACCELERATOR_TYPE",
+    "TPU_CHIPS_PER_HOST_BOUNDS",
+    "TPU_HOST_BOUNDS",
+    "TPU_WORKER_ID",
+    "TPU_SKIP_MDS_QUERY",
+    "JAX_PLATFORMS",
+    "PALLAS_AXON_TPU_GEN",
+    "PALLAS_AXON_POOL_IPS",
+)
+_METADATA_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/"
+    "attributes/accelerator-type"
+)
+
+
+def probe_dev_nodes(driver_root: str = "/") -> dict:
+    accel = sorted(glob.glob(os.path.join(driver_root, "dev", "accel*")))
+    vfio = sorted(glob.glob(os.path.join(driver_root, "dev", "vfio", "*")))
+    return {
+        "available": bool(accel),
+        "accel_nodes": accel,
+        "vfio_nodes": vfio,
+    }
+
+
+def probe_sysfs(driver_root: str = "/") -> dict:
+    base = os.path.join(driver_root, "sys", "class", "accel")
+    out = {"available": os.path.isdir(base), "class_dir": base, "devices": {}}
+    if not out["available"]:
+        return out
+    for dev in sorted(os.listdir(base)):
+        attrs = {}
+        for attr in SYSFS_ATTRS:
+            path = os.path.join(base, dev, "device", attr)
+            try:
+                with open(path) as f:
+                    attrs[attr] = f.read().strip()
+            except OSError:
+                attrs[attr] = None
+        out["devices"][dev] = attrs
+    return out
+
+
+def probe_pci(driver_root: str = "/") -> dict:
+    """Google vendor-id (0x1ae0) PCI functions — the BAR-size HBM tier."""
+    base = os.path.join(driver_root, "sys", "bus", "pci", "devices")
+    found = []
+    for dev in sorted(glob.glob(os.path.join(base, "*"))):
+        try:
+            with open(os.path.join(dev, "vendor")) as f:
+                vendor = f.read().strip()
+        except OSError:
+            continue
+        if vendor.lower() == "0x1ae0":
+            entry = {"path": dev, "vendor": vendor}
+            try:
+                with open(os.path.join(dev, "device")) as f:
+                    entry["device"] = f.read().strip()
+            except OSError:
+                pass
+            found.append(entry)
+    return {"available": bool(found), "google_functions": found}
+
+
+def probe_env() -> dict:
+    values = {k: os.environ.get(k) for k in ENV_KEYS}
+    return {
+        "available": any(
+            values[k] for k in ("TPU_ACCELERATOR_TYPE", "TPU_CHIPS_PER_HOST_BOUNDS")
+        ),
+        "values": values,
+    }
+
+
+def probe_metadata_server(timeout: float = 2.0) -> dict:
+    """GCE metadata server accelerator-type (tier 2b).  Honors
+    TPU_SKIP_MDS_QUERY the way libtpu does."""
+    if os.environ.get("TPU_SKIP_MDS_QUERY"):
+        return {"available": False, "skipped": "TPU_SKIP_MDS_QUERY set"}
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        _METADATA_URL, headers={"Metadata-Flavor": "Google"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return {"available": True, "accelerator_type": resp.read().decode()}
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        return {"available": False, "error": str(e)}
+
+
+def probe_native(driver_root: str = "/") -> dict:
+    """Attempt the daemon's own native discovery (libtpuinfo) and report
+    its provenance verdict."""
+    from .backend import BackendInitError
+    from .backend.tpu import TpuChipManager
+
+    mgr = TpuChipManager(driver_root=driver_root)
+    try:
+        mgr.init()
+    except BackendInitError as e:
+        return {"available": False, "error": str(e)}
+    try:
+        topo = mgr.topology()
+        return {
+            "available": True,
+            "n_chips": len(mgr.devices()),
+            "provenance": topo.provenance,
+            "chips": [
+                {"id": c.id, "coords": list(c.coords), "hbm_gib": c.hbm_gib}
+                for c in mgr.devices()
+            ],
+        }
+    finally:
+        mgr.shutdown()
+
+
+_RUNTIME_SNIPPET = """
+import json, sys
+import jax
+devs = jax.devices()
+out = []
+for d in devs:
+    entry = {
+        "id": d.id,
+        "platform": d.platform,
+        "device_kind": d.device_kind,
+        "coords": list(getattr(d, "coords", []) or []),
+        "core_on_chip": getattr(d, "core_on_chip", None),
+    }
+    try:
+        ms = d.memory_stats()
+        entry["hbm_bytes_limit"] = (ms or {}).get("bytes_limit")
+    except Exception:
+        entry["hbm_bytes_limit"] = None
+    out.append(entry)
+print(json.dumps(out))
+"""
+
+
+def probe_runtime(timeout: float = 120.0) -> dict:
+    """JAX/libtpu runtime view, from a SUBPROCESS so the chips are
+    released the moment the probe exits.  The strongest source available
+    on hosts without local device nodes (e.g. tunnelled chips)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _RUNTIME_SNIPPET],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except (subprocess.TimeoutExpired, OSError) as e:
+        return {"available": False, "error": str(e)}
+    if proc.returncode != 0:
+        return {
+            "available": False,
+            "error": proc.stderr.strip()[-500:] or f"exit {proc.returncode}",
+        }
+    try:
+        devices = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"available": False, "error": f"unparseable probe output: {e}"}
+    tpu = [d for d in devices if d["platform"] == "tpu"]
+    return {"available": bool(tpu), "devices": devices}
+
+
+def run_probe(driver_root: str = "/", runtime: bool = False) -> dict:
+    report = {
+        "driver_root": driver_root,
+        "dev_nodes": probe_dev_nodes(driver_root),
+        "sysfs": probe_sysfs(driver_root),
+        "pci": probe_pci(driver_root),
+        "env": probe_env(),
+        "metadata_server": probe_metadata_server(),
+        "native": probe_native(driver_root),
+    }
+    if runtime:
+        report["runtime"] = probe_runtime()
+    report["resolved_tiers"] = [
+        name for name, r in report.items()
+        if isinstance(r, dict) and r.get("available")
+    ]
+    return report
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="probe TPU discovery surfaces and report provenance"
+    )
+    parser.add_argument("--driver-root", default="/")
+    parser.add_argument(
+        "--runtime", action="store_true",
+        help="also probe the JAX/libtpu runtime from a throwaway subprocess",
+    )
+    args = parser.parse_args(argv)
+    print(json.dumps(run_probe(args.driver_root, args.runtime), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
