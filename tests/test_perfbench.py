@@ -135,6 +135,31 @@ def test_perfbench_tiny_end_to_end():
     assert set(out["flash_vs_xla_detail"]) == {"128"}
 
 
+def test_mfu_sweep_hardware_flops_accounting():
+    """HFU accounting: flash recompute adds one forward-attention pass;
+    remat adds one full layer-stack forward on top."""
+    from workloads.mfu_sweep import POINTS, SweepPoint, hardware_flops
+    from workloads.perfbench import train_step_flops
+
+    p = SweepPoint("x", d_model=8, n_heads=2, n_layers=3, d_ff=16,
+                   vocab=100, seq=5, batch=2)
+    config = p.config()
+    s = 4
+    fwd_attn = 3 * 2 * (4 * s * s * 8) * 0.5
+    base = train_step_flops(config, 2)
+    assert hardware_flops(config, 2) == base + fwd_attn
+
+    r = SweepPoint("y", d_model=8, n_heads=2, n_layers=3, d_ff=16,
+                   vocab=100, seq=5, batch=2, remat=True)
+    rconfig = r.config()
+    p_layers = 3 * (2 * 8 * 8 + 2 * 8 * (2 * 4) + 2 * 8 * 16)
+    extra_layers_fwd = 2 * 2 * s * p_layers + fwd_attn
+    assert hardware_flops(rconfig, 2) == base + fwd_attn + extra_layers_fwd
+    # Registry sanity: every point builds a valid config.
+    for point in POINTS.values():
+        point.config()
+
+
 def test_train_step_flops_gqa_counts_smaller_kv():
     from workloads.model import ModelConfig
 

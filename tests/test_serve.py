@@ -187,6 +187,76 @@ def test_engine_rejects_never_admittable_request():
         engine.submit(list(range(1, 8)), 30)
 
 
+def test_fanout_shares_prompt_pages_and_matches_greedy():
+    """submit_fanout: N samples of one prompt hold its full prompt pages
+    ONCE (refcounted fork), each member still emits exactly generate()'s
+    greedy tokens, and everything releases at drain."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine = ServeEngine(
+        params, CONFIG, slots=3, page_size=4, prompt_bucket=12, chunk=4
+    )
+    prompt = list(range(2, 12))  # 10 tokens: 2 full shared pages + tail
+    rids = engine.submit_fanout(prompt, 6, n_samples=3)
+    # Admit all three members, then check the sharing arithmetic while
+    # they are live: 2 shared pages + 3 private tail pages + decode pages.
+    finished = engine.step()
+    assert not finished
+    full = engine.ctrl.pages_needed(len(prompt))  # 3 pages unshared
+    independent_first_chunk = 3 * engine.ctrl.pages_needed(len(prompt) + 4)
+    shared_prefix_pages = len(prompt) // 4  # 2
+    # Sharing must be VISIBLE in the accounting: 2 shared + 3x(own tail
+    # + first decode page) = 8 < the 12 unshared allocation would hold.
+    assert engine.ctrl.used_pages == shared_prefix_pages + 3 * 2
+    assert engine.ctrl.used_pages < 3 * full  # a fortiori < unshared
+    assert engine.ctrl.used_pages < independent_first_chunk
+    # The shared pages are refcounted, not duplicated: the three tables
+    # start with the same physical pages.
+    tables = [
+        engine.ctrl.tables[engine._seq_id(s, engine._slot_req[s])]
+        for s in range(3)
+    ]
+    for t in tables[1:]:
+        assert t[:shared_prefix_pages] == tables[0][:shared_prefix_pages]
+        assert t[shared_prefix_pages] != tables[0][shared_prefix_pages]
+
+    served = engine.run()
+    want = generate(
+        params, jnp.asarray([prompt], jnp.int32), CONFIG, max_new_tokens=6
+    )
+    for rid in rids:
+        np.testing.assert_array_equal(np.asarray(served[rid]), np.asarray(want[0]))
+    assert engine.ctrl.used_pages == 0
+
+
+def test_fanout_sampling_diverges():
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine = ServeEngine(
+        params, CONFIG, slots=4, page_size=4, prompt_bucket=8, chunk=4,
+        temperature=1.0, rng=jax.random.PRNGKey(9),
+    )
+    rids = engine.submit_fanout([1, 2, 3, 4, 5], 8, n_samples=4)
+    served = engine.run()
+    assert len({tuple(served[r]) for r in rids}) >= 2  # samples diverge
+    assert engine.ctrl.used_pages == 0
+
+
+def test_fanout_short_prompt_degrades_to_independent():
+    """A prompt shorter than one page has nothing shareable; the fan-out
+    still serves correctly."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=8, prompt_bucket=8, chunk=8
+    )
+    rids = engine.submit_fanout([1, 2, 3], 5, n_samples=2)
+    served = engine.run()
+    want = generate(
+        params, jnp.asarray([[1, 2, 3]], jnp.int32), CONFIG, max_new_tokens=5
+    )
+    for rid in rids:
+        np.testing.assert_array_equal(np.asarray(served[rid]), np.asarray(want[0]))
+    assert engine.ctrl.used_pages == 0
+
+
 def test_engine_validates_submissions():
     import pytest
 

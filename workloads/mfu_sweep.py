@@ -1,0 +1,150 @@
+"""MFU experiment sweep: where does the non-MFU fraction go?
+
+One-shot harness behind the perf bench (workloads/perfbench.py): times
+the full training step across model shape, batch, sequence length and
+remat variants on the real chip, reporting per-point MFU (useful model
+FLOPs / time / peak) AND HFU (hardware FLOPs including the flash
+backward's recompute and layer-remat recompute / time / peak) — the
+difference is the price of memory-saving recompute, which MFU by
+convention does not credit.
+
+Run: ``python -m workloads.mfu_sweep [--points base,b16,...]``; prints
+one JSON line per point.  The committed record for this project's chip
+lives in docs/MFU_EXPERIMENTS.md, and the winner feeds
+perfbench.BenchScale.
+
+Reference pendant: none — the reference publishes no perf numbers at all
+(SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+import jax
+
+from .model import ModelConfig, loss_fn
+from .perfbench import device_peak_flops, measure_slope_secs, train_step_flops
+
+
+@dataclass(frozen=True)
+class SweepPoint:
+    name: str
+    d_model: int = 2048
+    n_heads: int = 16
+    n_layers: int = 8
+    d_ff: int = 8192
+    vocab: int = 32768
+    seq: int = 2048
+    batch: int = 8
+    remat: bool = False
+
+    def config(self) -> ModelConfig:
+        return ModelConfig(
+            vocab_size=self.vocab, d_model=self.d_model, n_heads=self.n_heads,
+            n_layers=self.n_layers, d_ff=self.d_ff, max_seq_len=self.seq,
+            attention_impl="flash", remat_layers=self.remat,
+        )
+
+
+# The sweep: batch scaling, longer sequences (where the flash kernel's
+# O(block) VMEM keeps compiling), deeper/wider shapes, and remat trades.
+POINTS = {
+    "base": SweepPoint("base"),
+    "b16": SweepPoint("b16", batch=16),
+    "b32": SweepPoint("b32", batch=32),
+    "seq4k_b4": SweepPoint("seq4k_b4", seq=4096, batch=4),
+    "seq4k_b8": SweepPoint("seq4k_b8", seq=4096, batch=8),
+    "deep_l16": SweepPoint("deep_l16", n_layers=16),
+    "wide_d2560": SweepPoint(
+        "wide_d2560", d_model=2560, n_heads=20, d_ff=10240
+    ),
+    "remat_b16": SweepPoint("remat_b16", batch=16, remat=True),
+    "remat_b32": SweepPoint("remat_b32", batch=32, remat=True),
+    "remat_seq4k_b8": SweepPoint("remat_seq4k_b8", seq=4096, batch=8, remat=True),
+}
+
+
+def hardware_flops(config: ModelConfig, batch: int) -> float:
+    """train_step_flops plus the recompute the hardware actually executes:
+    the flash backward recomputes attention probabilities (one extra
+    forward-attention pass), and remat_layers recomputes each layer's
+    whole forward once more in the backward."""
+    model = train_step_flops(config, batch)
+    d, s = config.d_model, config.max_seq_len - 1
+    fwd_attn = config.n_layers * batch * (4 * s * s * d) * 0.5
+    extra = fwd_attn  # flash bwd probability recompute
+    if config.remat_layers:
+        # One full extra forward of the layer stack (not the unembed).
+        kv_proj = 2 * d * (config.kv_heads * config.head_dim)
+        p_layers = config.n_layers * (2 * d * d + kv_proj + 2 * d * config.d_ff)
+        extra += 2 * batch * s * p_layers + fwd_attn
+    return model + extra
+
+
+def measure_point(point: SweepPoint) -> dict:
+    from .train import make_mesh, make_sharded_train_step, make_train_state, synthetic_batch
+
+    config = point.config()
+    mesh = make_mesh()
+    (params, opt_state), optimizer = make_train_state(config, mesh)
+    step = make_sharded_train_step(
+        lambda p, t: loss_fn(p, t, config), mesh, optimizer
+    )
+    tokens = synthetic_batch(config, point.batch)
+    state = [params, opt_state]
+
+    def chain(n: int) -> float:
+        for _ in range(n):
+            state[0], state[1], loss = step(state[0], state[1], tokens)
+        return float(loss)
+
+    secs = measure_slope_secs(chain, n_lo=4, n_hi=12)
+    peak = device_peak_flops()
+    model_flops = train_step_flops(config, point.batch)
+    hw_flops = hardware_flops(config, point.batch)
+    step_tokens = point.batch * (config.max_seq_len - 1)
+    return {
+        "point": point.name,
+        "batch": point.batch,
+        "seq": config.max_seq_len,
+        "layers": config.n_layers,
+        "d_model": config.d_model,
+        "remat": point.remat,
+        "step_ms": round(secs * 1000, 3),
+        "tokens_per_sec": round(step_tokens / secs, 1),
+        "mfu": round(model_flops / secs / peak, 4) if peak else None,
+        "hfu": round(hw_flops / secs / peak, 4) if peak else None,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="MFU experiment sweep")
+    parser.add_argument(
+        "--points", default=",".join(POINTS),
+        help="comma-separated subset of: " + ", ".join(POINTS),
+    )
+    args = parser.parse_args(argv)
+
+    from . import lease
+
+    lease.hold_claim_leases()  # mixed-strategy lifetime declaration
+
+    names = [n for n in args.points.split(",") if n]
+    unknown = [n for n in names if n not in POINTS]
+    if unknown:
+        parser.error(f"unknown points: {unknown}")
+    for name in names:
+        try:
+            result = measure_point(POINTS[name])
+        except Exception as e:  # OOM etc: record, keep sweeping
+            result = {"point": name, "error": f"{type(e).__name__}: {e}"[:300]}
+        print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -52,6 +52,12 @@ class ModelConfig:
     # kernel (with block-level compute skip), the dense core, and the
     # cached decode.
     attention_window: int | None = None
+    # Rematerialise each transformer layer in the backward pass
+    # (jax.checkpoint): activations are recomputed instead of stored,
+    # trading ~one extra forward of FLOPs for O(layers) less activation
+    # memory — the knob that buys a bigger batch (and with it, MFU) when
+    # HBM, not FLOPs, is the binding constraint.
+    remat_layers: bool = False
 
     def __post_init__(self):
         if self.attention_impl not in ("native", "flash"):
@@ -315,9 +321,15 @@ def forward(
 ) -> jax.Array:
     """Logits for next-token prediction.  tokens: [batch, seq] int32."""
     x = params["embed"].astype(config.dtype)[tokens]
-    for layer in params["layers"]:
+
+    def layer_step(x, layer):
         x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, config, attention_fn)
-        x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
+        return x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
+
+    if config.remat_layers:
+        layer_step = jax.checkpoint(layer_step)
+    for layer in params["layers"]:
+        x = layer_step(x, layer)
     # Final projection in float32 for a stable softmax/loss.
     return x.astype(jnp.float32) @ weight(params["unembed"], jnp.float32)
 

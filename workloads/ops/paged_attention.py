@@ -76,16 +76,17 @@ def _paged_decode_kernel(
     def _body():
         heads, head_dim = q_ref.shape
         group = heads // kv_heads
-        q = q_ref[:].reshape(kv_heads, group, head_dim)
         k = k_ref[:]  # [kv_heads, ps, hd]
         v = v_ref[:]
-        # Per-kv-head batched contraction: s[n, g, t] = q[n, g, :]·k[n, t, :]
+        q = q_ref[:].reshape(kv_heads, group, head_dim)
+        # Per-kv-head batched: s[n, g, t] = q[n, g, :]·k[n, t, :]
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        ) * sm_scale  # [kv_heads, group, ps]
+        ) * sm_scale
+        s = s.reshape(heads, page_size)
         k_ids = j * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, page_size), 2
+            jnp.int32, (1, page_size), 1
         )
         mask = k_ids < length
         if window is not None:
@@ -93,7 +94,6 @@ def _paged_decode_kernel(
             # the last ``window`` positions [length-window, length-1].
             mask &= k_ids >= length - window
         s = jnp.where(mask, s, NEG_INF)
-        s = s.reshape(heads, page_size)
 
         m_prev = m_ref[:]                       # [heads, LANES]
         l_prev = l_ref[:]
@@ -103,13 +103,12 @@ def _paged_decode_kernel(
         p = jnp.exp(s - m_new[:, :1])
         l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1)[:, None]
         m_ref[:] = m_new
-        # acc[n, g, :] += p[n, g, :] @ v[n, :, :]
         pv = jax.lax.dot_general(
             p.reshape(kv_heads, group, page_size).astype(v.dtype), v,
             (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )  # [kv_heads, group, hd]
-        acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv.reshape(heads, head_dim)
+        ).reshape(heads, head_dim)
+        acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv
 
     # A page fully past the row's length — or fully before its window
     # start — contributes nothing; its compute is skipped here and its
@@ -125,6 +124,39 @@ def _paged_decode_kernel(
         l = l_ref[:][:, :1]
         l_safe = jnp.where(l > 0, l, 1.0)  # fully-dead rows (empty slots)
         o_ref[:] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_attention_xla(
+    q, k_pages, v_pages, tables, lengths, *, layer, window
+):
+    """Gathered-view fallback with the kernel's exact semantics, for
+    shapes Mosaic cannot lay out (narrow head dims).  Gathers the rows'
+    table-mapped pages into a dense [batch, T, kv_heads, hd] view and
+    masks by per-row length — O(T) HBM per token, which is fine for the
+    small models that land here."""
+    batch, heads, head_dim = q.shape
+    kv_heads, page_size = k_pages.shape[2], k_pages.shape[3]
+    group = heads // kv_heads
+    max_pages = tables.shape[1]
+
+    def view(pool):
+        g = pool[layer][tables]  # [b, maxp, Hkv, ps, hd]
+        g = jnp.transpose(g, (0, 1, 3, 2, 4))
+        return g.reshape(batch, max_pages * page_size, kv_heads, head_dim)
+
+    k, v = view(k_pages), view(v_pages)
+    qg = q.reshape(batch, kv_heads, group, head_dim)
+    s = jnp.einsum(
+        "bngk,btnk->bngt", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) / (head_dim**0.5)
+    ids = jnp.arange(max_pages * page_size)
+    mask = ids[None, :] < lengths[:, None]
+    if window is not None:
+        mask &= ids[None, :] >= (lengths - window)[:, None]
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bngt,btnk->bngk", p, v.astype(jnp.float32))
+    return out.reshape(batch, heads, head_dim).astype(q.dtype)
 
 
 def paged_attention(
@@ -153,10 +185,12 @@ def paged_attention(
     kv_heads may be fewer than heads (grouped-query); heads must divide
     evenly.  Returns [batch, heads, head_dim].
 
-    Hardware notes: head_dim should be a multiple of 128 and page_size a
-    multiple of 8 (16 for bf16) for clean Mosaic tiling at speed (any
-    sizes work in interpret mode; Mosaic pads small operands on
-    hardware).
+    Hardware notes: the Pallas kernel runs when head_dim is a multiple
+    of 128 and page_size a multiple of 8 — the serving shapes (narrower
+    dims trip Mosaic's layout inference on the group-axis reshapes).
+    Anything else on hardware routes through a gathered-view XLA
+    fallback with identical semantics, so small demo/test models still
+    serve; interpret mode (off-TPU) always uses the kernel code path.
     """
     batch, heads, head_dim = q.shape
     layers, n_pages, kv_heads, page_size, hd2 = k_pages.shape
@@ -182,6 +216,10 @@ def paged_attention(
     sm_scale = 1.0 / (head_dim**0.5)
     if interpret is None:
         interpret = _default_interpret()
+    if not interpret and (head_dim % 128 or page_size % 8):
+        return _paged_attention_xla(
+            q, k_pages, v_pages, tables, lengths, layer=layer, window=window
+        )
 
     def kv_map(b, j, tables_ref, lengths_ref):
         length = lengths_ref[b]

@@ -57,7 +57,8 @@ from .paged import (
 class Request:
     """One sequence through the engine.  ``tokens`` accumulates generated
     tokens (the prompt is not echoed); ``done`` flips at ``max_new_tokens``
-    or on ``eos_token``."""
+    or on ``eos_token``.  ``group`` ties fan-out siblings to their shared
+    prompt pages (see ServeEngine.submit_fanout)."""
 
     rid: str
     prompt: list[int]
@@ -65,6 +66,7 @@ class Request:
     eos_token: int | None = None
     tokens: list[int] = field(default_factory=list)
     done: bool = False
+    group: str | None = None
 
 
 class ServeEngine:
@@ -141,6 +143,8 @@ class ServeEngine:
         # worst-case gated.
         self._committed_pages = 0
         self._slot_commit: dict[int, int] = {}
+        # Fan-out groups (submit_fanout): gid -> admission bookkeeping.
+        self._groups: dict[str, dict] = {}
         # Telemetry for benchmarking and tests.
         self.chunks_run = 0
         self.generated_tokens = 0
@@ -214,6 +218,38 @@ class ServeEngine:
         self.pending.append(req)
         return rid
 
+    def submit_fanout(
+        self,
+        prompt,
+        max_new_tokens: int | None = None,
+        n_samples: int = 2,
+        *,
+        eos_token: int | None = None,
+    ) -> list[str]:
+        """N independent samples of one prompt SHARING its prompt pages.
+
+        The first admitted member allocates and prefills the group's
+        shared full prompt pages once; every member forks them read-only
+        (PagePool refcounts) and owns only its partial tail page and its
+        generated tokens — an N-way fan-out stores the prompt's k/v one
+        time instead of N.  With temperature 0 all members emit the same
+        greedy tokens (pinned by tests); sampling makes them diverge.
+        Returns the member request ids."""
+        if n_samples < 1:
+            raise ValueError(f"n_samples must be >= 1, got {n_samples}")
+        gid = f"grp-{next(self._ids)}"
+        # submit() validates on (prompt, max_new_tokens) alone and the
+        # rids are engine-generated here, so if the FIRST submit passes
+        # every member passes: a validation error propagates before any
+        # member is queued, leaving nothing to clean up.
+        rids = []
+        for _ in range(n_samples):
+            rid = self.submit(prompt, max_new_tokens, eos_token=eos_token)
+            self.pending[-1].group = gid  # appended last by submit()
+            rids.append(rid)
+        self._groups[gid] = {"members_left": n_samples, "allocated": False}
+        return rids
+
     # ---- engine internals ----------------------------------------------
 
     def _next_key(self) -> jax.Array:
@@ -242,6 +278,31 @@ class ServeEngine:
         self._tokens[slot] = 0
         return req
 
+    def _allocate_group_member(self, req: Request, seq, n: int) -> None:
+        """Pages for a fan-out member: fork the group's shared full prompt
+        pages (allocated by the first member to arrive) read-only, own
+        only the partial tail page.  Each member's prefill re-scatters the
+        shared pages with identical bytes — safe by the fork contract —
+        so no cross-member sequencing is needed."""
+        g = self._groups[req.group]
+        shared = (n // self.page_size) * self.page_size
+        gseq = ("group", req.group)
+        if shared and not g["allocated"]:
+            self.ctrl.allocate(gseq, shared)
+            g["allocated"] = True
+        if shared:
+            self.ctrl.fork(gseq, seq, shared)
+            if n > shared:
+                self.ctrl.extend(seq, n)
+        else:  # prompt shorter than one page: nothing shareable
+            self.ctrl.allocate(seq, n)
+        g["members_left"] -= 1
+        if g["members_left"] == 0:
+            # Pages stay alive through the members' refcounts.
+            if g["allocated"]:
+                self.ctrl.release(gseq)
+            del self._groups[req.group]
+
     def _admit(self) -> list[Request]:
         """Fill free slots from the pending queue: allocate pages for the
         true prompt, prefill (one compiled batch-1 call per admission),
@@ -262,7 +323,10 @@ class ServeEngine:
             req = self.pending.popleft()
             seq = self._seq_id(slot, req)
             n = len(req.prompt)
-            self.ctrl.allocate(seq, n)
+            if req.group is not None:
+                self._allocate_group_member(req, seq, n)
+            else:
+                self.ctrl.allocate(seq, n)
             table = table_array(
                 [self.ctrl.tables[seq]], self.max_pages, fill=self.ctrl.trash
             )
