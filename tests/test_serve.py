@@ -151,6 +151,45 @@ def test_engine_sampling_stream_runs():
         assert all(0 <= t < CONFIG.vocab_size for t in served[rid])
 
 
+def test_chunked_prefill_serves_long_prompts():
+    """Prompts longer than the prefill bucket are admitted via
+    page-aligned chunked prefill and still emit exactly generate()'s
+    tokens; the bucket remains the compile-shape bound."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8, chunk=4
+    )
+    rng = np.random.default_rng(13)
+    requests = []
+    for plen in (9, 23, 37, 8):  # 2, 3, 5 chunks and the 1-chunk path
+        prompt = list(rng.integers(0, CONFIG.vocab_size, plen))
+        requests.append((prompt, int(rng.integers(2, 12))))
+    rids = [engine.submit(p, n) for p, n in requests]
+    served = engine.run()
+    for rid, (prompt, new) in zip(rids, requests):
+        want = generate(
+            params, jnp.asarray([prompt], jnp.int32), CONFIG,
+            max_new_tokens=new,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(served[rid]), np.asarray(want[0]),
+            err_msg=f"{rid} (prompt {len(prompt)})",
+        )
+    assert engine.ctrl.used_pages == 0
+
+
+def test_submit_rejects_past_context():
+    import pytest
+
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine = ServeEngine(
+        params, CONFIG, slots=1, page_size=4, prompt_bucket=8, chunk=4
+    )
+    engine.submit(list(range(CONFIG.max_seq_len - 1)), 1)  # at the cap
+    with pytest.raises(ValueError, match="prompt length"):
+        engine.submit(list(range(CONFIG.max_seq_len)), 1)
+
+
 def test_engine_backpressure_defers_admission():
     """A pool too small for every slot at once serializes admissions
     instead of dying mid-stream: allocate/extend can never raise because
@@ -226,6 +265,9 @@ def test_fanout_shares_prompt_pages_and_matches_greedy():
     for rid in rids:
         np.testing.assert_array_equal(np.asarray(served[rid]), np.asarray(want[0]))
     assert engine.ctrl.used_pages == 0
+    # Shared COMPUTE too: one prefill served all three members (siblings
+    # copy the retained tail page and reuse the cached logits).
+    assert engine.prefills_run == 1
 
 
 def test_fanout_sampling_diverges():
@@ -264,8 +306,6 @@ def test_engine_validates_submissions():
     engine = ServeEngine(params, CONFIG, slots=1, page_size=4, prompt_bucket=8)
     with pytest.raises(ValueError, match="prompt length"):
         engine.submit([], 4)
-    with pytest.raises(ValueError, match="prompt length"):
-        engine.submit(list(range(9)), 4)
     with pytest.raises(ValueError, match="max_seq_len"):
         engine.submit([1, 2], CONFIG.max_seq_len)
     engine.submit([1, 2], 4, rid="dup")

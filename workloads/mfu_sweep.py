@@ -22,10 +22,14 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass
 
-import jax
-
-from .model import ModelConfig, loss_fn
-from .perfbench import device_peak_flops, measure_slope_secs, train_step_flops
+from .model import ModelConfig
+from .perfbench import (
+    device_peak_flops,
+    fwd_attn_flops,
+    layer_matmul_params,
+    time_train_step,
+    train_step_flops,
+)
 
 
 @dataclass(frozen=True)
@@ -70,37 +74,21 @@ def hardware_flops(config: ModelConfig, batch: int) -> float:
     """train_step_flops plus the recompute the hardware actually executes:
     the flash backward recomputes attention probabilities (one extra
     forward-attention pass), and remat_layers recomputes each layer's
-    whole forward once more in the backward."""
-    model = train_step_flops(config, batch)
-    d, s = config.d_model, config.max_seq_len - 1
-    fwd_attn = config.n_layers * batch * (4 * s * s * d) * 0.5
-    extra = fwd_attn  # flash bwd probability recompute
+    whole forward once more in the backward.  Both terms reuse
+    perfbench's accounting primitives — one source of truth."""
+    extra = fwd_attn_flops(config, batch)  # flash bwd probability recompute
     if config.remat_layers:
         # One full extra forward of the layer stack (not the unembed).
-        kv_proj = 2 * d * (config.kv_heads * config.head_dim)
-        p_layers = config.n_layers * (2 * d * d + kv_proj + 2 * d * config.d_ff)
-        extra += 2 * batch * s * p_layers + fwd_attn
-    return model + extra
+        tokens = batch * (config.max_seq_len - 1)
+        extra += 2 * tokens * layer_matmul_params(config) + fwd_attn_flops(
+            config, batch
+        )
+    return train_step_flops(config, batch) + extra
 
 
 def measure_point(point: SweepPoint) -> dict:
-    from .train import make_mesh, make_sharded_train_step, make_train_state, synthetic_batch
-
     config = point.config()
-    mesh = make_mesh()
-    (params, opt_state), optimizer = make_train_state(config, mesh)
-    step = make_sharded_train_step(
-        lambda p, t: loss_fn(p, t, config), mesh, optimizer
-    )
-    tokens = synthetic_batch(config, point.batch)
-    state = [params, opt_state]
-
-    def chain(n: int) -> float:
-        for _ in range(n):
-            state[0], state[1], loss = step(state[0], state[1], tokens)
-        return float(loss)
-
-    secs = measure_slope_secs(chain, n_lo=4, n_hi=12)
+    secs = time_train_step(config, point.batch)
     peak = device_peak_flops()
     model_flops = train_step_flops(config, point.batch)
     hw_flops = hardware_flops(config, point.batch)

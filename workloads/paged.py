@@ -150,10 +150,25 @@ class PagePool:
 
     def release(self, seq_id) -> None:
         for p in self.tables.pop(seq_id):
-            self.refcounts[p] -= 1
-            if self.refcounts[p] == 0:
-                del self.refcounts[p]
-                self.free.append(p)
+            self._unref(p)
+
+    def retain_page(self, page: int) -> None:
+        """Pin one allocated physical page independently of any table —
+        e.g. a fan-out group keeps the first member's partial tail page
+        alive as the copy source while later members admit.  Pair with
+        release_page."""
+        if page not in self.refcounts:
+            raise ValueError(f"page {page} is not allocated")
+        self.refcounts[page] += 1
+
+    def release_page(self, page: int) -> None:
+        self._unref(page)
+
+    def _unref(self, page: int) -> None:
+        self.refcounts[page] -= 1
+        if self.refcounts[page] == 0:
+            del self.refcounts[page]
+            self.free.append(page)
 
     @property
     def used_pages(self) -> int:
@@ -275,6 +290,25 @@ def _decode_core(
     return logits, (k_pages, v_pages)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def copy_page(
+    pools: tuple[jax.Array, jax.Array], src, dst
+) -> tuple[jax.Array, jax.Array]:
+    """Duplicate one physical page (all layers, k and v) — the fan-out
+    path copies a group's partial tail page into each member's own page.
+    src/dst are traced scalars, so every copy shares one compile; pools
+    are DONATED (in-place dynamic slice update)."""
+    k_pages, v_pages = pools
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+
+    def one(pool):
+        page = jax.lax.dynamic_slice_in_dim(pool, src, 1, axis=1)
+        return jax.lax.dynamic_update_slice_in_dim(pool, page, dst, axis=1)
+
+    return one(k_pages), one(v_pages)
+
+
 @partial(jax.jit, static_argnames=("config",), donate_argnums=(1,))
 def paged_decode_step(
     params: dict,
@@ -392,6 +426,101 @@ def paged_prefill(
     gathered prompt pages round-trip HBM (one gather + one scatter per
     admission, O(prompt) — the per-token path never gathers)."""
     return _prefill_core(params, pools, tables, prompts, lengths, config)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("config", "start_page", "cover_pages", "emit"),
+    donate_argnums=(1,),
+)
+def paged_prefill_chunk(
+    params: dict,
+    pools: tuple[jax.Array, jax.Array],
+    tables: jax.Array,
+    chunk_tokens: jax.Array,
+    lengths: jax.Array,
+    config: ModelConfig,
+    start_page: int,
+    cover_pages: int,
+    emit: bool,
+):
+    """CHUNKED prefill: one fixed-width slice of a long prompt through
+    the paged pools — prompts longer than a single prefill bucket are
+    processed in page-aligned chunks, so prefill memory and compile
+    shapes stay bounded no matter the prompt length.
+
+    chunk_tokens: [batch, C] — the prompt tokens at absolute positions
+    ``start_page * page_size .. +C-1`` (C must be a multiple of
+    page_size), right-padded past each row's true length;
+    lengths: [batch] TRUE total prompt lengths; tables must cover
+    ``cover_pages = start_page + C/page_size`` columns (trash-padded
+    where a row's true pages end).  The chunk attends over ALL pages up
+    to its end (the gathered view spans 0..cover_pages), so total
+    chunked-prefill traffic is O(P^2 / C) — the standard chunked-prefill
+    trade.
+
+    ``emit`` returns logits at each row's true last position **provided
+    that position falls inside THIS chunk** (rows ending elsewhere get
+    values from a clipped position — meaningless by construction, never
+    silently "close").  A single-row caller sets emit on the row's final
+    chunk (ServeEngine does); a ragged multi-row caller sets emit on
+    every chunk and selects per row where ``start <= length-1 < start+C``
+    (pinned by tests).  emit=False skips the unembed entirely.
+
+    Returns (logits | None, pools); pools are DONATED."""
+    k_pages, v_pages = pools
+    batch, C = chunk_tokens.shape
+    page_size = k_pages.shape[3]
+    if C % page_size:
+        raise ValueError(
+            f"chunk width {C} must be a multiple of page_size {page_size}"
+        )
+    if cover_pages != start_page + C // page_size:
+        raise ValueError(
+            f"cover_pages {cover_pages} must equal start_page {start_page} "
+            f"+ chunk pages {C // page_size}"
+        )
+    start = start_page * page_size
+    trash = k_pages.shape[1] - 1
+    # Absolute columns past each row's true pages (or before this chunk's
+    # coverage of them) redirect writes to the trash page.
+    real_pages = (lengths.astype(jnp.int32) + page_size - 1) // page_size
+    col = jnp.arange(cover_pages)[None, :]
+    t_cov = jnp.where(
+        col < real_pages[:, None], tables[:, :cover_pages], trash
+    )
+
+    def view_of(pool):
+        g = pool[:, t_cov]  # [L, b, cover, Hkv, ps, hd]
+        g = jnp.transpose(g, (0, 1, 2, 4, 3, 5))
+        return g.reshape(
+            g.shape[0], batch, cover_pages * page_size, *g.shape[4:]
+        )
+
+    view = jnp.stack([view_of(k_pages), view_of(v_pages)], axis=1)
+    hidden, view = decode_block(
+        params, view, chunk_tokens, jnp.int32(start), config,
+        unembed="hidden" if emit else "none",
+    )
+    logits = None
+    if emit:
+        idx = (lengths - 1 - start).astype(jnp.int32)[:, None, None]
+        idx = jnp.clip(idx, 0, C - 1)
+        h_last = jnp.take_along_axis(
+            hidden, jnp.broadcast_to(idx, (batch, 1, hidden.shape[-1])), axis=1
+        )
+        logits = h_last[:, 0].astype(jnp.float32) @ weight(
+            params["unembed"], jnp.float32
+        )
+
+    # Scatter back ONLY the pages this chunk wrote (its own columns).
+    pv = view.reshape(
+        view.shape[0], 2, batch, cover_pages, page_size, *view.shape[4:]
+    )[:, :, :, start_page:]
+    pv = jnp.transpose(pv, (0, 1, 2, 3, 5, 4, 6))
+    k_pages = k_pages.at[:, t_cov[:, start_page:]].set(pv[:, 0])
+    v_pages = v_pages.at[:, t_cov[:, start_page:]].set(pv[:, 1])
+    return logits, (k_pages, v_pages)
 
 
 def _prefill_core(params, pools, tables, prompts, lengths, config):

@@ -147,43 +147,54 @@ def _model_config(scale: BenchScale) -> ModelConfig:
     )
 
 
+def layer_matmul_params(config: ModelConfig) -> int:
+    """Weight-matmul parameters touched per token across the layer stack
+    (embed is a gather, not a matmul; unembed counted separately).  q and
+    output projections are d*d each; k/v shrink by the grouped-query
+    ratio when n_kv_heads < n_heads.  Single source for the FLOPs
+    accounting here and in workloads/mfu_sweep.py."""
+    d, ff = config.d_model, config.d_ff
+    kv_proj = 2 * d * (config.kv_heads * config.head_dim)
+    return config.n_layers * (2 * d * d + kv_proj + 2 * d * ff)
+
+
+def fwd_attn_flops(config: ModelConfig, batch: int) -> float:
+    """Forward causal-attention FLOPs: q@k^T and p@v, 2*s*s*d MAC-pairs
+    each, halved by the causal mask (and the kernel really does skip the
+    masked blocks)."""
+    s = config.max_seq_len - 1
+    return config.n_layers * batch * (4 * s * s * config.d_model) * 0.5
+
+
 def train_step_flops(config: ModelConfig, batch: int) -> float:
     """Analytic FLOPs of one training step (fwd + bwd counted as 3x the
     forward matmul work — the standard accounting; the flash backward's
     recompute means the hardware actually does slightly more, so the MFU
     reported from this is conservative)."""
-    d, ff, s = config.d_model, config.d_ff, config.max_seq_len - 1
-    tokens = batch * s
-    # Weight matmuls touched per token (embed is a gather, not a matmul).
-    # q and output projections are d*d each; k/v shrink by the grouped-
-    # query ratio when n_kv_heads < n_heads.
-    kv_proj = 2 * d * (config.kv_heads * config.head_dim)
-    p_matmul = (
-        config.n_layers * (2 * d * d + kv_proj + 2 * d * ff)
-        + d * config.vocab_size
-    )
+    tokens = batch * (config.max_seq_len - 1)
+    p_matmul = layer_matmul_params(config) + config.d_model * config.vocab_size
     fwd_dense = 2 * tokens * p_matmul
-    # Causal attention: q@k^T and p@v, 2*s*s*d MAC-pairs each, halved by
-    # the causal mask (and the kernel really does skip the masked blocks).
-    fwd_attn = config.n_layers * batch * (4 * s * s * d) * 0.5
-    return 3 * (fwd_dense + fwd_attn)
+    return 3 * (fwd_dense + fwd_attn_flops(config, batch))
 
 
-def measure_train(scale: BenchScale) -> dict:
-    """Steady-state full-train-step time and MFU at the bench scale."""
-    from .train import make_mesh, make_train_state, synthetic_batch
+def time_train_step(config: ModelConfig, batch: int) -> float:
+    """Steady-state per-step seconds of the FULL training step (forward,
+    backward, Adam) at (config, batch) — the shared timing core for
+    measure_train and the mfu_sweep harness (one place carries the
+    chained-readback methodology the tunnelled chip needs)."""
+    from .train import (
+        make_mesh,
+        make_sharded_train_step,
+        make_train_state,
+        synthetic_batch,
+    )
 
-    config = _model_config(scale)
     mesh = make_mesh()
     (params, opt_state), optimizer = make_train_state(config, mesh)
-
-    from .train import make_sharded_train_step
-
     step = make_sharded_train_step(
         lambda p, t: loss_fn(p, t, config), mesh, optimizer
     )
-    tokens = synthetic_batch(config, scale.batch)
-
+    tokens = synthetic_batch(config, batch)
     state = [params, opt_state]
 
     def chain(n: int) -> float:
@@ -191,7 +202,13 @@ def measure_train(scale: BenchScale) -> dict:
             state[0], state[1], loss = step(state[0], state[1], tokens)
         return float(loss)  # single readback; params chain on device
 
-    secs = measure_slope_secs(chain, n_lo=4, n_hi=12)
+    return measure_slope_secs(chain, n_lo=4, n_hi=12)
+
+
+def measure_train(scale: BenchScale) -> dict:
+    """Steady-state full-train-step time and MFU at the bench scale."""
+    config = _model_config(scale)
+    secs = time_train_step(config, scale.batch)
     flops = train_step_flops(config, scale.batch)
     peak = device_peak_flops()
     step_tokens = scale.batch * (config.max_seq_len - 1)

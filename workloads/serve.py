@@ -45,6 +45,7 @@ from .generate import sample_logits
 from .model import ModelConfig, init_params
 from .paged import (
     PagePool,
+    copy_page,
     init_page_pools,
     paged_decode_chunk,
     paged_decode_step,
@@ -113,10 +114,22 @@ class ServeEngine:
                 f"prompt_bucket {self.prompt_bucket} exceeds max_seq_len "
                 f"{config.max_seq_len}"
             )
+        if self.prompt_bucket % page_size:
+            raise ValueError(
+                f"prompt_bucket {self.prompt_bucket} must be a multiple of "
+                f"page_size {page_size} (chunked prefill is page-aligned)"
+            )
         # Chunks may overshoot a request's retirement point by up to
         # chunk-1 positions (retirement is detected at the chunk
-        # boundary), so tables and the position range cover it.
-        self.max_pages = -(-(config.max_seq_len + self.chunk) // page_size)
+        # boundary), so tables and the position range cover it; chunked
+        # prefill additionally needs bucket-aligned page coverage.
+        bucket_pages = self.prompt_bucket // page_size
+        prefill_cover = (
+            -(-config.max_seq_len // self.prompt_bucket) * bucket_pages
+        )
+        self.max_pages = max(
+            -(-(config.max_seq_len + self.chunk) // page_size), prefill_cover
+        )
         n_pages = n_pages if n_pages is not None else slots * self.max_pages
         self.ctrl = PagePool(n_pages=n_pages, page_size=page_size)
         self.pools = init_page_pools(config, n_pages, page_size)
@@ -148,6 +161,7 @@ class ServeEngine:
         # Telemetry for benchmarking and tests.
         self.chunks_run = 0
         self.generated_tokens = 0
+        self.prefills_run = 0
 
         sampling = self.sampling
 
@@ -158,6 +172,7 @@ class ServeEngine:
             )
 
         self._first_token = first_token
+        self._mesh = mesh
         if mesh is None:
             self._prefill = partial(paged_prefill, config=self.config)
             self._chunk = partial(
@@ -185,10 +200,18 @@ class ServeEngine:
         rid: str | None = None,
     ) -> str:
         prompt = [int(t) for t in prompt]
-        if not 1 <= len(prompt) <= self.prompt_bucket:
+        limit = (
+            self.prompt_bucket if self._mesh is not None
+            else self.config.max_seq_len - 1
+        )
+        if not 1 <= len(prompt) <= limit:
             raise ValueError(
-                f"prompt length {len(prompt)} must be in [1, "
-                f"{self.prompt_bucket}] (the engine's prompt bucket)"
+                f"prompt length {len(prompt)} must be in [1, {limit}] "
+                + ("(the tensor-parallel engine prefills one bucket; "
+                   "chunked prefill is single-mesh for now)"
+                   if self._mesh is not None else
+                   "(max_seq_len minus one generated token; prompts beyond "
+                   "the bucket prefill in page-aligned chunks)")
             )
         if max_new_tokens is None:
             max_new_tokens = self.config.max_seq_len - len(prompt)
@@ -226,15 +249,18 @@ class ServeEngine:
         *,
         eos_token: int | None = None,
     ) -> list[str]:
-        """N independent samples of one prompt SHARING its prompt pages.
+        """N independent samples of one prompt SHARING its prompt pages
+        AND its prefill.
 
         The first admitted member allocates and prefills the group's
-        shared full prompt pages once; every member forks them read-only
-        (PagePool refcounts) and owns only its partial tail page and its
-        generated tokens — an N-way fan-out stores the prompt's k/v one
-        time instead of N.  With temperature 0 all members emit the same
-        greedy tokens (pinned by tests); sampling makes them diverge.
-        Returns the member request ids."""
+        pages once; later members fork the full pages read-only (PagePool
+        refcounts), copy the first member's partial tail page (retained
+        for the group's admission lifetime), and sample their own first
+        token from the group's cached prefill logits — no second forward
+        over the prompt.  An N-way fan-out stores and computes the
+        prompt's k/v one time instead of N.  With temperature 0 all
+        members emit the same greedy tokens (pinned by tests); sampling
+        makes them diverge.  Returns the member request ids."""
         if n_samples < 1:
             raise ValueError(f"n_samples must be >= 1, got {n_samples}")
         gid = f"grp-{next(self._ids)}"
@@ -278,12 +304,13 @@ class ServeEngine:
         self._tokens[slot] = 0
         return req
 
-    def _allocate_group_member(self, req: Request, seq, n: int) -> None:
-        """Pages for a fan-out member: fork the group's shared full prompt
-        pages (allocated by the first member to arrive) read-only, own
-        only the partial tail page.  Each member's prefill re-scatters the
-        shared pages with identical bytes — safe by the fork contract —
-        so no cross-member sequencing is needed."""
+    def _admit_group_member(self, req: Request, seq, n: int) -> jax.Array:
+        """Admit one fan-out member: fork the group's shared full prompt
+        pages read-only; the FIRST member runs the prefill and the group
+        caches its logits and retains its partial tail page, so later
+        members just copy that one page and reuse the logits — shared
+        memory AND shared compute.  Returns the member's first-token
+        logits."""
         g = self._groups[req.group]
         shared = (n // self.page_size) * self.page_size
         gseq = ("group", req.group)
@@ -296,12 +323,69 @@ class ServeEngine:
                 self.ctrl.extend(seq, n)
         else:  # prompt shorter than one page: nothing shareable
             self.ctrl.allocate(seq, n)
+        table = table_array(
+            [self.ctrl.tables[seq]], self.max_pages, fill=self.ctrl.trash
+        )
+        if g.get("logits") is None:
+            logits, self.pools = self._run_prefill(table, req.prompt)
+            g["logits"] = logits
+            if n > shared:
+                # The partial tail page is private per member; pin the
+                # first member's as the group's copy source (it survives
+                # even if that member retires before its siblings admit).
+                tail = self.ctrl.tables[seq][-1]
+                self.ctrl.retain_page(tail)
+                g["tail_page"] = tail
+        else:
+            logits = g["logits"]
+            if n > shared:
+                self.pools = copy_page(
+                    self.pools, g["tail_page"], self.ctrl.tables[seq][-1]
+                )
         g["members_left"] -= 1
         if g["members_left"] == 0:
             # Pages stay alive through the members' refcounts.
+            if g.get("tail_page") is not None:
+                self.ctrl.release_page(g["tail_page"])
             if g["allocated"]:
                 self.ctrl.release(gseq)
             del self._groups[req.group]
+        return logits
+
+    def _run_prefill(self, table: jax.Array, prompt_tokens: list[int]):
+        """Prefill one admission: a single bucket-wide call for prompts
+        that fit, page-aligned CHUNKS (paged_prefill_chunk) for longer
+        ones — prefill memory and compile shapes stay bucket-bounded for
+        any prompt length up to max_seq_len.  Returns (last-position
+        logits, pools)."""
+        n = len(prompt_tokens)
+        B = self.prompt_bucket
+        self.prefills_run += 1
+        lengths = jnp.asarray([n], jnp.int32)
+        if n <= B:
+            prompt = np.zeros((1, B), np.int32)
+            prompt[0, :n] = prompt_tokens
+            return self._prefill(
+                self.params, self.pools, table, jnp.asarray(prompt), lengths
+            )
+        from .paged import paged_prefill_chunk
+
+        pools = self.pools
+        bucket_pages = B // self.page_size
+        n_chunks = -(-n // B)
+        logits = None
+        for ci in range(n_chunks):
+            start = ci * B
+            chunk = np.zeros((1, B), np.int32)
+            width = min(B, n - start)
+            chunk[0, :width] = prompt_tokens[start : start + width]
+            logits, pools = paged_prefill_chunk(
+                self.params, pools, table, jnp.asarray(chunk), lengths,
+                config=self.config, start_page=ci * bucket_pages,
+                cover_pages=(ci + 1) * bucket_pages,
+                emit=ci == n_chunks - 1,
+            )
+        return logits, pools
 
     def _admit(self) -> list[Request]:
         """Fill free slots from the pending queue: allocate pages for the
@@ -324,18 +408,14 @@ class ServeEngine:
             seq = self._seq_id(slot, req)
             n = len(req.prompt)
             if req.group is not None:
-                self._allocate_group_member(req, seq, n)
+                logits = self._admit_group_member(req, seq, n)
             else:
                 self.ctrl.allocate(seq, n)
-            table = table_array(
-                [self.ctrl.tables[seq]], self.max_pages, fill=self.ctrl.trash
-            )
-            prompt = np.zeros((1, self.prompt_bucket), np.int32)
-            prompt[0, :n] = req.prompt
-            logits, self.pools = self._prefill(
-                self.params, self.pools, table, jnp.asarray(prompt),
-                jnp.asarray([n], jnp.int32),
-            )
+                table = table_array(
+                    [self.ctrl.tables[seq]], self.max_pages,
+                    fill=self.ctrl.trash,
+                )
+                logits, self.pools = self._run_prefill(table, req.prompt)
             tok = int(
                 self._first_token(
                     logits, self._next_key(),
@@ -512,9 +592,16 @@ def main(argv=None) -> int:
 
         params = quantize_params(params)
 
+    # Page-aligned bucket within the context window; prompts longer than
+    # the bucket admit via chunked prefill.
+    page_size = 16 if config.max_seq_len >= 32 else 4
+    bucket = min(
+        -(-args.prompt_len // page_size) * page_size,
+        config.max_seq_len // page_size * page_size,
+    )
     engine = ServeEngine(
-        params, config, slots=args.slots, page_size=16,
-        prompt_bucket=args.prompt_len,
+        params, config, slots=args.slots, page_size=page_size,
+        prompt_bucket=bucket,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         rng=jax.random.PRNGKey(42),
     )
