@@ -94,6 +94,48 @@ def test_tp_engine_matches_generate():
     assert engine.ctrl.used_pages == 0
 
 
+def test_tp_engine_chunked_prefill_long_prompt():
+    """Prompts beyond the bucket admit via chunked prefill on the TP
+    engine too (the chunked path is pure XLA — GSPMD partitions it from
+    the sharded pools) and still match single-device greedy exactly."""
+    mesh = make_mesh(4, model_parallel=4)
+    params = _params(CONFIG)
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8, chunk=4,
+        mesh=mesh,
+    )
+    rng = np.random.default_rng(21)
+    prompt = list(rng.integers(0, CONFIG.vocab_size, 21))  # 3 chunks
+    rid = engine.submit(prompt, 10)
+    served = engine.run()
+    want = generate(
+        params, jnp.asarray([prompt], jnp.int32), CONFIG, max_new_tokens=10
+    )
+    np.testing.assert_array_equal(np.asarray(served[rid]), np.asarray(want[0]))
+    assert engine.ctrl.used_pages == 0
+
+
+def test_tp_engine_fanout_shares_pages():
+    """Fan-out sampling composes with tensor parallelism: one prefill,
+    shared (sharded) prompt pages, greedy members match single-device."""
+    mesh = make_mesh(2, model_parallel=2)
+    params = _params(CONFIG)
+    engine = ServeEngine(
+        params, CONFIG, slots=3, page_size=4, prompt_bucket=12, chunk=4,
+        mesh=mesh,
+    )
+    prompt = list(range(2, 12))
+    rids = engine.submit_fanout(prompt, 6, n_samples=3)
+    served = engine.run()
+    want = generate(
+        params, jnp.asarray([prompt], jnp.int32), CONFIG, max_new_tokens=6
+    )
+    for rid in rids:
+        np.testing.assert_array_equal(np.asarray(served[rid]), np.asarray(want[0]))
+    assert engine.prefills_run == 1
+    assert engine.ctrl.used_pages == 0
+
+
 def test_tp_engine_gqa_window_stream():
     """GQA + sliding window through the TP engine drains and matches the
     single-device engine's greedy tokens."""

@@ -200,18 +200,12 @@ class ServeEngine:
         rid: str | None = None,
     ) -> str:
         prompt = [int(t) for t in prompt]
-        limit = (
-            self.prompt_bucket if self._mesh is not None
-            else self.config.max_seq_len - 1
-        )
+        limit = self.config.max_seq_len - 1
         if not 1 <= len(prompt) <= limit:
             raise ValueError(
                 f"prompt length {len(prompt)} must be in [1, {limit}] "
-                + ("(the tensor-parallel engine prefills one bucket; "
-                   "chunked prefill is single-mesh for now)"
-                   if self._mesh is not None else
-                   "(max_seq_len minus one generated token; prompts beyond "
-                   "the bucket prefill in page-aligned chunks)")
+                "(max_seq_len minus one generated token; prompts beyond "
+                "the bucket prefill in page-aligned chunks)"
             )
         if max_new_tokens is None:
             max_new_tokens = self.config.max_seq_len - len(prompt)
@@ -368,6 +362,10 @@ class ServeEngine:
             return self._prefill(
                 self.params, self.pools, table, jnp.asarray(prompt), lengths
             )
+        # The chunked path contains no Pallas call, so under a mesh it
+        # needs no dedicated program: the module-level jit picks the
+        # partitioning up from the sharded pools/params (GSPMD), and the
+        # pool shardings propagate through the scatter back out.
         from .paged import paged_prefill_chunk
 
         pools = self.pools
