@@ -27,22 +27,43 @@ import jax.numpy as jnp
 from . import lease
 
 
+def _calibrate_steps(run_n, target_burst_secs: float) -> int:
+    """Steps per burst so one burst runs ~target_burst_secs of DEVICE
+    time.  Per-step seconds come from the repo's median-slope estimator
+    (perfbench.measure_slope_secs): the constant dispatch+readback
+    round-trip — large and NOISY on a tunnelled chip — cancels in the
+    slope and the median defeats its jitter, instead of being mistaken
+    for step cost (which would shrink bursts until the chip idles
+    through a readback per lease hold)."""
+    from .perfbench import measure_slope_secs
+
+    def chain(n: int) -> float:
+        run_n(n)
+        return 0.0
+
+    per_step = measure_slope_secs(
+        chain, n_lo=1, n_hi=4, repeats=3, min_window_secs=0.1, max_n=64
+    )
+    return max(int(target_burst_secs / per_step), 1)
+
+
 def make_burst_fn(
     matrix_dim: int = 1024,
-    target_burst_secs: float = 0.25,
+    target_burst_secs: float = 1.0,
     timed_section=nullcontext,
 ):
     """A compute burst sized to keep the MXU busy: chained bf16 matmuls.
 
-    The step count is calibrated so one burst takes ~target_burst_secs on
-    this device — long enough that lease-handoff overhead (flock wakeup,
-    scheduling) stays a small fraction of the duty cycle, short enough that
-    siblings still interleave many times per second.
+    The step count is slope-calibrated so one burst runs
+    ~target_burst_secs of device time — long enough that lease-handoff
+    overhead AND the per-burst readback round-trip stay a small fraction
+    of the duty cycle, short enough that siblings still interleave every
+    second or so.
 
     Compilation is done ahead-of-time (host-side, no chip time needed), so
-    only the single timed calibration step runs under ``timed_section`` —
-    holding the chip lease across a multi-second compile would starve
-    siblings that are already in their measured window."""
+    only the short calibration runs under ``timed_section`` — holding the
+    chip lease across a multi-second compile would starve siblings that
+    are already in their measured window."""
 
     def chained(x):
         for _ in range(8):
@@ -51,27 +72,27 @@ def make_burst_fn(
 
     x = jnp.ones((matrix_dim, matrix_dim), jnp.bfloat16)
     compiled = jax.jit(chained).lower(x).compile()
+
     # Synchronization is a real host READBACK, not block_until_ready: on
     # the tunnelled single-chip target block_until_ready does not wait for
     # the device, which would turn every busy/calibration number into a
     # dispatch-rate measurement.
+    def run_n(n: int):
+        result = x
+        for _ in range(n):
+            result = compiled(result)
+        float(result[0, 0])
+
     with timed_section():
-        float(compiled(x)[0, 0])  # warm-up: exclude one-time dispatch costs
-        t0 = time.monotonic()
-        float(compiled(x)[0, 0])
-        step_secs = max(time.monotonic() - t0, 1e-6)
-    steps_per_burst = max(int(target_burst_secs / step_secs), 1)
+        steps_per_burst = _calibrate_steps(run_n, target_burst_secs)
 
     def burst():
-        result = x
-        for _ in range(steps_per_burst):
-            result = compiled(result)
-        float(result[0, 0])  # host readback = the synchronization point
+        run_n(steps_per_burst)
 
     return burst
 
 
-def make_train_burst_fn(target_burst_secs: float = 0.25, timed_section=nullcontext):
+def make_train_burst_fn(target_burst_secs: float = 1.0, timed_section=nullcontext):
     """A compute burst that is USEFUL work: full training steps of the
     flagship transformer at a tiny scale (forward, backward, Adam), so
     the oversubscription harness can report aggregate tokens/s — useful
@@ -99,25 +120,39 @@ def make_train_burst_fn(target_burst_secs: float = 0.25, timed_section=nullconte
     tokens_per_step = batch * (config.max_seq_len - 1)
     state = [params, opt_state]
 
+    # float(loss) is a REAL host readback (see make_burst_fn —
+    # block_until_ready does not synchronize on the tunnelled chip).
+    def run_n(n: int):
+        loss = None
+        for _ in range(n):
+            state[0], state[1], loss = compiled(state[0], state[1], tokens)
+        float(loss)
+
     with timed_section():
-        # Warm-up + calibration; float(loss) is a REAL host readback (see
-        # make_burst_fn — block_until_ready does not synchronize on the
-        # tunnelled chip).
-        state[0], state[1], loss = compiled(state[0], state[1], tokens)
-        float(loss)
-        t0 = time.monotonic()
-        state[0], state[1], loss = compiled(state[0], state[1], tokens)
-        float(loss)
-        step_secs = max(time.monotonic() - t0, 1e-6)
-    steps_per_burst = max(int(target_burst_secs / step_secs), 1)
+        steps_per_burst = _calibrate_steps(run_n, target_burst_secs)
 
     def burst():
-        loss = None
-        for _ in range(steps_per_burst):
-            state[0], state[1], loss = compiled(state[0], state[1], tokens)
-        float(loss)  # host readback = the synchronization point
+        run_n(steps_per_burst)
 
     return burst, steps_per_burst * tokens_per_step
+
+
+def _start_barrier(barrier_dir: str, count: int, timeout_secs: float):
+    """Gate the measured window on every sibling pod being READY (compiled
+    + calibrated): without it, one pod's lease-held calibration lands
+    inside another's measured window and reads as idle chip time.  Each
+    pod drops a ready-file and polls for ``count``; a straggler past the
+    timeout releases the barrier rather than wedging the harness (the
+    caller keeps the timeout BELOW the harness's own wedge deadline so a
+    crashed sibling surfaces as the failure, not its healthy peers)."""
+    os.makedirs(barrier_dir, exist_ok=True)
+    open(os.path.join(barrier_dir, f"ready-{os.getpid()}"), "w").close()
+    deadline = time.monotonic() + timeout_secs
+    while time.monotonic() < deadline:
+        ready = [f for f in os.listdir(barrier_dir) if f.startswith("ready-")]
+        if len(ready) >= count:
+            return
+        time.sleep(0.05)
 
 
 def run_probe(
@@ -125,11 +160,15 @@ def run_probe(
     report_path: str | None,
     matrix_dim: int = 1024,
     workload: str = "matmul",
+    barrier_dir: str | None = None,
+    barrier_count: int = 0,
 ) -> dict:
     """One pod's measured window.  workload="matmul" keeps the original
     occupancy burst; "train" runs flagship train steps and adds a
     ``tokens`` count to the row so the aggregate can report useful
-    throughput."""
+    throughput.  With ``barrier_dir``/``barrier_count``, the measured
+    window starts only after every sibling finished compiling and
+    calibrating (see _start_barrier)."""
     lease.hold_claim_leases()  # mixed-strategy lifetime declaration
     if workload == "train":
         burst, tokens_per_burst = make_train_burst_fn(
@@ -140,6 +179,12 @@ def run_probe(
         tokens_per_burst = 0
     else:
         raise ValueError(f"workload must be 'matmul' or 'train', got {workload!r}")
+    if barrier_dir and barrier_count:
+        # Stay under oversubscribe's wedge deadline (duration*10 + 300s).
+        _start_barrier(
+            barrier_dir, barrier_count,
+            timeout_secs=duration_secs * 10 + 180,
+        )
     stats = lease.run_leased_bursts(burst, duration_secs)
     stats.update(
         {
@@ -234,6 +279,10 @@ def main(argv=None) -> int:
     parser.add_argument("--workload", default="matmul", choices=["matmul", "train"],
                         help="burst content: occupancy matmuls or flagship "
                         "train steps (reports tokens)")
+    parser.add_argument("--barrier-dir", default="",
+                        help="start-barrier directory shared by sibling pods")
+    parser.add_argument("--barrier-count", type=int, default=0,
+                        help="pods that must be ready before measuring")
     parser.add_argument("--aggregate", action="store_true",
                         help="aggregate an existing report instead of probing")
     args = parser.parse_args(argv)
@@ -254,7 +303,8 @@ def main(argv=None) -> int:
         print(json.dumps(aggregate(args.report)))
         return 0
     stats = run_probe(
-        args.duration, args.report or None, args.matrix_dim, args.workload
+        args.duration, args.report or None, args.matrix_dim, args.workload,
+        args.barrier_dir or None, args.barrier_count,
     )
     print(json.dumps(stats))
     return 0

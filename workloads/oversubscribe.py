@@ -170,6 +170,10 @@ def run(
                             str(matrix_dim),
                             "--workload",
                             workload,
+                            "--barrier-dir",
+                            os.path.join(tmp, "barrier"),
+                            "--barrier-count",
+                            str(n_pods),
                             "--report",
                             report,
                         ],

@@ -208,12 +208,17 @@ def table_array(
 
 
 def _rope_rows(x: jax.Array, angles: jax.Array) -> jax.Array:
-    """Rotate x [batch, 1, heads, head_dim] by PER-ROW angles
-    [batch, head_dim//2] — the per-row-position counterpart of
-    model.apply_rope (same frequency formula via model.rope_angles)."""
+    """Rotate x [batch, s, heads, head_dim] by PER-ROW angles —
+    [batch, head_dim//2] (one position per row, broadcast over s) or
+    [batch, s, head_dim//2] (a block of positions per row) — the
+    per-row-position counterpart of model.apply_rope (same frequency
+    formula via model.rope_angles; single rotation body for the decode
+    and block-verify paths)."""
     half = x.shape[-1] // 2
-    cos = jnp.cos(angles)[:, None, None, :].astype(x.dtype)
-    sin = jnp.sin(angles)[:, None, None, :].astype(x.dtype)
+    if angles.ndim == 2:
+        angles = angles[:, None, :]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
@@ -398,6 +403,173 @@ def _chunk_core(
         body, (pools, token, positions), keys
     )
     return jnp.transpose(toks, (1, 0)), pools
+
+
+def _rowwise_block_core(
+    params: dict,
+    pools: tuple[jax.Array, jax.Array],
+    tables: jax.Array,
+    block: jax.Array,
+    positions: jax.Array,
+    config: ModelConfig,
+):
+    """``s`` consecutive tokens PER ROW at per-row start positions through
+    the paged pools in ONE weight stream — the paged, batched counterpart
+    of generate.decode_block (speculative verification's primitive:
+    rows at different depths each score a draft block in one target
+    forward).
+
+    block: [batch, s] int32 occupying positions positions[b]..+s-1;
+    returns (logits [batch, s, vocab], pools) where logits[:, i] predicts
+    the token after position positions[b]+i.
+
+    Implementation: gather each row's table-mapped pages into a dense
+    view (one gather + one scatter per call, amortised over the s
+    tokens), run the layer stack with per-row rotary angles and per-row
+    causal masks, write the block's k/v into the view at per-row offsets,
+    and scatter the rows' REAL pages back (padding columns redirect to
+    the trash page)."""
+    k_pages, v_pages = pools
+    batch, s = block.shape
+    page_size = k_pages.shape[3]
+    max_pages = tables.shape[1]
+    trash = k_pages.shape[1] - 1
+    T = max_pages * page_size
+    end_lengths = positions + s  # valid cache length after this block
+    # Padding columns (beyond each row's post-block coverage) must not be
+    # written by the scatter-back.
+    real_pages = (end_lengths + page_size - 1) // page_size
+    col = jnp.arange(max_pages)[None, :]
+    t_cov = jnp.where(col < real_pages[:, None], tables, trash)
+
+    def view_of(pool):
+        g = pool[:, t_cov]  # [L, b, maxp, Hkv, ps, hd]
+        g = jnp.transpose(g, (0, 1, 2, 4, 3, 5))
+        return g.reshape(g.shape[0], batch, T, *g.shape[4:])
+
+    view_k, view_v = view_of(k_pages), view_of(v_pages)
+
+    # Per-row rotary angles for the block's positions: [b, s, half].
+    pos_grid = positions[:, None] + jnp.arange(s)[None, :]
+    angles = rope_angles(pos_grid.reshape(-1), config.head_dim).reshape(
+        batch, s, -1
+    )
+
+    # Per-row causal mask over the view: block row i (at positions[b]+i)
+    # sees cache positions <= positions[b]+i (its own slot included),
+    # bounded below by the sliding window when configured.
+    k_pos = jnp.arange(T)[None, None, :]
+    row_pos = pos_grid[:, :, None]
+    mask = k_pos <= row_pos
+    if config.attention_window is not None:
+        mask &= k_pos > row_pos - config.attention_window
+    mask = mask[:, None]  # [b, 1, s, T]
+
+    from .model import masked_attention
+
+    def write_rows(view, new):  # new: [b, s, Hkv, hd] at per-row offsets
+        for b in range(batch):
+            view = jax.lax.dynamic_update_slice(
+                view, new[b][None].astype(view.dtype), (b, positions[b], 0, 0)
+            )
+        return view
+
+    x = params["embed"].astype(config.dtype)[block]  # [b, s, d]
+    for i, layer in enumerate(params["layers"]):
+        h = _rmsnorm(x, layer["ln1"])
+        q, k, v = project_qkv(h, layer)
+        q, k = _rope_rows(q, angles), _rope_rows(k, angles)
+        view_k = view_k.at[i].set(write_rows(view_k[i], k))
+        view_v = view_v.at[i].set(write_rows(view_v[i], v))
+        attn = masked_attention(q, view_k[i], view_v[i], mask, config.head_dim)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, weight(layer["wo"], x.dtype))
+        x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
+    logits = x.astype(jnp.float32) @ weight(params["unembed"], jnp.float32)
+
+    # Scatter the (possibly updated) pages back.
+    def scatter_back(pool, view):
+        pv = view.reshape(
+            view.shape[0], batch, max_pages, page_size, *view.shape[3:]
+        )
+        pv = jnp.transpose(pv, (0, 1, 2, 4, 3, 5))  # [L, b, maxp, Hkv, ps, hd]
+        return pool.at[:, t_cov].set(pv)
+
+    return logits, (scatter_back(k_pages, view_k), scatter_back(v_pages, view_v))
+
+
+@partial(
+    jax.jit,
+    static_argnames=("t_config", "d_config", "gamma"),
+    donate_argnums=(2, 3),
+)
+def paged_spec_round(
+    t_params: dict,
+    d_params: dict,
+    t_pools: tuple[jax.Array, jax.Array],
+    d_pools: tuple[jax.Array, jax.Array],
+    tables: jax.Array,
+    cur: jax.Array,
+    positions: jax.Array,
+    t_config: ModelConfig,
+    d_config: ModelConfig,
+    gamma: int,
+):
+    """One BATCHED speculative-decoding round over paged caches: the
+    draft proposes ``gamma`` tokens per row autoregressively (cheap
+    weights, per-row positions), the target scores every row's block
+    [cur, d_1..d_gamma] in ONE rowwise forward (its weights stream once
+    per round, the speculative win), and each row commits its own longest
+    agreeing prefix plus the target's correction — rows accept DIFFERENT
+    lengths and simply advance their positions by different amounts,
+    which the paged per-row design absorbs for free (this is the batched
+    speculation workloads/speculative.py declares out of its own scope).
+
+    cur: [batch] the latest committed token per row, sitting at
+    positions[b]; tables must cover positions + gamma + 1.  Returns
+    (committed [batch, gamma+1], n_accept [batch], t_pools, d_pools):
+    row b's new tokens are committed[b, :n_accept[b]+1], and its position
+    advances by n_accept[b]+1.  Greedy (the lossless formulation); both
+    pool pairs are DONATED.
+
+    Rejected drafts' k/v stay in the pages as stale slots — harmless:
+    every mask admits positions only up to each row's committed length,
+    and the next rounds overwrite the slots before ever admitting them
+    (same argument as the contiguous speculative module)."""
+    batch = cur.shape[0]
+
+    # Draft gamma+1 steps: the extra step writes the FINAL proposal's k/v
+    # so a fully-accepted round leaves no zero hole in the draft cache.
+    def draft_one(carry, i):
+        d_pools, tok = carry
+        logits, d_pools = _decode_core(
+            d_params, d_pools, tables, tok, positions + i, d_config
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (d_pools, nxt), nxt
+
+    (d_pools, _), proposals = jax.lax.scan(
+        draft_one, (d_pools, cur), jnp.arange(gamma + 1)
+    )
+    drafts = jnp.transpose(proposals, (1, 0))[:, :gamma]  # [batch, gamma]
+
+    block = jnp.concatenate([cur[:, None], drafts], axis=1)
+    t_logits, t_pools = _rowwise_block_core(
+        t_params, t_pools, tables, block, positions, t_config
+    )
+    picks = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [b, gamma+1]
+
+    # Per-row longest agreeing prefix, then the correction/bonus token.
+    agree = drafts == picks[:, :-1]
+    n = jnp.argmin(
+        jnp.concatenate([agree, jnp.zeros((batch, 1), bool)], axis=1), axis=1
+    ).astype(jnp.int32)
+    committed = jnp.concatenate(
+        [drafts, jnp.zeros((batch, 1), jnp.int32)], axis=1
+    )
+    committed = committed.at[jnp.arange(batch), n].set(
+        picks[jnp.arange(batch), n]
+    )
+    return committed, n, t_pools, d_pools
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnums=(1,))

@@ -468,7 +468,9 @@ def measure_serve(scale: BenchScale) -> dict:
     def run_chunks(n_chunks: int) -> float:
         engine = ServeEngine(
             params, config, slots=batch, page_size=ps, chunk=chunk,
-            prompt_bucket=prompt_len, temperature=0.8, top_k=50, top_p=0.95,
+            # Page-aligned bucket covering the prompt.
+            prompt_bucket=-(-prompt_len // ps) * ps,
+            temperature=0.8, top_k=50, top_p=0.95,
             rng=jax.random.PRNGKey(3),
         )
         for _ in range(batch):

@@ -100,10 +100,34 @@ class ServeEngine:
         top_p: float = 1.0,
         rng: jax.Array | None = None,
         mesh=None,
+        draft_params: dict | None = None,
+        draft_config: ModelConfig | None = None,
+        gamma: int = 4,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if (draft_params is None) != (draft_config is None):
+            raise ValueError(
+                "draft_params and draft_config come together (speculative "
+                "serving needs both)"
+            )
+        if draft_params is not None:
+            if temperature > 0.0:
+                raise ValueError(
+                    "speculative serving is greedy (the lossless "
+                    "formulation); temperature must be 0"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "speculative serving is single-mesh for now"
+                )
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError("target and draft must share a vocabulary")
+            if gamma < 1:
+                raise ValueError(f"gamma must be >= 1, got {gamma}")
         self.params, self.config = params, config
+        self.draft_params, self.draft_config = draft_params, draft_config
+        self.gamma = gamma
         self.page_size = page_size
         self.chunk = chunk or page_size
         self.prompt_bucket = prompt_bucket or min(
@@ -119,20 +143,31 @@ class ServeEngine:
                 f"prompt_bucket {self.prompt_bucket} must be a multiple of "
                 f"page_size {page_size} (chunked prefill is page-aligned)"
             )
-        # Chunks may overshoot a request's retirement point by up to
-        # chunk-1 positions (retirement is detected at the chunk
-        # boundary), so tables and the position range cover it; chunked
-        # prefill additionally needs bucket-aligned page coverage.
+        # Chunks (or speculative rounds of up to gamma+1 tokens) may
+        # overshoot a request's retirement point, so tables and the
+        # position range cover it; chunked prefill additionally needs
+        # bucket-aligned page coverage.
+        self._overshoot = max(
+            self.chunk, (gamma + 1) if draft_params is not None else 0
+        )
         bucket_pages = self.prompt_bucket // page_size
         prefill_cover = (
             -(-config.max_seq_len // self.prompt_bucket) * bucket_pages
         )
         self.max_pages = max(
-            -(-(config.max_seq_len + self.chunk) // page_size), prefill_cover
+            -(-(config.max_seq_len + self._overshoot) // page_size),
+            prefill_cover,
         )
         n_pages = n_pages if n_pages is not None else slots * self.max_pages
         self.ctrl = PagePool(n_pages=n_pages, page_size=page_size)
         self.pools = init_page_pools(config, n_pages, page_size)
+        # Speculative serving: the draft model gets its OWN physical
+        # pools but SHARES the control plane — same page indices, same
+        # tables — so one allocator serves both caches.
+        self.d_pools = (
+            init_page_pools(draft_config, n_pages, page_size)
+            if draft_params is not None else None
+        )
         self.slots = slots
         self.temperature = float(temperature)
         self.top_k, self.top_p = top_k, top_p
@@ -162,6 +197,7 @@ class ServeEngine:
         self.chunks_run = 0
         self.generated_tokens = 0
         self.prefills_run = 0
+        self.spec_rounds = 0
 
         sampling = self.sampling
 
@@ -280,13 +316,13 @@ class ServeEngine:
         return ("slot", slot, req.rid)
 
     def _worst_case_pages(self, prompt_len: int, max_new_tokens: int) -> int:
-        """Pages a request can hold over its whole lifetime: its final
-        position after the last chunk is prompt_len +
-        ceil((max_new_tokens - 1) / chunk) * chunk (retirement is
-        detected at chunk boundaries, so the position overshoots by up
-        to chunk - 1)."""
-        chunks = -(-(max_new_tokens - 1) // self.chunk)
-        return self.ctrl.pages_needed(prompt_len + chunks * self.chunk)
+        """Pages a request can hold over its whole lifetime: retirement
+        is detected at chunk/round boundaries, so its final position can
+        overshoot prompt + max_new - 1 by up to one step unit (the chunk
+        length, or gamma+1 in speculative mode)."""
+        return self.ctrl.pages_needed(
+            prompt_len + max_new_tokens - 1 + self._overshoot
+        )
 
     def _retire(self, slot: int) -> Request:
         req = self._slot_req.pop(slot)
@@ -333,9 +369,12 @@ class ServeEngine:
         else:
             logits = g["logits"]
             if n > shared:
-                self.pools = copy_page(
-                    self.pools, g["tail_page"], self.ctrl.tables[seq][-1]
-                )
+                dst = self.ctrl.tables[seq][-1]
+                self.pools = copy_page(self.pools, g["tail_page"], dst)
+                if self.d_pools is not None:
+                    self.d_pools = copy_page(
+                        self.d_pools, g["tail_page"], dst
+                    )
         g["members_left"] -= 1
         if g["members_left"] == 0:
             # Pages stay alive through the members' refcounts.
@@ -350,17 +389,33 @@ class ServeEngine:
         """Prefill one admission: a single bucket-wide call for prompts
         that fit, page-aligned CHUNKS (paged_prefill_chunk) for longer
         ones — prefill memory and compile shapes stay bucket-bounded for
-        any prompt length up to max_seq_len.  Returns (last-position
-        logits, pools)."""
+        any prompt length up to max_seq_len.  In speculative mode the
+        DRAFT pools prefill the same prompt too (same tables, its own
+        physical pages).  Returns (last-position logits, pools)."""
+        self.prefills_run += 1
+        logits, pools = self._prefill_into(
+            self.params, self.config, self.pools, self._prefill, table,
+            prompt_tokens,
+        )
+        if self.d_pools is not None:
+            _, self.d_pools = self._prefill_into(
+                self.draft_params, self.draft_config, self.d_pools,
+                partial(paged_prefill, config=self.draft_config), table,
+                prompt_tokens,
+            )
+        return logits, pools
+
+    def _prefill_into(
+        self, params, config, pools, prefill_program, table, prompt_tokens
+    ):
         n = len(prompt_tokens)
         B = self.prompt_bucket
-        self.prefills_run += 1
         lengths = jnp.asarray([n], jnp.int32)
         if n <= B:
             prompt = np.zeros((1, B), np.int32)
             prompt[0, :n] = prompt_tokens
-            return self._prefill(
-                self.params, self.pools, table, jnp.asarray(prompt), lengths
+            return prefill_program(
+                params, pools, table, jnp.asarray(prompt), lengths
             )
         # The chunked path contains no Pallas call, so under a mesh it
         # needs no dedicated program: the module-level jit picks the
@@ -368,7 +423,6 @@ class ServeEngine:
         # pool shardings propagate through the scatter back out.
         from .paged import paged_prefill_chunk
 
-        pools = self.pools
         bucket_pages = B // self.page_size
         n_chunks = -(-n // B)
         logits = None
@@ -378,8 +432,8 @@ class ServeEngine:
             width = min(B, n - start)
             chunk[0, :width] = prompt_tokens[start : start + width]
             logits, pools = paged_prefill_chunk(
-                self.params, pools, table, jnp.asarray(chunk), lengths,
-                config=self.config, start_page=ci * bucket_pages,
+                params, pools, table, jnp.asarray(chunk), lengths,
+                config=config, start_page=ci * bucket_pages,
                 cover_pages=(ci + 1) * bucket_pages,
                 emit=ci == n_chunks - 1,
             )
@@ -438,17 +492,22 @@ class ServeEngine:
         return finished
 
     def step(self) -> list[Request]:
-        """One engine iteration: admit into free slots, decode one chunk
+        """One engine iteration: admit into free slots, run one decode
+        chunk (or one speculative round, when a draft model is loaded)
         for every occupied slot, retire finished requests.  Returns the
         requests that finished during this step."""
         finished = self._admit()
         if not self._occupied.any():
             return finished
-        # Page coverage for the whole chunk, allocated on demand.
+        # Page coverage for the whole chunk/round, allocated on demand.
         for slot, req in self._slot_req.items():
             seq = self._seq_id(slot, req)
-            table = self.ctrl.extend(seq, int(self._positions[slot]) + self.chunk)
+            table = self.ctrl.extend(
+                seq, int(self._positions[slot]) + self._overshoot
+            )
             self._tables[slot, : len(table)] = table
+        if self.draft_params is not None:
+            return finished + self._step_spec()
 
         toks, self.pools = self._chunk(
             self.params, self.pools,
@@ -471,6 +530,41 @@ class ServeEngine:
                     break
             self._positions[slot] += self.chunk
             self._tokens[slot] = toks[slot, -1]
+            if req.done:
+                finished.append(self._retire(slot))
+        return finished
+
+    def _step_spec(self) -> list[Request]:
+        """One batched speculative round (paged_spec_round): every
+        occupied row drafts, verifies, and commits its OWN accepted
+        length — per-row positions advance by different amounts, which
+        is exactly what the paged compute path supports."""
+        from .paged import paged_spec_round
+
+        committed, n_acc, self.pools, self.d_pools = paged_spec_round(
+            self.params, self.draft_params, self.pools, self.d_pools,
+            jnp.asarray(self._tables), jnp.asarray(self._tokens),
+            jnp.asarray(self._positions),
+            t_config=self.config, d_config=self.draft_config,
+            gamma=self.gamma,
+        )
+        committed = np.asarray(committed)
+        n_acc = np.asarray(n_acc)
+        self.spec_rounds += 1
+        finished = []
+        for slot in list(self._slot_req):
+            req = self._slot_req[slot]
+            k = int(n_acc[slot]) + 1
+            for tok in committed[slot, :k]:
+                req.tokens.append(int(tok))
+                self.generated_tokens += 1
+                if int(tok) == req.eos_token or (
+                    len(req.tokens) >= req.max_new_tokens
+                ):
+                    req.done = True
+                    break
+            self._positions[slot] += k
+            self._tokens[slot] = committed[slot, k - 1]
             if req.done:
                 finished.append(self._retire(slot))
         return finished
