@@ -299,6 +299,57 @@ def test_fanout_short_prompt_degrades_to_independent():
     assert engine.ctrl.used_pages == 0
 
 
+def test_pipelined_engine_matches_generate():
+    """pipelined=True overlaps each chunk's readback with the next
+    chunk's compute; emission lags one chunk but every request's tokens
+    are identical — pinned against generate() over a mixed stream with
+    slot turnover."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=12, chunk=4,
+        pipelined=True,
+    )
+    requests = _mixed_requests(6, CONFIG.vocab_size, rng_seed=23)
+    rids = [engine.submit(p, n) for p, n in requests]
+    served = engine.run()
+    assert set(served) == set(rids)
+    for rid, (prompt, new) in zip(rids, requests):
+        want = generate(
+            params, jnp.asarray([prompt], jnp.int32), CONFIG,
+            max_new_tokens=new,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(served[rid]), np.asarray(want[0]),
+            err_msg=f"{rid} (prompt {len(prompt)}, new {new})",
+        )
+    assert engine.ctrl.used_pages == 0
+    assert engine._pending_read is None  # fully drained
+
+
+def test_pipelined_engine_eos_and_fanout():
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8, chunk=4,
+        pipelined=True,
+    )
+    prompt = [1, 2, 3]
+    want = generate(
+        params, jnp.asarray([prompt], jnp.int32), CONFIG, max_new_tokens=20
+    )
+    eos = int(np.asarray(want[0, 2]))
+    rid = engine.submit(prompt, 20, eos_token=eos)
+    fan = engine.submit_fanout([4, 5, 6, 7], 6, n_samples=2)
+    served = engine.run()
+    assert served[rid][-1] == eos and len(served[rid]) <= 3 + 2 * engine.chunk
+    fan_want = generate(
+        params, jnp.asarray([[4, 5, 6, 7]], jnp.int32), CONFIG,
+        max_new_tokens=6,
+    )
+    for r in fan:
+        np.testing.assert_array_equal(np.asarray(served[r]), np.asarray(fan_want[0]))
+    assert engine.ctrl.used_pages == 0
+
+
 DRAFT_CONFIG = ModelConfig(
     max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
     dtype=jnp.float32,

@@ -44,7 +44,13 @@ def _calibrate_steps(run_n, target_burst_secs: float) -> int:
     per_step = measure_slope_secs(
         chain, n_lo=1, n_hi=4, repeats=3, min_window_secs=0.1, max_n=64
     )
-    return max(int(target_burst_secs / per_step), 1)
+    # Floor and cap: a jitter-dominated slope can collapse to the
+    # estimator's 1e-9 floor, and an uncapped division would size a burst
+    # that holds the chip lease for hours.  1e-6 s/step is faster than
+    # any real step (each includes at least a dispatch), and 100k steps
+    # bounds one burst to ~target regardless.
+    per_step = max(per_step, 1e-6)
+    return min(max(int(target_burst_secs / per_step), 1), 100_000)
 
 
 def make_burst_fn(
@@ -144,7 +150,11 @@ def _start_barrier(barrier_dir: str, count: int, timeout_secs: float):
     pod drops a ready-file and polls for ``count``; a straggler past the
     timeout releases the barrier rather than wedging the harness (the
     caller keeps the timeout BELOW the harness's own wedge deadline so a
-    crashed sibling surfaces as the failure, not its healthy peers)."""
+    crashed sibling surfaces as the failure, not its healthy peers).
+
+    The directory must be FRESH PER RUN (the oversubscribe harness
+    passes a subdirectory of its own mkdtemp): stale ready-files from a
+    previous run would release the barrier early."""
     os.makedirs(barrier_dir, exist_ok=True)
     open(os.path.join(barrier_dir, f"ready-{os.getpid()}"), "w").close()
     deadline = time.monotonic() + timeout_secs

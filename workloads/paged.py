@@ -405,6 +405,44 @@ def _chunk_core(
     return jnp.transpose(toks, (1, 0)), pools
 
 
+def _redirect_padding(
+    tables_slice: jax.Array, covered_lengths: jax.Array, page_size: int,
+    trash: int,
+) -> jax.Array:
+    """Table columns beyond each row's real coverage point at the TRASH
+    page, so view scatters from padded positions can never write another
+    sequence's physical page.  Shared by every gathered-view path."""
+    real = (covered_lengths.astype(jnp.int32) + page_size - 1) // page_size
+    col = jnp.arange(tables_slice.shape[1])[None, :]
+    return jnp.where(col < real[:, None], tables_slice, trash)
+
+
+def _gather_view(pool: jax.Array, t_cov: jax.Array, page_size: int) -> jax.Array:
+    """[L, pages, Hkv, ps, hd] pool -> dense [L, b, cover*ps, Hkv, hd]
+    view of each row's t_cov-mapped pages (decode_block's cache layout)."""
+    g = pool[:, t_cov]  # [L, b, cover, Hkv, ps, hd]
+    g = jnp.transpose(g, (0, 1, 2, 4, 3, 5))
+    return g.reshape(
+        g.shape[0], g.shape[1], t_cov.shape[1] * page_size, *g.shape[4:]
+    )
+
+
+def _scatter_view(
+    pool: jax.Array, view: jax.Array, t_cov: jax.Array, page_size: int,
+    start_col: int = 0,
+) -> jax.Array:
+    """Inverse of _gather_view: write the view's pages (from table column
+    ``start_col`` on) back into the pool.  Duplicate t_cov entries only
+    arise from shared-prefix forks (identical bytes) or trash padding
+    (garbage by contract), so scatter order does not matter."""
+    pv = view.reshape(
+        view.shape[0], view.shape[1], t_cov.shape[1], page_size,
+        *view.shape[3:]
+    )
+    pv = jnp.transpose(pv, (0, 1, 2, 4, 3, 5))[:, :, start_col:]
+    return pool.at[:, t_cov[:, start_col:]].set(pv)
+
+
 def _rowwise_block_core(
     params: dict,
     pools: tuple[jax.Array, jax.Array],
@@ -428,26 +466,19 @@ def _rowwise_block_core(
     tokens), run the layer stack with per-row rotary angles and per-row
     causal masks, write the block's k/v into the view at per-row offsets,
     and scatter the rows' REAL pages back (padding columns redirect to
-    the trash page)."""
+    the trash page).  Callers bound the table width to the pages
+    actually live (paged_spec_round's static cover) — the gather is
+    O(cover), not O(max_seq)."""
     k_pages, v_pages = pools
     batch, s = block.shape
     page_size = k_pages.shape[3]
-    max_pages = tables.shape[1]
     trash = k_pages.shape[1] - 1
-    T = max_pages * page_size
-    end_lengths = positions + s  # valid cache length after this block
-    # Padding columns (beyond each row's post-block coverage) must not be
-    # written by the scatter-back.
-    real_pages = (end_lengths + page_size - 1) // page_size
-    col = jnp.arange(max_pages)[None, :]
-    t_cov = jnp.where(col < real_pages[:, None], tables, trash)
-
-    def view_of(pool):
-        g = pool[:, t_cov]  # [L, b, maxp, Hkv, ps, hd]
-        g = jnp.transpose(g, (0, 1, 2, 4, 3, 5))
-        return g.reshape(g.shape[0], batch, T, *g.shape[4:])
-
-    view_k, view_v = view_of(k_pages), view_of(v_pages)
+    T = tables.shape[1] * page_size
+    # Columns beyond each row's post-block coverage must not be written
+    # by the scatter-back.
+    t_cov = _redirect_padding(tables, positions + s, page_size, trash)
+    view_k = _gather_view(k_pages, t_cov, page_size)
+    view_v = _gather_view(v_pages, t_cov, page_size)
 
     # Per-row rotary angles for the block's positions: [b, s, half].
     pos_grid = positions[:, None] + jnp.arange(s)[None, :]
@@ -487,19 +518,15 @@ def _rowwise_block_core(
     logits = x.astype(jnp.float32) @ weight(params["unembed"], jnp.float32)
 
     # Scatter the (possibly updated) pages back.
-    def scatter_back(pool, view):
-        pv = view.reshape(
-            view.shape[0], batch, max_pages, page_size, *view.shape[3:]
-        )
-        pv = jnp.transpose(pv, (0, 1, 2, 4, 3, 5))  # [L, b, maxp, Hkv, ps, hd]
-        return pool.at[:, t_cov].set(pv)
-
-    return logits, (scatter_back(k_pages, view_k), scatter_back(v_pages, view_v))
+    return logits, (
+        _scatter_view(k_pages, view_k, t_cov, page_size),
+        _scatter_view(v_pages, view_v, t_cov, page_size),
+    )
 
 
 @partial(
     jax.jit,
-    static_argnames=("t_config", "d_config", "gamma"),
+    static_argnames=("t_config", "d_config", "gamma", "cover_pages"),
     donate_argnums=(2, 3),
 )
 def paged_spec_round(
@@ -513,6 +540,7 @@ def paged_spec_round(
     t_config: ModelConfig,
     d_config: ModelConfig,
     gamma: int,
+    cover_pages: int | None = None,
 ):
     """One BATCHED speculative-decoding round over paged caches: the
     draft proposes ``gamma`` tokens per row autoregressively (cheap
@@ -534,8 +562,15 @@ def paged_spec_round(
     Rejected drafts' k/v stay in the pages as stale slots — harmless:
     every mask admits positions only up to each row's committed length,
     and the next rounds overwrite the slots before ever admitting them
-    (same argument as the contiguous speculative module)."""
+    (same argument as the contiguous speculative module).
+
+    ``cover_pages`` (static) bounds the verify forward's gathered view to
+    the table columns actually live — callers pass a bucketised
+    ceil((max position + gamma + 1) / page_size) so the gather is O(live
+    pages), not O(max_seq), at a bounded number of compiles."""
     batch = cur.shape[0]
+    if cover_pages is not None:
+        tables = tables[:, :cover_pages]
 
     # Draft gamma+1 steps: the extra step writes the FINAL proposal's k/v
     # so a fully-accepted round leaves no zero hole in the draft cache.
@@ -656,20 +691,16 @@ def paged_prefill_chunk(
     trash = k_pages.shape[1] - 1
     # Absolute columns past each row's true pages (or before this chunk's
     # coverage of them) redirect writes to the trash page.
-    real_pages = (lengths.astype(jnp.int32) + page_size - 1) // page_size
-    col = jnp.arange(cover_pages)[None, :]
-    t_cov = jnp.where(
-        col < real_pages[:, None], tables[:, :cover_pages], trash
+    t_cov = _redirect_padding(
+        tables[:, :cover_pages], lengths, page_size, trash
     )
-
-    def view_of(pool):
-        g = pool[:, t_cov]  # [L, b, cover, Hkv, ps, hd]
-        g = jnp.transpose(g, (0, 1, 2, 4, 3, 5))
-        return g.reshape(
-            g.shape[0], batch, cover_pages * page_size, *g.shape[4:]
-        )
-
-    view = jnp.stack([view_of(k_pages), view_of(v_pages)], axis=1)
+    view = jnp.stack(
+        [
+            _gather_view(k_pages, t_cov, page_size),
+            _gather_view(v_pages, t_cov, page_size),
+        ],
+        axis=1,
+    )
     hidden, view = decode_block(
         params, view, chunk_tokens, jnp.int32(start), config,
         unembed="hidden" if emit else "none",
@@ -686,13 +717,10 @@ def paged_prefill_chunk(
         )
 
     # Scatter back ONLY the pages this chunk wrote (its own columns).
-    pv = view.reshape(
-        view.shape[0], 2, batch, cover_pages, page_size, *view.shape[4:]
-    )[:, :, :, start_page:]
-    pv = jnp.transpose(pv, (0, 1, 2, 3, 5, 4, 6))
-    k_pages = k_pages.at[:, t_cov[:, start_page:]].set(pv[:, 0])
-    v_pages = v_pages.at[:, t_cov[:, start_page:]].set(pv[:, 1])
-    return logits, (k_pages, v_pages)
+    return logits, (
+        _scatter_view(k_pages, view[:, 0], t_cov, page_size, start_page),
+        _scatter_view(v_pages, view[:, 1], t_cov, page_size, start_page),
+    )
 
 
 def _prefill_core(params, pools, tables, prompts, lengths, config):
@@ -709,22 +737,19 @@ def _prefill_core(params, pools, tables, prompts, lengths, config):
     # ever written.  Reads are unaffected: the length mask and the
     # kernel's DMA clamp already exclude them.
     trash = k_pages.shape[1] - 1
-    real_pages = (lengths.astype(jnp.int32) + page_size - 1) // page_size
-    col = jnp.arange(prefill_pages)[None, :]
-    t_pp = jnp.where(
-        col < real_pages[:, None], tables[:, :prefill_pages], trash
+    t_pp = _redirect_padding(
+        tables[:, :prefill_pages], lengths, page_size, trash
     )
 
     # Gathered view of just the prompt-covering pages, in decode_block's
     # contiguous-cache layout [L, 2, b, pp*ps, Hkv, hd].
-    def view_of(pool):
-        g = pool[:, t_pp]  # [L, b, pp, Hkv, ps, hd]
-        g = jnp.transpose(g, (0, 1, 2, 4, 3, 5))  # [L, b, pp, ps, Hkv, hd]
-        return g.reshape(
-            g.shape[0], batch, prefill_pages * page_size, *g.shape[4:]
-        )
-
-    view = jnp.stack([view_of(k_pages), view_of(v_pages)], axis=1)
+    view = jnp.stack(
+        [
+            _gather_view(k_pages, t_pp, page_size),
+            _gather_view(v_pages, t_pp, page_size),
+        ],
+        axis=1,
+    )
     hidden, view = decode_block(
         params, view, prompts, jnp.int32(0), config, unembed="hidden"
     )
@@ -737,14 +762,8 @@ def _prefill_core(params, pools, tables, prompts, lengths, config):
         params["unembed"], jnp.float32
     )
 
-    # ONE scatter writes the prompt-covering pages back.  Duplicate table
-    # entries among rows only arise from shared-prefix forks (identical
-    # bytes) or trash padding (garbage by contract), so scatter order
-    # does not matter.
-    pv = view.reshape(
-        view.shape[0], 2, batch, prefill_pages, page_size, *view.shape[4:]
-    )  # [L, 2, b, pp, ps, Hkv, hd]
-    pv = jnp.transpose(pv, (0, 1, 2, 3, 5, 4, 6))  # [L, 2, b, pp, Hkv, ps, hd]
-    k_pages = k_pages.at[:, t_pp].set(pv[:, 0])
-    v_pages = v_pages.at[:, t_pp].set(pv[:, 1])
-    return logits, (k_pages, v_pages)
+    # ONE scatter writes the prompt-covering pages back.
+    return logits, (
+        _scatter_view(k_pages, view[:, 0], t_pp, page_size),
+        _scatter_view(v_pages, view[:, 1], t_pp, page_size),
+    )

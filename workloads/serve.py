@@ -103,9 +103,16 @@ class ServeEngine:
         draft_params: dict | None = None,
         draft_config: ModelConfig | None = None,
         gamma: int = 4,
+        pipelined: bool = False,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if pipelined and draft_params is not None:
+            raise ValueError(
+                "pipelined stepping and speculative serving are mutually "
+                "exclusive (a speculative round's admission decisions need "
+                "its own commit counts)"
+            )
         if (draft_params is None) != (draft_config is None):
             raise ValueError(
                 "draft_params and draft_config come together (speculative "
@@ -145,10 +152,13 @@ class ServeEngine:
             )
         # Chunks (or speculative rounds of up to gamma+1 tokens) may
         # overshoot a request's retirement point, so tables and the
-        # position range cover it; chunked prefill additionally needs
+        # position range cover it; pipelined stepping defers retirement
+        # by one more chunk; chunked prefill additionally needs
         # bucket-aligned page coverage.
+        self.pipelined = pipelined
         self._overshoot = max(
-            self.chunk, (gamma + 1) if draft_params is not None else 0
+            self.chunk * (2 if pipelined else 1),
+            (gamma + 1) if draft_params is not None else 0,
         )
         bucket_pages = self.prompt_bucket // page_size
         prefill_cover = (
@@ -198,6 +208,12 @@ class ServeEngine:
         self.generated_tokens = 0
         self.prefills_run = 0
         self.spec_rounds = 0
+        # Pipelined stepping: the not-yet-read previous chunk (device
+        # tokens + the slot->request snapshot at dispatch) and the
+        # device-chained last-token array.
+        self._pending_read = None
+        self._chained_tok: jax.Array | None = None
+        self._fresh_slots: set[int] = set()
 
         sampling = self.sampling
 
@@ -484,6 +500,7 @@ class ServeEngine:
                 continue
             self._slot_req[slot] = req
             self._occupied[slot] = True
+            self._fresh_slots.add(slot)
             self._committed_pages += need
             self._slot_commit[slot] = need
             self._tables[slot, : len(self.ctrl.tables[seq])] = self.ctrl.tables[seq]
@@ -491,13 +508,33 @@ class ServeEngine:
             self._tokens[slot] = tok
         return finished
 
+    def _dev(self, mirror: np.ndarray) -> jax.Array:
+        """A host mirror crossing into a dispatch, COPIED first: on the
+        CPU backend jnp.asarray may alias numpy memory zero-copy, so an
+        in-place mirror update (extend/retire/position advance) after an
+        async dispatch would race the device's deferred read — a real
+        observed corruption under pipelined stepping."""
+        return jnp.asarray(mirror.copy())
+
     def step(self) -> list[Request]:
         """One engine iteration: admit into free slots, run one decode
         chunk (or one speculative round, when a draft model is loaded)
         for every occupied slot, retire finished requests.  Returns the
-        requests that finished during this step."""
+        requests that finished during this step.
+
+        With ``pipelined=True`` the chunk's tokens are NOT read back
+        before returning: the next step dispatches chunk N+1 chained on
+        chunk N's device-side outputs, and only then reads chunk N — the
+        readback round-trip overlaps the next chunk's compute instead of
+        idling the device (worth ~a round-trip per chunk on a tunnelled
+        chip).  Emission/retirement decisions lag one chunk; tokens are
+        identical."""
         finished = self._admit()
         if not self._occupied.any():
+            if self._pending_read is not None:
+                toks_dev, snapshot = self._pending_read
+                self._pending_read = None
+                finished += self._consume_chunk(toks_dev, snapshot)
             return finished
         # Page coverage for the whole chunk/round, allocated on demand.
         for slot, req in self._slot_req.items():
@@ -509,26 +546,62 @@ class ServeEngine:
         if self.draft_params is not None:
             return finished + self._step_spec()
 
+        tok_in = self._dev(self._tokens)
+        if self.pipelined and self._chained_tok is not None:
+            # Continue from the previous chunk's last tokens ON DEVICE;
+            # only freshly admitted slots take their host-side first
+            # token.
+            fresh = np.zeros(self.slots, bool)
+            for s in self._fresh_slots:
+                fresh[s] = True
+            tok_in = jnp.where(jnp.asarray(fresh), tok_in, self._chained_tok)
+        self._fresh_slots.clear()
+
         toks, self.pools = self._chunk(
             self.params, self.pools,
-            jnp.asarray(self._tables), jnp.asarray(self._tokens),
-            jnp.asarray(self._positions), jnp.asarray(self._occupied),
+            self._dev(self._tables), tok_in,
+            self._dev(self._positions), self._dev(self._occupied),
             self._next_key(), jnp.float32(self.temperature),
             jnp.int32(self.top_k), jnp.float32(self.top_p),
         )
-        toks = np.asarray(toks)  # the host sync point: tokens stream out
         self.chunks_run += 1
-        for slot in list(self._slot_req):
-            req = self._slot_req[slot]
-            for tok in toks[slot]:
-                req.tokens.append(int(tok))
-                self.generated_tokens += 1
-                if int(tok) == req.eos_token or (
-                    len(req.tokens) >= req.max_new_tokens
-                ):
-                    req.done = True
-                    break
+        snapshot = dict(self._slot_req)
+        for slot in snapshot:
             self._positions[slot] += self.chunk
+        if not self.pipelined:
+            return finished + self._consume_chunk(toks, snapshot)
+        self._chained_tok = toks[:, -1]
+        prev, self._pending_read = self._pending_read, (toks, snapshot)
+        if prev is not None:
+            # Reading the PREVIOUS chunk now overlaps the one in flight.
+            finished += self._consume_chunk(*prev)
+        return finished
+
+    def _emit(self, req: Request, toks_row) -> None:
+        """Append a row's freshly decoded tokens to its request, flipping
+        ``done`` at eos/max_new — the single emission policy for chunked
+        and speculative serving."""
+        for tok in toks_row:
+            req.tokens.append(int(tok))
+            self.generated_tokens += 1
+            if int(tok) == req.eos_token or (
+                len(req.tokens) >= req.max_new_tokens
+            ):
+                req.done = True
+                break
+
+    def _consume_chunk(self, toks_dev, snapshot: dict) -> list[Request]:
+        """Read a chunk's tokens back (the host sync point: tokens stream
+        out) and apply emission/eos/retirement for the slots as they were
+        at dispatch."""
+        toks = np.asarray(toks_dev)
+        finished = []
+        for slot, req in snapshot.items():
+            if req.done:
+                # Retired between dispatch and read (pipelined lag): the
+                # slot decoded a dead chunk; nothing to emit.
+                continue
+            self._emit(req, toks[slot])
             self._tokens[slot] = toks[slot, -1]
             if req.done:
                 finished.append(self._retire(slot))
@@ -541,12 +614,18 @@ class ServeEngine:
         is exactly what the paged compute path supports."""
         from .paged import paged_spec_round
 
+        # Bound the verify forward's gathered view to the live pages
+        # (bucketised so the static cover takes few distinct values).
+        u = self.gamma + 1
+        max_pos = max(int(self._positions[s]) for s in self._slot_req)
+        need = -(-(max_pos + u) // self.page_size)
+        cover = min(self.max_pages, -(-need // 4) * 4)
         committed, n_acc, self.pools, self.d_pools = paged_spec_round(
             self.params, self.draft_params, self.pools, self.d_pools,
-            jnp.asarray(self._tables), jnp.asarray(self._tokens),
-            jnp.asarray(self._positions),
+            self._dev(self._tables), self._dev(self._tokens),
+            self._dev(self._positions),
             t_config=self.config, d_config=self.draft_config,
-            gamma=self.gamma,
+            gamma=self.gamma, cover_pages=cover,
         )
         committed = np.asarray(committed)
         n_acc = np.asarray(n_acc)
@@ -555,14 +634,7 @@ class ServeEngine:
         for slot in list(self._slot_req):
             req = self._slot_req[slot]
             k = int(n_acc[slot]) + 1
-            for tok in committed[slot, :k]:
-                req.tokens.append(int(tok))
-                self.generated_tokens += 1
-                if int(tok) == req.eos_token or (
-                    len(req.tokens) >= req.max_new_tokens
-                ):
-                    req.done = True
-                    break
+            self._emit(req, committed[slot, :k])
             self._positions[slot] += k
             self._tokens[slot] = committed[slot, k - 1]
             if req.done:
@@ -571,7 +643,11 @@ class ServeEngine:
 
     @property
     def idle(self) -> bool:
-        return not self.pending and not self._occupied.any()
+        return (
+            not self.pending
+            and not self._occupied.any()
+            and self._pending_read is None
+        )
 
     def run(self) -> dict[str, list[int]]:
         """Drive step() until every submitted request has finished;
