@@ -738,6 +738,9 @@ def main(argv=None) -> int:
                         help="serve int8 weight-only quantized weights")
     parser.add_argument("--kv-heads", type=int, default=None,
                         help="grouped-query kv heads (default: n_heads)")
+    parser.add_argument("--pipelined", action="store_true",
+                        help="overlap each chunk's readback with the next "
+                        "chunk's compute (same tokens, higher throughput)")
     args = parser.parse_args(argv)
     if args.requests < 1 or args.slots < 1:
         parser.error("--requests and --slots must be >= 1")
@@ -771,7 +774,7 @@ def main(argv=None) -> int:
         params, config, slots=args.slots, page_size=page_size,
         prompt_bucket=bucket,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-        rng=jax.random.PRNGKey(42),
+        rng=jax.random.PRNGKey(42), pipelined=args.pipelined,
     )
     key = jax.random.PRNGKey(7)
     for i in range(args.requests):
