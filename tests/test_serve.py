@@ -436,6 +436,11 @@ def test_speculative_engine_validations():
             params, CONFIG, draft_params=draft, draft_config=DRAFT_CONFIG,
             temperature=0.5,
         )
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ServeEngine(
+            params, CONFIG, draft_params=draft, draft_config=DRAFT_CONFIG,
+            pipelined=True,
+        )
 
 
 def test_engine_validates_submissions():

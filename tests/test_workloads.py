@@ -170,6 +170,24 @@ class TestLease:
         assert claim_lease_state("tpu-0", str(tmp_path)) is False
 
 
+def test_burst_calibration_floors_and_caps(monkeypatch):
+    """A jitter-dominated (or degenerate) slope must not size an
+    hours-long lease-holding burst: the per-step estimate is floored and
+    the step count capped."""
+    import workloads.busy_probe as bp
+
+    # Degenerate slope: measure_slope_secs returns its 1e-9 floor.
+    monkeypatch.setattr(
+        "workloads.perfbench.measure_slope_secs", lambda *a, **k: 1e-9
+    )
+    assert bp._calibrate_steps(lambda n: None, 1.0) == 100_000
+    # A sane slope passes through: 10 ms/step at a 1 s target = 100.
+    monkeypatch.setattr(
+        "workloads.perfbench.measure_slope_secs", lambda *a, **k: 0.01
+    )
+    assert bp._calibrate_steps(lambda n: None, 1.0) == 100
+
+
 def test_busy_probe_aggregation(tmp_path, monkeypatch):
     from workloads import busy_probe
 
