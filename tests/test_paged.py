@@ -239,6 +239,37 @@ def test_xla_fallback_matches_kernel():
         )
 
 
+def test_length_zero_row_is_safe():
+    """A fully-dead row (length 0 — an empty serve slot) must not index
+    the block table at -1: the kv_map clamps its last-page computation,
+    matching _finalize's claim that such rows are supported (their
+    output is zeros from the l_safe guard).  Live rows are unaffected."""
+    import jax
+
+    from workloads.ops.paged_attention import (
+        _paged_attention_xla,
+        paged_attention,
+    )
+
+    L, n_pages, Hkv, ps, hd = 1, 8, 2, 4, 16
+    heads, batch, maxp = 2, 2, 2
+    kp = jax.random.normal(jax.random.PRNGKey(0), (L, n_pages, Hkv, ps, hd))
+    vp = jax.random.normal(jax.random.PRNGKey(1), (L, n_pages, Hkv, ps, hd))
+    q = jax.random.normal(jax.random.PRNGKey(2), (batch, heads, hd))
+    tables = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+    lengths = jnp.asarray([0, 6], jnp.int32)  # row 0 is dead
+    for impl in (
+        lambda *a: paged_attention(*a, layer=0, interpret=True),
+        lambda *a: _paged_attention_xla(*a, layer=0, window=None),
+    ):
+        out = np.asarray(impl(q, kp, vp, tables, lengths))
+        assert np.all(np.isfinite(out))
+        np.testing.assert_array_equal(out[0], np.zeros_like(out[0]))
+        # Row 1 matches itself with the dead row absent.
+        alone = impl(q[1:], kp, vp, tables[1:], lengths[1:])
+        np.testing.assert_allclose(out[1], np.asarray(alone[0]), atol=1e-6)
+
+
 def test_prefill_padding_never_writes_other_pages(params):
     """Padding table columns (whatever their value — here the dangerous
     default 0) must not be written by a ragged prefill: the scatter is

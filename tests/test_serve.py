@@ -350,6 +350,29 @@ def test_pipelined_engine_eos_and_fanout():
     assert engine.ctrl.used_pages == 0
 
 
+def test_pipelined_engine_full_length_request():
+    """A request using the FULL context window (prompt + max_new ==
+    max_seq_len, with (max_new-1) % chunk == 1 so the dead pipelined
+    chunk lands at the window edge) must serve without exhausting the
+    page pool: per-dispatch extension is one chunk past the position,
+    and only the admission commitment carries the 2-chunk pipelined
+    overshoot.  Regression test for the page-budget invariant (a valid
+    request that passed submit() must never crash mid-stream)."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine = ServeEngine(
+        params, CONFIG, slots=1, page_size=16, prompt_bucket=16, chunk=16,
+        pipelined=True,
+    )
+    prompt = list(range(1, 15))  # 14 + 50 == max_seq_len == 64
+    rid = engine.submit(prompt, 50)
+    served = engine.run()
+    want = generate(
+        params, jnp.asarray([prompt], jnp.int32), CONFIG, max_new_tokens=50
+    )
+    np.testing.assert_array_equal(np.asarray(served[rid]), np.asarray(want[0]))
+    assert engine.ctrl.used_pages == 0
+
+
 DRAFT_CONFIG = ModelConfig(
     max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
     dtype=jnp.float32,

@@ -144,6 +144,35 @@ class TestClaimLivenessProbe:
         probe = make_claim_liveness_probe(v5e4, str(tmp_path), counts_authoritative=True)
         assert probe(["tpu-0"]) == {"tpu-0": None}
 
+    def test_predecessor_drop_cannot_condemn_successor(self, v5e4, tmp_path):
+        """The ADVICE-r3 misfire: sibling A (epoch e1) declared its claim
+        lease and exited AFTER pod B (epoch e2) was allocated but BEFORE
+        B called hold_claim_leases.  A's unheld file must read as unknown
+        for B's epoch-scoped probe — never as B's death."""
+        import fcntl
+        import os
+
+        from tpu_device_plugin.sharing import claim_lease_path
+        from tpu_device_plugin.strategy import make_claim_liveness_probe
+
+        probe = make_claim_liveness_probe(v5e4, str(tmp_path))
+        # A declared at epoch e1 then exited: file exists, flock dropped.
+        open(claim_lease_path(str(tmp_path), "tpu-0", "e1"), "w").close()
+        assert probe({"tpu-0": "e2"}) == {"tpu-0": None}  # NOT False
+        # While A still lives (held flock), any epoch proves the chip alive.
+        fd = os.open(
+            claim_lease_path(str(tmp_path), "tpu-0", "e1"),
+            os.O_CREAT | os.O_RDWR, 0o666,
+        )
+        try:
+            fcntl.flock(fd, fcntl.LOCK_SH)
+            assert probe({"tpu-0": "e2"}) == {"tpu-0": True}
+        finally:
+            os.close(fd)
+        # B declares under ITS epoch and exits: that IS death evidence.
+        open(claim_lease_path(str(tmp_path), "tpu-0", "e2"), "w").close()
+        assert probe({"tpu-0": "e2"}) == {"tpu-0": False}
+
 
 def test_mixed_strategy_both_views_share_ledger(v5e4):
     strategy = make_strategy("mixed", v5e4)

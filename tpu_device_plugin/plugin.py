@@ -87,6 +87,10 @@ class _Claim:
     renewed: float  # last claim/renewal time; the TTL counts from here
     born: float  # original Allocate time; the startup grace counts from here
     seen_alive: bool = False  # workload observed alive at least once
+    # Per-allocation epoch (mirrors the TPU_CLAIM_EPOCH env the pod got):
+    # the probe reads death evidence only from THIS epoch's claim file, so
+    # a predecessor's dropped flock cannot condemn a successor's claim.
+    epoch: str | None = None
 
 
 class ClaimLedger:
@@ -147,11 +151,15 @@ class ClaimLedger:
         with self._lock:
             self._listeners.append(fn)
 
-    def claim(self, resource: str, chip_ids: list[str]) -> None:
+    def claim(
+        self, resource: str, chip_ids: list[str], epoch: str | None = None
+    ) -> None:
         now = self._clock()
         with self._lock:
             for cid in chip_ids:
-                self._claims[cid] = _Claim(resource=resource, renewed=now, born=now)
+                self._claims[cid] = _Claim(
+                    resource=resource, renewed=now, born=now, epoch=epoch
+                )
             listeners = list(self._listeners)
         for fn in listeners:
             fn()
@@ -184,7 +192,11 @@ class ClaimLedger:
         with self._lock:
             probe = self._probe
             due = probe is not None and now - self._last_probe >= self._probe_interval
-            claimed = list(self._claims) if due else []
+            # The probe gets each claim's allocation epoch so claim-lease
+            # death evidence is scoped to the allocation it belongs to.
+            claimed = (
+                {cid: c.epoch for cid, c in self._claims.items()} if due else {}
+            )
             if due:
                 self._last_probe = now
         if claimed:
@@ -601,10 +613,16 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
         response = pb.AllocateResponse()
         allocated_chips: list[str] = []
         labels = {"resource": self.resource_name}
+        # One fresh epoch per Allocate: the pod's claim-lease files carry
+        # it, so this allocation's death evidence can never be read off a
+        # predecessor's dropped flock (see sharing.CLAIM_EPOCH_ENV).
+        epoch = f"{time.time_ns():x}" if self._claims is not None else None
         with metrics_timed("allocate", labels):
             for req in request.container_requests:
                 try:
-                    container, chips = self._allocate_one(list(req.devicesIDs))
+                    container, chips = self._allocate_one(
+                        list(req.devicesIDs), claim_epoch=epoch
+                    )
                 except AllocationError as e:
                     metrics_registry.inc("allocation_errors_total", labels)
                     context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
@@ -615,7 +633,7 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
         # multi-container Allocate fails as a unit and must not leave orphan
         # claims blocking the other mixed view for the full TTL.
         if self._claims is not None and allocated_chips:
-            self._claims.claim(self.resource_name, allocated_chips)
+            self._claims.claim(self.resource_name, allocated_chips, epoch=epoch)
             # Fresh slate for the claim-lease evidence: a predecessor's
             # stale (unheld) claim file must not read as the NEW pod's
             # death once its grace passes.  Held files (live time-sliced
@@ -623,7 +641,9 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
             sharing.clear_stale_claim_leases(allocated_chips, self._lease_dir)
         return response
 
-    def _allocate_one(self, requested_ids: list[str]):
+    def _allocate_one(
+        self, requested_ids: list[str], claim_epoch: str | None = None
+    ):
         with self._lock:
             advertised_ids = self._advertised_ids
             unit_by_id = dict(self._unit_by_id)
@@ -658,8 +678,10 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
         for key, value in sharing.container_env(
             chips, shared=self.shared, lease_dir=self._lease_dir,
             # Mixed-strategy allocations carry the claim-lease dir so the
-            # workload can declare its lifetime (hostPID-free release).
+            # workload can declare its lifetime (hostPID-free release),
+            # epoch-scoped to this allocation.
             claim_lease=self._claims is not None,
+            claim_epoch=claim_epoch,
         ).items():
             container.envs[key] = value
         if self.shared or self._claims is not None:

@@ -35,6 +35,12 @@ SHARED_ENV = "TPU_DEVICE_PLUGIN_SHARED"
 # visibility is filesystem-level, so this is the release signal that
 # works with the chart's default ``hostPID: false``.
 CLAIM_LEASE_DIR_ENV = "TPU_CLAIM_LEASE_DIR"
+# Per-allocation epoch carried in the Allocate env and baked into the claim
+# file NAME: death evidence is only ever read from the epoch the ledger's
+# current claim was born with, so a PREDECESSOR's dropped flock (its pod
+# exited while the fresh pod is still in container start, before it could
+# declare) can never condemn the successor's live claim.
+CLAIM_EPOCH_ENV = "TPU_CLAIM_EPOCH"
 
 
 def process_bounds(chips: list[Chip]) -> tuple[str, str] | None:
@@ -68,12 +74,15 @@ def container_env(
     shared: bool,
     lease_dir: str = DEFAULT_LEASE_DIR,
     claim_lease: bool = False,
+    claim_epoch: str | None = None,
 ) -> dict[str, str]:
     """libtpu/JAX environment for a container granted ``chips``.
 
     ``claim_lease`` (mixed strategy) additionally points the workload at
     the claim-lease directory so it can declare its lifetime via
-    workloads.lease.hold_claim_leases — the hostPID-free release path."""
+    workloads.lease.hold_claim_leases — the hostPID-free release path.
+    ``claim_epoch`` scopes that declaration to THIS allocation (see
+    CLAIM_EPOCH_ENV)."""
     indices = sorted(c.index for c in chips)
     env = {
         "TPU_VISIBLE_DEVICES": ",".join(str(i) for i in indices),
@@ -88,6 +97,8 @@ def container_env(
         env[LEASE_DIR_ENV] = lease_dir
     if claim_lease:
         env[CLAIM_LEASE_DIR_ENV] = lease_dir
+        if claim_epoch:
+            env[CLAIM_EPOCH_ENV] = claim_epoch
     return env
 
 
@@ -107,36 +118,38 @@ def lease_path(lease_dir: str, chip_id: str) -> str:
     return os.path.join(lease_dir, f"chip-{chip_id.replace('/', '_')}.lock")
 
 
-def claim_lease_path(lease_dir: str, chip_id: str) -> str:
+def claim_lease_path(
+    lease_dir: str, chip_id: str, epoch: str | None = None
+) -> str:
     """Host path of a chip's lifetime claim lease (distinct from the
     cooperative time-slice lease: this one is held from workload start to
-    exit, not per burst)."""
-    return os.path.join(lease_dir, f"claim-{chip_id.replace('/', '_')}.lock")
+    exit, not per burst).  With ``epoch`` the file is scoped to one
+    allocation: ``claim-<chip>.<epoch>.lock``."""
+    base = f"claim-{chip_id.replace('/', '_')}"
+    if epoch:
+        return os.path.join(lease_dir, f"{base}.{epoch}.lock")
+    return os.path.join(lease_dir, f"{base}.lock")
 
 
-def claim_lease_state(chip_id: str, lease_dir: str = DEFAULT_LEASE_DIR):
-    """Tri-state lifetime evidence for the ClaimLedger's probe:
+def _claim_lease_files(lease_dir: str, chip_id: str) -> list[str]:
+    """Every claim-lease file for ``chip_id`` — the legacy un-epoched name
+    plus any epoch-qualified ones."""
+    import glob
 
-      * True  — the claim flock is HELD: at least one declaring workload
-        is alive (holders take SHARED flocks, so time-sliced siblings on
-        one chip all count; the probe's exclusive attempt fails while
-        any of them lives).
-      * False — the claim file EXISTS but nobody holds it: every
-        workload that declared itself on this chip has exited (flocks
-        drop with the process).  Death evidence that needs no hostPID.
-      * None  — no claim file: no workload ever declared itself (a
-        non-cooperative image); prove nothing.  The plugin removes
-        STALE claim files at Allocate so a predecessor's file can never
-        condemn a non-cooperative successor.
+    base = f"claim-{chip_id.replace('/', '_')}"
+    return sorted(
+        set(
+            glob.glob(os.path.join(glob.escape(lease_dir), f"{base}.lock"))
+            + glob.glob(os.path.join(glob.escape(lease_dir), f"{base}.*.lock"))
+        )
+    )
 
-    The momentary exclusive probe can race a workload's own acquisition;
-    the workload side (workloads.lease.hold_claim_leases) therefore
-    acquires with a BLOCKING shared flock, which simply waits out the
-    probe's microsecond hold.
-    """
+
+def _flock_held(path: str) -> bool | None:
+    """True: some process holds a flock on ``path``; False: file exists
+    unheld; None: no file."""
     import fcntl
 
-    path = claim_lease_path(lease_dir, chip_id)
     try:
         fd = os.open(path, os.O_RDWR)
     except OSError:
@@ -152,21 +165,74 @@ def claim_lease_state(chip_id: str, lease_dir: str = DEFAULT_LEASE_DIR):
         os.close(fd)
 
 
+def claim_lease_state(
+    chip_id: str,
+    lease_dir: str = DEFAULT_LEASE_DIR,
+    epoch: str | None = None,
+):
+    """Tri-state lifetime evidence for the ClaimLedger's probe:
+
+      * True  — some claim flock on this chip is HELD: at least one
+        declaring workload is alive (holders take SHARED flocks, so
+        time-sliced siblings on one chip all count; the probe's exclusive
+        attempt fails while any of them lives).  Any epoch counts — a
+        live sibling from an earlier allocation is still using the chip.
+      * False — the claim file for the probed allocation EXISTS but
+        nobody holds it: the workload that declared itself under this
+        epoch has exited (flocks drop with the process).  Death evidence
+        that needs no hostPID.
+      * None  — nothing declared under the probed allocation: prove
+        nothing.  Crucially, with ``epoch`` set, a PREDECESSOR's dropped
+        flock (a different epoch's unheld file) lands here, not at False
+        — its exit happened before this allocation's pod ever declared,
+        so it must not condemn the fresh claim while that pod is still
+        in container start (the ledger falls back to the TTL).
+
+    Callers without an epoch get the legacy semantics: any unheld claim
+    file reads as death.
+
+    The momentary exclusive probe can race a workload's own acquisition;
+    the workload side (workloads.lease.hold_claim_leases) therefore
+    acquires with a BLOCKING shared flock, which simply waits out the
+    probe's microsecond hold.
+    """
+    states = {
+        path: _flock_held(path) for path in _claim_lease_files(lease_dir, chip_id)
+    }
+    if any(held is True for held in states.values()):
+        return True
+    if epoch:
+        # Death evidence: this allocation's own file dropped, or a LEGACY
+        # (un-epoched) declaration dropped — a workload image predating
+        # the epoch env can only declare legacy, and for it the pre-epoch
+        # semantics (drop = death) is the best available; stale legacy
+        # files were cleared at Allocate, so the exposure is unchanged.
+        # A DIFFERENT epoch's unheld file is a predecessor's exit, not
+        # this allocation's: prove nothing.
+        dead = (
+            states.get(claim_lease_path(lease_dir, chip_id, epoch)) is False
+            or states.get(claim_lease_path(lease_dir, chip_id)) is False
+        )
+        return False if dead else None
+    return False if any(held is False for held in states.values()) else None
+
+
 def clear_stale_claim_leases(chip_ids: list[str], lease_dir: str = DEFAULT_LEASE_DIR) -> None:
-    """Remove STALE (existing but unheld) claim-lease files at Allocate
-    time: each new claim starts from ``None`` (nothing declared) so a
-    previous workload's leftover file cannot read as the NEW workload's
-    death.  A HELD file is left strictly alone — on a time-sliced chip it
-    is a live sibling's declaration, and the newcomer will share the same
-    inode.  (The check-then-unlink window is a bounded race: losing it
-    can only cost an early-release signal, degrading that chip to the
-    TTL fallback, never releasing a live claim by itself.)"""
+    """Remove STALE (existing but unheld) claim-lease files — any epoch —
+    at Allocate time: each new claim starts from ``None`` (nothing
+    declared) so a previous workload's leftover file cannot read as the
+    NEW workload's death.  A HELD file is left strictly alone — on a
+    time-sliced chip it is a live sibling's declaration.  (The
+    check-then-unlink window is a bounded race: losing it can only cost
+    an early-release signal, degrading that chip to the TTL fallback,
+    never releasing a live claim by itself.)"""
     for cid in chip_ids:
-        if claim_lease_state(cid, lease_dir) is False:
-            try:
-                os.unlink(claim_lease_path(lease_dir, cid))
-            except OSError:
-                pass
+        for path in _claim_lease_files(lease_dir, cid):
+            if _flock_held(path) is False:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
 
 def lease_held(chip_id: str, lease_dir: str = DEFAULT_LEASE_DIR) -> bool:

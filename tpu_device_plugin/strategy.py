@@ -74,10 +74,16 @@ def make_claim_liveness_probe(
         alive; a claim FILE left unheld proves the declaring workload
         exited — the death evidence that works under the chart's default
         ``hostPID: false``; no file proves nothing (non-cooperative
-        image; the plugin cleared stale files at Allocate).
+        image; the plugin cleared stale files at Allocate).  Death is
+        read ONLY from the probed claim's own allocation epoch (the
+        ledger passes {chip_id: epoch}): a predecessor's dropped flock
+        must not condemn a successor pod that has not yet declared.
     """
 
-    def probe(chip_ids: list[str]) -> dict:
+    def probe(chip_ids) -> dict:
+        # The ledger passes {chip_id: epoch}; a bare list (older callers,
+        # tests) probes with no epoch scoping.
+        epochs = chip_ids if isinstance(chip_ids, dict) else {}
         in_use: dict[int, int] = {}
         fn = getattr(manager, "chips_in_use", None)
         if callable(fn):
@@ -93,7 +99,9 @@ def make_claim_liveness_probe(
         for cid in chip_ids:
             idx = index_by_id.get(cid)
             count = in_use.get(idx) if idx is not None else None
-            claim = sharing.claim_lease_state(cid, lease_dir)
+            claim = sharing.claim_lease_state(
+                cid, lease_dir, epoch=epochs.get(cid)
+            )
             if count is not None and count > 0:
                 out[cid] = True
             elif claim is True or sharing.lease_held(cid, lease_dir):

@@ -24,6 +24,7 @@ import time
 from contextlib import contextmanager
 
 from tpu_device_plugin.sharing import (  # noqa: F401  (lease_path re-exported)
+    CLAIM_EPOCH_ENV,
     CLAIM_LEASE_DIR_ENV,
     DEFAULT_LEASE_DIR,
     LEASE_DIR_ENV,
@@ -64,17 +65,23 @@ def hold_claim_leases(
     which only ever waits out that probe's microsecond hold, never a
     sibling (shared locks compose).
 
+    The claim file name carries this allocation's epoch (TPU_CLAIM_EPOCH,
+    injected by Allocate) so the daemon reads death evidence only from
+    the allocation it belongs to — a predecessor pod's dropped flock can
+    never read as THIS pod's exit.
+
     No-op (returns 0) when TPU_CLAIM_LEASE_DIR is absent — non-mixed
     deployments inject no claim-lease env.  Idempotent per process.
     Returns the number of flocks newly taken."""
     lease_dir = lease_dir or os.environ.get(CLAIM_LEASE_DIR_ENV, "")
     if not lease_dir:
         return 0
+    epoch = os.environ.get(CLAIM_EPOCH_ENV) or None
     chip_ids = sorted(chip_ids if chip_ids is not None else chip_ids_from_env())
     os.makedirs(lease_dir, exist_ok=True)
     taken = 0
     for cid in chip_ids:
-        path = claim_lease_path(lease_dir, cid)
+        path = claim_lease_path(lease_dir, cid, epoch)
         if path in _claim_paths:
             continue  # this process already declares this chip
         fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o666)

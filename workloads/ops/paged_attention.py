@@ -156,6 +156,10 @@ def _paged_attention_xla(
     s = jnp.where(mask[:, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bngt,btnk->bngk", p, v.astype(jnp.float32))
+    # Fully-dead rows (length 0 — empty serve slots) have an all-False
+    # mask: softmax over uniform NEG_INF would average garbage pages.
+    # Match the kernel's _finalize l_safe semantics: zeros.
+    out = jnp.where((lengths > 0)[:, None, None, None], out, 0.0)
     return out.reshape(batch, heads, head_dim).astype(q.dtype)
 
 
@@ -223,7 +227,11 @@ def paged_attention(
 
     def kv_map(b, j, tables_ref, lengths_ref):
         length = lengths_ref[b]
-        last = (length - 1) // page_size
+        # Clamp before dividing: a fully-dead row (length 0, which
+        # _finalize supports) must not index tables_ref at -1 — interpret
+        # mode would wrap pythonically but a negative scalar-prefetch
+        # block index is undefined on hardware.
+        last = jnp.maximum(length - 1, 0) // page_size
         j_eff = jnp.minimum(j, last)
         if window is not None:
             # Pages fully before the window start clamp forward to the

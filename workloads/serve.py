@@ -537,10 +537,19 @@ class ServeEngine:
                 finished += self._consume_chunk(toks_dev, snapshot)
             return finished
         # Page coverage for the whole chunk/round, allocated on demand.
+        # Each dispatch needs exactly ONE step unit past the current
+        # position (the position already accounts for previously
+        # dispatched, not-yet-read chunks) — _overshoot is the LIFETIME
+        # bound used for commitment/max_pages sizing, and extending by it
+        # here would overrun both the admission-time commitment and
+        # max_pages on a request ending near max_seq_len.
+        step_need = (
+            (self.gamma + 1) if self.draft_params is not None else self.chunk
+        )
         for slot, req in self._slot_req.items():
             seq = self._seq_id(slot, req)
             table = self.ctrl.extend(
-                seq, int(self._positions[slot]) + self._overshoot
+                seq, int(self._positions[slot]) + step_need
             )
             self._tables[slot, : len(table)] = table
         if self.draft_params is not None:
