@@ -1,0 +1,180 @@
+"""Cross-request prefix caching (workloads/paged.py PrefixCache +
+ServeEngine prefix_cache=True): repeated prompts reuse k/v pages and skip
+their prefill compute; tokens stay exactly the uncached tokens; the
+cache yields pages back under pool pressure (LRU, index-only)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.paged import PagePool, PrefixCache
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+
+
+def _engine(params, config=CONFIG, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prompt_bucket", 8)
+    kw.setdefault("chunk", 4)
+    return ServeEngine(params, config, prefix_cache=True, **kw)
+
+
+def test_prefix_cache_unit_chain_and_eviction():
+    """PrefixCache alone: chain keys share only true common prefixes;
+    eviction frees LRU index-only pages and skips shared ones."""
+    ctrl = PagePool(n_pages=8, page_size=4)
+    cache = PrefixCache(ctrl)
+    t_a = ctrl.allocate("a", 12)  # 3 pages for tokens A
+    tokens_a = list(range(12))
+    cache.insert(tokens_a, t_a)
+    assert cache.cached_pages == 3
+    # Full-prefix hit, capped.
+    assert cache.lookup(tokens_a, 3) == t_a
+    assert cache.lookup(tokens_a, 2) == t_a[:2]
+    # A prompt sharing only the first block hits one page.
+    tokens_b = tokens_a[:4] + [99, 98, 97, 96]
+    assert cache.lookup(tokens_b, 2) == t_a[:1]
+    # A different first block misses entirely.
+    assert cache.lookup([7] * 8, 2) == []
+    # Release the sequence: pages become index-only (refcount 1).
+    ctrl.release("a")
+    assert ctrl.used_pages == 3
+    # Evict 2: LRU entries go first; the pages return to the free list.
+    assert cache.evict(2) == 2
+    assert ctrl.used_pages == 1
+    cache.clear()
+    assert ctrl.used_pages == 0 and cache.cached_pages == 0
+
+
+def test_second_request_reuses_prefix_tokens_identical():
+    """The parity pin: with the cache on, a repeated prompt emits exactly
+    the tokens generate() produces, while its prefill computes only the
+    un-cached remainder."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine = _engine(params)
+    prompt = list(range(1, 20))  # 19 tokens: 4 full pages, bucket=8 -> bp=2
+    r1 = engine.submit(prompt, 8)
+    engine.run()
+    first_prefill = engine.prefill_tokens
+    assert first_prefill == 19
+    r2 = engine.submit(prompt, 8)
+    served = engine.run()
+    want = generate(
+        params, jnp.asarray([prompt], jnp.int32), CONFIG, max_new_tokens=8
+    )
+    np.testing.assert_array_equal(np.asarray(served[r2]), np.asarray(want[0]))
+    # Hits capped to bucket-aligned pages: 4 full pages of 19 tokens,
+    # cap (19-1)//4=4 floored to bp-multiple 4 -> 16 tokens skipped.
+    assert engine.prefill_tokens - first_prefill == 3
+    assert engine.prefix.hits >= 4
+
+
+def test_shared_512_token_prefix_cuts_prefill_compute_4x():
+    """VERDICT r4 item: the second request with a shared 512-token prefix
+    runs >= ~4x less prefill compute (here 64x: only the 8-token suffix
+    forwards; prefill_tokens counts tokens actually forwarded)."""
+    config = ModelConfig(max_seq_len=640, n_layers=1, dtype=jnp.float32)
+    params = init_params(config, jax.random.PRNGKey(0))
+    engine = ServeEngine(
+        params, config, slots=2, page_size=16, prompt_bucket=64, chunk=16,
+        prefix_cache=True,
+    )
+    rng = np.random.default_rng(3)
+    prefix = list(rng.integers(0, config.vocab_size, 512))
+    a = engine.submit(prefix + [1, 2, 3, 4, 5, 6, 7, 8], 4)
+    engine.run()
+    first = engine.prefill_tokens
+    assert first == 520
+    b = engine.submit(prefix + [11, 12, 13, 14, 15, 16, 17, 18], 4)
+    served = engine.run()
+    second = engine.prefill_tokens - first
+    assert second * 4 <= first, (first, second)  # >= 4x less (actually 65x)
+    assert second == 8
+    # And the tokens are exactly the uncached engine's.
+    clean = ServeEngine(
+        params, config, slots=2, page_size=16, prompt_bucket=64, chunk=16,
+    )
+    b2 = clean.submit(prefix + [11, 12, 13, 14, 15, 16, 17, 18], 4)
+    want = clean.run()[b2]
+    assert served[b] == want
+
+
+def test_eviction_under_pressure_keeps_serving():
+    """A pool sized for ~one request still serves a stream with the cache
+    on: admissions evict index-only pages on demand, and evicted prefixes
+    simply re-prefill (miss, not failure)."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    # slots=1 so max_pages default sizes the pool to ONE request.
+    engine = ServeEngine(
+        params, CONFIG, slots=1, page_size=4, prompt_bucket=8, chunk=4,
+        prefix_cache=True,
+    )
+    rng = np.random.default_rng(11)
+    prompts = [list(rng.integers(0, CONFIG.vocab_size, 12)) for _ in range(3)]
+    outs = {}
+    for p in prompts + prompts:  # replay: some hit, some re-prefill
+        rid = engine.submit(p, 6)
+        outs[rid] = (p, engine.run()[rid])
+    for rid, (p, got) in outs.items():
+        want = generate(
+            params, jnp.asarray([p], jnp.int32), CONFIG, max_new_tokens=6
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want[0]))
+    # The cache held pages between requests but never broke an admission.
+    assert engine.ctrl.used_pages == engine.prefix.cached_pages
+    engine.prefix.clear()
+    assert engine.ctrl.used_pages == 0
+
+
+def test_prefix_cache_composes_with_speculative():
+    """Prefix reuse under speculative serving: the draft's cached pages
+    carry its own k/v from the original prefill, so a repeated prompt
+    skips BOTH models' prefill and still emits the target's greedy
+    tokens."""
+    draft_config = ModelConfig(
+        max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+        dtype=jnp.float32,
+    )
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    draft = init_params(draft_config, jax.random.PRNGKey(7))
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+        draft_params=draft, draft_config=draft_config, gamma=3,
+        prefix_cache=True,
+    )
+    prompt = list(range(3, 17))  # 14 tokens
+    r1 = engine.submit(prompt, 10)
+    engine.run()
+    first = engine.prefill_tokens
+    r2 = engine.submit(prompt, 10)
+    served = engine.run()
+    assert engine.prefill_tokens - first < first
+    want = generate(
+        params, jnp.asarray([prompt], jnp.int32), CONFIG, max_new_tokens=10
+    )
+    np.testing.assert_array_equal(np.asarray(served[r2]), np.asarray(want[0]))
+
+
+def test_prefix_cache_composes_with_tp_mesh():
+    """Sharded pools change nothing: page indices are mesh-agnostic, so
+    prefix hits skip the TP prefill too and tokens match single-device."""
+    from workloads.train import make_mesh
+
+    mesh = make_mesh(2, model_parallel=2)
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine = _engine(params, mesh=mesh)
+    prompt = list(range(2, 15))
+    r1 = engine.submit(prompt, 6)
+    engine.run()
+    first = engine.prefill_tokens
+    r2 = engine.submit(prompt, 6)
+    served = engine.run()
+    assert engine.prefill_tokens - first < len(prompt)
+    want = generate(
+        params, jnp.asarray([prompt], jnp.int32), CONFIG, max_new_tokens=6
+    )
+    np.testing.assert_array_equal(np.asarray(served[r2]), np.asarray(want[0]))

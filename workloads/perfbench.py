@@ -497,6 +497,65 @@ def measure_serve(scale: BenchScale) -> dict:
     }
 
 
+def measure_prefix_serve(scale: BenchScale) -> dict:
+    """Cross-request prefix caching, measured where it pays: a stream of
+    requests sharing a long system prompt (8 pages — 512 tokens at the
+    full scale's page size) with distinct short suffixes and short
+    generations, served with and without the cache.  Endpoints are real
+    host readbacks (engine.run streams tokens out), same engine config
+    otherwise; the cache is seeded by one warm request in both arms (the
+    uncached arm's warm request also warms the compiles)."""
+    import time as _time
+
+    from .serve import ServeEngine
+
+    ps = scale.page_size
+    prefix_len = 8 * ps
+    suffix_len, n_req = 8, scale.batch
+    chunk = ps
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
+        n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=prefix_len + suffix_len + 2 * chunk,
+    )
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype),
+        init_params(config, jax.random.PRNGKey(0)),
+    )
+    prefix = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(5), (prefix_len,), 0, config.vocab_size, jnp.int32
+    )]
+
+    def serve(cached: bool) -> tuple[float, int]:
+        engine = ServeEngine(
+            params, config, slots=min(4, n_req), page_size=ps, chunk=chunk,
+            prompt_bucket=2 * ps, prefix_cache=cached,
+        )
+        engine.submit(prefix + [1] * suffix_len, chunk)  # warm + seed
+        engine.run()
+        before = engine.prefill_tokens
+        t0 = _time.perf_counter()
+        for i in range(n_req):
+            engine.submit(prefix + [2 + i] * suffix_len, chunk)
+        engine.run()
+        return _time.perf_counter() - t0, engine.prefill_tokens - before
+
+    un_secs, un_tokens = serve(False)
+    ca_secs, ca_tokens = serve(True)
+    return {
+        "prefix_serve_requests": n_req,
+        "prefix_serve_prefix_tokens": prefix_len,
+        "prefix_serve_uncached_secs": round(un_secs, 4),
+        "prefix_serve_cached_secs": round(ca_secs, 4),
+        "prefix_serve_speedup": round(un_secs / max(ca_secs, 1e-9), 3),
+        # 1 - computed/uncomputed prompt tokens: the compute the cache
+        # deleted (the suffix + bucket-alignment remainder still runs).
+        "prefix_prefill_tokens_saved_fraction": round(
+            1.0 - ca_tokens / max(un_tokens, 1), 4
+        ),
+    }
+
+
 def run(scale_name: str = "full") -> dict:
     """The full perf suite as one flat dict (bench.py merges it into the
     JSON line)."""
@@ -520,6 +579,7 @@ def run(scale_name: str = "full") -> dict:
         out["paged_decode_tokens_per_sec"] / out["decode_tokens_per_sec"], 3
     )
     out.update(measure_serve(scale))
+    out.update(measure_prefix_serve(scale))
     return out
 
 

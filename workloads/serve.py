@@ -45,6 +45,7 @@ from .generate import sample_logits
 from .model import ModelConfig, init_params
 from .paged import (
     PagePool,
+    PrefixCache,
     copy_page,
     init_page_pools,
     paged_decode_chunk,
@@ -104,6 +105,7 @@ class ServeEngine:
         draft_config: ModelConfig | None = None,
         gamma: int = 4,
         pipelined: bool = False,
+        prefix_cache: bool = False,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -123,10 +125,6 @@ class ServeEngine:
                 raise ValueError(
                     "speculative serving is greedy (the lossless "
                     "formulation); temperature must be 0"
-                )
-            if mesh is not None:
-                raise ValueError(
-                    "speculative serving is single-mesh for now"
                 )
             if draft_config.vocab_size != config.vocab_size:
                 raise ValueError("target and draft must share a vocabulary")
@@ -171,6 +169,11 @@ class ServeEngine:
         n_pages = n_pages if n_pages is not None else slots * self.max_pages
         self.ctrl = PagePool(n_pages=n_pages, page_size=page_size)
         self.pools = init_page_pools(config, n_pages, page_size)
+        # Cross-request prefix caching: repeated prompts (system prompts,
+        # few-shot preambles) reuse their k/v pages AND skip their prefill
+        # compute.  Opt-in: with it on, drained engines intentionally keep
+        # pages pinned in the index (evicted on demand, or clear()ed).
+        self.prefix = PrefixCache(self.ctrl) if prefix_cache else None
         # Speculative serving: the draft model gets its OWN physical
         # pools but SHARES the control plane — same page indices, same
         # tables — so one allocator serves both caches.
@@ -207,6 +210,7 @@ class ServeEngine:
         self.chunks_run = 0
         self.generated_tokens = 0
         self.prefills_run = 0
+        self.prefill_tokens = 0  # prompt tokens actually forwarded
         self.spec_rounds = 0
         # Pipelined stepping: the not-yet-read previous chunk (device
         # tokens + the slot->request snapshot at dispatch) and the
@@ -232,7 +236,11 @@ class ServeEngine:
                 sampling=self.sampling,
             )
         else:
-            from .tp_serve import make_tp_serve_programs, shard_serving_state
+            from .tp_serve import (
+                make_tp_serve_programs,
+                make_tp_spec_program,
+                shard_serving_state,
+            )
 
             self._prefill, self._chunk = make_tp_serve_programs(
                 self.config, mesh, chunk=self.chunk, sampling=self.sampling
@@ -240,6 +248,17 @@ class ServeEngine:
             self.params, self.pools = shard_serving_state(
                 self.params, self.pools, self.config, mesh
             )
+            if draft_params is not None:
+                # Tensor-parallel speculation: draft and verify both run
+                # under the model mesh (the draft decode's kernel per
+                # shard, the dense verify via GSPMD); the draft state
+                # shards like the target's.
+                self._tp_spec = make_tp_spec_program(
+                    self.config, draft_config, mesh, gamma
+                )
+                self.draft_params, self.d_pools = shard_serving_state(
+                    self.draft_params, self.d_pools, draft_config, mesh
+                )
 
     # ---- submission -----------------------------------------------------
 
@@ -340,6 +359,23 @@ class ServeEngine:
             prompt_len + max_new_tokens - 1 + self._overshoot
         )
 
+    def _ensure_free(self, need: int) -> None:
+        """Evict index-only prefix-cache pages when the free list is short
+        of ``need`` — the cache may pin every idle page at zero cost, but
+        never at the cost of an allocation the budget promised."""
+        if self.prefix is not None and len(self.ctrl.free) < need:
+            self.prefix.evict(need - len(self.ctrl.free))
+
+    def _allocate_evicting(self, seq, n_tokens: int) -> list:
+        self._ensure_free(self.ctrl.pages_needed(n_tokens))
+        return self.ctrl.allocate(seq, n_tokens)
+
+    def _extend_evicting(self, seq, n_tokens: int) -> list:
+        self._ensure_free(
+            self.ctrl.pages_needed(n_tokens) - len(self.ctrl.tables[seq])
+        )
+        return self.ctrl.extend(seq, n_tokens)
+
     def _retire(self, slot: int) -> Request:
         req = self._slot_req.pop(slot)
         self.ctrl.release(self._seq_id(slot, req))
@@ -361,14 +397,14 @@ class ServeEngine:
         shared = (n // self.page_size) * self.page_size
         gseq = ("group", req.group)
         if shared and not g["allocated"]:
-            self.ctrl.allocate(gseq, shared)
+            self._allocate_evicting(gseq, shared)
             g["allocated"] = True
         if shared:
             self.ctrl.fork(gseq, seq, shared)
             if n > shared:
-                self.ctrl.extend(seq, n)
+                self._extend_evicting(seq, n)
         else:  # prompt shorter than one page: nothing shareable
-            self.ctrl.allocate(seq, n)
+            self._allocate_evicting(seq, n)
         table = table_array(
             [self.ctrl.tables[seq]], self.max_pages, fill=self.ctrl.trash
         )
@@ -401,33 +437,47 @@ class ServeEngine:
             del self._groups[req.group]
         return logits
 
-    def _run_prefill(self, table: jax.Array, prompt_tokens: list[int]):
+    def _run_prefill(
+        self, table: jax.Array, prompt_tokens: list[int], start_page: int = 0
+    ):
         """Prefill one admission: a single bucket-wide call for prompts
         that fit, page-aligned CHUNKS (paged_prefill_chunk) for longer
         ones — prefill memory and compile shapes stay bucket-bounded for
-        any prompt length up to max_seq_len.  In speculative mode the
-        DRAFT pools prefill the same prompt too (same tables, its own
-        physical pages).  Returns (last-position logits, pools)."""
+        any prompt length up to max_seq_len.  ``start_page`` skips
+        positions already covered by prefix-cache pages (must be a
+        multiple of bucket pages, so the chunked programs' static shapes
+        are reused).  In speculative mode the DRAFT pools prefill the
+        same remainder too (same tables, its own physical pages; cached
+        pages hold draft k/v from their original prefill).  Returns
+        (last-position logits, pools)."""
         self.prefills_run += 1
+        self.prefill_tokens += len(prompt_tokens) - start_page * self.page_size
         logits, pools = self._prefill_into(
             self.params, self.config, self.pools, self._prefill, table,
-            prompt_tokens,
+            prompt_tokens, start_page,
         )
         if self.d_pools is not None:
             _, self.d_pools = self._prefill_into(
                 self.draft_params, self.draft_config, self.d_pools,
                 partial(paged_prefill, config=self.draft_config), table,
-                prompt_tokens,
+                prompt_tokens, start_page,
             )
         return logits, pools
 
     def _prefill_into(
-        self, params, config, pools, prefill_program, table, prompt_tokens
+        self, params, config, pools, prefill_program, table, prompt_tokens,
+        start_page: int = 0,
     ):
         n = len(prompt_tokens)
         B = self.prompt_bucket
+        bucket_pages = B // self.page_size
+        if start_page % bucket_pages:
+            raise ValueError(
+                f"prefill start_page {start_page} must be a multiple of "
+                f"bucket pages {bucket_pages}"
+            )
         lengths = jnp.asarray([n], jnp.int32)
-        if n <= B:
+        if start_page == 0 and n <= B:
             prompt = np.zeros((1, B), np.int32)
             prompt[0, :n] = prompt_tokens
             return prefill_program(
@@ -439,10 +489,9 @@ class ServeEngine:
         # pool shardings propagate through the scatter back out.
         from .paged import paged_prefill_chunk
 
-        bucket_pages = B // self.page_size
         n_chunks = -(-n // B)
         logits = None
-        for ci in range(n_chunks):
+        for ci in range(start_page // bucket_pages, n_chunks):
             start = ci * B
             chunk = np.zeros((1, B), np.int32)
             width = min(B, n - start)
@@ -478,12 +527,31 @@ class ServeEngine:
             if req.group is not None:
                 logits = self._admit_group_member(req, seq, n)
             else:
-                self.ctrl.allocate(seq, n)
+                shared_pages = []
+                if self.prefix is not None:
+                    # Cap hits to (a) leave >= 1 prompt token computed (the
+                    # last position's logits feed the first sample) and (b)
+                    # a bucket-aligned page count, so the partial prefill
+                    # reuses the chunked programs' static shapes.
+                    bp = self.prompt_bucket // self.page_size
+                    cap = (n - 1) // self.page_size // bp * bp
+                    shared_pages = self.prefix.lookup(
+                        req.prompt, cap, granularity=bp
+                    )
+                if shared_pages:
+                    self.ctrl.adopt(seq, shared_pages)
+                    self._extend_evicting(seq, n)
+                else:
+                    self._allocate_evicting(seq, n)
                 table = table_array(
                     [self.ctrl.tables[seq]], self.max_pages,
                     fill=self.ctrl.trash,
                 )
-                logits, self.pools = self._run_prefill(table, req.prompt)
+                logits, self.pools = self._run_prefill(
+                    table, req.prompt, start_page=len(shared_pages)
+                )
+                if self.prefix is not None:
+                    self.prefix.insert(req.prompt, self.ctrl.tables[seq])
             tok = int(
                 self._first_token(
                     logits, self._next_key(),
@@ -548,7 +616,7 @@ class ServeEngine:
         )
         for slot, req in self._slot_req.items():
             seq = self._seq_id(slot, req)
-            table = self.ctrl.extend(
+            table = self._extend_evicting(
                 seq, int(self._positions[slot]) + step_need
             )
             self._tables[slot, : len(table)] = table
@@ -629,13 +697,20 @@ class ServeEngine:
         max_pos = max(int(self._positions[s]) for s in self._slot_req)
         need = -(-(max_pos + u) // self.page_size)
         cover = min(self.max_pages, -(-need // 4) * 4)
-        committed, n_acc, self.pools, self.d_pools = paged_spec_round(
-            self.params, self.draft_params, self.pools, self.d_pools,
-            self._dev(self._tables), self._dev(self._tokens),
-            self._dev(self._positions),
-            t_config=self.config, d_config=self.draft_config,
-            gamma=self.gamma, cover_pages=cover,
-        )
+        if self._mesh is None:
+            committed, n_acc, self.pools, self.d_pools = paged_spec_round(
+                self.params, self.draft_params, self.pools, self.d_pools,
+                self._dev(self._tables), self._dev(self._tokens),
+                self._dev(self._positions),
+                t_config=self.config, d_config=self.draft_config,
+                gamma=self.gamma, cover_pages=cover,
+            )
+        else:
+            committed, n_acc, self.pools, self.d_pools = self._tp_spec(
+                self.params, self.draft_params, self.pools, self.d_pools,
+                self._dev(self._tables), self._dev(self._tokens),
+                self._dev(self._positions), cover,
+            )
         committed = np.asarray(committed)
         n_acc = np.asarray(n_acc)
         self.spec_rounds += 1

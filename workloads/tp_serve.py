@@ -42,7 +42,7 @@ except ImportError:  # pragma: no cover - older jax
 from .generate import decode_step, init_kv_cache
 from .model import ModelConfig, param_specs
 from .ops.paged_attention import paged_attention
-from .paged import _chunk_core, _prefill_core
+from .paged import _chunk_core, _prefill_core, _spec_round_core
 
 
 def _check_tp(config: ModelConfig, mesh: Mesh) -> int:
@@ -200,6 +200,61 @@ def make_tp_serve_programs(
         )
 
     return tp_prefill, tp_chunk
+
+
+def make_tp_spec_program(
+    t_config: ModelConfig, d_config: ModelConfig, mesh: Mesh, gamma: int
+):
+    """Tensor-parallel batched speculative round: draft AND verify both
+    run under the "model" mesh axis.
+
+    The draft's per-token decode uses the Pallas paged-attention kernel,
+    so it gets the same per-shard shard_map treatment as the decode
+    chunk; the target's block-verify forward is dense (no kernel) and
+    partitions under plain GSPMD from the sharded params/pools.  Both
+    models must satisfy the head-divisibility contract (a draft with
+    fewer kv heads than the mesh's model degree cannot shard — shrink
+    the mesh or widen the draft).
+
+    Returns spec_round(t_params, d_params, t_pools, d_pools, tables,
+    cur, positions, cover_pages) -> (committed, n_accept, t_pools,
+    d_pools); both pool pairs are donated."""
+    _check_tp(t_config, mesh)
+    _check_tp(d_config, mesh)
+    t_param_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs(t_config)
+    )
+    d_param_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs(d_config)
+    )
+    pool_sh = NamedSharding(mesh, _POOL_SPEC)
+    rep = lambda *axes: NamedSharding(mesh, P(*axes))  # noqa: E731
+    d_attention_fn = _tp_paged_attention(d_config, mesh)
+
+    @partial(
+        jax.jit,
+        static_argnames=("cover_pages",),
+        donate_argnums=(2, 3),
+        in_shardings=(
+            t_param_sh, d_param_sh, (pool_sh, pool_sh), (pool_sh, pool_sh),
+            rep(None, None), rep(None), rep(None),
+        ),
+        out_shardings=(
+            rep(None, None), rep(None), (pool_sh, pool_sh),
+            (pool_sh, pool_sh),
+        ),
+    )
+    def tp_spec_round(
+        t_params, d_params, t_pools, d_pools, tables, cur, positions,
+        cover_pages,
+    ):
+        return _spec_round_core(
+            t_params, d_params, t_pools, d_pools, tables, cur, positions,
+            t_config=t_config, d_config=d_config, gamma=gamma,
+            cover_pages=cover_pages, d_attention_fn=d_attention_fn,
+        )
+
+    return tp_spec_round
 
 
 def shard_serving_state(params: dict, pools, config: ModelConfig, mesh: Mesh):
