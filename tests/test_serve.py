@@ -459,11 +459,64 @@ def test_speculative_engine_validations():
             params, CONFIG, draft_params=draft, draft_config=DRAFT_CONFIG,
             temperature=0.5,
         )
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        ServeEngine(
-            params, CONFIG, draft_params=draft, draft_config=DRAFT_CONFIG,
-            pipelined=True,
+
+
+def test_pipelined_speculative_matches_generate():
+    """Pipelined speculative rounds (round N+1 dispatches chained on
+    round N's device-side advance; the readback overlaps the next
+    round's compute): every request still emits exactly the target's
+    greedy tokens, across slot turnover and mixed lengths."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    draft = init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+        draft_params=draft, draft_config=DRAFT_CONFIG, gamma=3,
+        pipelined=True,
+    )
+    requests = _mixed_requests(6, CONFIG.vocab_size, rng_seed=29)
+    rids = [engine.submit(p, n) for p, n in requests]
+    served = engine.run()
+    assert set(served) == set(rids)
+    for rid, (prompt, new) in zip(rids, requests):
+        want = generate(
+            params, jnp.asarray([prompt], jnp.int32), CONFIG,
+            max_new_tokens=new,
         )
+        np.testing.assert_array_equal(
+            np.asarray(served[rid]), np.asarray(want[0]),
+            err_msg=f"{rid} (prompt {len(prompt)}, new {new})",
+        )
+    assert engine.spec_rounds > 0
+    assert engine._pending_spec is None  # fully drained
+    assert engine.ctrl.used_pages == 0
+
+
+def test_pipelined_speculative_full_length_and_eos():
+    """The page-budget edge (prompt + max_new == max_seq_len) and early
+    eos both hold under pipelined speculation — the dead in-flight round
+    after retirement never exhausts the pool or corrupts a successor."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    draft = init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
+    engine = ServeEngine(
+        params, CONFIG, slots=1, page_size=4, prompt_bucket=8,
+        draft_params=draft, draft_config=DRAFT_CONFIG, gamma=3,
+        pipelined=True,
+    )
+    prompt = [1, 2, 3, 4, 5, 6]
+    rid = engine.submit(prompt, CONFIG.max_seq_len - len(prompt))
+    want = generate(
+        params, jnp.asarray([prompt], jnp.int32), CONFIG,
+        max_new_tokens=CONFIG.max_seq_len - len(prompt),
+    )
+    eos_want = generate(
+        params, jnp.asarray([[9, 8, 7]], jnp.int32), CONFIG, max_new_tokens=20
+    )
+    eos = int(np.asarray(eos_want[0, 2]))
+    rid2 = engine.submit([9, 8, 7], 20, eos_token=eos)
+    served = engine.run()
+    np.testing.assert_array_equal(np.asarray(served[rid]), np.asarray(want[0]))
+    assert served[rid2][-1] == eos
+    assert engine.ctrl.used_pages == 0
 
 
 def test_engine_validates_submissions():

@@ -704,17 +704,66 @@ def paged_spec_round(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=("t_config", "d_config", "gamma", "cover_pages"),
+    donate_argnums=(2, 3),
+)
+def paged_spec_round_chained(
+    t_params: dict,
+    d_params: dict,
+    t_pools: tuple[jax.Array, jax.Array],
+    d_pools: tuple[jax.Array, jax.Array],
+    tables: jax.Array,
+    cur: jax.Array,
+    positions: jax.Array,
+    occupancy: jax.Array,
+    t_config: ModelConfig,
+    d_config: ModelConfig,
+    gamma: int,
+    cover_pages: int | None = None,
+):
+    """paged_spec_round with DEVICE-SIDE chaining for pipelined
+    speculative serving: additionally takes an occupancy mask and
+    returns (committed, n_accept, new_cur, new_pos, t_pools, d_pools)
+    where new_cur/new_pos are the round's own advance, ON DEVICE — so
+    the next round can dispatch chained on them while this round's
+    committed tokens are still in flight to the host (the readback
+    overlaps the next round's draft+verify compute).
+
+    Parked rows (occupancy False) are RESET, not frozen: their position
+    is pinned to 0 (bounding every table index their dead compute
+    touches) and their new_pos comes back 0, while their token passes
+    through.  A parked slot's chained state is therefore only a safe
+    dead placeholder — a caller re-admitting a row must inject fresh
+    host-side (cur, pos) for it, as ServeEngine's fresh mask does."""
+    return _spec_round_core(
+        t_params, d_params, t_pools, d_pools, tables, cur, positions,
+        t_config=t_config, d_config=d_config, gamma=gamma,
+        cover_pages=cover_pages, occupancy=occupancy,
+    )
+
+
 def _spec_round_core(
     t_params, d_params, t_pools, d_pools, tables, cur, positions,
     t_config, d_config, gamma, cover_pages, d_attention_fn=None,
+    occupancy=None,
 ):
     """paged_spec_round's body, un-jitted so the tensor-parallel path can
     re-jit it with explicit shardings and an injected draft attention op
     (the draft's per-token decode runs the Pallas kernel, which needs a
-    shard_map under a mesh; the verify forward is dense — plain GSPMD)."""
+    shard_map under a mesh; the verify forward is dense — plain GSPMD).
+    With ``occupancy`` it also emits the chained next-round state (see
+    paged_spec_round_chained)."""
     batch = cur.shape[0]
     if cover_pages is not None:
         tables = tables[:, :cover_pages]
+    if occupancy is not None:
+        # Parked rows compute a dead round on their all-trash tables;
+        # pinning their position to 0 keeps every index they touch inside
+        # the (possibly cover-sliced) table width regardless of how deep
+        # the retired request had decoded.
+        positions = jnp.where(occupancy, positions, 0)
 
     # Draft gamma+1 steps: the extra step writes the FINAL proposal's k/v
     # so a fully-accepted round leaves no zero hole in the draft cache.
@@ -749,7 +798,16 @@ def _spec_round_core(
     committed = committed.at[jnp.arange(batch), n].set(
         picks[jnp.arange(batch), n]
     )
-    return committed, n, t_pools, d_pools
+    if occupancy is None:
+        return committed, n, t_pools, d_pools
+    # Chained next-round state: live rows advance by their own accepted
+    # length, parked rows pass through untouched (their dead compute
+    # landed on trash pages).
+    new_cur = jnp.where(
+        occupancy, committed[jnp.arange(batch), n], cur
+    )
+    new_pos = jnp.where(occupancy, positions + n + 1, positions)
+    return committed, n, new_cur, new_pos, t_pools, d_pools
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnums=(1,))

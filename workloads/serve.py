@@ -109,12 +109,6 @@ class ServeEngine:
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
-        if pipelined and draft_params is not None:
-            raise ValueError(
-                "pipelined stepping and speculative serving are mutually "
-                "exclusive (a speculative round's admission decisions need "
-                "its own commit counts)"
-            )
         if (draft_params is None) != (draft_config is None):
             raise ValueError(
                 "draft_params and draft_config come together (speculative "
@@ -151,12 +145,13 @@ class ServeEngine:
         # Chunks (or speculative rounds of up to gamma+1 tokens) may
         # overshoot a request's retirement point, so tables and the
         # position range cover it; pipelined stepping defers retirement
-        # by one more chunk; chunked prefill additionally needs
-        # bucket-aligned page coverage.
+        # by one more step unit (chunk or round); chunked prefill
+        # additionally needs bucket-aligned page coverage.
         self.pipelined = pipelined
         self._overshoot = max(
             self.chunk * (2 if pipelined else 1),
-            (gamma + 1) if draft_params is not None else 0,
+            ((gamma + 1) * (2 if pipelined else 1))
+            if draft_params is not None else 0,
         )
         bucket_pages = self.prompt_bucket // page_size
         prefill_cover = (
@@ -214,9 +209,12 @@ class ServeEngine:
         self.spec_rounds = 0
         # Pipelined stepping: the not-yet-read previous chunk (device
         # tokens + the slot->request snapshot at dispatch) and the
-        # device-chained last-token array.
+        # device-chained last-token array; speculative rounds keep their
+        # own pending read and chained (cur, pos) device pair.
         self._pending_read = None
         self._chained_tok: jax.Array | None = None
+        self._pending_spec = None
+        self._spec_chained: tuple[jax.Array, jax.Array] | None = None
         self._fresh_slots: set[int] = set()
 
         sampling = self.sampling
@@ -254,7 +252,8 @@ class ServeEngine:
                 # shard, the dense verify via GSPMD); the draft state
                 # shards like the target's.
                 self._tp_spec = make_tp_spec_program(
-                    self.config, draft_config, mesh, gamma
+                    self.config, draft_config, mesh, gamma,
+                    chained=pipelined,
                 )
                 self.draft_params, self.d_pools = shard_serving_state(
                     self.draft_params, self.d_pools, draft_config, mesh
@@ -603,25 +602,26 @@ class ServeEngine:
                 toks_dev, snapshot = self._pending_read
                 self._pending_read = None
                 finished += self._consume_chunk(toks_dev, snapshot)
+            if self._pending_spec is not None:
+                arrs, snapshot = self._pending_spec
+                self._pending_spec = None
+                finished += self._consume_spec(arrs, snapshot)
             return finished
-        # Page coverage for the whole chunk/round, allocated on demand.
-        # Each dispatch needs exactly ONE step unit past the current
-        # position (the position already accounts for previously
-        # dispatched, not-yet-read chunks) — _overshoot is the LIFETIME
-        # bound used for commitment/max_pages sizing, and extending by it
-        # here would overrun both the admission-time commitment and
-        # max_pages on a request ending near max_seq_len.
-        step_need = (
-            (self.gamma + 1) if self.draft_params is not None else self.chunk
-        )
+        if self.draft_params is not None:
+            return finished + self._step_spec()
+        # Page coverage for the whole chunk, allocated on demand.  Each
+        # dispatch needs exactly ONE chunk past the current position (the
+        # position already accounts for previously dispatched,
+        # not-yet-read chunks) — _overshoot is the LIFETIME bound used
+        # for commitment/max_pages sizing, and extending by it here
+        # would overrun both the admission-time commitment and max_pages
+        # on a request ending near max_seq_len.
         for slot, req in self._slot_req.items():
             seq = self._seq_id(slot, req)
             table = self._extend_evicting(
-                seq, int(self._positions[slot]) + step_need
+                seq, int(self._positions[slot]) + self.chunk
             )
             self._tables[slot, : len(table)] = table
-        if self.draft_params is not None:
-            return finished + self._step_spec()
 
         tok_in = self._dev(self._tokens)
         if self.pipelined and self._chained_tok is not None:
@@ -688,35 +688,116 @@ class ServeEngine:
         """One batched speculative round (paged_spec_round): every
         occupied row drafts, verifies, and commits its OWN accepted
         length — per-row positions advance by different amounts, which
-        is exactly what the paged compute path supports."""
-        from .paged import paged_spec_round
+        is exactly what the paged compute path supports.
 
-        # Bound the verify forward's gathered view to the live pages
-        # (bucketised so the static cover takes few distinct values).
+        With ``pipelined`` the round's committed tokens are NOT read
+        before returning: the next round dispatches chained on this
+        round's device-side (new_cur, new_pos)
+        (paged.paged_spec_round_chained), and only then reads this one —
+        the per-round readback round-trip overlaps the next round's
+        draft+verify compute.  Host positions lag one round, so page
+        coverage accounts the unread in-flight advance (bounded by
+        gamma+1 per round).
+
+        Measured (r4, tunnelled v5e chip, single admission wave, the
+        bench's spec_pipelined_speedup field): the overlap does NOT pay
+        for speculative rounds there — 0.85-0.9x, because a round's
+        readback is small relative to its own draft+verify compute while
+        pipelining adds one DEAD round per retirement and lags admission
+        by a round.  It is profile-dependent (a higher-latency link with
+        cheap rounds inverts it), so the mode stays available, default
+        off, token-parity pinned by tests."""
+        from .paged import paged_spec_round, paged_spec_round_chained
+
+        # Page coverage + the verify gather bound (bucketised so the
+        # static cover takes few distinct values).  ub[slot] bounds the
+        # slot's DEVICE position: the host mirror plus gamma+1 for an
+        # unread in-flight round.
         u = self.gamma + 1
-        max_pos = max(int(self._positions[s]) for s in self._slot_req)
-        need = -(-(max_pos + u) // self.page_size)
+        in_flight = (
+            set(self._pending_spec[1]) if self._pending_spec else set()
+        )
+        ub = {
+            slot: int(self._positions[slot]) + (u if slot in in_flight else 0)
+            for slot in self._slot_req
+        }
+        for slot, req in self._slot_req.items():
+            seq = self._seq_id(slot, req)
+            table = self._extend_evicting(seq, ub[slot] + u)
+            self._tables[slot, : len(table)] = table
+        need = -(-(max(ub.values()) + u) // self.page_size)
         cover = min(self.max_pages, -(-need // 4) * 4)
+
+        if not self.pipelined:
+            if self._mesh is None:
+                committed, n_acc, self.pools, self.d_pools = paged_spec_round(
+                    self.params, self.draft_params, self.pools, self.d_pools,
+                    self._dev(self._tables), self._dev(self._tokens),
+                    self._dev(self._positions),
+                    t_config=self.config, d_config=self.draft_config,
+                    gamma=self.gamma, cover_pages=cover,
+                )
+            else:
+                committed, n_acc, self.pools, self.d_pools = self._tp_spec(
+                    self.params, self.draft_params, self.pools, self.d_pools,
+                    self._dev(self._tables), self._dev(self._tokens),
+                    self._dev(self._positions), cover,
+                )
+            self.spec_rounds += 1
+            return self._consume_spec((committed, n_acc), dict(self._slot_req))
+
+        cur = self._dev(self._tokens)
+        pos = self._dev(self._positions)
+        if self._spec_chained is not None:
+            # Continue from the previous round's advance ON DEVICE; only
+            # freshly admitted slots take their host-side state.
+            fresh = np.zeros(self.slots, bool)
+            for s in self._fresh_slots:
+                fresh[s] = True
+            fr = jnp.asarray(fresh)
+            c_cur, c_pos = self._spec_chained
+            cur = jnp.where(fr, cur, c_cur)
+            pos = jnp.where(fr, pos, c_pos)
+        self._fresh_slots.clear()
+        occ = self._dev(self._occupied)
         if self._mesh is None:
-            committed, n_acc, self.pools, self.d_pools = paged_spec_round(
-                self.params, self.draft_params, self.pools, self.d_pools,
-                self._dev(self._tables), self._dev(self._tokens),
-                self._dev(self._positions),
-                t_config=self.config, d_config=self.draft_config,
-                gamma=self.gamma, cover_pages=cover,
+            committed, n_acc, new_cur, new_pos, self.pools, self.d_pools = (
+                paged_spec_round_chained(
+                    self.params, self.draft_params, self.pools, self.d_pools,
+                    self._dev(self._tables), cur, pos, occ,
+                    t_config=self.config, d_config=self.draft_config,
+                    gamma=self.gamma, cover_pages=cover,
+                )
             )
         else:
-            committed, n_acc, self.pools, self.d_pools = self._tp_spec(
-                self.params, self.draft_params, self.pools, self.d_pools,
-                self._dev(self._tables), self._dev(self._tokens),
-                self._dev(self._positions), cover,
+            committed, n_acc, new_cur, new_pos, self.pools, self.d_pools = (
+                self._tp_spec(
+                    self.params, self.draft_params, self.pools, self.d_pools,
+                    self._dev(self._tables), cur, pos, occ, cover,
+                )
             )
-        committed = np.asarray(committed)
-        n_acc = np.asarray(n_acc)
         self.spec_rounds += 1
+        self._spec_chained = (new_cur, new_pos)
+        snapshot = dict(self._slot_req)
+        prev, self._pending_spec = self._pending_spec, (
+            (committed, n_acc), snapshot,
+        )
+        if prev is not None:
+            # Reading the PREVIOUS round now overlaps the one in flight.
+            return self._consume_spec(*prev)
+        return []
+
+    def _consume_spec(self, arrs, snapshot: dict) -> list[Request]:
+        """Read a speculative round's (committed, n_accept) back (the
+        host sync point) and apply per-row emission/retirement for the
+        slots as they were at dispatch."""
+        committed, n_acc = (np.asarray(a) for a in arrs)
         finished = []
-        for slot in list(self._slot_req):
-            req = self._slot_req[slot]
+        for slot, req in snapshot.items():
+            if req.done:
+                # Retired between dispatch and read (pipelined lag): the
+                # slot computed a dead round; nothing to emit.
+                continue
             k = int(n_acc[slot]) + 1
             self._emit(req, committed[slot, :k])
             self._positions[slot] += k
@@ -731,6 +812,7 @@ class ServeEngine:
             not self.pending
             and not self._occupied.any()
             and self._pending_read is None
+            and self._pending_spec is None
         )
 
     def run(self) -> dict[str, list[int]]:

@@ -203,7 +203,8 @@ def make_tp_serve_programs(
 
 
 def make_tp_spec_program(
-    t_config: ModelConfig, d_config: ModelConfig, mesh: Mesh, gamma: int
+    t_config: ModelConfig, d_config: ModelConfig, mesh: Mesh, gamma: int,
+    chained: bool = False,
 ):
     """Tensor-parallel batched speculative round: draft AND verify both
     run under the "model" mesh axis.
@@ -218,7 +219,11 @@ def make_tp_spec_program(
 
     Returns spec_round(t_params, d_params, t_pools, d_pools, tables,
     cur, positions, cover_pages) -> (committed, n_accept, t_pools,
-    d_pools); both pool pairs are donated."""
+    d_pools); both pool pairs are donated.  With ``chained`` the program
+    additionally takes an occupancy mask and returns device-side
+    (new_cur, new_pos) between n_accept and the pools — the pipelined
+    speculative variant (paged.paged_spec_round_chained) under the
+    mesh."""
     _check_tp(t_config, mesh)
     _check_tp(d_config, mesh)
     t_param_sh = jax.tree.map(
@@ -230,29 +235,57 @@ def make_tp_spec_program(
     pool_sh = NamedSharding(mesh, _POOL_SPEC)
     rep = lambda *axes: NamedSharding(mesh, P(*axes))  # noqa: E731
     d_attention_fn = _tp_paged_attention(d_config, mesh)
-
-    @partial(
-        jax.jit,
-        static_argnames=("cover_pages",),
-        donate_argnums=(2, 3),
-        in_shardings=(
-            t_param_sh, d_param_sh, (pool_sh, pool_sh), (pool_sh, pool_sh),
-            rep(None, None), rep(None), rep(None),
-        ),
-        out_shardings=(
-            rep(None, None), rep(None), (pool_sh, pool_sh),
-            (pool_sh, pool_sh),
-        ),
+    in_sh = (
+        t_param_sh, d_param_sh, (pool_sh, pool_sh), (pool_sh, pool_sh),
+        rep(None, None), rep(None), rep(None),
+    ) + ((rep(None),) if chained else ())
+    out_sh = (
+        (rep(None, None), rep(None))
+        + ((rep(None), rep(None)) if chained else ())
+        + ((pool_sh, pool_sh), (pool_sh, pool_sh))
     )
-    def tp_spec_round(
-        t_params, d_params, t_pools, d_pools, tables, cur, positions,
-        cover_pages,
-    ):
-        return _spec_round_core(
-            t_params, d_params, t_pools, d_pools, tables, cur, positions,
-            t_config=t_config, d_config=d_config, gamma=gamma,
-            cover_pages=cover_pages, d_attention_fn=d_attention_fn,
+    # cover_pages is static and POSITIONAL (last): pjit rejects kwargs
+    # once in_shardings is given.
+
+    if chained:
+
+        @partial(
+            jax.jit,
+            static_argnums=(8,),
+            donate_argnums=(2, 3),
+            in_shardings=in_sh,
+            out_shardings=out_sh,
         )
+        def tp_spec_round(
+            t_params, d_params, t_pools, d_pools, tables, cur, positions,
+            occupancy, cover_pages,
+        ):
+            return _spec_round_core(
+                t_params, d_params, t_pools, d_pools, tables, cur,
+                positions, t_config=t_config, d_config=d_config,
+                gamma=gamma, cover_pages=cover_pages,
+                d_attention_fn=d_attention_fn, occupancy=occupancy,
+            )
+
+    else:
+
+        @partial(
+            jax.jit,
+            static_argnums=(7,),
+            donate_argnums=(2, 3),
+            in_shardings=in_sh,
+            out_shardings=out_sh,
+        )
+        def tp_spec_round(
+            t_params, d_params, t_pools, d_pools, tables, cur, positions,
+            cover_pages,
+        ):
+            return _spec_round_core(
+                t_params, d_params, t_pools, d_pools, tables, cur,
+                positions, t_config=t_config, d_config=d_config,
+                gamma=gamma, cover_pages=cover_pages,
+                d_attention_fn=d_attention_fn,
+            )
 
     return tp_spec_round
 
