@@ -23,8 +23,15 @@ coverage: native
 	$(PYTHON) -m pytest tests/ -q --cov=tpu_device_plugin --cov=workloads --cov-report=term 2>/dev/null \
 		|| $(PYTHON) -m pytest tests/ -q
 
+# Capture-then-diff keeps the regression tripwire in the loop: any
+# tracked metric dropping >2% vs the newest committed BENCH_r*.json
+# prints a WARN (tools/bench_diff.py; the diff never fails the build —
+# but a failing bench.py still fails the target before the diff runs,
+# which a `| tee` pipeline would have swallowed).
 bench: native
-	$(PYTHON) bench.py
+	$(PYTHON) bench.py > .bench-latest.json
+	@cat .bench-latest.json
+	$(PYTHON) tools/bench_diff.py .bench-latest.json
 
 # Useful-compute bench alone (train-step MFU, flash-vs-XLA, decode tok/s).
 # Meaningful on a TPU host; SCALE=tiny exercises the harness anywhere.

@@ -310,6 +310,11 @@ def scale_extras() -> dict:
             subprocess.run(["make", "-C", native_dir], check=True, capture_output=True)
         from tpu_device_plugin.backend.tpu import TpuChipManager
 
+        # This is a SYNTHETIC tree measuring table-scale RPC latency: the
+        # auto runtime-discovery probe (weak provenance + idle chips)
+        # would overlay real-chip data onto the fake topology and cost a
+        # JAX subprocess init.
+        os.environ.setdefault("TPU_DP_RUNTIME_PROBE", "0")
         manager = TpuChipManager(driver_root=root, lib_path=lib)
         manager.init()
     except Exception as e:

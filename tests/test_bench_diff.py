@@ -1,0 +1,60 @@
+"""Regression tripwire (tools/bench_diff.py): artifact parsing, drop/gain
+detection, platform guards."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench_diff  # noqa: E402
+
+
+def test_load_metrics_handles_driver_artifact_and_bench_stdout(tmp_path):
+    artifact = tmp_path / "BENCH_r09.json"
+    artifact.write_text(json.dumps({"parsed": {"mfu": 0.5}}, indent=2))
+    assert bench_diff.load_metrics(str(artifact)) == {"mfu": 0.5}
+    stdout = tmp_path / "out.txt"
+    stdout.write_text("log line\nmore logs\n" + json.dumps({"mfu": 0.6}) + "\n")
+    assert bench_diff.load_metrics(str(stdout)) == {"mfu": 0.6}
+
+
+def test_diff_warns_on_drop_and_notes_gains():
+    old = {"mfu": 0.5, "decode_tokens_per_sec": 1000.0,
+           "serve_tokens_per_sec": 100.0}
+    new = {"mfu": 0.45, "decode_tokens_per_sec": 1100.0,
+           "serve_tokens_per_sec": 101.0}
+    lines = bench_diff.diff(new, old, threshold=0.02)
+    assert any(line.startswith("WARN") and "mfu" in line for line in lines)
+    assert any(line.startswith("INFO") and "decode" in line for line in lines)
+    # 1% move: below threshold, silent.
+    assert not any("serve_tokens_per_sec" in line for line in lines)
+
+
+def test_diff_skips_busy_across_platform_change_and_flags_fallback():
+    old = {"busy_platform": "axon", "aggregate_chip_busy_fraction": 0.99}
+    new = {"busy_platform": "cpu", "aggregate_chip_busy_fraction": 0.5,
+           "busy_platform_fallback": True, "busy_fallback_reason": "boom"}
+    lines = bench_diff.diff(new, old, threshold=0.02)
+    assert not any("aggregate_chip_busy_fraction" in line for line in lines)
+    assert any("platform changed" in line for line in lines)
+    assert any("FALLBACK" in line and "boom" in line for line in lines)
+
+
+def test_cli_against_committed_artifact(tmp_path):
+    new = tmp_path / "new.json"
+    new.write_text(json.dumps({"mfu": 0.0001}))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_diff.py"), str(new)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0  # loud, never a gate
+    assert "WARN" in out.stdout and "mfu" in out.stdout
+
+
+def test_latest_committed_picks_highest_round(tmp_path):
+    for n in (1, 3, 2):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text("{}")
+    assert bench_diff.latest_committed(str(tmp_path)).endswith("BENCH_r03.json")

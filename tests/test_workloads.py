@@ -61,11 +61,21 @@ def test_mesh_shape_and_param_sharding(jax_cpu):
     mesh = make_mesh(8)
     assert dict(mesh.shape) == {"data": 2, "model": 4}
     config = ModelConfig(max_seq_len=16, n_layers=1)
-    (params, _), _ = make_train_state(config, mesh)
+    (params, opt_state), _ = make_train_state(config, mesh)
     wqkv = params["layers"][0]["wqkv"]
     assert wqkv.sharding.spec == P(None, None, "model", None)
     # The head axis is actually split 4 ways across the model axis.
     assert wqkv.addressable_shards[0].data.shape[2] == config.n_heads // 4
+    # Default optimizer: first moment in bf16 (the measured HBM-stream
+    # lever, docs/MFU_EXPERIMENTS.md) — and STILL sharded like its
+    # parameter, not silently replicated by the dtype mismatch.
+    import jax.numpy as jnp
+
+    mu = opt_state[0].mu["layers"][0]["wqkv"]
+    assert mu.dtype == jnp.bfloat16
+    assert mu.sharding.spec == P(None, None, "model", None)
+    nu = opt_state[0].nu["layers"][0]["wqkv"]
+    assert nu.dtype == jnp.float32  # second moment keeps full precision
 
 
 def test_graft_entry_compiles(jax_cpu):

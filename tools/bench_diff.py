@@ -1,0 +1,155 @@
+"""Round-over-round bench regression tripwire.
+
+Compares a fresh bench JSON (file, or stdin via ``-``) against the most
+recent committed ``BENCH_r{N}.json`` artifact and prints one WARN line
+per tracked higher-is-better metric that dropped more than the
+threshold (default 2%), plus an INFO line for notable gains.  The r3→r2
+MFU slip (0.544 → 0.536) went unnoticed for a full round because
+nothing diffed the artifacts — this is that diff, run by ``make bench``.
+
+Exit code is always 0: a perf regression is a loud message, not a build
+failure (hardware variance would make it flaky as a gate); the WARN
+lines land in the bench log and the round artifacts.
+
+Usage:
+    python bench.py | tee /tmp/bench.json | python tools/bench_diff.py -
+    python tools/bench_diff.py /tmp/bench.json [--against BENCH_r03.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# Higher-is-better metrics worth a round-over-round eye.  Latencies are
+# deliberately absent: the p50s sit at ~1% of target and their jitter
+# would drown the signal.
+TRACKED_UP = [
+    "mfu",
+    "train_tokens_per_sec",
+    "flash_vs_xla_speedup",
+    "flash_window_speedup",
+    "decode_tokens_per_sec",
+    "decode_int8_speedup",
+    "paged_decode_tokens_per_sec",
+    "paged_vs_contiguous_decode",
+    "serve_tokens_per_sec",
+    "serve_requests_per_sec",
+    "prefix_serve_speedup",
+    "spec_serve_tokens_per_sec",
+    "aggregate_chip_busy_fraction",
+    "aggregate_tokens_per_sec",
+]
+
+
+def latest_committed(repo_root: str) -> str | None:
+    """Newest BENCH_r{N}.json by round number."""
+    best, best_n = None, -1
+    for path in glob.glob(os.path.join(repo_root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m and int(m.group(1)) > best_n:
+            best, best_n = path, int(m.group(1))
+    return best
+
+
+def load_metrics(path_or_dash: str) -> dict:
+    """A bench JSON either raw ({metric...}) or as a driver artifact
+    ({"parsed": {...}} / {"tail": "...last line json..."})."""
+    raw = (
+        sys.stdin.read()
+        if path_or_dash == "-"
+        else open(path_or_dash).read()
+    )
+    try:
+        # A whole-file JSON document (the committed, pretty-printed
+        # driver artifacts).
+        data = json.loads(raw)
+    except json.JSONDecodeError:
+        # Bench stdout: one JSON line last, log lines above it.
+        for line in reversed([ln for ln in raw.splitlines() if ln.strip()]):
+            try:
+                data = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+        else:
+            raise SystemExit(f"bench_diff: no JSON found in {path_or_dash!r}")
+    if "parsed" in data and isinstance(data["parsed"], dict):
+        return data["parsed"]
+    return data
+
+
+def diff(new: dict, old: dict, threshold: float) -> list[str]:
+    lines = []
+    # Comparing a real-chip number against a CPU-fallback one (or vice
+    # versa) is a platform change, not a regression — flag it as such.
+    plat_new, plat_old = new.get("busy_platform"), old.get("busy_platform")
+    busy_comparable = plat_new == plat_old
+    for key in TRACKED_UP:
+        if key.startswith("aggregate") and not busy_comparable:
+            continue
+        a, b = old.get(key), new.get(key)
+        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+            continue
+        if a <= 0:
+            continue
+        change = (b - a) / a
+        if change < -threshold:
+            lines.append(
+                f"WARN bench_diff: {key} dropped {-change * 100:.1f}% "
+                f"({a} -> {b})"
+            )
+        elif change > threshold:
+            lines.append(
+                f"INFO bench_diff: {key} improved {change * 100:.1f}% "
+                f"({a} -> {b})"
+            )
+    if plat_new != plat_old and (plat_new or plat_old):
+        lines.append(
+            f"INFO bench_diff: busy platform changed {plat_old} -> "
+            f"{plat_new}; busy metrics not compared"
+        )
+    if new.get("busy_platform_fallback"):
+        lines.append(
+            "WARN bench_diff: busy number is a FALLBACK platform "
+            f"({new.get('busy_fallback_reason', 'no reason recorded')})"
+        )
+    return lines
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("new", help="fresh bench JSON file, or - for stdin")
+    parser.add_argument(
+        "--against",
+        default=None,
+        help="baseline artifact (default: newest committed BENCH_r*.json)",
+    )
+    parser.add_argument("--threshold", type=float, default=0.02)
+    args = parser.parse_args(argv)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    against = args.against or latest_committed(repo_root)
+    if against is None:
+        print("bench_diff: no committed BENCH_r*.json to compare against")
+        return 0
+    new = load_metrics(args.new)
+    old = load_metrics(against)
+    lines = diff(new, old, args.threshold)
+    label = os.path.basename(against)
+    if lines:
+        for line in lines:
+            print(f"{line} [vs {label}]")
+    else:
+        print(
+            f"bench_diff: no tracked metric moved "
+            f">{args.threshold * 100:g}% vs {label}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

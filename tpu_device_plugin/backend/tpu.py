@@ -20,13 +20,23 @@ from ..topology import Topology
 from . import BackendInitError, ChipManager
 from .native import NativeTpuInfo, NativeUnavailableError
 
-# Opt-in runtime discovery tier: when "1", init() runs a throwaway
-# SUBPROCESS that initialises the JAX/libtpu runtime once, and overlays
-# its measured per-chip coords / HBM limits wherever the native tiers
-# only reached "assumed"/"table" provenance.  Off by default because it
-# momentarily opens the chips (the subprocess exits immediately, but a
-# workload racing that window would fail its exclusive open).  The probe
-# record for this project's environments lives in docs/ (see
+# Runtime discovery tier: init() can run a throwaway SUBPROCESS that
+# initialises the JAX/libtpu runtime once and overlays its measured
+# per-chip coords / HBM limits wherever the native tiers only reached
+# "assumed"/"table" provenance.  The probe momentarily opens the chips
+# (the subprocess exits immediately, but a workload racing that window
+# would fail its exclusive open), so:
+#   "1"              — always probe;
+#   "0"              — never probe;
+#   unset / "auto"   — probe ONLY when it is both needed and safe:
+#                      some provenance is weak, the daemon was told its
+#                      open-count walk is node-wide truth
+#                      (counts_authoritative, which the chart ties to
+#                      hostPID — a namespace-local walk returns
+#                      confident zeros for other pods' handles), that
+#                      walk shows every chip idle, AND no
+#                      namespace-independent lease/claim flock is held.
+# The probe record for this project's environments lives in docs/ (see
 # tpu_device_plugin/probe_discovery.py).
 RUNTIME_PROBE_ENV = "TPU_DP_RUNTIME_PROBE"
 # Provenance tiers that runtime measurements outrank.
@@ -36,9 +46,19 @@ _WEAK_SOURCES = ("assumed", "table")
 class TpuChipManager(ChipManager):
     """ChipManager backed by the native libtpuinfo library."""
 
-    def __init__(self, driver_root: str = "/", lib_path: str | None = None):
+    def __init__(
+        self,
+        driver_root: str = "/",
+        lib_path: str | None = None,
+        counts_authoritative: bool = False,
+        lease_dir: str | None = None,
+    ):
         self._driver_root = driver_root
         self._lib_path = lib_path
+        # Whether chips_in_use() sees node-wide truth (hostPID); gates
+        # the AUTO runtime probe — see RUNTIME_PROBE_ENV.
+        self._counts_authoritative = counts_authoritative
+        self._lease_dir = lease_dir
         self._native: NativeTpuInfo | None = None
         self._topology: Topology | None = None
 
@@ -57,8 +77,50 @@ class TpuChipManager(ChipManager):
                 f"no TPU chips found under {self._driver_root!r}/dev"
             )
         self._topology = self._native.topology()
-        if os.environ.get(RUNTIME_PROBE_ENV) == "1":
+        mode = os.environ.get(RUNTIME_PROBE_ENV, "auto")
+        if mode == "1" or (mode not in ("0", "off") and self._should_auto_probe()):
             self._apply_runtime_probe()
+
+    def _should_auto_probe(self) -> bool:
+        """Auto mode (see RUNTIME_PROBE_ENV): probe iff some provenance
+        is weak AND idleness is POSITIVELY proven.  Zero open counts are
+        only evidence under hostPID (``counts_authoritative``) — a
+        namespace-local walk returns confident zeros for other pods'
+        handles, and the probe must never race a live workload's
+        exclusive open.  Held lease/claim flocks (filesystem-level,
+        namespace-independent) veto regardless."""
+        prov = self._topology.provenance or {}
+        weak = (
+            prov.get("coords_source") in _WEAK_SOURCES
+            or prov.get("hbm_source") in _WEAK_SOURCES
+        )
+        if not weak or not self._counts_authoritative:
+            return False
+        try:
+            in_use = self._native.chips_in_use()
+        except Exception:
+            return False
+        if not in_use:
+            return False  # walk unavailable: idleness not provable
+        if any(count != 0 for count in in_use.values()):
+            return False
+        if self._lease_dir:
+            from .. import sharing
+
+            for chip in self._topology.chips_by_id.values():
+                if sharing.lease_held(chip.id, self._lease_dir) or (
+                    sharing.claim_lease_state(chip.id, self._lease_dir)
+                    is True
+                ):
+                    return False
+        logging.getLogger(__name__).info(
+            "weak discovery provenance (%s) and all chips provably idle: "
+            "running the one-shot runtime discovery probe (set %s=0 to "
+            "disable)",
+            {k: v for k, v in prov.items() if k.endswith("_source")},
+            RUNTIME_PROBE_ENV,
+        )
+        return True
 
     def _apply_runtime_probe(self) -> None:
         """Overlay runtime-measured coords/HBM onto weakly-sourced native

@@ -45,11 +45,19 @@ class FatalEvent:
     message: str
 
 
-def make_backend(flags) -> ChipManager:
+def make_backend(flags, lease_dir: str = sharing.DEFAULT_LEASE_DIR) -> ChipManager:
     if flags.backend == BACKEND_FAKE:
         chips, per_tray = _parse_fake_topology(flags.fake_topology)
         return FakeChipManager(n_chips=chips, chips_per_tray=per_tray)
-    return TpuChipManager(driver_root=flags.driver_root)
+    return TpuChipManager(
+        driver_root=flags.driver_root,
+        # Gates the AUTO runtime-discovery probe: zero open counts only
+        # prove chips idle when the /proc walk is node-wide truth — the
+        # same hostPID condition this flag already attests for the claim
+        # ledger's early release.
+        counts_authoritative=flags.claim_liveness_release,
+        lease_dir=lease_dir,
+    )
 
 
 class Daemon:
@@ -64,7 +72,10 @@ class Daemon:
     ):
         self.config = config
         self.events = events if events is not None else queue.Queue()
-        self.backend = backend if backend is not None else make_backend(config.flags)
+        self.backend = (
+            backend if backend is not None
+            else make_backend(config.flags, lease_dir=lease_dir)
+        )
         self.lease_dir = lease_dir
         self.plugin_dir = config.flags.device_plugin_path or constants.DEVICE_PLUGIN_PATH
         self.kubelet_socket = self.plugin_dir.rstrip("/") + "/kubelet.sock"

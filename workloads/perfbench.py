@@ -507,14 +507,12 @@ def measure_spec_serve(scale: BenchScale) -> dict:
     the round N+1-overlaps-round-N readback (pipelined=True).  Endpoints
     are real host readbacks; compiles are warmed by a full-depth request
     per arm."""
-    import time as _time
-
     from .serve import ServeEngine
 
     ps = scale.page_size
     gamma = 4
     prompt_len = scale.decode_prompt
-    lo, hi = scale.serve_chunks
+    hi = scale.serve_chunks[1]
     max_new = max(hi * (gamma + 1), gamma + 2)
     config = ModelConfig(
         vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
@@ -540,12 +538,12 @@ def measure_spec_serve(scale: BenchScale) -> dict:
         engine.submit(prompt, max_new)  # warm every compile at full depth
         engine.run()
         before = engine.generated_tokens
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         for _ in range(n_req):
             engine.submit(prompt, max_new)
         engine.run()
         return (engine.generated_tokens - before) / (
-            _time.perf_counter() - t0
+            time.perf_counter() - t0
         )
 
     plain = serve(False)
@@ -569,7 +567,6 @@ def measure_prefix_serve(scale: BenchScale) -> dict:
     host readbacks (engine.run streams tokens out), same engine config
     otherwise; the cache is seeded by one warm request in both arms (the
     uncached arm's warm request also warms the compiles)."""
-    import time as _time
 
     from .serve import ServeEngine
 
@@ -598,11 +595,11 @@ def measure_prefix_serve(scale: BenchScale) -> dict:
         engine.submit(prefix + [1] * suffix_len, chunk)  # warm + seed
         engine.run()
         before = engine.prefill_tokens
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         for i in range(n_req):
             engine.submit(prefix + [2 + i] * suffix_len, chunk)
         engine.run()
-        return _time.perf_counter() - t0, engine.prefill_tokens - before
+        return time.perf_counter() - t0, engine.prefill_tokens - before
 
     un_secs, un_tokens = serve(False)
     ca_secs, ca_tokens = serve(True)

@@ -55,8 +55,19 @@ def make_sharded_train_state(mesh: Mesh, init_fn, specs, optimizer=None, abstrac
 
     ``abstract=True`` returns ShapeDtypeStructs carrying the shardings
     instead of materialized arrays — a checkpoint-restore target without
-    paying for an initialization that would be thrown away."""
-    optimizer = optax.adamw(1e-3) if optimizer is None else optimizer
+    paying for an initialization that would be thrown away.
+
+    Default optimizer: AdamW with the FIRST moment stored in bfloat16
+    (same exponent range as f32, so no clipping — only mantissa noise on
+    a quantity that is itself an EMA of noisy gradients).  The optimizer
+    update is a pure HBM stream, and halving the m read+write measured
+    473.6 -> 450.6 ms per flagship train step on a v5e chip (MFU 0.530
+    -> 0.557) — the lever docs/MFU_EXPERIMENTS.md identified.  Pass an
+    explicit optimizer to opt out."""
+    optimizer = (
+        optax.adamw(1e-3, mu_dtype=jnp.bfloat16)
+        if optimizer is None else optimizer
+    )
 
     def init():
         params = init_fn()
@@ -161,18 +172,21 @@ def make_train_state(config: ModelConfig, mesh: Mesh, seed: int = 0, abstract=Fa
 
 def _opt_shardings_like(opt_shape, params_shape, param_shardings, mesh):
     """Map each optimizer-state leaf to its parameter's sharding when shapes
-    match, else replicate (scalar counts etc.)."""
+    match, else replicate (scalar counts etc.).  Shape-only matching: a
+    moment stored in a narrower dtype than its parameter (the default
+    bf16 first moment) must still shard WITH the parameter, not
+    replicate."""
     flat_params, _ = jax.tree.flatten(params_shape)
     flat_shardings, _ = jax.tree.flatten(
         param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
     )
     by_shape = {}
     for leaf, sharding in zip(flat_params, flat_shardings):
-        by_shape.setdefault((leaf.shape, leaf.dtype), sharding)
+        by_shape.setdefault(leaf.shape, sharding)
     replicated = NamedSharding(mesh, P())
 
     def pick(leaf):
-        return by_shape.get((leaf.shape, leaf.dtype), replicated)
+        return by_shape.get(leaf.shape, replicated)
 
     return jax.tree.map(pick, opt_shape)
 
