@@ -73,4 +73,5 @@ image:
 
 clean:
 	$(MAKE) -C native clean
+	rm -f .bench-latest.json
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

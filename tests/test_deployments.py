@@ -18,7 +18,12 @@ HELM_DAEMONSET = os.path.join(
 STATIC_DIR = os.path.join(REPO, "deployments", "static")
 
 # TPU_WORKER_ID etc. are ambient TPU VM metadata, not daemon flags.
-AMBIENT_OK = {"TPU_WORKER_ID", "TPU_TOPOLOGY", "TPU_HOST_BOUNDS", "TPU_TOPOLOGY_WRAP"}
+AMBIENT_OK = {
+    "TPU_WORKER_ID", "TPU_TOPOLOGY", "TPU_HOST_BOUNDS", "TPU_TOPOLOGY_WRAP",
+    # Backend-level env knob (backend/tpu.py RUNTIME_PROBE_ENV), read by
+    # the discovery layer directly rather than through a config flag.
+    "TPU_DP_RUNTIME_PROBE",
+}
 
 
 def env_names(path: str) -> set[str]:
