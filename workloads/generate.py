@@ -45,7 +45,8 @@ def init_kv_cache(config: ModelConfig, batch: int, max_len: int):
 
 
 def decode_block(params: dict, cache: jax.Array, tokens: jax.Array,
-                 pos: jax.Array, config: ModelConfig, unembed: str = "all"):
+                 pos: jax.Array, config: ModelConfig, unembed: str = "all",
+                 lora=None):
     """A block of ``s`` consecutive tokens through the cached model in ONE
     forward — the prefill/verification primitive (speculative decoding
     scores a whole draft block this way; ``decode_step`` is its s=1 case).
@@ -60,7 +61,11 @@ def decode_block(params: dict, cache: jax.Array, tokens: jax.Array,
     returns the final hidden states [batch, s, d_model] so a caller with
     per-row true lengths can gather one row each before unembedding —
     the ragged-prompt prefill path), or "none" (cache-fill only, logits
-    is None)."""
+    is None).
+
+    ``lora=(stacked, idx, alpha)`` applies PER-ROW adapter deltas to the
+    q/k/v and output projections (workloads/multi_lora.py) — the
+    multi-tenant serving path; None is the plain model."""
     if unembed not in ("all", "last", "none", "hidden"):
         # Eager, pre-trace validation (repo convention: a typo fails at
         # the call site, not after tracing the whole layer stack).
@@ -82,9 +87,17 @@ def decode_block(params: dict, cache: jax.Array, tokens: jax.Array,
         mask &= k_pos[None, :] > row_pos - config.attention_window
     mask = mask[None, None]  # [1, 1, s, max_len]
 
+    if lora is not None:
+        from .multi_lora import apply_qkv, wo_row_delta
+
+        stacked, aidx, alpha = lora
     for i, layer in enumerate(params["layers"]):
         h = _rmsnorm(x, layer["ln1"])
         q, k, v = project_qkv(h, layer)  # [b, s, H|Hkv, hd]
+        if lora is not None:
+            q, k, v = apply_qkv(
+                q, k, v, h, stacked[i], aidx, config, alpha, config.dtype
+            )
         q, k = apply_rope(q, angles), apply_rope(k, angles)
         cache = jax.lax.dynamic_update_slice(
             cache, k[None, None], (i, 0, 0, pos, 0, 0)
@@ -94,7 +107,12 @@ def decode_block(params: dict, cache: jax.Array, tokens: jax.Array,
         )
         keys, values = cache[i, 0], cache[i, 1]  # [b, max_len, Hkv, hd]
         attn = masked_attention(q, keys, values, mask, config.head_dim)
-        x = x + jnp.einsum("bshk,hkd->bsd", attn, weight(layer["wo"], x.dtype))
+        proj = jnp.einsum("bshk,hkd->bsd", attn, weight(layer["wo"], x.dtype))
+        if lora is not None:
+            d_wo = wo_row_delta(attn, stacked[i], aidx, alpha)
+            if d_wo is not None:
+                proj = (proj.astype(jnp.float32) + d_wo).astype(x.dtype)
+        x = x + proj
         x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
 
     if unembed == "none":

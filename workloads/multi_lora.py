@@ -1,0 +1,156 @@
+"""Multi-LoRA serving: many fine-tuned adapters over ONE shared base.
+
+The serving-side counterpart of workloads/lora.py (which trains one
+adapter): a fleet of rank-r adapters — one per tenant/task — serves
+through a single ServeEngine over one copy of the base weights.  The
+S-LoRA/punica idea, expressed the JAX way:
+
+  * adapters are STACKED into one pytree per layer
+    (``{"a": [n, fan_in, r], "b": [n, r, fan_out]}``) so the batched
+    decode path gathers each row's factors by index — data, not shape;
+    admitting a request for a different adapter never recompiles;
+  * the adapted weight is never materialised: the delta is applied on
+    the ACTIVATION side, ``x @ W + alpha * (x @ a_i) @ b_i`` — O(r)
+    extra HBM per row versus the O(fan_in * fan_out) a per-request merge
+    would stream;
+  * index 0 is the reserved BASE entry (zero factors): requests without
+    an adapter ride the same code path at the same cost shape.
+
+Reference pendant: none — the reference daemon has no model code; part
+of the JAX serving workloads (SURVEY.md §7 step 8).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .model import ModelConfig
+
+
+def synthetic_adapters(
+    config: ModelConfig,
+    n: int,
+    rank: int = 8,
+    scale: float = 0.1,
+    seed: int = 0,
+    prefix: str = "tenant",
+) -> dict:
+    """N trained-looking adapters for demos/benches/tests: lora_init's
+    zero ``b`` (the identity adapter) is replaced with scaled normals so
+    each tenant genuinely changes the model.  One source for the CLI,
+    the bench, and the tests — the adapter layout lives here."""
+    from .lora import lora_init
+
+    key = jax.random.PRNGKey(seed)
+    out = {}
+    for i in range(n):
+        ad = lora_init(config, rank, jax.random.PRNGKey(seed + 1000 + i))
+        for layer in ad:
+            for ab in layer.values():
+                key, k = jax.random.split(key)
+                ab["b"] = (
+                    jax.random.normal(k, ab["b"].shape, jnp.float32) * scale
+                )
+        out[f"{prefix}-{i}"] = ad
+    return out
+
+
+def stack_adapters(adapters: list, config: ModelConfig) -> list:
+    """[adapter][layer]{name: {a, b}} -> [layer]{name: {a: [n+1, fi, r],
+    b: [n+1, r, fo]}} with the zero BASE adapter prepended at index 0.
+
+    Every adapter must target the same weights at the same rank (one
+    compiled gather shape); lora_init with shared (config, rank,
+    targets) guarantees that."""
+    if not adapters:
+        raise ValueError("stack_adapters needs at least one adapter")
+    n_layers = len(adapters[0])
+    for i, ad in enumerate(adapters):
+        if len(ad) != n_layers:
+            raise ValueError(
+                f"adapter {i} has {len(ad)} layers, expected {n_layers}"
+            )
+    stacked = []
+    for li in range(n_layers):
+        names = set(adapters[0][li])
+        entry = {}
+        for i, ad in enumerate(adapters):
+            if set(ad[li]) != names:
+                raise ValueError(
+                    f"adapter {i} targets {sorted(ad[li])} at layer {li}, "
+                    f"expected {sorted(names)} (all adapters must target "
+                    "the same weights)"
+                )
+        for name in sorted(names):
+            a_list = [ad[li][name]["a"] for ad in adapters]
+            b_list = [ad[li][name]["b"] for ad in adapters]
+            shapes = {(a.shape, b.shape) for a, b in zip(a_list, b_list)}
+            if len(shapes) != 1:
+                raise ValueError(
+                    f"adapter factor shapes disagree for {name!r} at layer "
+                    f"{li}: {sorted(shapes)} (same rank required)"
+                )
+            a = jnp.stack([jnp.zeros_like(a_list[0])] + list(a_list))
+            b = jnp.stack([jnp.zeros_like(b_list[0])] + list(b_list))
+            entry[name] = {"a": a, "b": b}
+        stacked.append(entry)
+    return stacked
+
+
+def _row_delta(x: jax.Array, ab: dict, idx: jax.Array) -> jax.Array:
+    """Per-row adapter delta: x [b, fan_in] (or [b, s, fan_in]) through
+    each row's own (a, b) factors -> [b(, s), fan_out]."""
+    a = ab["a"][idx]  # [b, fan_in, r]
+    b = ab["b"][idx]  # [b, r, fan_out]
+    if x.ndim == 2:
+        u = jnp.einsum("bd,bdr->br", x.astype(jnp.float32), a)
+        return jnp.einsum("br,brf->bf", u, b)
+    u = jnp.einsum("bsd,bdr->bsr", x.astype(jnp.float32), a)
+    return jnp.einsum("bsr,brf->bsf", u, b)
+
+
+def qkv_row_deltas(h: jax.Array, entry: dict, idx: jax.Array,
+                   config: ModelConfig):
+    """(dq, dk, dv) — UNSCALED — for the layer's q/k/v projections from
+    per-row adapters — fused ``wqkv`` or split ``wq``/``wkv`` layouts,
+    matching model.project_qkv's output shapes; None where the layer has
+    no such target."""
+    lead = h.shape[:-1]  # (b,) or (b, s)
+    H, Hkv, hd = config.n_heads, config.kv_heads, config.head_dim
+    if "wqkv" in entry:
+        d = _row_delta(h, entry["wqkv"], idx).reshape(*lead, 3, H, hd)
+        d = jnp.moveaxis(d, len(lead), 0)
+        return d[0], d[1], d[2]
+    dq = dk = dv = None
+    if "wq" in entry:
+        dq = _row_delta(h, entry["wq"], idx).reshape(*lead, H, hd)
+    if "wkv" in entry:
+        dkv = _row_delta(h, entry["wkv"], idx).reshape(*lead, 2, Hkv, hd)
+        dkv = jnp.moveaxis(dkv, len(lead), 0)
+        dk, dv = dkv[0], dkv[1]
+    return dq, dk, dv
+
+
+def wo_row_delta(attn: jax.Array, entry: dict, idx: jax.Array,
+                 alpha: float):
+    """Output-projection delta (alpha-scaled) from per-row adapters:
+    attn [b(, s), H, hd] -> [b(, s), d_model]; None when wo is
+    untargeted."""
+    if "wo" not in entry:
+        return None
+    flat = attn.reshape(*attn.shape[:-2], attn.shape[-2] * attn.shape[-1])
+    return _row_delta(flat, entry["wo"], idx) * alpha
+
+
+def apply_qkv(q, k, v, h, entry, idx, config, alpha, dtype):
+    """q/k/v with the per-row adapter deltas added (alpha-scaled), cast
+    back to the compute dtype; untargeted projections pass through."""
+    dq, dk, dv = qkv_row_deltas(h, entry, idx, config)
+
+    def add(x, d):
+        if d is None:
+            return x
+        return (x.astype(jnp.float32) + alpha * d).astype(dtype)
+
+    return add(q, dq), add(k, dk), add(v, dv)

@@ -227,12 +227,17 @@ class PrefixCache:
         self.hits = 0  # pages served from cache
         self.misses = 0  # lookups that found nothing
 
-    def _keys(self, tokens: list[int], n_pages: int) -> list[bytes]:
-        """Chain keys of the first ``n_pages`` full blocks."""
+    def _keys(
+        self, tokens: list[int], n_pages: int, salt: str = ""
+    ) -> list[bytes]:
+        """Chain keys of the first ``n_pages`` full blocks.  ``salt``
+        partitions the key space — the engine passes the adapter id, so
+        cached pages (which hold ADAPTED k/v under multi-LoRA) are never
+        shared across adapters."""
         import hashlib
 
         ps = self.page_size
-        keys, prev = [], b""
+        keys, prev = [], salt.encode()
         for i in range(n_pages):
             block = tokens[i * ps : (i + 1) * ps]
             h = hashlib.blake2b(digest_size=16)
@@ -243,7 +248,8 @@ class PrefixCache:
         return keys
 
     def lookup(
-        self, tokens: list[int], max_pages: int, granularity: int = 1
+        self, tokens: list[int], max_pages: int, granularity: int = 1,
+        salt: str = "",
     ) -> list[int]:
         """Longest cached prefix of ``tokens``, as pages, capped at
         ``max_pages`` and floored to a multiple of ``granularity`` (the
@@ -251,7 +257,9 @@ class PrefixCache:
         static shapes).  Touches only the RETURNED entries' LRU position,
         and counts only them as hits."""
         keys, pages = [], []
-        for key in self._keys(tokens, min(max_pages, len(tokens) // self.page_size)):
+        for key in self._keys(
+            tokens, min(max_pages, len(tokens) // self.page_size), salt
+        ):
             page = self._index.get(key)
             if page is None:
                 break
@@ -267,12 +275,14 @@ class PrefixCache:
             self.misses += 1
         return pages
 
-    def insert(self, tokens: list[int], table: list[int]) -> None:
+    def insert(
+        self, tokens: list[int], table: list[int], salt: str = ""
+    ) -> None:
         """Register the fully-written prompt pages of a just-prefilled
         sequence (the first len(tokens)//page_size entries of its table).
         New entries pin their page; known entries just refresh LRU."""
         full = len(tokens) // self.page_size
-        for key, page in zip(self._keys(tokens, full), table[:full]):
+        for key, page in zip(self._keys(tokens, full, salt), table[:full]):
             if key in self._index:
                 self._index.move_to_end(key)
                 continue
@@ -376,6 +386,7 @@ def _decode_core(
     positions: jax.Array,
     config: ModelConfig,
     attention_fn=None,
+    lora=None,
 ):
     """One token per row through the paged cache: write the new k/v into
     each row's current page, then run the paged-attention kernel over the
@@ -387,7 +398,12 @@ def _decode_core(
     overrides the attention op — the tensor-parallel path
     (workloads/tp_serve.py) injects the kernel wrapped in a shard_map
     over the model axis; everything else here partitions under plain
-    XLA sharding."""
+    XLA sharding.
+
+    ``lora=(stacked, idx, alpha)`` applies PER-ROW adapter deltas
+    (workloads/multi_lora.py): row b's q/k/v and output projections gain
+    ``alpha * (h @ a[idx[b]]) @ b[idx[b]]`` — multi-tenant LoRA serving
+    over one base weight stream."""
     k_pages, v_pages = pools
     batch = token.shape[0]
     page_size = k_pages.shape[3]
@@ -396,11 +412,19 @@ def _decode_core(
     slot = positions % page_size
     lengths = positions + 1
     angles = rope_angles(positions, config.head_dim)  # [batch, half]
+    if lora is not None:
+        from .multi_lora import apply_qkv, wo_row_delta
+
+        stacked, aidx, alpha = lora
 
     x = params["embed"].astype(config.dtype)[token][:, None]  # [b, 1, d]
     for i, layer in enumerate(params["layers"]):
         h = _rmsnorm(x, layer["ln1"])
         q, k, v = project_qkv(h, layer)  # [b, 1, H|Hkv, hd]
+        if lora is not None:
+            q, k, v = apply_qkv(
+                q, k, v, h, stacked[i], aidx, config, alpha, config.dtype
+            )
         q, k = _rope_rows(q, angles), _rope_rows(k, angles)
         # Write this token's k/v into each row's current page slot with
         # per-row dynamic_update_slice, NOT an advanced-index scatter:
@@ -416,9 +440,12 @@ def _decode_core(
             )
         else:
             attn = attention_fn(q[:, 0], k_pages, v_pages, tables, lengths, i)
-        x = x + jnp.einsum(
-            "bhk,hkd->bd", attn, weight(layer["wo"], x.dtype)
-        )[:, None]
+        proj = jnp.einsum("bhk,hkd->bd", attn, weight(layer["wo"], x.dtype))
+        if lora is not None:
+            d_wo = wo_row_delta(attn, stacked[i], aidx, alpha)
+            if d_wo is not None:
+                proj = (proj.astype(jnp.float32) + d_wo).astype(x.dtype)
+        x = x + proj[:, None]
         x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
     logits = x[:, 0].astype(jnp.float32) @ weight(params["unembed"], jnp.float32)
     return logits, (k_pages, v_pages)
@@ -486,6 +513,7 @@ def paged_decode_chunk(
     config: ModelConfig,
     chunk: int,
     sampling: bool,
+    lora=None,
 ):
     """``chunk`` decode steps in ONE dispatch (a lax.scan): between page
     boundaries the block tables cannot change, so the host only needs to
@@ -499,17 +527,20 @@ def paged_decode_chunk(
     swallows the dead scatter, so admission/retire between chunks never
     recompiles (shapes are static, occupancy is data).  tables must
     already cover positions + chunk tokens for occupied rows.
+    ``lora=(stacked, idx, alpha)``: per-row adapter deltas (see
+    _decode_core) — idx is DATA, so adapter churn never recompiles.
 
     Returns (tokens [batch, chunk], pools); pools are DONATED."""
     return _chunk_core(
         params, pools, tables, token, positions, occupancy, rng,
-        temperature, top_k, top_p, config, chunk, sampling,
+        temperature, top_k, top_p, config, chunk, sampling, lora=lora,
     )
 
 
 def _chunk_core(
     params, pools, tables, token, positions, occupancy, rng,
     temperature, top_k, top_p, config, chunk, sampling, attention_fn=None,
+    lora=None,
 ):
     """paged_decode_chunk's body, un-jitted so the tensor-parallel path
     can re-jit it with explicit shardings and an injected attention op."""
@@ -518,7 +549,7 @@ def _chunk_core(
     def body(carry, key):
         pools, tok, pos = carry
         logits, pools = _decode_core(
-            params, pools, tables, tok, pos, config, attention_fn
+            params, pools, tables, tok, pos, config, attention_fn, lora
         )
         nxt = sample_logits(
             logits, key if sampling else None, temperature, top_k, top_p
@@ -818,6 +849,7 @@ def paged_prefill(
     prompts: jax.Array,
     lengths: jax.Array,
     config: ModelConfig,
+    lora=None,
 ):
     """Prefill a batch of fresh prompts into the paged pools in one block
     forward.
@@ -834,8 +866,12 @@ def paged_prefill(
     Returns (next-token logits [batch, vocab] — each row's last TRUE
     position — and the updated pools).  Pools are DONATED.  Only the
     gathered prompt pages round-trip HBM (one gather + one scatter per
-    admission, O(prompt) — the per-token path never gathers)."""
-    return _prefill_core(params, pools, tables, prompts, lengths, config)
+    admission, O(prompt) — the per-token path never gathers).
+    ``lora=(stacked, idx, alpha)``: per-row adapter deltas (see
+    _decode_core); the engine's batch-1 admissions pass idx=[adapter]."""
+    return _prefill_core(
+        params, pools, tables, prompts, lengths, config, lora=lora
+    )
 
 
 @partial(
@@ -853,6 +889,7 @@ def paged_prefill_chunk(
     start_page: int,
     cover_pages: int,
     emit: bool,
+    lora=None,
 ):
     """CHUNKED prefill: one fixed-width slice of a long prompt through
     the paged pools — prompts longer than a single prefill bucket are
@@ -906,7 +943,7 @@ def paged_prefill_chunk(
     )
     hidden, view = decode_block(
         params, view, chunk_tokens, jnp.int32(start), config,
-        unembed="hidden" if emit else "none",
+        unembed="hidden" if emit else "none", lora=lora,
     )
     logits = None
     if emit:
@@ -926,7 +963,7 @@ def paged_prefill_chunk(
     )
 
 
-def _prefill_core(params, pools, tables, prompts, lengths, config):
+def _prefill_core(params, pools, tables, prompts, lengths, config, lora=None):
     """paged_prefill's body, un-jitted so the tensor-parallel path can
     re-jit it with explicit shardings (the dense block forward inside
     partitions under plain XLA sharding — no kernel, no shard_map)."""
@@ -954,7 +991,8 @@ def _prefill_core(params, pools, tables, prompts, lengths, config):
         axis=1,
     )
     hidden, view = decode_block(
-        params, view, prompts, jnp.int32(0), config, unembed="hidden"
+        params, view, prompts, jnp.int32(0), config, unembed="hidden",
+        lora=lora,
     )
     # Per-row last true hidden row -> one next-token prediction each.
     idx = (lengths - 1).astype(jnp.int32)[:, None, None]

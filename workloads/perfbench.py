@@ -559,6 +559,67 @@ def measure_spec_serve(scale: BenchScale) -> dict:
     }
 
 
+def measure_multi_lora(scale: BenchScale) -> dict:
+    """Multi-tenant LoRA serving overhead: the serve loop with requests
+    round-robining across 4 rank-16 adapters (per-row activation deltas,
+    one shared base weight stream) against the same loop serving the
+    base only — the cost of multi-tenancy, measured."""
+    from .multi_lora import synthetic_adapters
+    from .serve import ServeEngine
+
+    ps = scale.page_size
+    chunk, hi = ps, scale.serve_chunks[1]
+    prompt_len = scale.decode_prompt
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
+        n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=prompt_len + 1 + hi * chunk,
+    )
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype),
+        init_params(config, jax.random.PRNGKey(0)),
+    )
+    n_adapters, rank = 4, 16
+    adapters = synthetic_adapters(config, n_adapters, rank=rank, seed=11)
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(1), (prompt_len,), 0, config.vocab_size, jnp.int32
+    )]
+    names = [None] + sorted(adapters)
+
+    def serve(multi: bool) -> float:
+        engine = ServeEngine(
+            params, config, slots=scale.batch, page_size=ps, chunk=chunk,
+            prompt_bucket=-(-prompt_len // ps) * ps,
+            adapters=adapters if multi else None,
+        )
+        engine.submit(
+            prompt, 1 + hi * chunk, adapter=names[1] if multi else None
+        )
+        engine.run()  # warm
+        before = engine.generated_tokens
+        t0 = time.perf_counter()
+        for i in range(scale.batch):
+            engine.submit(
+                prompt, 1 + hi * chunk,
+                adapter=names[i % len(names)] if multi else None,
+            )
+        engine.run()
+        return (engine.generated_tokens - before) / (
+            time.perf_counter() - t0
+        )
+
+    base = serve(False)
+    multi = serve(True)
+    return {
+        "multi_lora_adapters": n_adapters,
+        "multi_lora_rank": rank,
+        "multi_lora_tokens_per_sec": round(multi, 1),
+        "multi_lora_base_tokens_per_sec": round(base, 1),
+        # >= ~0.9 means multi-tenancy is nearly free, the design goal.
+        "multi_lora_relative_throughput": round(multi / max(base, 1e-9), 3),
+    }
+
+
 def measure_prefix_serve(scale: BenchScale) -> dict:
     """Cross-request prefix caching, measured where it pays: a stream of
     requests sharing a long system prompt (8 pages — 512 tokens at the
@@ -642,6 +703,7 @@ def run(scale_name: str = "full") -> dict:
     out.update(measure_serve(scale))
     out.update(measure_prefix_serve(scale))
     out.update(measure_spec_serve(scale))
+    out.update(measure_multi_lora(scale))
     return out
 
 

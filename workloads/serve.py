@@ -69,6 +69,7 @@ class Request:
     tokens: list[int] = field(default_factory=list)
     done: bool = False
     group: str | None = None
+    adapter: str | None = None  # multi-LoRA: which adapter serves this
 
 
 class ServeEngine:
@@ -106,6 +107,8 @@ class ServeEngine:
         gamma: int = 4,
         pipelined: bool = False,
         prefix_cache: bool = False,
+        adapters: dict[str, list] | None = None,
+        lora_alpha: float = 1.0,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -114,6 +117,23 @@ class ServeEngine:
                 "draft_params and draft_config come together (speculative "
                 "serving needs both)"
             )
+        if adapters is not None:
+            if draft_params is not None:
+                raise ValueError(
+                    "multi-LoRA serving does not compose with speculative "
+                    "decoding yet (the draft would need per-row adapters "
+                    "of its own)"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "multi-LoRA serving is single-device for now (the TP "
+                    "programs do not thread adapter operands)"
+                )
+            if not adapters:
+                raise ValueError(
+                    "adapters must be a non-empty {name: adapter} dict "
+                    "(or None to serve the plain base)"
+                )
         if draft_params is not None:
             if temperature > 0.0:
                 raise ValueError(
@@ -182,10 +202,27 @@ class ServeEngine:
         self.sampling = self.temperature > 0.0
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
 
+        # Multi-LoRA: adapters stacked once (index 0 = the zero BASE
+        # entry, so adapter-less requests share the code path); per-slot
+        # indices are DATA — adapter churn never recompiles.
+        self.lora_alpha = float(lora_alpha)
+        if adapters is not None:
+            from .multi_lora import stack_adapters
+
+            names = sorted(adapters)
+            self._adapter_ids = {name: i + 1 for i, name in enumerate(names)}
+            self._stacked_adapters = stack_adapters(
+                [adapters[n] for n in names], config
+            )
+        else:
+            self._adapter_ids = {}
+            self._stacked_adapters = None
+
         trash = self.ctrl.trash
         self._tables = np.full((slots, self.max_pages), trash, np.int32)
         self._positions = np.zeros(slots, np.int32)
         self._tokens = np.zeros(slots, np.int32)
+        self._adapter_idx = np.zeros(slots, np.int32)
         self._occupied = np.zeros(slots, bool)
         self._slot_req: dict[int, Request] = {}
         self.pending: deque[Request] = deque()
@@ -268,8 +305,14 @@ class ServeEngine:
         *,
         eos_token: int | None = None,
         rid: str | None = None,
+        adapter: str | None = None,
     ) -> str:
         prompt = [int(t) for t in prompt]
+        if adapter is not None and adapter not in self._adapter_ids:
+            raise ValueError(
+                f"unknown adapter {adapter!r}: engine serves "
+                f"{sorted(self._adapter_ids) or '(base only)'}"
+            )
         limit = self.config.max_seq_len - 1
         if not 1 <= len(prompt) <= limit:
             raise ValueError(
@@ -301,7 +344,7 @@ class ServeEngine:
             # Loud at the call site: a duplicate would silently overwrite
             # one request's tokens in run()'s {rid: tokens} result.
             raise ValueError(f"request id {rid!r} is already in flight")
-        req = Request(rid, prompt, max_new_tokens, eos_token)
+        req = Request(rid, prompt, max_new_tokens, eos_token, adapter=adapter)
         self.pending.append(req)
         return rid
 
@@ -312,6 +355,7 @@ class ServeEngine:
         n_samples: int = 2,
         *,
         eos_token: int | None = None,
+        adapter: str | None = None,
     ) -> list[str]:
         """N independent samples of one prompt SHARING its prompt pages
         AND its prefill.
@@ -334,7 +378,9 @@ class ServeEngine:
         # member is queued, leaving nothing to clean up.
         rids = []
         for _ in range(n_samples):
-            rid = self.submit(prompt, max_new_tokens, eos_token=eos_token)
+            rid = self.submit(
+                prompt, max_new_tokens, eos_token=eos_token, adapter=adapter
+            )
             self.pending[-1].group = gid  # appended last by submit()
             rids.append(rid)
         self._groups[gid] = {"members_left": n_samples, "allocated": False}
@@ -383,6 +429,7 @@ class ServeEngine:
         self._tables[slot] = self.ctrl.trash
         self._positions[slot] = 0
         self._tokens[slot] = 0
+        self._adapter_idx[slot] = 0
         return req
 
     def _admit_group_member(self, req: Request, seq, n: int) -> jax.Array:
@@ -408,7 +455,10 @@ class ServeEngine:
             [self.ctrl.tables[seq]], self.max_pages, fill=self.ctrl.trash
         )
         if g.get("logits") is None:
-            logits, self.pools = self._run_prefill(table, req.prompt)
+            logits, self.pools = self._run_prefill(
+                table, req.prompt,
+                adapter_idx=self._adapter_ids.get(req.adapter, 0),
+            )
             g["logits"] = logits
             if n > shared:
                 # The partial tail page is private per member; pin the
@@ -437,7 +487,8 @@ class ServeEngine:
         return logits
 
     def _run_prefill(
-        self, table: jax.Array, prompt_tokens: list[int], start_page: int = 0
+        self, table: jax.Array, prompt_tokens: list[int], start_page: int = 0,
+        adapter_idx: int = 0,
     ):
         """Prefill one admission: a single bucket-wide call for prompts
         that fit, page-aligned CHUNKS (paged_prefill_chunk) for longer
@@ -451,9 +502,16 @@ class ServeEngine:
         (last-position logits, pools)."""
         self.prefills_run += 1
         self.prefill_tokens += len(prompt_tokens) - start_page * self.page_size
+        lora = None
+        if self._stacked_adapters is not None:
+            lora = (
+                self._stacked_adapters,
+                jnp.asarray([adapter_idx], jnp.int32),
+                self.lora_alpha,
+            )
         logits, pools = self._prefill_into(
             self.params, self.config, self.pools, self._prefill, table,
-            prompt_tokens, start_page,
+            prompt_tokens, start_page, lora,
         )
         if self.d_pools is not None:
             _, self.d_pools = self._prefill_into(
@@ -465,7 +523,7 @@ class ServeEngine:
 
     def _prefill_into(
         self, params, config, pools, prefill_program, table, prompt_tokens,
-        start_page: int = 0,
+        start_page: int = 0, lora=None,
     ):
         n = len(prompt_tokens)
         B = self.prompt_bucket
@@ -476,11 +534,15 @@ class ServeEngine:
                 f"bucket pages {bucket_pages}"
             )
         lengths = jnp.asarray([n], jnp.int32)
+        # The TP programs do not take a lora operand (the engine forbids
+        # adapters+mesh); only pass the kwarg when set, so their
+        # signatures stay untouched.
+        lora_kw = {} if lora is None else {"lora": lora}
         if start_page == 0 and n <= B:
             prompt = np.zeros((1, B), np.int32)
             prompt[0, :n] = prompt_tokens
             return prefill_program(
-                params, pools, table, jnp.asarray(prompt), lengths
+                params, pools, table, jnp.asarray(prompt), lengths, **lora_kw
             )
         # The chunked path contains no Pallas call, so under a mesh it
         # needs no dedicated program: the module-level jit picks the
@@ -499,7 +561,7 @@ class ServeEngine:
                 params, pools, table, jnp.asarray(chunk), lengths,
                 config=config, start_page=ci * bucket_pages,
                 cover_pages=(ci + 1) * bucket_pages,
-                emit=ci == n_chunks - 1,
+                emit=ci == n_chunks - 1, **lora_kw,
             )
         return logits, pools
 
@@ -523,9 +585,14 @@ class ServeEngine:
             req = self.pending.popleft()
             seq = self._seq_id(slot, req)
             n = len(req.prompt)
+            aidx = self._adapter_ids.get(req.adapter, 0)
             if req.group is not None:
                 logits = self._admit_group_member(req, seq, n)
             else:
+                # Adapter-salted prefix keys: the cached pages hold
+                # ADAPTED k/v, so the same tokens under different
+                # adapters must never share pages.
+                salt = f"lora:{aidx}" if aidx else ""
                 shared_pages = []
                 if self.prefix is not None:
                     # Cap hits to (a) leave >= 1 prompt token computed (the
@@ -535,7 +602,7 @@ class ServeEngine:
                     bp = self.prompt_bucket // self.page_size
                     cap = (n - 1) // self.page_size // bp * bp
                     shared_pages = self.prefix.lookup(
-                        req.prompt, cap, granularity=bp
+                        req.prompt, cap, granularity=bp, salt=salt
                     )
                 if shared_pages:
                     self.ctrl.adopt(seq, shared_pages)
@@ -547,10 +614,13 @@ class ServeEngine:
                     fill=self.ctrl.trash,
                 )
                 logits, self.pools = self._run_prefill(
-                    table, req.prompt, start_page=len(shared_pages)
+                    table, req.prompt, start_page=len(shared_pages),
+                    adapter_idx=aidx,
                 )
                 if self.prefix is not None:
-                    self.prefix.insert(req.prompt, self.ctrl.tables[seq])
+                    self.prefix.insert(
+                        req.prompt, self.ctrl.tables[seq], salt=salt
+                    )
             tok = int(
                 self._first_token(
                     logits, self._next_key(),
@@ -567,6 +637,7 @@ class ServeEngine:
                 continue
             self._slot_req[slot] = req
             self._occupied[slot] = True
+            self._adapter_idx[slot] = aidx
             self._fresh_slots.add(slot)
             self._committed_pages += need
             self._slot_commit[slot] = need
@@ -634,12 +705,20 @@ class ServeEngine:
             tok_in = jnp.where(jnp.asarray(fresh), tok_in, self._chained_tok)
         self._fresh_slots.clear()
 
+        chunk_kw = {}
+        if self._stacked_adapters is not None:
+            # Per-row adapters ride as DATA (the gather index array);
+            # a parked row's index is 0 (the zero base entry).
+            chunk_kw["lora"] = (
+                self._stacked_adapters, self._dev(self._adapter_idx),
+                self.lora_alpha,
+            )
         toks, self.pools = self._chunk(
             self.params, self.pools,
             self._dev(self._tables), tok_in,
             self._dev(self._positions), self._dev(self._occupied),
             self._next_key(), jnp.float32(self.temperature),
-            jnp.int32(self.top_k), jnp.float32(self.top_p),
+            jnp.int32(self.top_k), jnp.float32(self.top_p), **chunk_kw,
         )
         self.chunks_run += 1
         snapshot = dict(self._slot_req)
@@ -907,6 +986,10 @@ def main(argv=None) -> int:
     parser.add_argument("--pipelined", action="store_true",
                         help="overlap each chunk's readback with the next "
                         "chunk's compute (same tokens, higher throughput)")
+    parser.add_argument("--lora-adapters", type=int, default=0,
+                        help="serve N synthetic LoRA adapters multi-tenant "
+                        "(requests round-robin across them + the base)")
+    parser.add_argument("--lora-rank", type=int, default=8)
     args = parser.parse_args(argv)
     if args.requests < 1 or args.slots < 1:
         parser.error("--requests and --slots must be >= 1")
@@ -936,11 +1019,21 @@ def main(argv=None) -> int:
         -(-args.prompt_len // page_size) * page_size,
         config.max_seq_len // page_size * page_size,
     )
+    adapters = None
+    names: list = [None]
+    if args.lora_adapters > 0:
+        from .multi_lora import synthetic_adapters
+
+        adapters = synthetic_adapters(
+            config, args.lora_adapters, rank=args.lora_rank, seed=99
+        )
+        names += sorted(adapters)
     engine = ServeEngine(
         params, config, slots=args.slots, page_size=page_size,
         prompt_bucket=bucket,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         rng=jax.random.PRNGKey(42), pipelined=args.pipelined,
+        adapters=adapters,
     )
     key = jax.random.PRNGKey(7)
     for i in range(args.requests):
@@ -951,7 +1044,9 @@ def main(argv=None) -> int:
         )
         # Mixed lengths: the stream the engine's slot turnover exists for.
         new = max(1, args.max_new_tokens // (1 + i % 3))
-        engine.submit([int(t) for t in prompt], new)
+        engine.submit(
+            [int(t) for t in prompt], new, adapter=names[i % len(names)]
+        )
 
     # Warm the three compiled programs on the first step, then time the
     # rest against a wall clock whose endpoints are REAL host readbacks
@@ -969,6 +1064,7 @@ def main(argv=None) -> int:
         f"done: {args.requests} requests, {engine.generated_tokens} tokens, "
         f"{engine.chunks_run} chunks, steady-state ≈ {rate:.0f} tok/s "
         f"(int8={args.int8}, kv_heads={config.kv_heads}, "
+        f"adapters={args.lora_adapters}, "
         f"pool={engine.ctrl.n_pages} pages, "
         f"pages in use after drain: {engine.ctrl.used_pages})"
     )
