@@ -124,11 +124,6 @@ class ServeEngine:
                     "decoding yet (the draft would need per-row adapters "
                     "of its own)"
                 )
-            if mesh is not None:
-                raise ValueError(
-                    "multi-LoRA serving is single-device for now (the TP "
-                    "programs do not thread adapter operands)"
-                )
             if not adapters:
                 raise ValueError(
                     "adapters must be a non-empty {name: adapter} dict "
@@ -277,9 +272,42 @@ class ServeEngine:
                 shard_serving_state,
             )
 
-            self._prefill, self._chunk = make_tp_serve_programs(
-                self.config, mesh, chunk=self.chunk, sampling=self.sampling
+            tp_prefill, tp_chunk = make_tp_serve_programs(
+                self.config, mesh, chunk=self.chunk, sampling=self.sampling,
+                lora_stacked=self._stacked_adapters,
+                lora_alpha=self.lora_alpha,
             )
+            if self._stacked_adapters is not None:
+                # Place the adapter stack on the mesh ONCE (replicated —
+                # rank-r factors are tiny next to the sharded base);
+                # leaving it on a single device would re-replicate the
+                # whole stack at every prefill/chunk dispatch.
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                self._stacked_adapters = jax.device_put(
+                    self._stacked_adapters,
+                    jax.tree.map(
+                        lambda _: NamedSharding(mesh, PartitionSpec()),
+                        self._stacked_adapters,
+                    ),
+                )
+
+                # pjit with in_shardings takes no kwargs: adapt the
+                # engine's uniform ``lora=`` keyword to the TP programs'
+                # trailing positional (stacked, idx) operands (alpha is
+                # baked into the program).
+                def _wrap(prog):
+                    def call(*args, lora=None):
+                        if lora is not None:
+                            stacked, idx, _alpha = lora
+                            return prog(*args, stacked, idx)
+                        return prog(*args)
+
+                    return call
+
+                self._prefill, self._chunk = _wrap(tp_prefill), _wrap(tp_chunk)
+            else:
+                self._prefill, self._chunk = tp_prefill, tp_chunk
             self.params, self.pools = shard_serving_state(
                 self.params, self.pools, self.config, mesh
             )
@@ -534,9 +562,12 @@ class ServeEngine:
                 f"bucket pages {bucket_pages}"
             )
         lengths = jnp.asarray([n], jnp.int32)
-        # The TP programs do not take a lora operand (the engine forbids
-        # adapters+mesh); only pass the kwarg when set, so their
-        # signatures stay untouched.
+        # Adapters ride a uniform ``lora=`` keyword: the single-device
+        # programs take it directly, the TP programs through the _wrap
+        # shim (which converts it to their trailing positional operands),
+        # and the chunked path (paged_prefill_chunk) under GSPMD.  Only
+        # pass it when set so adapter-less engines' signatures are
+        # untouched.
         lora_kw = {} if lora is None else {"lora": lora}
         if start_page == 0 and n <= B:
             prompt = np.zeros((1, B), np.int32)

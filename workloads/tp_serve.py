@@ -144,7 +144,8 @@ def _tp_paged_attention(config: ModelConfig, mesh: Mesh):
 
 
 def make_tp_serve_programs(
-    config: ModelConfig, mesh: Mesh, chunk: int, sampling: bool
+    config: ModelConfig, mesh: Mesh, chunk: int, sampling: bool,
+    lora_stacked=None, lora_alpha: float = 1.0,
 ):
     """Tensor-parallel (prefill, decode_chunk) with the signatures
     ServeEngine expects (minus the static config/chunk/sampling, baked
@@ -153,7 +154,13 @@ def make_tp_serve_programs(
     The engine's batch axis stays replicated — serving tensor
     parallelism is about fitting/sharding the MODEL; scale request
     throughput by running more engines — so the mesh's "data" degree
-    must be 1 (build it with make_mesh(n, model_parallel=n))."""
+    must be 1 (build it with make_mesh(n, model_parallel=n)).
+
+    With ``lora_stacked`` (multi-tenant LoRA: workloads/multi_lora.py
+    stacked adapter trees) both programs take TWO trailing operands —
+    the stacked tree (replicated: rank-r factors are tiny next to the
+    sharded base) and the per-row adapter index array — and apply the
+    per-row activation deltas inside the sharded forward."""
     _check_tp(config, mesh)
     if mesh.shape.get("data", 1) != 1:
         raise ValueError(
@@ -167,36 +174,49 @@ def make_tp_serve_programs(
     pool_sh = NamedSharding(mesh, _POOL_SPEC)
     rep = lambda *axes: NamedSharding(mesh, P(*axes))  # noqa: E731
     attention_fn = _tp_paged_attention(config, mesh)
+    lora_sh = (
+        ()
+        if lora_stacked is None
+        else (jax.tree.map(lambda _: rep(), lora_stacked), rep(None))
+    )
 
     @partial(
         jax.jit,
         donate_argnums=(1,),
         in_shardings=(
             param_sh, (pool_sh, pool_sh), rep(None, None), rep(None, None),
-            rep(None),
+            rep(None), *lora_sh,
         ),
         out_shardings=(rep(None, None), (pool_sh, pool_sh)),
     )
-    def tp_prefill(params, pools, tables, prompts, lengths):
-        return _prefill_core(params, pools, tables, prompts, lengths, config)
+    def tp_prefill(params, pools, tables, prompts, lengths, *lora_args):
+        lora = (
+            (lora_args[0], lora_args[1], lora_alpha) if lora_args else None
+        )
+        return _prefill_core(
+            params, pools, tables, prompts, lengths, config, lora=lora
+        )
 
     @partial(
         jax.jit,
         donate_argnums=(1,),
         in_shardings=(
             param_sh, (pool_sh, pool_sh), rep(None, None), rep(None),
-            rep(None), rep(None), rep(None), rep(), rep(), rep(),
+            rep(None), rep(None), rep(None), rep(), rep(), rep(), *lora_sh,
         ),
         out_shardings=(rep(None, None), (pool_sh, pool_sh)),
     )
     def tp_chunk(
         params, pools, tables, token, positions, occupancy, rng,
-        temperature, top_k, top_p,
+        temperature, top_k, top_p, *lora_args,
     ):
+        lora = (
+            (lora_args[0], lora_args[1], lora_alpha) if lora_args else None
+        )
         return _chunk_core(
             params, pools, tables, token, positions, occupancy, rng,
             temperature, top_k, top_p, config, chunk, sampling,
-            attention_fn=attention_fn,
+            attention_fn=attention_fn, lora=lora,
         )
 
     return tp_prefill, tp_chunk
