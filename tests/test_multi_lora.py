@@ -213,16 +213,53 @@ def test_tp_multi_lora_matches_single_device(params, adapters):
     np.testing.assert_array_equal(np.asarray(got[rids[0]]), np.asarray(ref[0]))
 
 
+DRAFT_CONFIG = ModelConfig(
+    max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+
+
+def test_speculative_multi_lora_matches_merged_model(params, adapters):
+    """Speculation composes with multi-LoRA: the TARGET verifies with
+    each row's adapter applied (the draft guesses unadapted — acceptance
+    cost, never correctness), so every tenant still gets exactly its
+    merged-weight model's greedy tokens, per row, in one batch."""
+    draft = init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
+    for pipelined in (False, True):
+        engine = ServeEngine(
+            params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+            adapters=adapters, draft_params=draft,
+            draft_config=DRAFT_CONFIG, gamma=3, pipelined=pipelined,
+        )
+        stream = [([1, 2, 3, 4], "tenant-a"), ([5, 6, 7], None),
+                  ([1, 2, 3, 4], "tenant-b")]
+        rids = [engine.submit(p, 10, adapter=a) for p, a in stream]
+        served = engine.run()
+        for rid, (p, a) in zip(rids, stream):
+            model = (
+                params if a is None
+                else merge_lora(params, adapters[a], dtype=jnp.float32)
+            )
+            want = generate(
+                model, jnp.asarray([p], jnp.int32), CONFIG,
+                max_new_tokens=10,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(served[rid]), np.asarray(want[0]),
+                err_msg=f"{a} pipelined={pipelined}",
+            )
+        assert engine.spec_rounds > 0
+        assert engine.ctrl.used_pages == 0
+
+
 def test_validations(params, adapters):
-    draft_config = ModelConfig(
-        max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
-        dtype=jnp.float32,
-    )
-    draft = init_params(draft_config, jax.random.PRNGKey(7))
-    with pytest.raises(ValueError, match="speculative"):
+    from workloads.train import make_mesh
+
+    draft = init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
+    with pytest.raises(ValueError, match="not.*threaded|threaded yet"):
         ServeEngine(
             params, CONFIG, adapters=adapters, draft_params=draft,
-            draft_config=draft_config,
+            draft_config=DRAFT_CONFIG, mesh=make_mesh(2, model_parallel=2),
         )
     with pytest.raises(ValueError, match="non-empty"):
         ServeEngine(params, CONFIG, adapters={})

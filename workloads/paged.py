@@ -610,6 +610,7 @@ def _rowwise_block_core(
     block: jax.Array,
     positions: jax.Array,
     config: ModelConfig,
+    lora=None,
 ):
     """``s`` consecutive tokens PER ROW at per-row start positions through
     the paged pools in ONE weight stream — the paged, batched counterpart
@@ -658,6 +659,11 @@ def _rowwise_block_core(
 
     from .model import masked_attention
 
+    if lora is not None:
+        from .multi_lora import apply_qkv, wo_row_delta
+
+        stacked, aidx, alpha = lora
+
     def write_rows(view, new):  # new: [b, s, Hkv, hd] at per-row offsets
         for b in range(batch):
             view = jax.lax.dynamic_update_slice(
@@ -669,11 +675,20 @@ def _rowwise_block_core(
     for i, layer in enumerate(params["layers"]):
         h = _rmsnorm(x, layer["ln1"])
         q, k, v = project_qkv(h, layer)
+        if lora is not None:
+            q, k, v = apply_qkv(
+                q, k, v, h, stacked[i], aidx, config, alpha, config.dtype
+            )
         q, k = _rope_rows(q, angles), _rope_rows(k, angles)
         view_k = view_k.at[i].set(write_rows(view_k[i], k))
         view_v = view_v.at[i].set(write_rows(view_v[i], v))
         attn = masked_attention(q, view_k[i], view_v[i], mask, config.head_dim)
-        x = x + jnp.einsum("bshk,hkd->bsd", attn, weight(layer["wo"], x.dtype))
+        proj = jnp.einsum("bshk,hkd->bsd", attn, weight(layer["wo"], x.dtype))
+        if lora is not None:
+            d_wo = wo_row_delta(attn, stacked[i], aidx, alpha)
+            if d_wo is not None:
+                proj = (proj.astype(jnp.float32) + d_wo).astype(x.dtype)
+        x = x + proj
         x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer)
     logits = x.astype(jnp.float32) @ weight(params["unembed"], jnp.float32)
 
@@ -701,6 +716,7 @@ def paged_spec_round(
     d_config: ModelConfig,
     gamma: int,
     cover_pages: int | None = None,
+    t_lora=None,
 ):
     """One BATCHED speculative-decoding round over paged caches: the
     draft proposes ``gamma`` tokens per row autoregressively (cheap
@@ -731,7 +747,7 @@ def paged_spec_round(
     return _spec_round_core(
         t_params, d_params, t_pools, d_pools, tables, cur, positions,
         t_config=t_config, d_config=d_config, gamma=gamma,
-        cover_pages=cover_pages,
+        cover_pages=cover_pages, t_lora=t_lora,
     )
 
 
@@ -753,6 +769,7 @@ def paged_spec_round_chained(
     d_config: ModelConfig,
     gamma: int,
     cover_pages: int | None = None,
+    t_lora=None,
 ):
     """paged_spec_round with DEVICE-SIDE chaining for pipelined
     speculative serving: additionally takes an occupancy mask and
@@ -771,14 +788,14 @@ def paged_spec_round_chained(
     return _spec_round_core(
         t_params, d_params, t_pools, d_pools, tables, cur, positions,
         t_config=t_config, d_config=d_config, gamma=gamma,
-        cover_pages=cover_pages, occupancy=occupancy,
+        cover_pages=cover_pages, occupancy=occupancy, t_lora=t_lora,
     )
 
 
 def _spec_round_core(
     t_params, d_params, t_pools, d_pools, tables, cur, positions,
     t_config, d_config, gamma, cover_pages, d_attention_fn=None,
-    occupancy=None,
+    occupancy=None, t_lora=None,
 ):
     """paged_spec_round's body, un-jitted so the tensor-parallel path can
     re-jit it with explicit shardings and an injected draft attention op
@@ -813,8 +830,12 @@ def _spec_round_core(
     drafts = jnp.transpose(proposals, (1, 0))[:, :gamma]  # [batch, gamma]
 
     block = jnp.concatenate([cur[:, None], drafts], axis=1)
+    # The TARGET verifies with the rows' adapters applied (t_lora): the
+    # committed tokens are the ADAPTED model's argmax, so speculation
+    # stays lossless per tenant.  The draft stays unadapted — a worse
+    # guesser only lowers acceptance, never correctness.
     t_logits, t_pools = _rowwise_block_core(
-        t_params, t_pools, tables, block, positions, t_config
+        t_params, t_pools, tables, block, positions, t_config, lora=t_lora
     )
     picks = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [b, gamma+1]
 

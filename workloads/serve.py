@@ -118,11 +118,11 @@ class ServeEngine:
                 "serving needs both)"
             )
         if adapters is not None:
-            if draft_params is not None:
+            if draft_params is not None and mesh is not None:
                 raise ValueError(
-                    "multi-LoRA serving does not compose with speculative "
-                    "decoding yet (the draft would need per-row adapters "
-                    "of its own)"
+                    "speculative x multi-LoRA x tensor-parallel is not "
+                    "threaded yet (the TP spec programs take no adapter "
+                    "operands); drop one of the three"
                 )
             if not adapters:
                 raise ValueError(
@@ -297,11 +297,11 @@ class ServeEngine:
                 # trailing positional (stacked, idx) operands (alpha is
                 # baked into the program).
                 def _wrap(prog):
-                    def call(*args, lora=None):
-                        if lora is not None:
-                            stacked, idx, _alpha = lora
-                            return prog(*args, stacked, idx)
-                        return prog(*args)
+                    # Every adapter-engine call site passes lora= (base
+                    # requests ride idx 0): unpack unconditionally.
+                    def call(*args, lora):
+                        stacked, idx, _alpha = lora
+                        return prog(*args, stacked, idx)
 
                     return call
 
@@ -838,6 +838,14 @@ class ServeEngine:
         need = -(-(max(ub.values()) + u) // self.page_size)
         cover = min(self.max_pages, -(-need // 4) * 4)
 
+        # Per-row adapters apply to the TARGET's verify forward only
+        # (the draft guesses unadapted — acceptance, not correctness).
+        t_lora = None
+        if self._stacked_adapters is not None:
+            t_lora = (
+                self._stacked_adapters, self._dev(self._adapter_idx),
+                self.lora_alpha,
+            )
         if not self.pipelined:
             if self._mesh is None:
                 committed, n_acc, self.pools, self.d_pools = paged_spec_round(
@@ -845,7 +853,7 @@ class ServeEngine:
                     self._dev(self._tables), self._dev(self._tokens),
                     self._dev(self._positions),
                     t_config=self.config, d_config=self.draft_config,
-                    gamma=self.gamma, cover_pages=cover,
+                    gamma=self.gamma, cover_pages=cover, t_lora=t_lora,
                 )
             else:
                 committed, n_acc, self.pools, self.d_pools = self._tp_spec(
@@ -876,7 +884,7 @@ class ServeEngine:
                     self.params, self.draft_params, self.pools, self.d_pools,
                     self._dev(self._tables), cur, pos, occ,
                     t_config=self.config, d_config=self.draft_config,
-                    gamma=self.gamma, cover_pages=cover,
+                    gamma=self.gamma, cover_pages=cover, t_lora=t_lora,
                 )
             )
         else:
