@@ -1,0 +1,108 @@
+"""Feature-matrix fuzz for the serving engine: randomized streams
+through randomized engine configurations (prefix cache x pipelined x
+speculative x multi-LoRA x fan-out x eos x chunked prefill), every
+request pinned exactly against the dense reference model it should be
+equivalent to.  Deterministic seeds — failures reproduce."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from workloads.generate import generate
+from workloads.lora import merge_lora
+from workloads.model import ModelConfig, init_params
+from workloads.multi_lora import synthetic_adapters
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+DRAFT_CONFIG = ModelConfig(
+    max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+
+
+def _run_one(seed: int, params, draft, adapters) -> None:
+    rng = np.random.default_rng(seed)
+    spec = bool(rng.integers(2))
+    use_adapters = bool(rng.integers(2))
+    kw = dict(
+        slots=int(rng.integers(1, 4)),
+        page_size=int(rng.choice([4, 8])),
+        prefix_cache=bool(rng.integers(2)),
+        pipelined=bool(rng.integers(2)),
+    )
+    kw["prompt_bucket"] = int(kw["page_size"] * rng.choice([2, 3]))
+    if spec:
+        kw.update(draft_params=draft, draft_config=DRAFT_CONFIG,
+                  gamma=int(rng.integers(2, 5)))
+    else:
+        kw["chunk"] = kw["page_size"]
+    engine = ServeEngine(
+        params, CONFIG, adapters=adapters if use_adapters else None, **kw
+    )
+    names = [None] + (sorted(adapters) if use_adapters else [])
+
+    expected = {}  # rid -> (prompt, max_new, adapter, eos)
+    n_requests = int(rng.integers(3, 7))
+    for _ in range(n_requests):
+        plen = int(rng.integers(1, 25))
+        prompt = [int(t) for t in rng.integers(0, CONFIG.vocab_size, plen)]
+        new = int(rng.integers(1, min(24, CONFIG.max_seq_len - plen) + 1))
+        adapter = names[int(rng.integers(len(names)))]
+        if rng.integers(4) == 0 and new >= 2:  # occasional fan-out pair
+            rids = engine.submit_fanout(
+                prompt, new, n_samples=2, adapter=adapter
+            )
+            for rid in rids:
+                expected[rid] = (prompt, new, adapter, None)
+        else:
+            # Occasional eos mid-stream: pick the token the reference
+            # model will emit at a known step, so retirement truly
+            # triggers early.
+            eos = None
+            model = (
+                params if adapter is None
+                else merge_lora(params, adapters[adapter], dtype=jnp.float32)
+            )
+            if rng.integers(4) == 0 and new >= 4:
+                ref = generate(
+                    model, jnp.asarray([prompt], jnp.int32), CONFIG,
+                    max_new_tokens=new,
+                )
+                eos = int(np.asarray(ref[0, new // 2]))
+            rid = engine.submit(prompt, new, eos_token=eos, adapter=adapter)
+            expected[rid] = (prompt, new, adapter, eos)
+
+    served = engine.run()
+    assert set(served) == set(expected)
+    for rid, (prompt, new, adapter, eos) in expected.items():
+        model = (
+            params if adapter is None
+            else merge_lora(params, adapters[adapter], dtype=jnp.float32)
+        )
+        ref = [int(t) for t in np.asarray(generate(
+            model, jnp.asarray([prompt], jnp.int32), CONFIG,
+            max_new_tokens=new,
+        )[0])]
+        if eos is not None and eos in ref:
+            ref = ref[: ref.index(eos) + 1]
+        got = list(served[rid])
+        if eos is None:
+            assert got == ref, (seed, rid, kw, adapter)
+        else:
+            # Retirement is detected at chunk/round boundaries, so a few
+            # tokens past the eos may be emitted; the prefix up to and
+            # including the eos must match exactly.
+            assert got[: len(ref)] == ref, (seed, rid, kw, adapter, "eos")
+            assert eos in got, (seed, rid, kw, adapter, "eos missing")
+    # Hygiene: everything drained; only prefix-cache pins may remain.
+    pinned = engine.prefix.cached_pages if engine.prefix is not None else 0
+    assert engine.ctrl.used_pages == pinned, (seed, kw)
+
+
+def test_engine_feature_matrix_fuzz():
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    draft = init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
+    adapters = synthetic_adapters(CONFIG, 2, rank=4, scale=0.3, seed=3)
+    for seed in range(8):
+        _run_one(seed, params, draft, adapters)

@@ -18,8 +18,17 @@ admission and retirement never retrace.
 
 The flagship serving features compose here end-to-end: grouped-query
 attention (smaller pages), int8 weight-only bases (halved weight
-stream), paged memory with on-demand allocation, and
-temperature/top-k/top-p sampling (traced knobs).
+stream), paged memory with on-demand allocation, temperature/top-k/top-p
+sampling (traced knobs), fan-out sampling (shared prompt pages AND
+prefill), cross-request prefix caching (``prefix_cache=True``,
+adapter-salted), batched speculative decoding (``draft_params=``, with
+optionally PIPELINED rounds chained on device), multi-tenant LoRA
+serving (``adapters=``: per-row activation deltas over one base), and
+tensor parallelism (``mesh=``).  Every pairwise composition is
+supported and parity-pinned except two loud ValueErrors: speculative
+serving is greedy-only (temperature must be 0 — the lossless
+formulation), and the speculative x LoRA x TP three-way is not
+threaded; tests/test_serve_fuzz.py sweeps the matrix.
 
 ``serve_batch`` remains as the LOCKSTEP baseline (admit a whole batch,
 decode to the common max, retire together) — both the simplest way to
