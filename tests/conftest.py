@@ -33,6 +33,9 @@ import pytest  # noqa: E402
 _FAST_DESPITE_JAX = {
     # Drives subprocess pods with tiny matmul kernels; wall time is seconds.
     "test_oversubscribe",
+    # Pure host-side control-plane properties (PagePool/PrefixCache):
+    # imports workloads.paged but never traces a jax program.
+    "test_paged_properties",
 }
 _JAX_IMPORT_RE = re.compile(r"^\s*(?:import|from)\s+(?:jax|workloads)\b", re.MULTILINE)
 _slow_file_cache: dict[str, bool] = {}

@@ -1,0 +1,129 @@
+"""Property-based spec of the page-pool control plane (hypothesis):
+refcount conservation, no page ever double-owned writable, eviction
+safety, and the free-list/used accounting staying exact under ANY
+interleaving of allocate / extend / fork / adopt / retain / release /
+prefix-cache operations.
+
+These are pure host-side structures (no jax), so hundreds of random
+op sequences run in milliseconds — the control-plane complement of
+tests/test_serve_fuzz.py's compute-path sweep."""
+
+from hypothesis import given, settings, strategies as st
+
+from workloads.paged import PagePool, PrefixCache
+
+N_PAGES, PAGE_SIZE = 12, 4
+
+
+def _check_invariants(ctrl: PagePool, cache: PrefixCache | None = None) -> None:
+    # Every page is in exactly one of: free list, refcounted-live.
+    free = set(ctrl.free)
+    live = set(ctrl.refcounts)
+    assert free.isdisjoint(live)
+    assert free | live == set(range(ctrl.n_pages)), (free, live)
+    assert all(c > 0 for c in ctrl.refcounts.values())
+    # EXACT refcount conservation: in this harness the only holders are
+    # sequence tables and the prefix cache's pins, so every count must
+    # equal appearances + pins — a leak or double-free trips here.
+    appearances: dict[int, int] = {}
+    for table in ctrl.tables.values():
+        for p in table:
+            assert p in live
+            appearances[p] = appearances.get(p, 0) + 1
+    if cache is not None:
+        for p in cache._index.values():
+            appearances[p] = appearances.get(p, 0) + 1
+    for p, c in ctrl.refcounts.items():
+        assert c == appearances.get(p, 0), (p, c, appearances.get(p, 0))
+    assert ctrl.used_pages == len(live)
+
+
+ops = st.lists(
+    st.tuples(
+        st.sampled_from(
+            ["allocate", "extend", "fork", "release", "cache_insert",
+             "cache_lookup", "evict", "adopt"]
+        ),
+        st.integers(0, 6),   # seq selector
+        st.integers(1, 3),   # size in pages
+    ),
+    min_size=1,
+    max_size=60,
+)
+
+
+@given(ops)
+@settings(max_examples=300, deadline=None)
+def test_pool_invariants_under_random_ops(op_list):
+    ctrl = PagePool(n_pages=N_PAGES, page_size=PAGE_SIZE)
+    cache = PrefixCache(ctrl)
+    tokens_of: dict = {}
+    for op, sel, pages in op_list:
+        seq = f"s{sel}"
+        try:
+            if op == "allocate":
+                if seq not in ctrl.tables:
+                    ctrl.allocate(seq, pages * PAGE_SIZE)
+                    tokens_of[seq] = list(range(sel * 50, sel * 50 + pages * PAGE_SIZE))
+            elif op == "extend":
+                if seq in ctrl.tables:
+                    ctrl.extend(seq, (len(ctrl.tables[seq]) + pages) * PAGE_SIZE)
+                    tokens_of[seq] = list(
+                        range(sel * 50, sel * 50 + len(ctrl.tables[seq]) * PAGE_SIZE)
+                    )
+            elif op == "fork":
+                parent = f"s{(sel + 1) % 7}"
+                if parent in ctrl.tables and seq not in ctrl.tables:
+                    shared = min(pages, len(ctrl.tables[parent])) * PAGE_SIZE
+                    ctrl.fork(parent, seq, shared)
+                    tokens_of[seq] = (tokens_of.get(parent) or [])[:shared]
+            elif op == "release":
+                if seq in ctrl.tables:
+                    ctrl.release(seq)
+                    tokens_of.pop(seq, None)
+            elif op == "cache_insert":
+                if seq in ctrl.tables and tokens_of.get(seq):
+                    toks = tokens_of[seq][: len(ctrl.tables[seq]) * PAGE_SIZE]
+                    cache.insert(toks, ctrl.tables[seq])
+            elif op == "cache_lookup":
+                toks = tokens_of.get(seq) or list(range(pages * PAGE_SIZE))
+                got = cache.lookup(toks, pages)
+                for p in got:
+                    assert p in ctrl.refcounts  # never a freed page
+            elif op == "evict":
+                cache.evict(pages)
+            elif op == "adopt":
+                if seq not in ctrl.tables and cache.cached_pages:
+                    donor = list(cache._index.values())[:pages]
+                    ctrl.adopt(seq, donor)
+                    tokens_of[seq] = None  # unknown tokens: fine, host-only
+        except RuntimeError:
+            pass  # pool exhausted: legal outcome, invariants must still hold
+        _check_invariants(ctrl, cache)
+    # Drain everything: with the cache cleared too, every page is free.
+    for seq in list(ctrl.tables):
+        ctrl.release(seq)
+    cache.clear()
+    _check_invariants(ctrl, cache)
+    assert ctrl.used_pages == 0
+
+
+@given(st.lists(st.integers(0, 300), min_size=PAGE_SIZE, max_size=48),
+       st.integers(1, 8))
+@settings(max_examples=200, deadline=None)
+def test_prefix_chain_keys_share_only_true_prefixes(tokens, cut):
+    """lookup can only ever return pages for an exact token-prefix match
+    — chain keys commit to every earlier token, and salts partition."""
+    ctrl = PagePool(n_pages=32, page_size=PAGE_SIZE)
+    cache = PrefixCache(ctrl)
+    table = ctrl.allocate("s", len(tokens))
+    cache.insert(tokens, table)
+    full = len(tokens) // PAGE_SIZE
+    # Exact prefix: hits exactly min(cut, full) pages of the table.
+    got = cache.lookup(tokens, cut)
+    assert got == table[: min(cut, full)]
+    # A mutated first block: zero hits.
+    mutated = [tokens[0] + 1] + tokens[1:]
+    assert cache.lookup(mutated, cut) == []
+    # Same tokens, different salt: zero hits.
+    assert cache.lookup(tokens, cut, salt="other") == []

@@ -36,11 +36,22 @@ def _run_one(seed: int, params, draft, adapters) -> None:
         kw.update(draft_params=draft, draft_config=DRAFT_CONFIG,
                   gamma=int(rng.integers(2, 5)))
     else:
-        kw["chunk"] = kw["page_size"]
+        # chunk != page_size exercises the overshoot/boundary accounting.
+        kw["chunk"] = int(kw["page_size"] * rng.choice([1, 2]))
     engine = ServeEngine(
         params, CONFIG, adapters=adapters if use_adapters else None, **kw
     )
     names = [None] + (sorted(adapters) if use_adapters else [])
+    merged_cache: dict = {}
+
+    def model_for(adapter):
+        if adapter is None:
+            return params
+        if adapter not in merged_cache:
+            merged_cache[adapter] = merge_lora(
+                params, adapters[adapter], dtype=jnp.float32
+            )
+        return merged_cache[adapter]
 
     expected = {}  # rid -> (prompt, max_new, adapter, eos)
     n_requests = int(rng.integers(3, 7))
@@ -60,14 +71,10 @@ def _run_one(seed: int, params, draft, adapters) -> None:
             # model will emit at a known step, so retirement truly
             # triggers early.
             eos = None
-            model = (
-                params if adapter is None
-                else merge_lora(params, adapters[adapter], dtype=jnp.float32)
-            )
             if rng.integers(4) == 0 and new >= 4:
                 ref = generate(
-                    model, jnp.asarray([prompt], jnp.int32), CONFIG,
-                    max_new_tokens=new,
+                    model_for(adapter), jnp.asarray([prompt], jnp.int32),
+                    CONFIG, max_new_tokens=new,
                 )
                 eos = int(np.asarray(ref[0, new // 2]))
             rid = engine.submit(prompt, new, eos_token=eos, adapter=adapter)
@@ -76,12 +83,8 @@ def _run_one(seed: int, params, draft, adapters) -> None:
     served = engine.run()
     assert set(served) == set(expected)
     for rid, (prompt, new, adapter, eos) in expected.items():
-        model = (
-            params if adapter is None
-            else merge_lora(params, adapters[adapter], dtype=jnp.float32)
-        )
         ref = [int(t) for t in np.asarray(generate(
-            model, jnp.asarray([prompt], jnp.int32), CONFIG,
+            model_for(adapter), jnp.asarray([prompt], jnp.int32), CONFIG,
             max_new_tokens=new,
         )[0])]
         if eos is not None and eos in ref:
