@@ -252,15 +252,43 @@ def test_speculative_multi_lora_matches_merged_model(params, adapters):
         assert engine.ctrl.used_pages == 0
 
 
-def test_validations(params, adapters):
+def test_three_way_spec_lora_tp_matches_merged_models(params, adapters):
+    """The full stack at once: speculation x multi-LoRA x tensor
+    parallelism (pipelined rounds included) — every tenant's tokens
+    still exactly equal its merged-weight model's greedy output."""
     from workloads.train import make_mesh
 
+    mesh = make_mesh(2, model_parallel=2)
     draft = init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
-    with pytest.raises(ValueError, match="not.*threaded|threaded yet"):
-        ServeEngine(
-            params, CONFIG, adapters=adapters, draft_params=draft,
-            draft_config=DRAFT_CONFIG, mesh=make_mesh(2, model_parallel=2),
+    stream = [([1, 2, 3, 4], "tenant-a"), ([5, 6, 7], None),
+              ([1, 2, 3, 4], "tenant-b")]
+    for pipelined in (False, True):
+        engine = ServeEngine(
+            params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+            adapters=adapters, draft_params=draft,
+            draft_config=DRAFT_CONFIG, gamma=3, mesh=mesh,
+            pipelined=pipelined,
         )
+        rids = [engine.submit(p, 8, adapter=a) for p, a in stream]
+        served = engine.run()
+        for rid, (p, a) in zip(rids, stream):
+            model = (
+                params if a is None
+                else merge_lora(params, adapters[a], dtype=jnp.float32)
+            )
+            want = generate(
+                model, jnp.asarray([p], jnp.int32), CONFIG,
+                max_new_tokens=8,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(served[rid]), np.asarray(want[0]),
+                err_msg=f"{a} pipelined={pipelined}",
+            )
+        assert engine.spec_rounds > 0
+        assert engine.ctrl.used_pages == 0
+
+
+def test_validations(params, adapters):
     with pytest.raises(ValueError, match="non-empty"):
         ServeEngine(params, CONFIG, adapters={})
     engine = _engine(params, adapters)

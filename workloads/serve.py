@@ -24,11 +24,12 @@ prefill), cross-request prefix caching (``prefix_cache=True``,
 adapter-salted), batched speculative decoding (``draft_params=``, with
 optionally PIPELINED rounds chained on device), multi-tenant LoRA
 serving (``adapters=``: per-row activation deltas over one base), and
-tensor parallelism (``mesh=``).  Every pairwise composition is
-supported and parity-pinned except two loud ValueErrors: speculative
-serving is greedy-only (temperature must be 0 — the lossless
-formulation), and the speculative x LoRA x TP three-way is not
-threaded; tests/test_serve_fuzz.py sweeps the matrix.
+tensor parallelism (``mesh=``).  Every composition is supported and
+parity-pinned — including speculative x LoRA x TP three-ways
+(tests/test_multi_lora.py pins those; tests/test_serve_fuzz.py sweeps
+the single-device matrix) — with one loud ValueError: speculative
+serving is greedy-only (temperature must be 0, the lossless
+formulation).
 
 ``serve_batch`` remains as the LOCKSTEP baseline (admit a whole batch,
 decode to the common max, retire together) — both the simplest way to
@@ -127,12 +128,6 @@ class ServeEngine:
                 "serving needs both)"
             )
         if adapters is not None:
-            if draft_params is not None and mesh is not None:
-                raise ValueError(
-                    "speculative x multi-LoRA x tensor-parallel is not "
-                    "threaded yet (the TP spec programs take no adapter "
-                    "operands); drop one of the three"
-                )
             if not adapters:
                 raise ValueError(
                     "adapters must be a non-empty {name: adapter} dict "
@@ -328,6 +323,8 @@ class ServeEngine:
                 self._tp_spec = make_tp_spec_program(
                     self.config, draft_config, mesh, gamma,
                     chained=pipelined,
+                    lora_stacked=self._stacked_adapters,
+                    lora_alpha=self.lora_alpha,
                 )
                 self.draft_params, self.d_pools = shard_serving_state(
                     self.draft_params, self.d_pools, draft_config, mesh
@@ -855,6 +852,8 @@ class ServeEngine:
                 self._stacked_adapters, self._dev(self._adapter_idx),
                 self.lora_alpha,
             )
+        # TP programs take (stacked, idx) positionally; alpha is baked in.
+        lora_ops = () if t_lora is None else (t_lora[0], t_lora[1])
         if not self.pipelined:
             if self._mesh is None:
                 committed, n_acc, self.pools, self.d_pools = paged_spec_round(
@@ -868,7 +867,7 @@ class ServeEngine:
                 committed, n_acc, self.pools, self.d_pools = self._tp_spec(
                     self.params, self.draft_params, self.pools, self.d_pools,
                     self._dev(self._tables), self._dev(self._tokens),
-                    self._dev(self._positions), cover,
+                    self._dev(self._positions), *lora_ops, cover,
                 )
             self.spec_rounds += 1
             return self._consume_spec((committed, n_acc), dict(self._slot_req))
@@ -900,7 +899,7 @@ class ServeEngine:
             committed, n_acc, new_cur, new_pos, self.pools, self.d_pools = (
                 self._tp_spec(
                     self.params, self.draft_params, self.pools, self.d_pools,
-                    self._dev(self._tables), cur, pos, occ, cover,
+                    self._dev(self._tables), cur, pos, occ, *lora_ops, cover,
                 )
             )
         self.spec_rounds += 1

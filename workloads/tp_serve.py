@@ -224,7 +224,7 @@ def make_tp_serve_programs(
 
 def make_tp_spec_program(
     t_config: ModelConfig, d_config: ModelConfig, mesh: Mesh, gamma: int,
-    chained: bool = False,
+    chained: bool = False, lora_stacked=None, lora_alpha: float = 1.0,
 ):
     """Tensor-parallel batched speculative round: draft AND verify both
     run under the "model" mesh axis.
@@ -243,7 +243,11 @@ def make_tp_spec_program(
     additionally takes an occupancy mask and returns device-side
     (new_cur, new_pos) between n_accept and the pools — the pipelined
     speculative variant (paged.paged_spec_round_chained) under the
-    mesh."""
+    mesh.  With ``lora_stacked`` (multi-tenant LoRA) the program takes
+    TWO further trailing operands — the replicated stacked adapter tree
+    and the per-row index array — applied to the TARGET's verify
+    forward only (the draft guesses unadapted; acceptance cost, never
+    correctness)."""
     _check_tp(t_config, mesh)
     _check_tp(d_config, mesh)
     t_param_sh = jax.tree.map(
@@ -255,57 +259,50 @@ def make_tp_spec_program(
     pool_sh = NamedSharding(mesh, _POOL_SPEC)
     rep = lambda *axes: NamedSharding(mesh, P(*axes))  # noqa: E731
     d_attention_fn = _tp_paged_attention(d_config, mesh)
+    lora_sh = (
+        ()
+        if lora_stacked is None
+        else (jax.tree.map(lambda _: rep(), lora_stacked), rep(None))
+    )
     in_sh = (
         t_param_sh, d_param_sh, (pool_sh, pool_sh), (pool_sh, pool_sh),
         rep(None, None), rep(None), rep(None),
-    ) + ((rep(None),) if chained else ())
+    ) + ((rep(None),) if chained else ()) + lora_sh
     out_sh = (
         (rep(None, None), rep(None))
         + ((rep(None), rep(None)) if chained else ())
         + ((pool_sh, pool_sh), (pool_sh, pool_sh))
     )
     # cover_pages is static and POSITIONAL (last): pjit rejects kwargs
-    # once in_shardings is given.
+    # once in_shardings is given.  The static index shifts with the
+    # optional occupancy/lora operands before it.
+    n_operands = 7 + (1 if chained else 0) + (2 if lora_stacked is not None else 0)
 
-    if chained:
-
-        @partial(
-            jax.jit,
-            static_argnums=(8,),
-            donate_argnums=(2, 3),
-            in_shardings=in_sh,
-            out_shardings=out_sh,
+    @partial(
+        jax.jit,
+        static_argnums=(n_operands,),
+        donate_argnums=(2, 3),
+        in_shardings=in_sh,
+        out_shardings=out_sh,
+    )
+    def tp_spec_round(
+        t_params, d_params, t_pools, d_pools, tables, cur, positions,
+        *rest,
+    ):
+        rest = list(rest)
+        cover_pages = rest.pop()  # static, always last
+        occupancy = rest.pop(0) if chained else None
+        t_lora = (
+            (rest[0], rest[1], lora_alpha) if lora_stacked is not None
+            else None
         )
-        def tp_spec_round(
-            t_params, d_params, t_pools, d_pools, tables, cur, positions,
-            occupancy, cover_pages,
-        ):
-            return _spec_round_core(
-                t_params, d_params, t_pools, d_pools, tables, cur,
-                positions, t_config=t_config, d_config=d_config,
-                gamma=gamma, cover_pages=cover_pages,
-                d_attention_fn=d_attention_fn, occupancy=occupancy,
-            )
-
-    else:
-
-        @partial(
-            jax.jit,
-            static_argnums=(7,),
-            donate_argnums=(2, 3),
-            in_shardings=in_sh,
-            out_shardings=out_sh,
+        return _spec_round_core(
+            t_params, d_params, t_pools, d_pools, tables, cur,
+            positions, t_config=t_config, d_config=d_config,
+            gamma=gamma, cover_pages=cover_pages,
+            d_attention_fn=d_attention_fn, occupancy=occupancy,
+            t_lora=t_lora,
         )
-        def tp_spec_round(
-            t_params, d_params, t_pools, d_pools, tables, cur, positions,
-            cover_pages,
-        ):
-            return _spec_round_core(
-                t_params, d_params, t_pools, d_pools, tables, cur,
-                positions, t_config=t_config, d_config=d_config,
-                gamma=gamma, cover_pages=cover_pages,
-                d_attention_fn=d_attention_fn,
-            )
 
     return tp_spec_round
 
