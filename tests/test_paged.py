@@ -143,8 +143,13 @@ def test_per_row_positions_match_lockstep(params):
         tok = jnp.asarray(
             [tokens[r, int(positions[r])] for r in range(2)], jnp.int32
         )
+        # COPY the mirror before it crosses into the dispatch: on the CPU
+        # backend jnp.asarray may alias the numpy buffer zero-copy, and
+        # the in-place `positions += 1` below would race the device's
+        # deferred read (observed as an order-dependent full-suite-only
+        # failure; same discipline as ServeEngine._dev).
         logits, pools = paged_decode_step(
-            params, pools, tables, tok, jnp.asarray(positions), CONFIG
+            params, pools, tables, tok, jnp.asarray(positions.copy()), CONFIG
         )
         for r in range(2):
             got[(r, int(positions[r]))] = logits[r : r + 1]

@@ -1098,12 +1098,16 @@ def main(argv=None) -> int:
     # Warm the three compiled programs on the first step, then time the
     # rest against a wall clock whose endpoints are REAL host readbacks
     # (engine.step returns host tokens each chunk, so its internal sync
-    # is already a readback, not block_until_ready).
-    engine.step()
+    # is already a readback, not block_until_ready).  Each step runs
+    # under the cooperative chip lease so a time-sliced sibling pod gets
+    # the chip between chunks (no granted chips -> the lease is a no-op).
+    with lease.chip_lease():
+        engine.step()
     tokens_before = engine.generated_tokens
     t0 = time.perf_counter()
     while not engine.idle:
-        engine.step()
+        with lease.chip_lease():
+            engine.step()
     elapsed = time.perf_counter() - t0
     generated = engine.generated_tokens - tokens_before
     rate = generated / elapsed if elapsed > 0 and generated else 0.0
