@@ -31,6 +31,24 @@ def test_oversubscribed_pods_hit_busy_target():
     assert agg["aggregate_busy_fraction"] >= BASELINE_BUSY_FRACTION
 
 
+def test_oversubscribed_serve_pods_report_tokens():
+    """Serving pods time-slice too: the 'serve' workload runs full
+    requests through the continuous-batching engine per burst and the
+    aggregate carries generated tokens/s next to the busy fraction."""
+    agg = run(
+        n_chips=1,
+        chips_per_tray=1,
+        replicas=2,
+        n_pods=2,
+        duration_secs=3.0,
+        platform="cpu",
+        workload="serve",
+    )
+    assert agg["pods"] == 2 and agg["chips"] == 1
+    assert agg["aggregate_busy_fraction"] >= BASELINE_BUSY_FRACTION
+    assert agg["tokens"] > 0 and agg["aggregate_tokens_per_sec"] > 0
+
+
 def test_aggregate_per_chip_union_window(tmp_path):
     """Per-chip busy fractions use the union wall window of the pods that
     used the chip, so staggered pod start-up does not deflate the metric."""

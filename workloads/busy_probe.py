@@ -27,7 +27,9 @@ import jax.numpy as jnp
 from . import lease
 
 
-def _calibrate_steps(run_n, target_burst_secs: float) -> int:
+def _calibrate_steps(
+    run_n, target_burst_secs: float, n_lo: int = 1, n_hi: int = 4
+) -> int:
     """Steps per burst so one burst runs ~target_burst_secs of DEVICE
     time.  Per-step seconds come from the repo's median-slope estimator
     (perfbench.measure_slope_secs): the constant dispatch+readback
@@ -42,7 +44,7 @@ def _calibrate_steps(run_n, target_burst_secs: float) -> int:
         return 0.0
 
     per_step = measure_slope_secs(
-        chain, n_lo=1, n_hi=4, repeats=3, min_window_secs=0.1, max_n=64
+        chain, n_lo=n_lo, n_hi=n_hi, repeats=3, min_window_secs=0.1, max_n=64
     )
     # Floor and cap: a jitter-dominated slope can collapse to the
     # estimator's 1e-9 floor, and an uncapped division would size a burst
@@ -143,6 +145,63 @@ def make_train_burst_fn(target_burst_secs: float = 1.0, timed_section=nullcontex
     return burst, steps_per_burst * tokens_per_step
 
 
+def make_serve_burst_fn(target_burst_secs: float = 1.0, timed_section=nullcontext):
+    """A compute burst that is SERVING work: full requests through the
+    continuous-batching engine (workloads/serve.py — paged KV cache,
+    chunked decode, sampling) at a tiny scale, so the oversubscription
+    harness can report aggregate GENERATED tokens/s under time-slicing —
+    the serving-era counterpart of make_train_burst_fn.
+
+    Returns (burst, tokens_per_burst).  Same discipline as the other
+    burst builders: the engine's three programs compile ahead-of-time
+    (one warm request) outside the chip lease; only the short
+    calibration runs under ``timed_section``."""
+    from .model import ModelConfig, init_params
+    from .serve import ServeEngine
+
+    config = ModelConfig(
+        d_model=256, n_heads=4, n_layers=2, d_ff=1024, vocab_size=2048,
+        max_seq_len=64,
+    )
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype),
+        init_params(config, jax.random.PRNGKey(0)),
+    )
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(1), (16,), 0, config.vocab_size, jnp.int32
+    )]
+    new_tokens = 32
+    engine = ServeEngine(
+        params, config, slots=2, page_size=8, prompt_bucket=16, chunk=8,
+        temperature=0.8, top_k=50, rng=jax.random.PRNGKey(2),
+    )
+    # engine.run() ends on host-side token readbacks — real syncs (see
+    # make_burst_fn on why block_until_ready cannot be trusted here).
+    def run_n(n: int):
+        for _ in range(n):
+            engine.submit(prompt, new_tokens)
+        engine.run()
+
+    with timed_section():
+        # Unlike the matmul/train builders (whose warm-up is host-only
+        # AOT compilation), warming the engine EXECUTES a request — so
+        # it runs under the lease too, or a standalone late-starting pod
+        # would compute unleased inside a sibling's measured window.
+        engine.submit(prompt, new_tokens)
+        engine.run()
+        # Calibrate at multiples of the slot count: odd request counts
+        # cost the same waves as the next multiple, which would bias the
+        # slope ~1.5x low and oversize the burst.
+        requests_per_burst = _calibrate_steps(
+            run_n, target_burst_secs, n_lo=2, n_hi=8
+        )
+
+    def burst():
+        run_n(requests_per_burst)
+
+    return burst, requests_per_burst * (new_tokens)
+
+
 def _start_barrier(barrier_dir: str, count: int, timeout_secs: float):
     """Gate the measured window on every sibling pod being READY (compiled
     + calibrated): without it, one pod's lease-held calibration lands
@@ -174,9 +233,9 @@ def run_probe(
     barrier_count: int = 0,
 ) -> dict:
     """One pod's measured window.  workload="matmul" keeps the original
-    occupancy burst; "train" runs flagship train steps and adds a
-    ``tokens`` count to the row so the aggregate can report useful
-    throughput.  With ``barrier_dir``/``barrier_count``, the measured
+    occupancy burst; "train" runs flagship train steps and "serve" runs
+    full serving-engine requests — both add a ``tokens`` count to the
+    row so the aggregate can report useful throughput.  With ``barrier_dir``/``barrier_count``, the measured
     window starts only after every sibling finished compiling and
     calibrating (see _start_barrier)."""
     lease.hold_claim_leases()  # mixed-strategy lifetime declaration
@@ -184,11 +243,17 @@ def run_probe(
         burst, tokens_per_burst = make_train_burst_fn(
             timed_section=lease.chip_lease
         )
+    elif workload == "serve":
+        burst, tokens_per_burst = make_serve_burst_fn(
+            timed_section=lease.chip_lease
+        )
     elif workload == "matmul":
         burst = make_burst_fn(matrix_dim=matrix_dim, timed_section=lease.chip_lease)
         tokens_per_burst = 0
     else:
-        raise ValueError(f"workload must be 'matmul' or 'train', got {workload!r}")
+        raise ValueError(
+            f"workload must be 'matmul', 'train' or 'serve', got {workload!r}"
+        )
     if barrier_dir and barrier_count:
         # Stay under oversubscribe's wedge deadline (duration*10 + 300s).
         _start_barrier(
@@ -286,9 +351,9 @@ def main(argv=None) -> int:
     parser.add_argument("--duration", type=float, default=10.0)
     parser.add_argument("--report", default="")
     parser.add_argument("--matrix-dim", type=int, default=1024)
-    parser.add_argument("--workload", default="matmul", choices=["matmul", "train"],
-                        help="burst content: occupancy matmuls or flagship "
-                        "train steps (reports tokens)")
+    parser.add_argument("--workload", default="matmul", choices=["matmul", "train", "serve"],
+                        help="burst content: occupancy matmuls, flagship train "
+                        "steps, or serving-engine requests ('train'/'serve' report tokens)")
     parser.add_argument("--barrier-dir", default="",
                         help="start-barrier directory shared by sibling pods")
     parser.add_argument("--barrier-count", type=int, default=0,

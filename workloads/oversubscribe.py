@@ -238,8 +238,8 @@ def main(argv=None) -> int:
     parser.add_argument("--pods", type=int, default=8)
     parser.add_argument("--duration", type=float, default=8.0)
     parser.add_argument("--matrix-dim", type=int, default=512)
-    parser.add_argument("--workload", default="matmul", choices=["matmul", "train"],
-                        help="pod burst content; 'train' reports aggregate "
+    parser.add_argument("--workload", default="matmul", choices=["matmul", "train", "serve"],
+                        help="pod burst content; 'train'/'serve' report aggregate "
                         "useful tokens/s next to the busy fraction")
     parser.add_argument(
         "--platform",
