@@ -135,26 +135,23 @@ def decode_step(params: dict, cache: jax.Array, token: jax.Array, pos: jax.Array
     return logits[:, 0], cache
 
 
-def sample_logits(
+def filter_logits(
     logits: jax.Array,
-    key: jax.Array | None,
     temperature,
     top_k,
     top_p,
 ) -> jax.Array:
-    """One sampling decision over [batch, vocab] float32 logits.
-
-    No key means greedy argmax.  With a key, ``temperature`` scales the
-    logits, ``top_k`` keeps only the k highest and ``top_p`` the smallest
-    nucleus whose softmax mass reaches p.  The knobs are TRACED values
-    (changing them does not recompile the decode scan): both truncations
-    reduce to thresholds read off one shared descending sort, expressed
-    as static-shape masking — never dynamic gathers — so the whole decode
-    stays one compiled scan.  Out-of-range knobs (top_k <= 0 or >= vocab,
-    top_p <= 0 or >= 1) disable their truncation."""
-    if key is None:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    """Temperature-scaled logits with top-k/nucleus masking applied
+    (-inf outside the kept set) over [..., vocab] float32 logits — the
+    exact distribution ``sample_logits`` draws from, exposed separately
+    so speculative rejection sampling (paged._spec_accept) can compare
+    draft and target under the SAME filtered distributions (losslessness
+    is w.r.t. what the dense sampler would sample).  The knobs are
+    TRACED values; out-of-range knobs (top_k <= 0 or >= vocab, top_p <=
+    0 or >= 1) disable their truncation."""
     vocab = logits.shape[-1]
+    lead = logits.shape[:-1]
+    logits = logits.reshape(-1, vocab)
     # temperature ~ 0 degenerates to argmax through a very cold softmax.
     logits = logits / jnp.maximum(jnp.float32(temperature), 1e-3)
     sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
@@ -179,7 +176,29 @@ def sample_logits(
 
     cutoff = jnp.maximum(k_cut, p_cut)[:, None]
     logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    return logits.reshape(*lead, vocab)
+
+
+def sample_logits(
+    logits: jax.Array,
+    key: jax.Array | None,
+    temperature,
+    top_k,
+    top_p,
+) -> jax.Array:
+    """One sampling decision over [batch, vocab] float32 logits.
+
+    No key means greedy argmax.  With a key, ``temperature`` scales the
+    logits, ``top_k`` keeps only the k highest and ``top_p`` the smallest
+    nucleus whose softmax mass reaches p (filter_logits).  The knobs are
+    TRACED values (changing them does not recompile the decode scan):
+    both truncations reduce to thresholds read off one shared descending
+    sort, expressed as static-shape masking — never dynamic gathers — so
+    the whole decode stays one compiled scan."""
+    if key is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filtered = filter_logits(logits, temperature, top_k, top_p)
+    return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("config", "max_new_tokens", "sampling"))

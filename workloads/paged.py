@@ -48,7 +48,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from .generate import decode_block, sample_logits
+from .generate import decode_block, filter_logits, sample_logits
 from .model import (
     ModelConfig,
     _mlp,
@@ -699,9 +699,65 @@ def _rowwise_block_core(
     )
 
 
+def _spec_accept(
+    drafts: jax.Array,
+    q: jax.Array,
+    p: jax.Array,
+    rng: jax.Array,
+):
+    """Batched speculative REJECTION SAMPLING (the standard lossless
+    acceptance rule): drafts [b, gamma] were sampled from the draft
+    distributions q [b, gamma, vocab]; p [b, gamma+1, vocab] are the
+    target's distributions at the same positions (plus the bonus
+    position).  Per row: accept draft i with probability
+    min(1, p_i(x_i)/q_i(x_i)); at the first rejection n, emit a
+    correction sampled from normalize(max(p_n - q_n, 0)); if all gamma
+    drafts are accepted, emit a bonus token sampled from p_gamma.  The
+    committed tokens are then EXACTLY distributed as sequential sampling
+    from p — losslessness does not depend on how good q is (a bad draft
+    only lowers acceptance).
+
+    Returns (committed [b, gamma+1], n [b]) with row b's new tokens
+    committed[b, :n[b]+1], mirroring the greedy path's contract."""
+    batch, gamma = drafts.shape
+    row = jnp.arange(batch)
+    p_x = jnp.take_along_axis(
+        p[:, :gamma], drafts[..., None], axis=-1
+    )[..., 0]  # [b, gamma]
+    q_x = jnp.take_along_axis(q, drafts[..., None], axis=-1)[..., 0]
+    u = jax.random.uniform(jax.random.fold_in(rng, 0), (batch, gamma))
+    # u*q < p  <=>  u < p/q (q_x > 0 a.s.: x was sampled from q); the
+    # multiplied form needs no divide-by-zero guard.
+    accept = u * q_x < p_x
+    n = jnp.argmin(
+        jnp.concatenate([accept, jnp.zeros((batch, 1), bool)], axis=1), axis=1
+    ).astype(jnp.int32)
+    # Correction/bonus distribution at each row's own n: the residual
+    # max(p_n - q_n, 0) renormalised — except when n == gamma (all
+    # accepted), where q is taken as 0 so the residual IS p_gamma.
+    q_pad = jnp.concatenate(
+        [q, jnp.zeros_like(q[:, :1])], axis=1
+    )  # [b, gamma+1, vocab]
+    p_n = p[row, n]
+    resid = jnp.maximum(p_n - q_pad[row, n], 0.0)
+    norm = jnp.sum(resid, axis=-1, keepdims=True)
+    # Degenerate residual (p <= q everywhere, e.g. draft == target, or
+    # float cancellation): fall back to sampling from p_n itself — any
+    # choice here has probability 0 under exact arithmetic.
+    dist = jnp.where(norm > 1e-9, resid / jnp.maximum(norm, 1e-9), p_n)
+    corr = jax.random.categorical(
+        jax.random.fold_in(rng, 1), jnp.log(jnp.maximum(dist, 1e-38))
+    ).astype(jnp.int32)
+    committed = jnp.concatenate(
+        [drafts, jnp.zeros((batch, 1), jnp.int32)], axis=1
+    )
+    return committed.at[row, n].set(corr), n
+
+
 @partial(
     jax.jit,
-    static_argnames=("t_config", "d_config", "gamma", "cover_pages"),
+    static_argnames=("t_config", "d_config", "gamma", "cover_pages",
+                     "sampling"),
     donate_argnums=(2, 3),
 )
 def paged_spec_round(
@@ -717,6 +773,11 @@ def paged_spec_round(
     gamma: int,
     cover_pages: int | None = None,
     t_lora=None,
+    sampling: bool = False,
+    rng: jax.Array | None = None,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
 ):
     """One BATCHED speculative-decoding round over paged caches: the
     draft proposes ``gamma`` tokens per row autoregressively (cheap
@@ -743,17 +804,27 @@ def paged_spec_round(
     ``cover_pages`` (static) bounds the verify forward's gathered view to
     the table columns actually live — callers pass a bucketised
     ceil((max position + gamma + 1) / page_size) so the gather is O(live
-    pages), not O(max_seq), at a bounded number of compiles."""
+    pages), not O(max_seq), at a bounded number of compiles.
+
+    ``sampling=True`` (static) switches the round from greedy agreement
+    to LOSSLESS SPECULATIVE SAMPLING: the draft proposes from its own
+    filtered distribution (filter_logits under the shared
+    temperature/top_k/top_p knobs, traced), the target's distributions
+    verify via the rejection rule (_spec_accept), and the committed
+    tokens are exactly distributed as sequential sampling from the
+    filtered target.  Requires ``rng``."""
     return _spec_round_core(
         t_params, d_params, t_pools, d_pools, tables, cur, positions,
         t_config=t_config, d_config=d_config, gamma=gamma,
-        cover_pages=cover_pages, t_lora=t_lora,
+        cover_pages=cover_pages, t_lora=t_lora, sampling=sampling,
+        rng=rng, temperature=temperature, top_k=top_k, top_p=top_p,
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("t_config", "d_config", "gamma", "cover_pages"),
+    static_argnames=("t_config", "d_config", "gamma", "cover_pages",
+                     "sampling"),
     donate_argnums=(2, 3),
 )
 def paged_spec_round_chained(
@@ -770,6 +841,11 @@ def paged_spec_round_chained(
     gamma: int,
     cover_pages: int | None = None,
     t_lora=None,
+    sampling: bool = False,
+    rng: jax.Array | None = None,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
 ):
     """paged_spec_round with DEVICE-SIDE chaining for pipelined
     speculative serving: additionally takes an occupancy mask and
@@ -789,20 +865,27 @@ def paged_spec_round_chained(
         t_params, d_params, t_pools, d_pools, tables, cur, positions,
         t_config=t_config, d_config=d_config, gamma=gamma,
         cover_pages=cover_pages, occupancy=occupancy, t_lora=t_lora,
+        sampling=sampling, rng=rng, temperature=temperature, top_k=top_k,
+        top_p=top_p,
     )
 
 
 def _spec_round_core(
     t_params, d_params, t_pools, d_pools, tables, cur, positions,
     t_config, d_config, gamma, cover_pages, d_attention_fn=None,
-    occupancy=None, t_lora=None,
+    occupancy=None, t_lora=None, sampling=False, rng=None,
+    temperature=0.0, top_k=0, top_p=1.0,
 ):
     """paged_spec_round's body, un-jitted so the tensor-parallel path can
     re-jit it with explicit shardings and an injected draft attention op
     (the draft's per-token decode runs the Pallas kernel, which needs a
     shard_map under a mesh; the verify forward is dense — plain GSPMD).
     With ``occupancy`` it also emits the chained next-round state (see
-    paged_spec_round_chained)."""
+    paged_spec_round_chained).  With ``sampling`` (static) the greedy
+    agreement rule is replaced by lossless rejection sampling
+    (_spec_accept) under the traced temperature/top_k/top_p knobs."""
+    if sampling and rng is None:
+        raise ValueError("sampling speculative round requires an rng key")
     batch = cur.shape[0]
     if cover_pages is not None:
         tables = tables[:, :cover_pages]
@@ -815,16 +898,26 @@ def _spec_round_core(
 
     # Draft gamma+1 steps: the extra step writes the FINAL proposal's k/v
     # so a fully-accepted round leaves no zero hole in the draft cache.
+    # In sampling mode each step proposes from the draft's own FILTERED
+    # distribution (same knobs as the target — losslessness is w.r.t.
+    # the filtered target) and records that distribution for the
+    # rejection rule.
     def draft_one(carry, i):
         d_pools, tok = carry
         logits, d_pools = _decode_core(
             d_params, d_pools, tables, tok, positions + i, d_config,
             d_attention_fn,
         )
+        if sampling:
+            f = filter_logits(logits, temperature, top_k, top_p)
+            nxt = jax.random.categorical(
+                jax.random.fold_in(rng, 2 + i), f, axis=-1
+            ).astype(jnp.int32)
+            return (d_pools, nxt), (nxt, jax.nn.softmax(f, axis=-1))
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (d_pools, nxt), nxt
+        return (d_pools, nxt), (nxt, jnp.float32(0.0))
 
-    (d_pools, _), proposals = jax.lax.scan(
+    (d_pools, _), (proposals, q_all) = jax.lax.scan(
         draft_one, (d_pools, cur), jnp.arange(gamma + 1)
     )
     drafts = jnp.transpose(proposals, (1, 0))[:, :gamma]  # [batch, gamma]
@@ -837,19 +930,26 @@ def _spec_round_core(
     t_logits, t_pools = _rowwise_block_core(
         t_params, t_pools, tables, block, positions, t_config, lora=t_lora
     )
-    picks = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [b, gamma+1]
-
-    # Per-row longest agreeing prefix, then the correction/bonus token.
-    agree = drafts == picks[:, :-1]
-    n = jnp.argmin(
-        jnp.concatenate([agree, jnp.zeros((batch, 1), bool)], axis=1), axis=1
-    ).astype(jnp.int32)
-    committed = jnp.concatenate(
-        [drafts, jnp.zeros((batch, 1), jnp.int32)], axis=1
-    )
-    committed = committed.at[jnp.arange(batch), n].set(
-        picks[jnp.arange(batch), n]
-    )
+    if sampling:
+        q = jnp.transpose(q_all, (1, 0, 2))[:, :gamma]  # [b, gamma, vocab]
+        p = jax.nn.softmax(
+            filter_logits(t_logits, temperature, top_k, top_p), axis=-1
+        )  # [b, gamma+1, vocab]
+        committed, n = _spec_accept(drafts, q, p, rng)
+    else:
+        picks = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+        # Per-row longest agreeing prefix, then the correction/bonus token.
+        agree = drafts == picks[:, :-1]
+        n = jnp.argmin(
+            jnp.concatenate([agree, jnp.zeros((batch, 1), bool)], axis=1),
+            axis=1,
+        ).astype(jnp.int32)
+        committed = jnp.concatenate(
+            [drafts, jnp.zeros((batch, 1), jnp.int32)], axis=1
+        )
+        committed = committed.at[jnp.arange(batch), n].set(
+            picks[jnp.arange(batch), n]
+        )
     if occupancy is None:
         return committed, n, t_pools, d_pools
     # Chained next-round state: live rows advance by their own accepted

@@ -27,9 +27,12 @@ serving (``adapters=``: per-row activation deltas over one base), and
 tensor parallelism (``mesh=``).  Every composition is supported and
 parity-pinned — including speculative x LoRA x TP three-ways
 (tests/test_multi_lora.py pins those; tests/test_serve_fuzz.py sweeps
-the single-device matrix) — with one loud ValueError: speculative
-serving is greedy-only (temperature must be 0, the lossless
-formulation).
+the single-device matrix).  Speculation composes with sampling too:
+``temperature > 0`` switches the rounds to lossless speculative
+SAMPLING (rejection-sample against the draft distribution,
+paged._spec_accept), so the committed tokens are exactly distributed
+as sequential sampling from the filtered target; at temperature 0 the
+greedy agreement rule and its tokens are unchanged.
 
 ``serve_batch`` remains as the LOCKSTEP baseline (admit a whole batch,
 decode to the common max, retire together) — both the simplest way to
@@ -134,11 +137,6 @@ class ServeEngine:
                     "(or None to serve the plain base)"
                 )
         if draft_params is not None:
-            if temperature > 0.0:
-                raise ValueError(
-                    "speculative serving is greedy (the lossless "
-                    "formulation); temperature must be 0"
-                )
             if draft_config.vocab_size != config.vocab_size:
                 raise ValueError("target and draft must share a vocabulary")
             if gamma < 1:
@@ -325,6 +323,7 @@ class ServeEngine:
                     chained=pipelined,
                     lora_stacked=self._stacked_adapters,
                     lora_alpha=self.lora_alpha,
+                    sampling=self.sampling,
                 )
                 self.draft_params, self.d_pools = shard_serving_state(
                     self.draft_params, self.d_pools, draft_config, mesh
@@ -854,6 +853,19 @@ class ServeEngine:
             )
         # TP programs take (stacked, idx) positionally; alpha is baked in.
         lora_ops = () if t_lora is None else (t_lora[0], t_lora[1])
+        # Sampling knobs for lossless speculative sampling; greedy rounds
+        # take no key (sampling is a static switch in the programs).
+        samp_kw = dict(
+            sampling=self.sampling,
+            rng=self._next_key() if self.sampling else None,
+            temperature=jnp.float32(self.temperature),
+            top_k=jnp.int32(self.top_k), top_p=jnp.float32(self.top_p),
+        )
+        samp_ops = (
+            (samp_kw["rng"], samp_kw["temperature"], samp_kw["top_k"],
+             samp_kw["top_p"])
+            if self.sampling else ()
+        )
         if not self.pipelined:
             if self._mesh is None:
                 committed, n_acc, self.pools, self.d_pools = paged_spec_round(
@@ -862,12 +874,13 @@ class ServeEngine:
                     self._dev(self._positions),
                     t_config=self.config, d_config=self.draft_config,
                     gamma=self.gamma, cover_pages=cover, t_lora=t_lora,
+                    **samp_kw,
                 )
             else:
                 committed, n_acc, self.pools, self.d_pools = self._tp_spec(
                     self.params, self.draft_params, self.pools, self.d_pools,
                     self._dev(self._tables), self._dev(self._tokens),
-                    self._dev(self._positions), *lora_ops, cover,
+                    self._dev(self._positions), *lora_ops, *samp_ops, cover,
                 )
             self.spec_rounds += 1
             return self._consume_spec((committed, n_acc), dict(self._slot_req))
@@ -893,13 +906,15 @@ class ServeEngine:
                     self._dev(self._tables), cur, pos, occ,
                     t_config=self.config, d_config=self.draft_config,
                     gamma=self.gamma, cover_pages=cover, t_lora=t_lora,
+                    **samp_kw,
                 )
             )
         else:
             committed, n_acc, new_cur, new_pos, self.pools, self.d_pools = (
                 self._tp_spec(
                     self.params, self.draft_params, self.pools, self.d_pools,
-                    self._dev(self._tables), cur, pos, occ, *lora_ops, cover,
+                    self._dev(self._tables), cur, pos, occ, *lora_ops,
+                    *samp_ops, cover,
                 )
             )
         self.spec_rounds += 1
@@ -1033,6 +1048,15 @@ def main(argv=None) -> int:
     parser.add_argument("--pipelined", action="store_true",
                         help="overlap each chunk's readback with the next "
                         "chunk's compute (same tokens, higher throughput)")
+    parser.add_argument("--spec-int8-draft", action="store_true",
+                        help="speculative decoding with the int8-quantized "
+                        "model drafting for its own bf16 self (quantized "
+                        "self-speculation: the draft streams half the "
+                        "weights; acceptance is the int8/bf16 argmax "
+                        "agreement); composes with --temperature via "
+                        "lossless speculative sampling")
+    parser.add_argument("--gamma", type=int, default=4,
+                        help="draft tokens per speculative round")
     parser.add_argument("--lora-adapters", type=int, default=0,
                         help="serve N synthetic LoRA adapters multi-tenant "
                         "(requests round-robin across them + the base)")
@@ -1075,12 +1099,24 @@ def main(argv=None) -> int:
             config, args.lora_adapters, rank=args.lora_rank, seed=99
         )
         names += sorted(adapters)
+    spec_kw = {}
+    if args.spec_int8_draft:
+        from .quant import quantize_params
+
+        # int8 self-draft: same architecture, half the weight stream —
+        # the target stays the bf16 params passed above.  Under --int8
+        # the target is already quantized, so the draft IS the target
+        # (pure self-draft: overhead-only, acceptance ~1).
+        spec_kw = dict(
+            draft_params=params if args.int8 else quantize_params(params),
+            draft_config=config, gamma=args.gamma,
+        )
     engine = ServeEngine(
         params, config, slots=args.slots, page_size=page_size,
         prompt_bucket=bucket,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         rng=jax.random.PRNGKey(42), pipelined=args.pipelined,
-        adapters=adapters,
+        adapters=adapters, **spec_kw,
     )
     key = jax.random.PRNGKey(7)
     for i in range(args.requests):

@@ -225,6 +225,7 @@ def make_tp_serve_programs(
 def make_tp_spec_program(
     t_config: ModelConfig, d_config: ModelConfig, mesh: Mesh, gamma: int,
     chained: bool = False, lora_stacked=None, lora_alpha: float = 1.0,
+    sampling: bool = False,
 ):
     """Tensor-parallel batched speculative round: draft AND verify both
     run under the "model" mesh axis.
@@ -247,7 +248,9 @@ def make_tp_spec_program(
     TWO further trailing operands — the replicated stacked adapter tree
     and the per-row index array — applied to the TARGET's verify
     forward only (the draft guesses unadapted; acceptance cost, never
-    correctness)."""
+    correctness).  With ``sampling`` (lossless speculative sampling) the
+    program takes FOUR further trailing operands — rng key, temperature,
+    top_k, top_p (all replicated) — before the static cover_pages."""
     _check_tp(t_config, mesh)
     _check_tp(d_config, mesh)
     t_param_sh = jax.tree.map(
@@ -264,10 +267,11 @@ def make_tp_spec_program(
         if lora_stacked is None
         else (jax.tree.map(lambda _: rep(), lora_stacked), rep(None))
     )
+    samp_sh = (rep(None), rep(), rep(), rep()) if sampling else ()
     in_sh = (
         t_param_sh, d_param_sh, (pool_sh, pool_sh), (pool_sh, pool_sh),
         rep(None, None), rep(None), rep(None),
-    ) + ((rep(None),) if chained else ()) + lora_sh
+    ) + ((rep(None),) if chained else ()) + lora_sh + samp_sh
     out_sh = (
         (rep(None, None), rep(None))
         + ((rep(None), rep(None)) if chained else ())
@@ -275,8 +279,11 @@ def make_tp_spec_program(
     )
     # cover_pages is static and POSITIONAL (last): pjit rejects kwargs
     # once in_shardings is given.  The static index shifts with the
-    # optional occupancy/lora operands before it.
-    n_operands = 7 + (1 if chained else 0) + (2 if lora_stacked is not None else 0)
+    # optional occupancy/lora/sampling operands before it.
+    n_operands = (
+        7 + (1 if chained else 0) + (2 if lora_stacked is not None else 0)
+        + (4 if sampling else 0)
+    )
 
     @partial(
         jax.jit,
@@ -292,6 +299,15 @@ def make_tp_spec_program(
         rest = list(rest)
         cover_pages = rest.pop()  # static, always last
         occupancy = rest.pop(0) if chained else None
+        samp = {}
+        if sampling:
+            # Trailing four operands, in the engine's samp_ops order.
+            rng, temperature, top_k, top_p = rest[-4:]
+            del rest[-4:]
+            samp = dict(
+                sampling=True, rng=rng, temperature=temperature,
+                top_k=top_k, top_p=top_p,
+            )
         t_lora = (
             (rest[0], rest[1], lora_alpha) if lora_stacked is not None
             else None
@@ -301,7 +317,7 @@ def make_tp_spec_program(
             positions, t_config=t_config, d_config=d_config,
             gamma=gamma, cover_pages=cover_pages,
             d_attention_fn=d_attention_fn, occupancy=occupancy,
-            t_lora=t_lora,
+            t_lora=t_lora, **samp,
         )
 
     return tp_spec_round
