@@ -270,6 +270,44 @@ def busy_extras() -> dict:
     raise last_err if last_err else RuntimeError("no busy platform candidates")
 
 
+def busy_4way_extras() -> dict:
+    """BASELINE config #3 in its LITERAL shape (BASELINE.md: \"4 JAX pods
+    oversubscribed on 1 chip (replicas=4)\"): 4 real train pods
+    time-slicing ONE chip at replicas=4 — the 4-deep time-slice the
+    2-pod per-chip-slice harness above never exercises (VERDICT r4
+    missing #4 / item 5).  Chip-only: on a host without the tunnelled
+    TPU the field is omitted rather than simulated."""
+    from workloads.oversubscribe import run as busy_run
+
+    forced = os.environ.get("BENCH_BUSY_PLATFORM")
+    if forced and forced != "axon":
+        print("bench: 4-way busy skipped (chip-only measurement; "
+              f"BENCH_BUSY_PLATFORM={forced})", file=sys.stderr)
+        return {}
+    if not forced and not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        print("bench: 4-way busy skipped (no tunnelled chip)", file=sys.stderr)
+        return {}
+    last_err: Exception | None = None
+    for _ in range(2):  # same tunnel-transient retry as busy_extras
+        try:
+            agg = busy_run(
+                n_chips=1, chips_per_tray=1, replicas=4, n_pods=4,
+                duration_secs=6.0, platform="axon", workload="train",
+            )
+        except Exception as e:
+            print(f"bench: 4-way busy attempt failed: {e}", file=sys.stderr)
+            last_err = e
+            continue
+        out = {
+            "busy_4way_fraction": round(agg["aggregate_busy_fraction"], 4),
+            "busy_4way_pods": agg["pods"],
+        }
+        if "aggregate_tokens_per_sec" in agg:
+            out["busy_4way_tokens_per_sec"] = agg["aggregate_tokens_per_sec"]
+        return out
+    raise last_err if last_err else RuntimeError("4-way busy: no attempts")
+
+
 def scale_extras() -> dict:
     """Allocate/GetPreferredAllocation latency at a REALISTIC table size.
 
@@ -355,16 +393,24 @@ def scale_extras() -> dict:
         for i in range(WARMUP_RPCS):
             allocate(i)
             preferred(i)
-        lat = [allocate(i) for i in range(MEASURED_RPCS)]
-        pref = [preferred(i) for i in range(MEASURED_RPCS // 4)]
+        # Three repeats, median-of-percentiles: the p99 on this pure
+        # in-memory path is GC/scheduler noise away from the p50 (the r4
+        # builder saw a 5.2 ms p99 the driver could not reproduce within
+        # 4x) — one noisy rep must not become the published SLO number.
+        reps = []
+        for _ in range(3):
+            lat = [allocate(i) for i in range(MEASURED_RPCS)]
+            pref = [preferred(i) for i in range(MEASURED_RPCS // 4)]
+            reps.append(_p50_p99(lat) + _p50_p99(pref))
 
-    alloc_p50, alloc_p99 = _p50_p99(lat)
-    pref_p50, pref_p99 = _p50_p99(pref)
+    med = [statistics.median(col) for col in zip(*reps)]
+    alloc_p50, alloc_p99, pref_p50, pref_p99 = med
     out = {
         "large_table_devices": len(device_ids),
         "large_table_backend": backend,
         "large_table_allocate_p50_ms": round(alloc_p50, 4),
         "large_table_allocate_p99_ms": round(alloc_p99, 4),
+        "large_table_allocate_p99_max_ms": round(max(r[1] for r in reps), 4),
         "large_table_preferred_p50_ms": round(pref_p50, 4),
         "large_table_preferred_p99_ms": round(pref_p99, 4),
     }
@@ -462,6 +508,7 @@ if __name__ == "__main__":
     result = run_bench()
     for name, extras, guard in (
         ("busy", busy_extras, "BENCH_SKIP_BUSY"),
+        ("busy_4way", busy_4way_extras, "BENCH_SKIP_BUSY"),
         ("scale", scale_extras, "BENCH_SKIP_SCALE"),
         ("perf", perf_extras, "BENCH_SKIP_PERF"),
     ):

@@ -1,0 +1,111 @@
+"""Serve latency telemetry (VERDICT r4 item 6): per-request
+submit/first-token/retirement stamps on the engine, and the TTFT/e2e
+percentile measurement built on them — pinned so that backpressured
+admission SHOWS UP in the TTFT tail while token parity is untouched."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.perfbench import BenchScale, _pctl, measure_serve_latency
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+
+
+def _stream_engine(params, slots: int):
+    engine = ServeEngine(
+        params, CONFIG, slots=slots, page_size=4, prompt_bucket=8
+    )
+    # Warm the compiles (slots=1 and slots=4 have different batch shapes,
+    # so each engine pays its own) — the measured stream must see steady
+    # state, not XLA compile time masquerading as queue wait.
+    engine.submit([9], 12)
+    engine.run()
+    engine.completed.clear()
+    rids = [engine.submit([1 + i, 2, 3], 12) for i in range(6)]
+    served = engine.run()
+    return engine, rids, served
+
+
+def test_latency_stamps_are_ordered_and_complete():
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine, rids, _ = _stream_engine(params, slots=2)
+    assert len(engine.completed) == len(rids)
+    for req in engine.completed:
+        assert req.t_submit is not None
+        assert req.t_submit <= req.t_first <= req.t_done
+        assert req.ttft_secs >= 0 and req.e2e_secs >= req.ttft_secs
+
+
+def test_backpressure_lands_in_ttft_tail_not_in_tokens():
+    """The same 6-request stream through slots=1 (everything queues) and
+    slots=4 (the last wave queues): tokens must be identical (greedy
+    parity is latency-blind), while in BOTH engines the queued requests'
+    TTFT must dominate the immediately-admitted ones' — queue wait is IN
+    the client-visible first-token latency, which is exactly what the
+    bench's serve_ttft_p99_ms field surfaces."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    eng1, rids1, served1 = _stream_engine(params, slots=1)
+    eng4, rids4, served4 = _stream_engine(params, slots=4)
+    for r1, r4 in zip(rids1, rids4):
+        assert served1[r1] == served4[r4]
+    for eng, rids in ((eng1, rids1), (eng4, rids4)):
+        by_rid = {r.rid: r for r in eng.completed}
+        ttfts = [by_rid[r].ttft_secs for r in rids]
+        # The tail (queued arrivals) must sit far above the head
+        # (admitted instantly): queue wait, not decode time, dominates.
+        assert _pctl(ttfts, 0.99) > 4 * min(ttfts), (eng.slots, ttfts)
+    # With one slot, arrival order IS service order: TTFT must be
+    # monotonically non-decreasing along the submission order.
+    by_rid1 = {r.rid: r for r in eng1.completed}
+    ttft1 = [by_rid1[r].ttft_secs for r in rids1]
+    assert all(a <= b * 1.5 for a, b in zip(ttft1, ttft1[1:])), ttft1
+    assert ttft1[-1] > 3 * ttft1[0]
+
+
+def test_at_admission_finish_gets_stamps_too():
+    """max_new_tokens=1 retires at admission (never takes a slot): the
+    stamps must still be complete, with t_done == t_first."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine = ServeEngine(params, CONFIG, slots=1, page_size=4, prompt_bucket=8)
+    rid = engine.submit([5, 6], 1)
+    served = engine.run()
+    assert len(served[rid]) == 1
+    (req,) = engine.completed
+    assert req.rid == rid and req.t_done == req.t_first >= req.t_submit
+
+
+def test_measure_serve_latency_fields_sane():
+    out = measure_serve_latency(BenchScale.named("tiny"))
+    assert out["serve_latency_requests"] == 6  # 3 x tiny batch
+    for key in ("serve_ttft_p50_ms", "serve_ttft_p99_ms",
+                "serve_e2e_p50_ms", "serve_e2e_p99_ms"):
+        assert out[key] > 0
+    assert out["serve_ttft_p50_ms"] <= out["serve_ttft_p99_ms"]
+    assert out["serve_e2e_p50_ms"] <= out["serve_e2e_p99_ms"]
+    assert out["serve_ttft_p99_ms"] <= out["serve_e2e_p99_ms"]
+
+
+def test_pipelined_emission_lag_is_in_ttft():
+    """Pipelined stepping defers emission by a chunk: the stamps must
+    reflect OBSERVED emission (client-visible), so pipelined TTFT for a
+    lone request is >= the unpipelined one measured the same way —
+    and parity still holds."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    ref = generate(
+        params, jnp.asarray([[9, 8, 7]], jnp.int32), CONFIG,
+        max_new_tokens=10,
+    )
+    for pipelined in (False, True):
+        engine = ServeEngine(
+            params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+            pipelined=pipelined,
+        )
+        rid = engine.submit([9, 8, 7], 10)
+        served = engine.run()
+        assert served[rid] == [int(t) for t in np.asarray(ref[0])]
+        (req,) = engine.completed
+        assert req.t_submit <= req.t_first <= req.t_done

@@ -498,6 +498,86 @@ def measure_serve(scale: BenchScale) -> dict:
     }
 
 
+def _interleaved_repeats(arm_a, arm_b, repeats: int = 3):
+    """Run two measurement arms ROUND-ROBIN ``repeats`` times and return
+    (a_samples, b_samples): back-to-back pairs under the same link drift.
+    The r04 driver run flipped two published single-shot serving ratios
+    (prefix 1.265x -> 0.992x) purely on drift; callers pair the samples
+    into per-repeat ratios in whichever orientation their metric reads
+    and publish the median with min/max spread (VERDICT r4 item 2)."""
+    a_s, b_s = [], []
+    for _ in range(repeats):
+        a_s.append(arm_a())
+        b_s.append(arm_b())
+    return a_s, b_s
+
+
+def _pctl(samples: list[float], q: float) -> float:
+    """Ceil-rank percentile (same convention as bench._p50_p99): the
+    smallest value with >= q of the mass at or below it."""
+    import math
+
+    ordered = sorted(samples)
+    rank = min(len(ordered) - 1, math.ceil(q * len(ordered)) - 1)
+    return ordered[rank]
+
+
+def measure_serve_latency(scale: BenchScale) -> dict:
+    """TTFT and end-to-end latency distribution through the SAME composed
+    engine configuration measure_serve times for throughput — int8 base,
+    sampling knobs, pipelined stepping — under a backpressured mixed
+    stream (3x slots requests, all submitted up front): later waves
+    queue behind earlier ones, so admission wait lands IN the TTFT tail
+    exactly as a client would see it.  Host-side stamps come from the
+    engine's own Request telemetry (submit / first observed token /
+    retirement); VERDICT r4 item 6."""
+    from .quant import quantize_params
+    from .serve import ServeEngine
+
+    batch, ps = scale.batch, scale.page_size
+    chunk = ps
+    lo, hi = scale.serve_chunks
+    prompt_len = scale.decode_prompt
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
+        n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=prompt_len + 1 + hi * chunk,
+    )
+    params = quantize_params(
+        jax.tree.map(
+            lambda w: w.astype(config.dtype),
+            init_params(config, jax.random.PRNGKey(0)),
+        )
+    )
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(1), (prompt_len,), 0, config.vocab_size, jnp.int32
+    )]
+    engine = ServeEngine(
+        params, config, slots=batch, page_size=ps, chunk=chunk,
+        prompt_bucket=-(-prompt_len // ps) * ps,
+        temperature=0.8, top_k=50, top_p=0.95, rng=jax.random.PRNGKey(3),
+        pipelined=True,
+    )
+    engine.submit(prompt, 1 + hi * chunk)  # warm every compile
+    engine.run()
+    engine.completed.clear()
+    n_req = 3 * batch
+    for i in range(n_req):
+        # Mixed generation lengths: the stream continuous batching is for.
+        engine.submit(prompt, 1 + chunk * (1 + i % hi))
+    engine.run()
+    ttfts = [r.ttft_secs * 1000 for r in engine.completed]
+    e2es = [r.e2e_secs * 1000 for r in engine.completed]
+    assert len(ttfts) == n_req
+    return {
+        "serve_latency_requests": n_req,
+        "serve_ttft_p50_ms": round(_pctl(ttfts, 0.50), 2),
+        "serve_ttft_p99_ms": round(_pctl(ttfts, 0.99), 2),
+        "serve_e2e_p50_ms": round(_pctl(e2es, 0.50), 2),
+        "serve_e2e_p99_ms": round(_pctl(e2es, 0.99), 2),
+    }
+
+
 def measure_spec_serve(scale: BenchScale) -> dict:
     """Batched speculative serving on the chip, and what pipelining its
     rounds buys: SELF-draft (the target drafts for itself — acceptance
@@ -546,17 +626,189 @@ def measure_spec_serve(scale: BenchScale) -> dict:
             time.perf_counter() - t0
         )
 
-    plain = serve(False)
-    piped = serve(True)
+    import statistics
+
+    plain_s, piped_s = _interleaved_repeats(
+        lambda: serve(False), lambda: serve(True)
+    )
+    # Ratio of medians of tok/s (higher is better on both sides); the
+    # per-pair spread rides along so a drifting link cannot silently
+    # manufacture or erase the pipelining effect (VERDICT r4 weak #3).
+    pair_ratios = [p / max(q, 1e-9) for q, p in zip(plain_s, piped_s)]
     return {
-        "spec_serve_tokens_per_sec": round(plain, 1),
-        "spec_serve_pipelined_tokens_per_sec": round(piped, 1),
+        "spec_serve_tokens_per_sec": round(statistics.median(plain_s), 1),
+        "spec_serve_pipelined_tokens_per_sec": round(
+            statistics.median(piped_s), 1
+        ),
         # The VERDICT r3 question: what overlapping the draft+verify of
         # round N+1 with round N's readback recovers on this target.
-        "spec_pipelined_speedup": round(piped / max(plain, 1e-9), 3),
+        "spec_pipelined_speedup": round(statistics.median(pair_ratios), 3),
+        "spec_pipelined_speedup_min": round(min(pair_ratios), 3),
+        "spec_pipelined_speedup_max": round(max(pair_ratios), 3),
         "spec_serve_gamma": gamma,
         "spec_serve_requests": n_req,
     }
+
+
+def measure_spec_economics(scale: BenchScale) -> dict:
+    """Does speculation PAY on this chip?  (VERDICT r4 missing #1: the
+    self-draft bench can only measure overhead.)
+
+    The draft here is REAL and CHEAPER: the int8-quantized model
+    drafting for its own bf16 target (quantized self-speculation — the
+    draft streams half the weight bytes per step, and acceptance is the
+    honestly-measured int8/bf16 argmax agreement, ~0.9 on this synthetic
+    model).  Economics are measured DEVICE-SIDE by the slope method over
+    CHAINED rounds: paged_spec_round_chained keeps (cur, pos) on device,
+    so K rounds dispatch back-to-back with a single trailing readback
+    and the tunnel's round-trip cancels in the slope.  The link's
+    per-round readback tax is measured separately (the same K rounds
+    with a sync each) and reported as its own field — design win and
+    link tax, each pinned.
+
+    spec_vs_plain_decode_bN > 1.0 means a batch-N greedy stream decodes
+    faster through speculation than through the plain per-token path."""
+    import numpy as np
+
+    from .paged import (
+        PagePool,
+        init_page_pools,
+        paged_prefill,
+        paged_spec_round_chained,
+        table_array,
+    )
+    from .quant import quantize_params
+
+    gamma = 4
+    prompt_len = 32
+    k_count = 12  # acceptance/readback-tax pass length (each round syncs)
+    k_max = 48  # longest timed chain; the page budget must cover it
+    ps = scale.page_size
+    budget = prompt_len + (k_max + 1) * (gamma + 1) + gamma + 2
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
+        n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=-(-budget // ps) * ps,
+    )
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype),
+        init_params(config, jax.random.PRNGKey(0)),
+    )
+    draft = quantize_params(params)
+    cover = -(-config.max_seq_len // ps)
+
+    def plain_per_token(batch: int) -> float:
+        """Plain greedy decode steady-state secs/token-step at batch
+        (the measure_decode methodology, bf16 weights)."""
+        from .generate import generate
+
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, prompt_len), 0,
+            config.vocab_size, jnp.int32,
+        )
+        lo, hi = scale.decode_lens
+        hi = min(hi, config.max_seq_len - prompt_len - 1)
+
+        def run(n_new: int) -> float:
+            return float(generate(params, prompt, config, n_new)[0, -1])
+
+        return measure_slope_secs(
+            run, n_lo=min(lo, 32), n_hi=hi, min_window_secs=0.0, max_n=hi
+        )
+
+    def spec_state(batch: int):
+        """Fresh pools/tables with every page the whole K-round chain
+        can touch allocated up front — the chain never needs the host."""
+        n_pages = batch * cover
+        ctrl = PagePool(n_pages=n_pages, page_size=ps)
+        pools = init_page_pools(config, n_pages, ps)
+        d_pools = init_page_pools(config, n_pages, ps)
+        for b in range(batch):
+            ctrl.allocate(b, config.max_seq_len)
+        tables = table_array(
+            [ctrl.tables[b] for b in range(batch)], cover, fill=ctrl.trash
+        )
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, prompt_len), 0,
+            config.vocab_size, jnp.int32,
+        )
+        lengths = jnp.full((batch,), prompt_len, jnp.int32)
+        logits, pools = paged_prefill(
+            params, pools, tables, prompt, lengths, config
+        )
+        _, d_pools = paged_prefill(
+            draft, d_pools, tables, prompt, lengths, config
+        )
+        cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        pos = jnp.full((batch,), prompt_len, jnp.int32)
+        occ = jnp.ones((batch,), bool)
+        return pools, d_pools, tables, cur, pos, occ
+
+    def round_args(tables, occ):
+        return dict(
+            tables=tables, occupancy=occ, t_config=config, d_config=config,
+            gamma=gamma, cover_pages=cover,
+        )
+
+    results = {}
+    for batch in (1, 4):
+        pools, d_pools, tables, cur, pos, occ = spec_state(batch)
+        # Warm the compiles OUTSIDE every timed window (the first chained
+        # round costs tens of seconds of compilation).
+        _, n, cur, pos, pools, d_pools = paged_spec_round_chained(
+            params, draft, pools, d_pools, cur=cur, positions=pos,
+            **round_args(tables, occ),
+        )
+        np.asarray(n)
+        # Counting + readback-tax pass: K rounds, each synced to host.
+        accepted = []
+        t0 = time.perf_counter()
+        for _ in range(k_count):
+            _, n, cur, pos, pools, d_pools = paged_spec_round_chained(
+                params, draft, pools, d_pools, cur=cur, positions=pos,
+                **round_args(tables, occ),
+            )
+            accepted.append(np.asarray(n))
+        synced_per_round = (time.perf_counter() - t0) / k_count
+        acceptance = float(np.mean(accepted)) / gamma
+        tokens_per_round = float(np.mean(accepted)) + 1.0
+
+        def run_chain(k: int, _batch=batch) -> float:
+            pools, d_pools, tables, cur, pos, occ = spec_state(_batch)
+            for _ in range(k):
+                _, _, cur, pos, pools, d_pools = paged_spec_round_chained(
+                    params, draft, pools, d_pools, cur=cur, positions=pos,
+                    **round_args(tables, occ),
+                )
+            return float(pos[0])  # the chain's only readback
+
+        # Chains double (8/24 -> 16/48) until the timing window beats
+        # link jitter; k_max bounds the doubling inside the page budget.
+        round_secs = measure_slope_secs(
+            run_chain, n_lo=8, n_hi=k_max // 2, min_window_secs=0.25,
+            max_n=k_max,
+        )
+        plain_secs = plain_per_token(batch)
+        spec_tps = batch * tokens_per_round / round_secs
+        plain_tps = batch / plain_secs
+        results[f"spec_vs_plain_decode_b{batch}"] = round(
+            spec_tps / plain_tps, 3
+        )
+        if batch == 1:
+            results.update({
+                "spec_acceptance_rate": round(acceptance, 4),
+                "spec_tokens_per_round": round(tokens_per_round, 2),
+                "spec_round_ms": round(round_secs * 1000, 3),
+                "spec_round_readback_ms": round(
+                    max(synced_per_round - round_secs, 0.0) * 1000, 3
+                ),
+                "spec_plain_step_ms": round(plain_secs * 1000, 4),
+            })
+    results.update({
+        "spec_econ_gamma": gamma,
+        "spec_econ_draft": "int8-self",
+    })
+    return results
 
 
 def measure_multi_lora(scale: BenchScale) -> dict:
@@ -608,32 +860,47 @@ def measure_multi_lora(scale: BenchScale) -> dict:
             time.perf_counter() - t0
         )
 
-    base = serve(False)
-    multi = serve(True)
+    import statistics
+
+    base_s, multi_s = _interleaved_repeats(
+        lambda: serve(False), lambda: serve(True)
+    )
+    pair_ratios = [m / max(b, 1e-9) for b, m in zip(base_s, multi_s)]
     return {
         "multi_lora_adapters": n_adapters,
         "multi_lora_rank": rank,
-        "multi_lora_tokens_per_sec": round(multi, 1),
-        "multi_lora_base_tokens_per_sec": round(base, 1),
-        # >= ~0.9 means multi-tenancy is nearly free, the design goal.
-        "multi_lora_relative_throughput": round(multi / max(base, 1e-9), 3),
+        "multi_lora_tokens_per_sec": round(statistics.median(multi_s), 1),
+        "multi_lora_base_tokens_per_sec": round(statistics.median(base_s), 1),
+        # >= ~0.9 means multi-tenancy is nearly free, the design goal;
+        # median-of-pairs with spread (VERDICT r4 item 2).
+        "multi_lora_relative_throughput": round(
+            statistics.median(pair_ratios), 3
+        ),
+        "multi_lora_relative_throughput_min": round(min(pair_ratios), 3),
+        "multi_lora_relative_throughput_max": round(max(pair_ratios), 3),
     }
 
 
 def measure_prefix_serve(scale: BenchScale) -> dict:
-    """Cross-request prefix caching, measured where it pays: a stream of
-    requests sharing a long system prompt (8 pages — 512 tokens at the
-    full scale's page size) with distinct short suffixes and short
-    generations, served with and without the cache.  Endpoints are real
-    host readbacks (engine.run streams tokens out), same engine config
-    otherwise; the cache is seeded by one warm request in both arms (the
-    uncached arm's warm request also warms the compiles)."""
+    """Cross-request prefix caching, measured IN the phase it deletes: a
+    stream of requests sharing a long system prompt (8 pages — 512
+    tokens at the full scale's page size) with distinct short suffixes
+    and max_new_tokens=1, so the measured window is the prefill phase
+    itself plus one sampled token — not a decode stream that buries the
+    treatment effect (the r04 driver run saw a 98% prefill-compute
+    saving produce 0% wall-clock win because decode chunks and
+    readbacks dominated the old window; VERDICT r4 weak #4).
+
+    Both arms repeat interleaved and the published speedup is the
+    median of back-to-back pairs with its min/max spread — single-shot
+    wall clocks on the tunnelled chip swing with link drift."""
+    import statistics
 
     from .serve import ServeEngine
 
     ps = scale.page_size
     prefix_len = 8 * ps
-    suffix_len, n_req = 8, scale.batch
+    suffix_len, n_req = 8, 2 * scale.batch
     chunk = ps
     config = ModelConfig(
         vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
@@ -647,33 +914,40 @@ def measure_prefix_serve(scale: BenchScale) -> dict:
     prefix = [int(t) for t in jax.random.randint(
         jax.random.PRNGKey(5), (prefix_len,), 0, config.vocab_size, jnp.int32
     )]
+    tokens_forwarded = {}
 
-    def serve(cached: bool) -> tuple[float, int]:
+    def serve(cached: bool) -> float:
         engine = ServeEngine(
             params, config, slots=min(4, n_req), page_size=ps, chunk=chunk,
             prompt_bucket=2 * ps, prefix_cache=cached,
         )
-        engine.submit(prefix + [1] * suffix_len, chunk)  # warm + seed
+        engine.submit(prefix + [1] * suffix_len, 1)  # warm + seed
         engine.run()
         before = engine.prefill_tokens
         t0 = time.perf_counter()
         for i in range(n_req):
-            engine.submit(prefix + [2 + i] * suffix_len, chunk)
+            engine.submit(prefix + [2 + i] * suffix_len, 1)
         engine.run()
-        return time.perf_counter() - t0, engine.prefill_tokens - before
+        secs = time.perf_counter() - t0
+        tokens_forwarded[cached] = engine.prefill_tokens - before
+        return secs
 
-    un_secs, un_tokens = serve(False)
-    ca_secs, ca_tokens = serve(True)
+    un_s, ca_s = _interleaved_repeats(
+        lambda: serve(False), lambda: serve(True)
+    )
+    ratios = [u / max(c, 1e-9) for u, c in zip(un_s, ca_s)]
     return {
         "prefix_serve_requests": n_req,
         "prefix_serve_prefix_tokens": prefix_len,
-        "prefix_serve_uncached_secs": round(un_secs, 4),
-        "prefix_serve_cached_secs": round(ca_secs, 4),
-        "prefix_serve_speedup": round(un_secs / max(ca_secs, 1e-9), 3),
+        "prefix_serve_uncached_secs": round(statistics.median(un_s), 4),
+        "prefix_serve_cached_secs": round(statistics.median(ca_s), 4),
+        "prefix_serve_speedup": round(statistics.median(ratios), 3),
+        "prefix_serve_speedup_min": round(min(ratios), 3),
+        "prefix_serve_speedup_max": round(max(ratios), 3),
         # 1 - computed/uncomputed prompt tokens: the compute the cache
         # deleted (the suffix + bucket-alignment remainder still runs).
         "prefix_prefill_tokens_saved_fraction": round(
-            1.0 - ca_tokens / max(un_tokens, 1), 4
+            1.0 - tokens_forwarded[True] / max(tokens_forwarded[False], 1), 4
         ),
     }
 
@@ -701,8 +975,10 @@ def run(scale_name: str = "full") -> dict:
         out["paged_decode_tokens_per_sec"] / out["decode_tokens_per_sec"], 3
     )
     out.update(measure_serve(scale))
+    out.update(measure_serve_latency(scale))
     out.update(measure_prefix_serve(scale))
     out.update(measure_spec_serve(scale))
+    out.update(measure_spec_economics(scale))
     out.update(measure_multi_lora(scale))
     return out
 

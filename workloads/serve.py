@@ -46,6 +46,7 @@ the JAX serving workloads (SURVEY.md §7 step 8).
 from __future__ import annotations
 
 import itertools
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
@@ -73,7 +74,14 @@ class Request:
     """One sequence through the engine.  ``tokens`` accumulates generated
     tokens (the prompt is not echoed); ``done`` flips at ``max_new_tokens``
     or on ``eos_token``.  ``group`` ties fan-out siblings to their shared
-    prompt pages (see ServeEngine.submit_fanout)."""
+    prompt pages (see ServeEngine.submit_fanout).
+
+    ``t_submit``/``t_first``/``t_done`` are host-side perf_counter stamps
+    (submission, first token OBSERVED host-side, retirement) — the
+    latency telemetry behind the TTFT/e2e percentiles the bench reports.
+    Under pipelined stepping emission lags a chunk, so t_first is the
+    time the engine could actually have streamed the token out — the
+    honest client-visible TTFT, queueing and pipeline lag included."""
 
     rid: str
     prompt: list[int]
@@ -83,6 +91,23 @@ class Request:
     done: bool = False
     group: str | None = None
     adapter: str | None = None  # multi-LoRA: which adapter serves this
+    t_submit: float | None = None
+    t_first: float | None = None
+    t_done: float | None = None
+
+    @property
+    def ttft_secs(self) -> float | None:
+        """Submission -> first observed token (None until then)."""
+        if self.t_submit is None or self.t_first is None:
+            return None
+        return self.t_first - self.t_submit
+
+    @property
+    def e2e_secs(self) -> float | None:
+        """Submission -> retirement (None until done)."""
+        if self.t_submit is None or self.t_done is None:
+            return None
+        return self.t_done - self.t_submit
 
 
 class ServeEngine:
@@ -241,6 +266,12 @@ class ServeEngine:
         self.prefills_run = 0
         self.prefill_tokens = 0  # prompt tokens actually forwarded
         self.spec_rounds = 0
+        # Finished Request objects, in retirement order, carrying their
+        # t_submit/t_first/t_done latency stamps — the TTFT/e2e source
+        # for the bench and tests.  Tiny host objects, but unbounded for
+        # an unbounded stream: long-running callers should drain it
+        # (e.g. ``engine.completed.clear()``) between measurement windows.
+        self.completed: list[Request] = []
         # Pipelined stepping: the not-yet-read previous chunk (device
         # tokens + the slot->request snapshot at dispatch) and the
         # device-chained last-token array; speculative rounds keep their
@@ -377,7 +408,10 @@ class ServeEngine:
             # Loud at the call site: a duplicate would silently overwrite
             # one request's tokens in run()'s {rid: tokens} result.
             raise ValueError(f"request id {rid!r} is already in flight")
-        req = Request(rid, prompt, max_new_tokens, eos_token, adapter=adapter)
+        req = Request(
+            rid, prompt, max_new_tokens, eos_token, adapter=adapter,
+            t_submit=time.perf_counter(),
+        )
         self.pending.append(req)
         return rid
 
@@ -456,6 +490,8 @@ class ServeEngine:
 
     def _retire(self, slot: int) -> Request:
         req = self._slot_req.pop(slot)
+        req.t_done = time.perf_counter()
+        self.completed.append(req)
         self.ctrl.release(self._seq_id(slot, req))
         self._committed_pages -= self._slot_commit.pop(slot)
         self._occupied[slot] = False
@@ -665,11 +701,14 @@ class ServeEngine:
                 )[0]
             )
             req.tokens.append(tok)
+            req.t_first = time.perf_counter()  # first token, queue wait included
             self.generated_tokens += 1
             if req.max_new_tokens == 1 or tok == req.eos_token:
                 req.done = True
+                req.t_done = req.t_first
                 self.ctrl.release(seq)
                 finished.append(req)
+                self.completed.append(req)
                 continue
             self._slot_req[slot] = req
             self._occupied[slot] = True
@@ -814,14 +853,15 @@ class ServeEngine:
         coverage accounts the unread in-flight advance (bounded by
         gamma+1 per round).
 
-        Measured (r4, tunnelled v5e chip, single admission wave, the
-        bench's spec_pipelined_speedup field): the overlap does NOT pay
-        for speculative rounds there — 0.85-0.9x, because a round's
-        readback is small relative to its own draft+verify compute while
-        pipelining adds one DEAD round per retirement and lags admission
-        by a round.  It is profile-dependent (a higher-latency link with
-        cheap rounds inverts it), so the mode stays available, default
-        off, token-parity pinned by tests."""
+        Whether the overlap pays is LINK-PROFILE-DEPENDENT: a round's
+        readback must be large next to its own draft+verify compute,
+        while pipelining adds one DEAD round per retirement and lags
+        admission by a round.  The bench's spec_pipelined_speedup field
+        (median of interleaved repeats with min/max spread; see
+        docs/bench-builder-latest.json for the current artifact) is the
+        authoritative number — single-shot measurements of this ratio
+        swung 0.80-0.96x across r4 runs on the same code.  The mode
+        stays available, default off, token-parity pinned by tests."""
         from .paged import paged_spec_round, paged_spec_round_chained
 
         # Page coverage + the verify gather bound (bucketised so the
