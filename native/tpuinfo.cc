@@ -758,6 +758,38 @@ int tpuinfo_get_provenance(tpuinfo_provenance_t* out) {
   return 0;
 }
 
+int tpuinfo_health_class_support(int index) {
+  std::lock_guard<std::mutex> lock(g_state.mu);
+  if (!g_state.initialized) return TPUINFO_ERR_NOT_INITIALIZED;
+  // `index` is the chip's host-local index (chip.index, the /dev/accelN
+  // number), which on a host with sparse accel nodes is NOT its position
+  // in the enumeration — translate like tpuinfo_chip_in_use does.
+  const Chip* chip = nullptr;
+  for (const Chip& cand : g_state.chips) {
+    if (cand.index == index) chip = &cand;
+  }
+  if (chip == nullptr) return TPUINFO_ERR_INVALID;
+  const Chip& c = *chip;
+  int mask = 1 << TPUINFO_EVENT_NODE_LIVENESS;  // dev-node watch: always on
+  if (g_state.open_probe_enabled) mask |= 1 << TPUINFO_EVENT_OPEN_PROBE;
+  // Error-counter classes are live iff their sysfs attribute is readable
+  // now or the watcher ever saw it (the driver may create it late) — the
+  // same condition under which the watch loop can emit the class.
+  auto it = g_state.health.find("accel" + std::to_string(c.index));
+  int64_t v;
+  bool chip_seen = it != g_state.health.end() && it->second.chip_err_seen;
+  bool app_seen = it != g_state.health.end() && it->second.app_err_seen;
+  if (chip_seen ||
+      ReadFileInt64(ErrCounterPath(g_state.root, c.index, "tpu_error_count"),
+                    &v))
+    mask |= 1 << TPUINFO_EVENT_CHIP_ERROR_COUNTER;
+  if (app_seen ||
+      ReadFileInt64(
+          ErrCounterPath(g_state.root, c.index, "tpu_app_error_count"), &v))
+    mask |= 1 << TPUINFO_EVENT_APP_ERROR_COUNTER;
+  return mask;
+}
+
 const char* tpuinfo_version(void) { return kVersion; }
 
 }  // extern "C"

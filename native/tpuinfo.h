@@ -140,6 +140,19 @@ typedef struct {
 
 int tpuinfo_get_provenance(tpuinfo_provenance_t* out);
 
+/* Which health-event classes the watcher can STRUCTURALLY observe for
+ * chip `index` on this host, as a bitmask (bit k set = TPUINFO_EVENT_k
+ * live).  Node liveness (bit 0) is always observable; the open probe
+ * (bit 1) unless TPUINFO_DISABLE_OPEN_PROBE=1; the error-counter classes
+ * (bits 2/3) only when the corresponding sysfs attribute is readable
+ * right now or was ever seen by the watcher — the attribute names are
+ * speculative ahead of a real accel sysfs class, and this is the
+ * measured per-host verdict on whether those tiers exist (consumed by
+ * tpu-info, the health fan-out's startup log, and probe_discovery).
+ * Returns the bitmask, or a negative error when uninitialised / index
+ * out of range. */
+int tpuinfo_health_class_support(int index);
+
 const char* tpuinfo_version(void);
 
 #ifdef __cplusplus

@@ -628,3 +628,139 @@ def test_tpu_manager_chips_in_use(lib_path, fake_tree):
             assert usage.get(0) == 0
     finally:
         mgr.shutdown()
+
+
+def test_unknown_runtime_probe_value_fails_safe_to_off(
+    lib_path, fake_tree, monkeypatch, caplog
+):
+    """ADVICE r4: a typo'd/unknown TPU_DP_RUNTIME_PROBE value must NOT
+    silently behave as auto (the probe opens chips) — it parses strictly
+    to off, with a warning."""
+    import logging
+
+    from tpu_device_plugin.backend import tpu as tpu_backend
+    from tpu_device_plugin.backend.tpu import TpuChipManager
+
+    monkeypatch.setenv(tpu_backend.RUNTIME_PROBE_ENV, "aut")  # typo'd "auto"
+    calls = []
+    monkeypatch.setattr(
+        "tpu_device_plugin.probe_discovery.probe_runtime",
+        lambda: calls.append(1) or {"available": False},
+    )
+    # Same weak-provenance + provably-idle arrangement under which auto
+    # WOULD probe — the unknown value must still suppress it.
+    mgr = TpuChipManager(
+        driver_root=fake_tree, lib_path=lib_path, counts_authoritative=True
+    )
+    with caplog.at_level(logging.WARNING):
+        mgr.init()
+    try:
+        assert calls == []
+        assert any("unrecognised" in r.message for r in caplog.records)
+    finally:
+        mgr.shutdown()
+
+
+def test_health_class_support_measures_error_counter_surfaces(
+    lib_path, fake_tree, native, monkeypatch
+):
+    """The native per-class verdict (VERDICT r4 item 7): on a tree with
+    no error-counter attributes only node-liveness + open-probe are
+    observable; creating tpu_error_count on one chip lights the chip
+    class for that chip (and the manager aggregate)."""
+    import os
+
+    from tpu_device_plugin.backend.tpu import TpuChipManager
+
+    assert native.init(fake_tree) == 4
+    mask = native.health_class_support(0)
+    assert mask == 0b0011, bin(mask)
+    # The driver grows the attribute after init: the class lights up.
+    err = os.path.join(
+        fake_tree, "sys", "class", "accel", "accel0", "device",
+        "tpu_error_count",
+    )
+    with open(err, "w") as f:
+        f.write("0\n")
+    assert native.health_class_support(0) == 0b0111
+    assert native.health_class_support(1) == 0b0011  # other chips unchanged
+    assert native.health_class_support(99) is None  # bad index -> no verdict
+
+    mgr = TpuChipManager(driver_root=fake_tree, lib_path=lib_path)
+    monkeypatch.setenv("TPU_DP_RUNTIME_PROBE", "0")
+    mgr.init()
+    try:
+        avail = mgr.health_class_availability()
+        # Aggregate is a UNION across chips: accel0's counter makes the
+        # chip-error class live host-wide; app-error stays absent.
+        assert avail == {0: True, 1: True, 2: True, 3: False}
+    finally:
+        mgr.shutdown()
+
+
+def test_probe_error_counters_verdicts(fake_tree, tmp_path):
+    from tpu_device_plugin.probe_discovery import probe_error_counters
+
+    report = probe_error_counters(fake_tree)
+    assert report["verdict"] == "attrs-absent"
+    assert not report["available"]
+
+    import os
+
+    err = os.path.join(
+        fake_tree, "sys", "class", "accel", "accel2", "device",
+        "tpu_app_error_count",
+    )
+    with open(err, "w") as f:
+        f.write("3\n")
+    report = probe_error_counters(fake_tree)
+    assert report["verdict"] == "live"
+    assert report["app_error_counter"] and not report["chip_error_counter"]
+    assert report["devices"]["accel2"]["tpu_app_error_count"]
+
+    assert probe_error_counters(str(tmp_path / "nothing"))["verdict"] == (
+        "no-accel-sysfs-class"
+    )
+
+
+def test_health_fanout_logs_class_availability_once(caplog):
+    import logging
+
+    from tpu_device_plugin.backend.fake import FakeChipManager
+    from tpu_device_plugin.health import HealthFanout
+
+    manager = FakeChipManager(n_chips=2, chips_per_tray=2)
+    manager.init()
+    fanout = HealthFanout(manager)
+    with caplog.at_level(logging.INFO, logger="tpu_device_plugin.health"):
+        q = fanout.subscribe()
+    try:
+        lines = [
+            r.message for r in caplog.records
+            if "health classes on this host" in r.message
+        ]
+        assert len(lines) == 1
+        assert "structurally-absent=none" in lines[0]
+        assert "app-error-counter" in lines[0]
+    finally:
+        fanout.unsubscribe(q)
+        manager.shutdown()
+
+
+def test_health_class_support_on_sparse_accel_nodes(native, tmp_path):
+    """Chip indices are /dev/accelN numbers, not enumeration positions:
+    with only accel0 + accel2 present the verdict for index 2 must
+    resolve (the enumeration has no position 2)."""
+    root = tmp_path / "sparse"
+    (root / "dev").mkdir(parents=True)
+    for i in (0, 2):
+        (root / "dev" / f"accel{i}").write_text("")
+        d = root / "sys" / "class" / "accel" / f"accel{i}" / "device"
+        d.mkdir(parents=True)
+        (d / "tpu_hbm_bytes").write_text(str(16 << 30))
+    (root / "sys" / "class" / "accel" / "accel2" / "device"
+     / "tpu_error_count").write_text("0\n")
+    assert native.init(str(root)) == 2
+    assert native.health_class_support(0) == 0b0011
+    assert native.health_class_support(2) == 0b0111
+    assert native.health_class_support(1) is None  # hole in the numbering

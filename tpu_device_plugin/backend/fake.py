@@ -95,6 +95,10 @@ class FakeChipManager(ChipManager):
         never "all idle" (matching backend/native.py:194-208)."""
         return dict(self._in_use)
 
+    def health_class_availability(self) -> dict[int, bool]:
+        """The fake can inject every class, so all four are live."""
+        return {code: True for code in range(4)}
+
     # -- test/bench controls --------------------------------------------------
 
     def inject(self, chip_id: str, health: str = UNHEALTHY, code: int = 0) -> None:

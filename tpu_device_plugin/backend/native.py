@@ -150,6 +150,9 @@ class NativeTpuInfo:
         if hasattr(lib, "tpuinfo_get_provenance"):
             lib.tpuinfo_get_provenance.argtypes = [ctypes.POINTER(_ProvenanceStruct)]
             lib.tpuinfo_get_provenance.restype = ctypes.c_int
+        if hasattr(lib, "tpuinfo_health_class_support"):
+            lib.tpuinfo_health_class_support.argtypes = [ctypes.c_int]
+            lib.tpuinfo_health_class_support.restype = ctypes.c_int
 
     # ------------------------------------------------------------------ calls
 
@@ -238,6 +241,17 @@ class NativeTpuInfo:
         # chips() preserves the library's enumeration order, which is what
         # counts[] is keyed by.
         return {chips[i].index: counts[i] for i in range(min(n, len(chips)))}
+
+    def health_class_support(self, index: int) -> int | None:
+        """Bitmask of health-event classes the watcher can structurally
+        observe for chip ``index`` (bit k = TPUINFO_EVENT_k live on this
+        host); None when the loaded .so predates the call or it fails.
+        The measured per-host verdict on the speculative error-counter
+        sysfs tiers (tpuinfo.h TPUINFO_EVENT_*_ERROR_COUNTER)."""
+        if not hasattr(self._lib, "tpuinfo_health_class_support"):
+            return None
+        mask = self._lib.tpuinfo_health_class_support(index)
+        return None if mask < 0 else mask
 
     def wait_health_events(self, timeout_ms: int = 1000) -> list[HealthEvent]:
         buf = (_HealthEventStruct * _MAX_EVENTS)()

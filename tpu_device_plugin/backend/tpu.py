@@ -77,8 +77,18 @@ class TpuChipManager(ChipManager):
                 f"no TPU chips found under {self._driver_root!r}/dev"
             )
         self._topology = self._native.topology()
+        # Strict parse: the probe momentarily OPENS the chips, so an
+        # unrecognised value (a typo'd "aut", a chart's "false") must
+        # fail SAFE to off — not silently behave as auto.
         mode = os.environ.get(RUNTIME_PROBE_ENV, "auto")
-        if mode == "1" or (mode not in ("0", "off") and self._should_auto_probe()):
+        if mode not in ("0", "off", "1", "auto"):
+            logging.getLogger(__name__).warning(
+                "unrecognised %s=%r: treating as '0' (valid: 1, 0, off, "
+                "auto); the runtime probe opens idle chips, so unknown "
+                "values fail safe to disabled", RUNTIME_PROBE_ENV, mode,
+            )
+            mode = "0"
+        if mode == "1" or (mode == "auto" and self._should_auto_probe()):
             self._apply_runtime_probe()
 
     def _should_auto_probe(self) -> bool:
@@ -181,6 +191,26 @@ class TpuChipManager(ChipManager):
         are namespace-local — deploy with hostPID for node-wide visibility."""
         self._require_init()
         return self._native.chips_in_use()
+
+    def health_class_availability(self) -> dict[int, bool] | None:
+        """Per-class structural liveness of the health tiers on THIS host
+        (health.EVENT_* code -> observable), aggregated across chips (a
+        class is live if ANY chip exposes its surface).  The error-counter
+        classes ride speculative sysfs names (native/tpuinfo.cc); this is
+        the measured verdict the health fan-out logs once at watcher start
+        and tpu-info/probe_discovery surface.  None with an .so predating
+        tpuinfo_health_class_support."""
+        self._require_init()
+        masks = [
+            self._native.health_class_support(c.index)
+            for c in self.devices()
+        ]
+        if not masks or any(m is None for m in masks):
+            return None
+        union = 0
+        for m in masks:
+            union |= m
+        return {code: bool(union & (1 << code)) for code in range(4)}
 
     def check_health(
         self,

@@ -155,6 +155,28 @@ class HealthFanout:
         self._stop.clear()
         chips = self._manager.devices()
         self._chip_ids = [c.id for c in chips]
+        # One startup line pinning which classes can actually fire HERE:
+        # the error-counter tiers ride speculative sysfs names, and a
+        # class that is structurally absent on this host must read as
+        # "cannot fire", never be mistaken for "everything healthy".
+        avail_fn = getattr(self._manager, "health_class_availability", None)
+        avail = avail_fn() if callable(avail_fn) else None
+        if avail is not None:
+            names = {
+                EVENT_NODE_LIVENESS: "node-liveness",
+                EVENT_OPEN_PROBE: "open-probe",
+                EVENT_CHIP_ERROR_COUNTER: "chip-error-counter",
+                EVENT_APP_ERROR_COUNTER: "app-error-counter",
+            }
+            live = [names[c] for c, on in sorted(avail.items()) if on]
+            absent = [names[c] for c, on in sorted(avail.items()) if not on]
+            log.info(
+                "health classes on this host: live=%s structurally-absent=%s"
+                " (skip-listed codes: %s)",
+                ",".join(live) or "none",
+                ",".join(absent) or "none",
+                ",".join(str(c) for c in sorted(self._skip_codes)) or "none",
+            )
         self._watcher = threading.Thread(
             target=self._manager.check_health,
             args=(self._stop, self._central, chips),

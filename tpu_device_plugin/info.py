@@ -78,6 +78,8 @@ def collect(flags: Flags, backend=None) -> dict:
         topo = backend.topology()
         chips = backend.devices()
         in_use = _in_use(backend)
+        avail_fn = getattr(backend, "health_class_availability", None)
+        health_avail = avail_fn() if callable(avail_fn) else None
         info = {
             "accelerator_type": topo.accelerator_type,
             "torus_shape": list(topo.torus_shape),
@@ -87,6 +89,20 @@ def collect(flags: Flags, backend=None) -> dict:
             **(
                 {"provenance": topo.provenance}
                 if getattr(topo, "provenance", None) is not None
+                else {}
+            ),
+            # Which health-event classes can structurally fire on this
+            # host (the error-counter tiers ride speculative sysfs names;
+            # see tpuinfo_health_class_support).
+            **(
+                {"health_classes": {
+                    name: health_avail[code]
+                    for code, name in (
+                        (0, "node_liveness"), (1, "open_probe"),
+                        (2, "chip_error_counter"), (3, "app_error_counter"),
+                    )
+                }}
+                if health_avail is not None
                 else {}
             ),
             "trays": {
@@ -142,6 +158,15 @@ def render(info: dict) -> str:
         lines.append(
             f"slice: worker {s['worker_id']}/{s['n_hosts']} of {s['topology']} "
             f"(host grid {s['host_bounds']})"
+        )
+    if "health_classes" in info:
+        hc = info["health_classes"]
+        lines.append(
+            "health classes: "
+            + ", ".join(
+                f"{name} {'live' if on else 'ABSENT'}"
+                for name, on in hc.items()
+            )
         )
     header = (
         f"{'IDX':>3}  {'ID':<24} {'PATH':<16} {'HBM':>7}  "

