@@ -764,3 +764,28 @@ def test_health_class_support_on_sparse_accel_nodes(native, tmp_path):
     assert native.health_class_support(0) == 0b0011
     assert native.health_class_support(2) == 0b0111
     assert native.health_class_support(1) is None  # hole in the numbering
+
+
+def test_empty_runtime_probe_value_is_unset_not_a_typo(
+    lib_path, fake_tree, monkeypatch
+):
+    """A chart templating TPU_DP_RUNTIME_PROBE: "" means 'not
+    configured' — it must take the auto default (and probe under the
+    auto conditions), not the unknown-value fail-safe."""
+    from tpu_device_plugin.backend import tpu as tpu_backend
+    from tpu_device_plugin.backend.tpu import TpuChipManager
+
+    monkeypatch.setenv(tpu_backend.RUNTIME_PROBE_ENV, "")
+    calls = []
+    monkeypatch.setattr(
+        "tpu_device_plugin.probe_discovery.probe_runtime",
+        lambda: calls.append(1) or {"available": False},
+    )
+    mgr = TpuChipManager(
+        driver_root=fake_tree, lib_path=lib_path, counts_authoritative=True
+    )
+    mgr.init()  # weak provenance + provably idle: auto fires the probe
+    try:
+        assert calls == [1]
+    finally:
+        mgr.shutdown()

@@ -96,8 +96,10 @@ class FakeChipManager(ChipManager):
         return dict(self._in_use)
 
     def health_class_availability(self) -> dict[int, bool]:
-        """The fake can inject every class, so all four are live."""
-        return {code: True for code in range(4)}
+        """The fake can inject every class, so all are live."""
+        from ..health import EVENT_NAMES
+
+        return {code: True for code in EVENT_NAMES}
 
     # -- test/bench controls --------------------------------------------------
 

@@ -79,8 +79,9 @@ class TpuChipManager(ChipManager):
         self._topology = self._native.topology()
         # Strict parse: the probe momentarily OPENS the chips, so an
         # unrecognised value (a typo'd "aut", a chart's "false") must
-        # fail SAFE to off — not silently behave as auto.
-        mode = os.environ.get(RUNTIME_PROBE_ENV, "auto")
+        # fail SAFE to off — not silently behave as auto.  An EMPTY value
+        # is "not configured" (charts template "" for unset), not a typo.
+        mode = os.environ.get(RUNTIME_PROBE_ENV) or "auto"
         if mode not in ("0", "off", "1", "auto"):
             logging.getLogger(__name__).warning(
                 "unrecognised %s=%r: treating as '0' (valid: 1, 0, off, "
@@ -207,10 +208,12 @@ class TpuChipManager(ChipManager):
         ]
         if not masks or any(m is None for m in masks):
             return None
+        from ..health import EVENT_NAMES
+
         union = 0
         for m in masks:
             union |= m
-        return {code: bool(union & (1 << code)) for code in range(4)}
+        return {code: bool(union & (1 << code)) for code in EVENT_NAMES}
 
     def check_health(
         self,

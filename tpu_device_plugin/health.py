@@ -39,6 +39,16 @@ EVENT_OPEN_PROBE = 1  # node enumerates but open() fails hardware-ish: wedged
 EVENT_CHIP_ERROR_COUNTER = 2  # driver tpu_error_count rose above baseline
 EVENT_APP_ERROR_COUNTER = 3  # workload-attributable tpu_app_error_count
 
+# Canonical code -> name map: the ONE place a new native event class gets
+# a human name (the fan-out startup log, tpu-info and the backends'
+# health_class_availability all key off this).
+EVENT_NAMES = {
+    EVENT_NODE_LIVENESS: "node-liveness",
+    EVENT_OPEN_PROBE: "open-probe",
+    EVENT_CHIP_ERROR_COUNTER: "chip-error-counter",
+    EVENT_APP_ERROR_COUNTER: "app-error-counter",
+}
+
 # Event codes that indicate a workload/application-level fault rather than a
 # sick chip — the analog of the reference's application-error XID skip list
 # (nvidia.go:193-199, XIDs 13/31/43/45/68).  Node-liveness (code 0) is not
@@ -162,12 +172,7 @@ class HealthFanout:
         avail_fn = getattr(self._manager, "health_class_availability", None)
         avail = avail_fn() if callable(avail_fn) else None
         if avail is not None:
-            names = {
-                EVENT_NODE_LIVENESS: "node-liveness",
-                EVENT_OPEN_PROBE: "open-probe",
-                EVENT_CHIP_ERROR_COUNTER: "chip-error-counter",
-                EVENT_APP_ERROR_COUNTER: "app-error-counter",
-            }
+            names = {c: EVENT_NAMES.get(c, f"class-{c}") for c in avail}
             live = [names[c] for c, on in sorted(avail.items()) if on]
             absent = [names[c] for c, on in sorted(avail.items()) if not on]
             log.info(

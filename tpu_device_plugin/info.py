@@ -21,6 +21,7 @@ import time
 
 from .backend import BackendInitError
 from .config import Flags
+from .health import EVENT_NAMES
 from .slice_topology import SliceConfigError, slice_info_from_env
 
 
@@ -96,11 +97,9 @@ def collect(flags: Flags, backend=None) -> dict:
             # see tpuinfo_health_class_support).
             **(
                 {"health_classes": {
-                    name: health_avail[code]
-                    for code, name in (
-                        (0, "node_liveness"), (1, "open_probe"),
-                        (2, "chip_error_counter"), (3, "app_error_counter"),
-                    )
+                    EVENT_NAMES.get(code, f"class-{code}").replace("-", "_"):
+                        on
+                    for code, on in sorted(health_avail.items())
                 }}
                 if health_avail is not None
                 else {}

@@ -131,14 +131,20 @@ def probe_metadata_server(timeout: float = 2.0) -> dict:
         return {"available": False, "error": str(e)}
 
 
-def probe_error_counters(driver_root: str = "/") -> dict:
+def probe_error_counters(
+    driver_root: str = "/", sysfs: dict | None = None
+) -> dict:
     """Measured per-host verdict on the ERROR-COUNTER health tiers
     (native/tpuinfo.cc TPUINFO_EVENT_{CHIP,APP}_ERROR_COUNTER): the sysfs
     attribute names behind them are speculative ahead of a standardised
     accel sysfs class, so the record must say whether ANY error-counter
     surface exists here — a structurally-absent class can never fire and
-    must not be read as \"no errors\" (VERDICT r4 item 7)."""
-    sysfs = probe_sysfs(driver_root)
+    must not be read as \"no errors\" (VERDICT r4 item 7).
+
+    ``sysfs`` takes an existing probe_sysfs() report so run_probe derives
+    both sections from ONE walk (no double read, no skew between them)."""
+    if sysfs is None:
+        sysfs = probe_sysfs(driver_root)
     per_dev = {
         dev: {
             attr: attrs.get(attr) is not None
@@ -240,15 +246,16 @@ def probe_runtime(timeout: float = 120.0) -> dict:
 
 
 def run_probe(driver_root: str = "/", runtime: bool = False) -> dict:
+    sysfs = probe_sysfs(driver_root)
     report = {
         "driver_root": driver_root,
         "dev_nodes": probe_dev_nodes(driver_root),
-        "sysfs": probe_sysfs(driver_root),
+        "sysfs": sysfs,
         "pci": probe_pci(driver_root),
         "env": probe_env(),
         "metadata_server": probe_metadata_server(),
         "native": probe_native(driver_root),
-        "error_counters": probe_error_counters(driver_root),
+        "error_counters": probe_error_counters(driver_root, sysfs=sysfs),
     }
     if runtime:
         report["runtime"] = probe_runtime()
