@@ -479,7 +479,8 @@ COMPACT_KEYS = [
     "serve_ttft_p50_ms", "serve_ttft_p99_ms",
     "serve_e2e_p50_ms", "serve_e2e_p99_ms",
     "prefix_serve_speedup", "prefix_prefill_speedup",
-    "spec_serve_tokens_per_sec", "spec_vs_plain_decode_b1",
+    "spec_serve_tokens_per_sec", "spec_lookahead_speedup",
+    "spec_serve_lookahead_tokens_per_sec", "spec_vs_plain_decode_b1",
     "spec_vs_plain_decode_b4", "spec_acceptance_rate",
     "multi_lora_relative_throughput",
 ]

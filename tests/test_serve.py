@@ -454,11 +454,14 @@ def test_speculative_engine_validations():
     draft = init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
     with pytest.raises(ValueError, match="come together"):
         ServeEngine(params, CONFIG, draft_params=draft)
-    with pytest.raises(ValueError, match="greedy"):
-        ServeEngine(
-            params, CONFIG, draft_params=draft, draft_config=DRAFT_CONFIG,
-            temperature=0.5,
-        )
+    # temperature > 0 with a draft is VALID since lossless speculative
+    # sampling landed — construction must succeed (behavior pinned in
+    # tests/test_spec_sampling.py).
+    engine = ServeEngine(
+        params, CONFIG, draft_params=draft, draft_config=DRAFT_CONFIG,
+        temperature=0.5, rng=jax.random.PRNGKey(1),
+    )
+    assert engine.sampling
 
 
 def test_pipelined_speculative_matches_generate():

@@ -46,7 +46,10 @@ def _run_one(seed: int, params, draft, adapters) -> None:
     kw["prompt_bucket"] = int(kw["page_size"] * rng.choice([2, 3]))
     if spec:
         kw.update(draft_params=draft, draft_config=DRAFT_CONFIG,
-                  gamma=int(rng.integers(2, 5)))
+                  gamma=int(rng.integers(2, 5)),
+                  # Lookahead supersteps (k rounds per dispatch) must be
+                  # emission-invariant for every k.
+                  spec_lookahead=int(rng.choice([1, 1, 2, 3])))
     else:
         # chunk != page_size exercises the overshoot/boundary accounting.
         kw["chunk"] = int(kw["page_size"] * rng.choice([1, 2]))

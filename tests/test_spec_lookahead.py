@@ -1,0 +1,180 @@
+"""Speculative lookahead supersteps (paged.paged_spec_superstep +
+ServeEngine(spec_lookahead=k)): k chained rounds per dispatch, tokens
+read back once per superstep.  Parity is the bar: the emitted tokens
+must be EXACTLY the single-round engine's tokens (greedy = the dense
+reference) for every k, with eos, retirement lag, pipelining, sampling
+and LoRA composed on top."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=96, n_layers=2, dtype=jnp.float32)
+DRAFT_CONFIG = ModelConfig(
+    max_seq_len=96, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def models():
+    return (
+        init_params(CONFIG, jax.random.PRNGKey(0)),
+        init_params(DRAFT_CONFIG, jax.random.PRNGKey(7)),
+    )
+
+
+def _engine(params, draft, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prompt_bucket", 8)
+    kw.setdefault("gamma", 3)
+    return ServeEngine(
+        params, CONFIG, draft_params=draft, draft_config=DRAFT_CONFIG, **kw
+    )
+
+
+def _ref(params, prompt, new):
+    return [int(t) for t in np.asarray(
+        generate(params, jnp.asarray([prompt], jnp.int32), CONFIG, new)[0]
+    )]
+
+
+@pytest.mark.parametrize("k", [2, 3])
+def test_lookahead_greedy_matches_dense_reference(models, k):
+    params, draft = models
+    engine = _engine(params, draft, spec_lookahead=k)
+    streams = [([3, 1, 4, 1, 5], 17), ([2, 7], 9), ([9] * 11, 13)]
+    rids = [engine.submit(p, n) for p, n in streams]
+    served = engine.run()
+    for rid, (p, n) in zip(rids, streams):
+        assert served[rid] == _ref(params, p, n), (k, rid)
+    assert engine.ctrl.used_pages == 0
+
+
+def test_lookahead_fewer_host_syncs_same_rounds(models):
+    """The superstep's point: k rounds per dispatch.  spec_rounds counts
+    device rounds either way, so a k=3 engine must finish the same work
+    while stepping ~1/3 as many times."""
+    params, draft = models
+    ref = _ref(params, [5, 2, 9], 25)
+    steps = {}
+    for k in (1, 3):
+        engine = _engine(params, draft, slots=1, spec_lookahead=k)
+        rid = engine.submit([5, 2, 9], 25)
+        n_steps, served = 0, {}
+        while not engine.idle:
+            for req in engine.step():
+                served[req.rid] = req.tokens
+            n_steps += 1
+        steps[k] = n_steps
+        assert served[rid] == ref, k
+    assert steps[3] < steps[1], steps
+
+
+def test_lookahead_eos_retires_with_bounded_overshoot(models):
+    """A request hitting eos mid-superstep retires with its prefix
+    intact; the rounds after eos are dead compute, never emission."""
+    params, draft = models
+    prompt = [4, 4, 8]
+    full = _ref(params, prompt, 20)
+    eos = full[6]
+    engine = _engine(params, draft, spec_lookahead=3)
+    rid = engine.submit(prompt, 20, eos_token=eos)
+    got = engine.run()[rid]
+    want = full[: full.index(eos) + 1]
+    assert got[: len(want)] == want
+    assert eos in got
+    assert engine.ctrl.used_pages == 0
+
+
+def test_lookahead_composes_with_pipelined(models):
+    params, draft = models
+    engine = _engine(params, draft, spec_lookahead=2, pipelined=True)
+    streams = [([1, 2, 3], 15), ([6, 5], 11), ([7] * 5, 8)]
+    rids = [engine.submit(p, n) for p, n in streams]
+    served = engine.run()
+    for rid, (p, n) in zip(rids, streams):
+        assert served[rid] == _ref(params, p, n)
+    assert engine.ctrl.used_pages == 0
+
+
+def test_lookahead_composes_with_sampling_and_lora(models):
+    from workloads.multi_lora import synthetic_adapters
+
+    params, draft = models
+    adapters = synthetic_adapters(CONFIG, 2, rank=4, scale=0.3, seed=3)
+    engine = _engine(
+        params, draft, spec_lookahead=2, temperature=0.8, top_k=40,
+        rng=jax.random.PRNGKey(5), adapters=adapters,
+    )
+    names = [None] + sorted(adapters)
+    rids = [
+        engine.submit([1 + i, 2], 10, adapter=names[i % 3]) for i in range(4)
+    ]
+    served = engine.run()
+    for rid in rids:
+        toks = served[rid]
+        assert len(toks) == 10
+        assert all(0 <= t < CONFIG.vocab_size for t in toks)
+    assert engine.ctrl.used_pages == 0
+
+
+def test_lookahead_validation(models):
+    params, draft = models
+    with pytest.raises(ValueError, match="spec_lookahead"):
+        _engine(params, draft, spec_lookahead=0)
+    with pytest.raises(ValueError, match="spec_lookahead"):
+        ServeEngine(params, CONFIG, spec_lookahead=2)
+
+
+def test_lookahead_tp_sampling_structurally_sound(models):
+    """TP x sampling x lookahead: the superstep program's sampling
+    operand quad (rng/temperature/top_k/top_p shardings and unpack
+    order) under the mesh — budgets exact, tokens in-vocab."""
+    from workloads.train import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    params, draft = models
+    mesh = make_mesh(2, model_parallel=2)
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+        mesh=mesh, draft_params=draft, draft_config=DRAFT_CONFIG,
+        gamma=3, spec_lookahead=2, temperature=0.9, top_k=40,
+        rng=jax.random.PRNGKey(13),
+    )
+    rids = [engine.submit([2 + i, 5], 9) for i in range(3)]
+    served = engine.run()
+    for rid in rids:
+        toks = served[rid]
+        assert len(toks) == 9
+        assert all(0 <= t < CONFIG.vocab_size for t in toks)
+    assert engine.ctrl.used_pages == 0
+
+
+def test_lookahead_tp_matches_greedy(models):
+    """The superstep under a ("data", "model") mesh: scan-of-shard_map
+    draft + GSPMD verify; tokens must equal the dense reference."""
+    from workloads.train import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    params, draft = models
+    mesh = make_mesh(2, model_parallel=2)
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+        mesh=mesh, draft_params=draft, draft_config=DRAFT_CONFIG,
+        gamma=3, spec_lookahead=2,
+    )
+    streams = [([1, 2, 3, 4], 12), ([9, 8, 7], 8)]
+    rids = [engine.submit(p, n) for p, n in streams]
+    served = engine.run()
+    for rid, (p, n) in zip(rids, streams):
+        assert served[rid] == _ref(params, p, n)
+    assert engine.ctrl.used_pages == 0

@@ -41,6 +41,7 @@ TRACKED_UP = [
     "serve_requests_per_sec",
     "prefix_serve_speedup",
     "spec_serve_tokens_per_sec",
+    "spec_serve_lookahead_tokens_per_sec",
     "aggregate_chip_busy_fraction",
     "aggregate_tokens_per_sec",
 ]

@@ -870,6 +870,98 @@ def paged_spec_round_chained(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=("t_config", "d_config", "gamma", "k", "cover_pages",
+                     "sampling"),
+    donate_argnums=(2, 3),
+)
+def paged_spec_superstep(
+    t_params: dict,
+    d_params: dict,
+    t_pools: tuple[jax.Array, jax.Array],
+    d_pools: tuple[jax.Array, jax.Array],
+    tables: jax.Array,
+    cur: jax.Array,
+    positions: jax.Array,
+    occupancy: jax.Array,
+    t_config: ModelConfig,
+    d_config: ModelConfig,
+    gamma: int,
+    k: int,
+    cover_pages: int | None = None,
+    t_lora=None,
+    sampling: bool = False,
+    rng: jax.Array | None = None,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
+):
+    """``k`` chained speculative rounds in ONE dispatch (a lax.scan over
+    paged_spec_round_chained's body) — the spec-serving control plane
+    batched for high-RTT links.
+
+    A speculative round advances at most gamma+1 tokens, so a per-round
+    host sync caps throughput at (gamma+1)/RTT no matter how fast the
+    chip is; on the tunnelled bench chip the measured readback tax is
+    ~20x the round's own compute.  Tables must already cover
+    positions + k*(gamma+1) for occupied rows (the engine pre-extends —
+    between page-aligned boundaries block tables are the ONLY thing the
+    host needed per round, so covering k rounds up front removes the
+    host from the loop entirely).  Rows that retire mid-superstep simply
+    compute dead rounds until it ends (the consumer stops emitting at
+    eos/max_new) — the same dead-compute economics as pipelined
+    stepping, scaled by k.
+
+    Returns (committed [k, batch, gamma+1], n_accept [k, batch],
+    new_cur, new_pos, t_pools, d_pools); committed/n stack per round in
+    execution order, new_cur/new_pos are the state AFTER round k (the
+    next superstep chains on them, fresh rows re-injected host-side).
+    In sampling mode ``rng`` is split into one key per round — the same
+    lossless rejection rule per round."""
+    return _spec_superstep_core(
+        t_params, d_params, t_pools, d_pools, tables, cur, positions,
+        occupancy, t_config=t_config, d_config=d_config, gamma=gamma,
+        k=k, cover_pages=cover_pages, t_lora=t_lora, sampling=sampling,
+        rng=rng, temperature=temperature, top_k=top_k, top_p=top_p,
+    )
+
+
+def _spec_superstep_core(
+    t_params, d_params, t_pools, d_pools, tables, cur, positions,
+    occupancy, t_config, d_config, gamma, k, cover_pages,
+    d_attention_fn=None, t_lora=None, sampling=False, rng=None,
+    temperature=0.0, top_k=0, top_p=1.0,
+):
+    """paged_spec_superstep's body, un-jitted so the tensor-parallel
+    path can re-jit it with explicit shardings and an injected draft
+    attention op (scan-of-shard_map: the per-round body is identical to
+    the chained round's)."""
+    if sampling and rng is None:
+        raise ValueError("sampling speculative superstep requires an rng key")
+    keys = (
+        jax.random.split(rng, k) if sampling
+        else jnp.zeros((k, 2), jnp.uint32)  # dummy xs; greedy ignores them
+    )
+
+    def one_round(carry, key):
+        t_pools, d_pools, cur, pos = carry
+        committed, n, new_cur, new_pos, t_pools, d_pools = _spec_round_core(
+            t_params, d_params, t_pools, d_pools, tables, cur, pos,
+            t_config=t_config, d_config=d_config, gamma=gamma,
+            cover_pages=cover_pages, d_attention_fn=d_attention_fn,
+            occupancy=occupancy, t_lora=t_lora, sampling=sampling,
+            rng=key if sampling else None, temperature=temperature,
+            top_k=top_k, top_p=top_p,
+        )
+        return (t_pools, d_pools, new_cur, new_pos), (committed, n)
+
+    (t_pools, d_pools, new_cur, new_pos), (committed, n) = jax.lax.scan(
+        one_round, (t_pools, d_pools, cur, positions), keys
+    )
+    return committed, n, new_cur, new_pos, t_pools, d_pools
+
+
 def _spec_round_core(
     t_params, d_params, t_pools, d_pools, tables, cur, positions,
     t_config, d_config, gamma, cover_pages, d_attention_fn=None,

@@ -143,6 +143,7 @@ class ServeEngine:
         draft_params: dict | None = None,
         draft_config: ModelConfig | None = None,
         gamma: int = 4,
+        spec_lookahead: int = 1,
         pipelined: bool = False,
         prefix_cache: bool = False,
         adapters: dict[str, list] | None = None,
@@ -166,6 +167,15 @@ class ServeEngine:
                 raise ValueError("target and draft must share a vocabulary")
             if gamma < 1:
                 raise ValueError(f"gamma must be >= 1, got {gamma}")
+        if spec_lookahead < 1:
+            raise ValueError(
+                f"spec_lookahead must be >= 1, got {spec_lookahead}"
+            )
+        if spec_lookahead > 1 and draft_params is None:
+            raise ValueError(
+                "spec_lookahead > 1 is a speculative-serving mode; pass "
+                "draft_params/draft_config"
+            )
         self.params, self.config = params, config
         self.draft_params, self.draft_config = draft_params, draft_config
         self.gamma = gamma
@@ -190,9 +200,10 @@ class ServeEngine:
         # by one more step unit (chunk or round); chunked prefill
         # additionally needs bucket-aligned page coverage.
         self.pipelined = pipelined
+        self.spec_lookahead = spec_lookahead
         self._overshoot = max(
             self.chunk * (2 if pipelined else 1),
-            ((gamma + 1) * (2 if pipelined else 1))
+            ((gamma + 1) * spec_lookahead * (2 if pipelined else 1))
             if draft_params is not None else 0,
         )
         bucket_pages = self.prompt_bucket // page_size
@@ -301,7 +312,6 @@ class ServeEngine:
         else:
             from .tp_serve import (
                 make_tp_serve_programs,
-                make_tp_spec_program,
                 shard_serving_state,
             )
 
@@ -349,9 +359,14 @@ class ServeEngine:
                 # under the model mesh (the draft decode's kernel per
                 # shard, the dense verify via GSPMD); the draft state
                 # shards like the target's.
-                self._tp_spec = make_tp_spec_program(
+                # ONE TP spec program for every k (the engine's spec
+                # dispatch is always a superstep; k=1 is the classic
+                # per-round engine).
+                from .tp_serve import make_tp_spec_superstep
+
+                self._tp_spec = make_tp_spec_superstep(
                     self.config, draft_config, mesh, gamma,
-                    chained=pipelined,
+                    k=spec_lookahead,
                     lora_stacked=self._stacked_adapters,
                     lora_alpha=self.lora_alpha,
                     sampling=self.sampling,
@@ -839,36 +854,32 @@ class ServeEngine:
         return finished
 
     def _step_spec(self) -> list[Request]:
-        """One batched speculative round (paged_spec_round): every
-        occupied row drafts, verifies, and commits its OWN accepted
-        length — per-row positions advance by different amounts, which
-        is exactly what the paged compute path supports.
+        """One speculative SUPERSTEP: ``spec_lookahead`` chained rounds
+        in a single dispatch (paged.paged_spec_superstep) — every
+        occupied row drafts, verifies and commits its OWN accepted
+        length per round, with tables pre-extended to cover every round
+        so the host leaves the loop for k rounds at a time.  The
+        default ``spec_lookahead=1`` is the classic one-round-per-step
+        engine (a 1-round superstep compiles to the same work); on a
+        high-RTT link raising k divides the per-round readback tax by k
+        (measured ~20x the round's compute on the bench tunnel), at the
+        cost of emission/retirement lag of up to k rounds (dead compute
+        on rows that finish mid-superstep) and admission only at
+        superstep boundaries.
 
-        With ``pipelined`` the round's committed tokens are NOT read
-        before returning: the next round dispatches chained on this
-        round's device-side (new_cur, new_pos)
-        (paged.paged_spec_round_chained), and only then reads this one —
-        the per-round readback round-trip overlaps the next round's
-        draft+verify compute.  Host positions lag one round, so page
-        coverage accounts the unread in-flight advance (bounded by
-        gamma+1 per round).
+        With ``pipelined`` the superstep's tokens are NOT read before
+        returning: superstep S+1 dispatches chained on S's device-side
+        (new_cur, new_pos) while S's tokens are still in flight, so the
+        readback overlaps the next superstep's compute.  Whether THAT
+        overlap pays is link-profile-dependent (the bench's
+        spec_pipelined_speedup field, median with spread, is the
+        authoritative number); lookahead attacks the same tax more
+        directly by batching.  Sampling composes (one key per round,
+        the same lossless rejection rule)."""
+        from .paged import paged_spec_superstep
 
-        Whether the overlap pays is LINK-PROFILE-DEPENDENT: a round's
-        readback must be large next to its own draft+verify compute,
-        while pipelining adds one DEAD round per retirement and lags
-        admission by a round.  The bench's spec_pipelined_speedup field
-        (median of interleaved repeats with min/max spread; see
-        docs/bench-builder-latest.json for the current artifact) is the
-        authoritative number — single-shot measurements of this ratio
-        swung 0.80-0.96x across r4 runs on the same code.  The mode
-        stays available, default off, token-parity pinned by tests."""
-        from .paged import paged_spec_round, paged_spec_round_chained
-
-        # Page coverage + the verify gather bound (bucketised so the
-        # static cover takes few distinct values).  ub[slot] bounds the
-        # slot's DEVICE position: the host mirror plus gamma+1 for an
-        # unread in-flight round.
-        u = self.gamma + 1
+        k = self.spec_lookahead
+        u = (self.gamma + 1) * k
         in_flight = (
             set(self._pending_spec[1]) if self._pending_spec else set()
         )
@@ -882,54 +893,22 @@ class ServeEngine:
             self._tables[slot, : len(table)] = table
         need = -(-(max(ub.values()) + u) // self.page_size)
         cover = min(self.max_pages, -(-need // 4) * 4)
-
-        # Per-row adapters apply to the TARGET's verify forward only
-        # (the draft guesses unadapted — acceptance, not correctness).
         t_lora = None
         if self._stacked_adapters is not None:
             t_lora = (
                 self._stacked_adapters, self._dev(self._adapter_idx),
                 self.lora_alpha,
             )
-        # TP programs take (stacked, idx) positionally; alpha is baked in.
         lora_ops = () if t_lora is None else (t_lora[0], t_lora[1])
-        # Sampling knobs for lossless speculative sampling; greedy rounds
-        # take no key (sampling is a static switch in the programs).
-        samp_kw = dict(
-            sampling=self.sampling,
-            rng=self._next_key() if self.sampling else None,
-            temperature=jnp.float32(self.temperature),
-            top_k=jnp.int32(self.top_k), top_p=jnp.float32(self.top_p),
-        )
+        rng = self._next_key() if self.sampling else None
         samp_ops = (
-            (samp_kw["rng"], samp_kw["temperature"], samp_kw["top_k"],
-             samp_kw["top_p"])
+            (rng, jnp.float32(self.temperature), jnp.int32(self.top_k),
+             jnp.float32(self.top_p))
             if self.sampling else ()
         )
-        if not self.pipelined:
-            if self._mesh is None:
-                committed, n_acc, self.pools, self.d_pools = paged_spec_round(
-                    self.params, self.draft_params, self.pools, self.d_pools,
-                    self._dev(self._tables), self._dev(self._tokens),
-                    self._dev(self._positions),
-                    t_config=self.config, d_config=self.draft_config,
-                    gamma=self.gamma, cover_pages=cover, t_lora=t_lora,
-                    **samp_kw,
-                )
-            else:
-                committed, n_acc, self.pools, self.d_pools = self._tp_spec(
-                    self.params, self.draft_params, self.pools, self.d_pools,
-                    self._dev(self._tables), self._dev(self._tokens),
-                    self._dev(self._positions), *lora_ops, *samp_ops, cover,
-                )
-            self.spec_rounds += 1
-            return self._consume_spec((committed, n_acc), dict(self._slot_req))
-
         cur = self._dev(self._tokens)
         pos = self._dev(self._positions)
-        if self._spec_chained is not None:
-            # Continue from the previous round's advance ON DEVICE; only
-            # freshly admitted slots take their host-side state.
+        if self.pipelined and self._spec_chained is not None:
             fresh = np.zeros(self.slots, bool)
             for s in self._fresh_slots:
                 fresh[s] = True
@@ -941,12 +920,15 @@ class ServeEngine:
         occ = self._dev(self._occupied)
         if self._mesh is None:
             committed, n_acc, new_cur, new_pos, self.pools, self.d_pools = (
-                paged_spec_round_chained(
+                paged_spec_superstep(
                     self.params, self.draft_params, self.pools, self.d_pools,
                     self._dev(self._tables), cur, pos, occ,
                     t_config=self.config, d_config=self.draft_config,
-                    gamma=self.gamma, cover_pages=cover, t_lora=t_lora,
-                    **samp_kw,
+                    gamma=self.gamma, k=k, cover_pages=cover, t_lora=t_lora,
+                    sampling=self.sampling, rng=rng,
+                    temperature=jnp.float32(self.temperature),
+                    top_k=jnp.int32(self.top_k),
+                    top_p=jnp.float32(self.top_p),
                 )
             )
         else:
@@ -957,32 +939,46 @@ class ServeEngine:
                     *samp_ops, cover,
                 )
             )
-        self.spec_rounds += 1
-        self._spec_chained = (new_cur, new_pos)
+        self.spec_rounds += k
         snapshot = dict(self._slot_req)
+        if not self.pipelined:
+            return self._consume_spec((committed, n_acc), snapshot)
+        self._spec_chained = (new_cur, new_pos)
         prev, self._pending_spec = self._pending_spec, (
             (committed, n_acc), snapshot,
         )
         if prev is not None:
-            # Reading the PREVIOUS round now overlaps the one in flight.
             return self._consume_spec(*prev)
         return []
 
+
     def _consume_spec(self, arrs, snapshot: dict) -> list[Request]:
-        """Read a speculative round's (committed, n_accept) back (the
-        host sync point) and apply per-row emission/retirement for the
-        slots as they were at dispatch."""
+        """Read a speculative round's — or superstep's — (committed,
+        n_accept) back (the host sync point) and apply per-row
+        emission/retirement for the slots as they were at dispatch.
+
+        A single round's arrays are [batch, gamma+1]/[batch]; a
+        superstep stacks a leading per-round axis.  Either way the host
+        mirrors advance by the DEVICE's total advance (emission stops at
+        eos/max_new; rounds past a row's retirement point are the
+        superstep's documented dead compute)."""
         committed, n_acc = (np.asarray(a) for a in arrs)
+        if committed.ndim == 2:  # single round -> a 1-round superstep
+            committed, n_acc = committed[None], n_acc[None]
         finished = []
         for slot, req in snapshot.items():
             if req.done:
                 # Retired between dispatch and read (pipelined lag): the
                 # slot computed a dead round; nothing to emit.
                 continue
-            k = int(n_acc[slot]) + 1
-            self._emit(req, committed[slot, :k])
-            self._positions[slot] += k
-            self._tokens[slot] = committed[slot, k - 1]
+            advance = 0
+            for j in range(committed.shape[0]):
+                k = int(n_acc[j, slot]) + 1
+                if not req.done:
+                    self._emit(req, committed[j, slot, :k])
+                advance += k
+            self._positions[slot] += advance
+            self._tokens[slot] = committed[-1, slot, int(n_acc[-1, slot])]
             if req.done:
                 finished.append(self._retire(slot))
         return finished
@@ -1097,6 +1093,13 @@ def main(argv=None) -> int:
                         "lossless speculative sampling")
     parser.add_argument("--gamma", type=int, default=4,
                         help="draft tokens per speculative round")
+    parser.add_argument("--spec-lookahead", type=int, default=1,
+                        help="speculative rounds per dispatch (the "
+                        "superstep): k>1 pre-extends page tables k rounds "
+                        "ahead and reads tokens back once per k rounds — "
+                        "divides the per-round host round-trip tax by k on "
+                        "high-latency links at the cost of up to k rounds "
+                        "of emission lag")
     parser.add_argument("--lora-adapters", type=int, default=0,
                         help="serve N synthetic LoRA adapters multi-tenant "
                         "(requests round-robin across them + the base)")
@@ -1150,6 +1153,7 @@ def main(argv=None) -> int:
         spec_kw = dict(
             draft_params=params if args.int8 else quantize_params(params),
             draft_config=config, gamma=args.gamma,
+            spec_lookahead=args.spec_lookahead,
         )
     engine = ServeEngine(
         params, config, slots=args.slots, page_size=page_size,

@@ -323,6 +323,85 @@ def make_tp_spec_program(
     return tp_spec_round
 
 
+def make_tp_spec_superstep(
+    t_config: ModelConfig, d_config: ModelConfig, mesh: Mesh, gamma: int,
+    k: int, lora_stacked=None, lora_alpha: float = 1.0,
+    sampling: bool = False,
+):
+    """Tensor-parallel speculative SUPERSTEP: ``k`` chained rounds in one
+    dispatch under the model mesh (a lax.scan of the chained round's
+    body — scan-of-shard_map for the draft kernel, GSPMD for the dense
+    verify).  Operand order matches make_tp_spec_program's chained form
+    (occupancy always present, then optional lora pair, then optional
+    sampling quad, then the static cover_pages last); returns
+    (committed [k, b, gamma+1], n [k, b], new_cur, new_pos, t_pools,
+    d_pools)."""
+    from .paged import _spec_superstep_core
+
+    _check_tp(t_config, mesh)
+    _check_tp(d_config, mesh)
+    t_param_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs(t_config)
+    )
+    d_param_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs(d_config)
+    )
+    pool_sh = NamedSharding(mesh, _POOL_SPEC)
+    rep = lambda *axes: NamedSharding(mesh, P(*axes))  # noqa: E731
+    d_attention_fn = _tp_paged_attention(d_config, mesh)
+    lora_sh = (
+        ()
+        if lora_stacked is None
+        else (jax.tree.map(lambda _: rep(), lora_stacked), rep(None))
+    )
+    samp_sh = (rep(None), rep(), rep(), rep()) if sampling else ()
+    in_sh = (
+        t_param_sh, d_param_sh, (pool_sh, pool_sh), (pool_sh, pool_sh),
+        rep(None, None), rep(None), rep(None), rep(None),
+    ) + lora_sh + samp_sh
+    out_sh = (
+        rep(None, None, None), rep(None, None), rep(None), rep(None),
+        (pool_sh, pool_sh), (pool_sh, pool_sh),
+    )
+    n_operands = (
+        8 + (2 if lora_stacked is not None else 0) + (4 if sampling else 0)
+    )
+
+    @partial(
+        jax.jit,
+        static_argnums=(n_operands,),
+        donate_argnums=(2, 3),
+        in_shardings=in_sh,
+        out_shardings=out_sh,
+    )
+    def tp_spec_superstep(
+        t_params, d_params, t_pools, d_pools, tables, cur, positions,
+        occupancy, *rest,
+    ):
+        rest = list(rest)
+        cover_pages = rest.pop()  # static, always last
+        samp = {}
+        if sampling:
+            rng, temperature, top_k, top_p = rest[-4:]
+            del rest[-4:]
+            samp = dict(
+                sampling=True, rng=rng, temperature=temperature,
+                top_k=top_k, top_p=top_p,
+            )
+        t_lora = (
+            (rest[0], rest[1], lora_alpha) if lora_stacked is not None
+            else None
+        )
+        return _spec_superstep_core(
+            t_params, d_params, t_pools, d_pools, tables, cur,
+            positions, occupancy, t_config=t_config, d_config=d_config,
+            gamma=gamma, k=k, cover_pages=cover_pages,
+            d_attention_fn=d_attention_fn, t_lora=t_lora, **samp,
+        )
+
+    return tp_spec_superstep
+
+
 def shard_serving_state(params: dict, pools, config: ModelConfig, mesh: Mesh):
     """Place existing host/single-device serving state onto the mesh in
     the layouts the TP programs expect: params by param_specs, pools by
