@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat fmt-check
 
 all: native
 
@@ -46,7 +46,15 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: test
+check: check-compat test
+
+# Fast kernel-layer API tripwire: importing workloads.ops pulls every
+# Pallas kernel module through its module-level API surface (compiler
+# params, grid semantics), so a JAX rename fails HERE in seconds instead
+# of as 16 pytest collection errors (the pltpu.CompilerParams incident —
+# workloads/ops/pallas_compat.py carries the version tolerance).
+check-compat:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import workloads.ops, workloads.ops.paged_attention, workloads.ops.ulysses, workloads.ops.usp; print('workloads.ops import OK')"
 
 # Containerised variants: `make docker-test`, `make docker-bench`, ... run
 # the same target inside the devel image (reference analog: Makefile:33-66

@@ -478,6 +478,8 @@ COMPACT_KEYS = [
     "serve_tokens_per_sec", "serve_requests_per_sec",
     "serve_ttft_p50_ms", "serve_ttft_p99_ms",
     "serve_e2e_p50_ms", "serve_e2e_p99_ms",
+    "admission_tokens_per_sec", "admission_speedup",
+    "admission_dispatches_per_request",
     "prefix_serve_speedup", "prefix_prefill_speedup",
     "spec_serve_tokens_per_sec", "spec_lookahead_speedup",
     "spec_serve_lookahead_tokens_per_sec", "spec_vs_plain_decode_b1",

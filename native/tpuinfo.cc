@@ -759,33 +759,45 @@ int tpuinfo_get_provenance(tpuinfo_provenance_t* out) {
 }
 
 int tpuinfo_health_class_support(int index) {
-  std::lock_guard<std::mutex> lock(g_state.mu);
-  if (!g_state.initialized) return TPUINFO_ERR_NOT_INITIALIZED;
-  // `index` is the chip's host-local index (chip.index, the /dev/accelN
-  // number), which on a host with sparse accel nodes is NOT its position
-  // in the enumeration — translate like tpuinfo_chip_in_use does.
-  const Chip* chip = nullptr;
-  for (const Chip& cand : g_state.chips) {
-    if (cand.index == index) chip = &cand;
+  // Copy what the probes need under the lock, then do the sysfs I/O
+  // OUTSIDE the critical section: ReadFileInt64 against a slow or hung
+  // sysfs under g_state.mu would block the health-event wait path (and
+  // every other API call) for the duration of the read.
+  std::string root;
+  int chip_index = 0;
+  bool open_probe_enabled = false;
+  bool chip_seen = false;
+  bool app_seen = false;
+  {
+    std::lock_guard<std::mutex> lock(g_state.mu);
+    if (!g_state.initialized) return TPUINFO_ERR_NOT_INITIALIZED;
+    // `index` is the chip's host-local index (chip.index, the /dev/accelN
+    // number), which on a host with sparse accel nodes is NOT its position
+    // in the enumeration — translate like tpuinfo_chip_in_use does.
+    const Chip* chip = nullptr;
+    for (const Chip& cand : g_state.chips) {
+      if (cand.index == index) chip = &cand;
+    }
+    if (chip == nullptr) return TPUINFO_ERR_INVALID;
+    chip_index = chip->index;
+    root = g_state.root;
+    open_probe_enabled = g_state.open_probe_enabled;
+    auto it = g_state.health.find("accel" + std::to_string(chip_index));
+    chip_seen = it != g_state.health.end() && it->second.chip_err_seen;
+    app_seen = it != g_state.health.end() && it->second.app_err_seen;
   }
-  if (chip == nullptr) return TPUINFO_ERR_INVALID;
-  const Chip& c = *chip;
   int mask = 1 << TPUINFO_EVENT_NODE_LIVENESS;  // dev-node watch: always on
-  if (g_state.open_probe_enabled) mask |= 1 << TPUINFO_EVENT_OPEN_PROBE;
+  if (open_probe_enabled) mask |= 1 << TPUINFO_EVENT_OPEN_PROBE;
   // Error-counter classes are live iff their sysfs attribute is readable
   // now or the watcher ever saw it (the driver may create it late) — the
   // same condition under which the watch loop can emit the class.
-  auto it = g_state.health.find("accel" + std::to_string(c.index));
   int64_t v;
-  bool chip_seen = it != g_state.health.end() && it->second.chip_err_seen;
-  bool app_seen = it != g_state.health.end() && it->second.app_err_seen;
   if (chip_seen ||
-      ReadFileInt64(ErrCounterPath(g_state.root, c.index, "tpu_error_count"),
-                    &v))
+      ReadFileInt64(ErrCounterPath(root, chip_index, "tpu_error_count"), &v))
     mask |= 1 << TPUINFO_EVENT_CHIP_ERROR_COUNTER;
   if (app_seen ||
-      ReadFileInt64(
-          ErrCounterPath(g_state.root, c.index, "tpu_app_error_count"), &v))
+      ReadFileInt64(ErrCounterPath(root, chip_index, "tpu_app_error_count"),
+                    &v))
     mask |= 1 << TPUINFO_EVENT_APP_ERROR_COUNTER;
   return mask;
 }

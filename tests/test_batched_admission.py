@@ -1,0 +1,330 @@
+"""Batched admission (workloads/serve.py): all admissions in one step()
+coalesce into ONE multi-row prefill sweep and ONE fused first-token
+readback, with token streams BIT-IDENTICAL to serial admission — across
+mixed prompt lengths, chunked prefill, prefix-cache hits, LoRA adapters,
+fan-out groups, sampling, and speculative serving."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+
+
+def _mixed_requests(n, vocab, rng_seed=7, p_lo=3, p_hi=11):
+    rng = np.random.default_rng(rng_seed)
+    out = []
+    for _ in range(n):
+        plen = int(rng.integers(p_lo, p_hi))
+        new = int(rng.integers(2, 25))
+        out.append((list(rng.integers(0, vocab, plen)), new))
+    return out
+
+
+def _serve_both(params, requests, config=CONFIG, submit=None, **kw):
+    """Run the same stream through a serial-admission and a
+    batched-admission engine; return (serial_out, batched_out, engines)."""
+    outs, engines = [], []
+    for batched in (False, True):
+        engine = ServeEngine(
+            params, config, batched_admission=batched, **kw
+        )
+        if submit is not None:
+            rids = submit(engine)
+        else:
+            rids = [engine.submit(p, n) for p, n in requests]
+        served = engine.run()
+        outs.append({r: served[r] for r in rids})
+        engines.append(engine)
+    return outs[0], outs[1], engines
+
+
+def _assert_identical(serial, batched):
+    assert set(serial) == set(batched)
+    for rid in serial:
+        assert serial[rid] == batched[rid], (
+            f"{rid}: serial {serial[rid]} != batched {batched[rid]}"
+        )
+
+
+def test_batched_matches_serial_greedy_mixed_lengths():
+    """The core parity pin: mixed prompt lengths (including prompts
+    longer than the bucket, so rows finish in DIFFERENT chunks of the
+    shared sweep) emit bit-identical greedy streams."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    requests = _mixed_requests(7, CONFIG.vocab_size, rng_seed=3, p_lo=3, p_hi=20)
+    serial, batched, (es, eb) = _serve_both(
+        params, requests, slots=3, page_size=4, prompt_bucket=8, chunk=4,
+    )
+    _assert_identical(serial, batched)
+    assert es.ctrl.used_pages == 0 and eb.ctrl.used_pages == 0
+    # Same per-row accounting through a different execution shape.
+    assert es.prefill_tokens == eb.prefill_tokens
+    assert es.prefills_run == eb.prefills_run
+    assert eb.prefill_sweeps > 0
+    assert eb.admission_readbacks < es.admission_readbacks
+
+
+def test_batched_matches_serial_sampling_stream():
+    """Bit-identical SAMPLED streams: the fused sampler draws each row
+    under its own key, in the serial path's exact _next_key() order."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    requests = _mixed_requests(6, CONFIG.vocab_size, rng_seed=5)
+    serial, batched, _ = _serve_both(
+        params, requests, slots=3, page_size=4, prompt_bucket=8, chunk=4,
+        temperature=0.8, top_k=20, top_p=0.9, rng=jax.random.PRNGKey(11),
+    )
+    _assert_identical(serial, batched)
+
+
+def test_batched_admission_dispatch_counts():
+    """The structural claim: admitting R requests in one step issues
+    exactly ONE prefill sweep (one dispatch for single-bucket prompts)
+    and ONE first-token readback — not R of each."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    R = 4
+    engine = ServeEngine(
+        params, CONFIG, slots=R, page_size=4, prompt_bucket=8, chunk=4,
+    )
+    rng = np.random.default_rng(9)
+    for _ in range(R):
+        engine.submit(list(rng.integers(0, CONFIG.vocab_size, 7)), 4)
+    engine.step()  # all R admit here
+    assert engine.prefill_sweeps == 1
+    assert engine.prefill_dispatches == 1
+    assert engine.admission_readbacks == 1
+    assert engine.prefills_run == R
+    # The serial reference really pays R of each.
+    serial = ServeEngine(
+        params, CONFIG, slots=R, page_size=4, prompt_bucket=8, chunk=4,
+        batched_admission=False,
+    )
+    rng = np.random.default_rng(9)
+    for _ in range(R):
+        serial.submit(list(rng.integers(0, CONFIG.vocab_size, 7)), 4)
+    serial.step()
+    assert serial.prefill_dispatches == R
+    assert serial.admission_readbacks == R
+
+
+def test_batched_ragged_sweep_is_one_sweep():
+    """Rows of different chunk counts still ride ONE sweep: its dispatch
+    count is the LONGEST row's chunk count, not the sum over rows."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine = ServeEngine(
+        params, CONFIG, slots=3, page_size=4, prompt_bucket=8, chunk=4,
+    )
+    rng = np.random.default_rng(13)
+    for plen in (5, 14, 23):  # 1, 2 and 3 bucket chunks
+        engine.submit(list(rng.integers(0, CONFIG.vocab_size, plen)), 3)
+    engine.step()
+    assert engine.prefill_sweeps == 1
+    assert engine.prefill_dispatches == 3  # ceil(23 / 8)
+    assert engine.admission_readbacks == 1
+
+
+def test_batched_matches_serial_prefix_cache():
+    """Prefix-cache hits ride the shared sweep (row_start guards their
+    shared pages from the scatter): identical tokens AND identical
+    prefill-compute accounting, including same-step repeated prompts
+    hitting the promissory insert."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(17)
+    common = list(rng.integers(0, CONFIG.vocab_size, 19))
+    fresh = list(rng.integers(0, CONFIG.vocab_size, 9))
+
+    def submit(engine):
+        engine.submit(common, 6)
+        engine.run()  # seed the cache (drained before the compared run)
+        rids = [engine.submit(common, 4)]          # cache hit
+        rids.append(engine.submit(fresh, 5))       # miss, same step
+        rids.append(engine.submit(common[:12], 4))  # partial-prefix hit
+        return rids
+
+    serial, batched, (es, eb) = _serve_both(
+        params, None, submit=submit, slots=3, page_size=4, prompt_bucket=8,
+        chunk=4, prefix_cache=True,
+    )
+    _assert_identical(serial, batched)
+    assert es.prefill_tokens == eb.prefill_tokens
+    assert es.prefix.hits == eb.prefix.hits
+
+
+def test_batched_same_step_repeated_prompt_shares_pages():
+    """Two identical prompts admitted in the SAME step share the first
+    row's pages through the promissory insert — the sweep's chunk order
+    writes them before the second row's chunks read them."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    prompt = list(range(2, 21))  # 19 tokens, 4 full pages
+    outs = {}
+    for batched in (False, True):
+        engine = ServeEngine(
+            params, CONFIG, slots=2, page_size=4, prompt_bucket=8, chunk=4,
+            prefix_cache=True, batched_admission=batched,
+        )
+        r1, r2 = engine.submit(prompt, 5), engine.submit(prompt, 5)
+        served = engine.run()
+        outs[batched] = (served[r1], served[r2], engine.prefill_tokens,
+                         engine.prefix.hits)
+    assert outs[False] == outs[True]
+    assert outs[True][3] >= 4  # the second row really hit
+
+
+def test_batched_matches_serial_multi_lora():
+    """Per-row adapter indices ride the sweep as data: every tenant gets
+    its serial tokens, including base (adapter-less) rows in the same
+    sweep as adapted ones."""
+    from workloads.multi_lora import synthetic_adapters
+
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    adapters = synthetic_adapters(CONFIG, 2, rank=4, seed=21)
+    names = [None] + sorted(adapters)
+    requests = _mixed_requests(6, CONFIG.vocab_size, rng_seed=23)
+
+    def submit(engine):
+        return [
+            engine.submit(p, n, adapter=names[i % len(names)])
+            for i, (p, n) in enumerate(requests)
+        ]
+
+    serial, batched, _ = _serve_both(
+        params, None, submit=submit, slots=3, page_size=4, prompt_bucket=8,
+        chunk=4, adapters=adapters,
+    )
+    _assert_identical(serial, batched)
+
+
+def test_batched_matches_serial_fanout_groups():
+    """Fan-out groups under batched admission: the first member's sweep
+    row becomes the group's cached logits, later members copy the tail
+    page after the sweep — same tokens, same single prefill."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+
+    def submit(engine):
+        rids = engine.submit_fanout(list(range(2, 12)), 6, n_samples=3)
+        rids += [engine.submit([5, 4, 3, 2], 4)]
+        return rids
+
+    serial, batched, (es, eb) = _serve_both(
+        params, None, submit=submit, slots=4, page_size=4,
+        prompt_bucket=12, chunk=4,
+    )
+    _assert_identical(serial, batched)
+    assert es.prefills_run == eb.prefills_run == 2  # group once + lone req
+    assert eb.ctrl.used_pages == 0
+
+
+def test_batched_matches_serial_speculative():
+    """The draft pools prefill through the same batched sweep; the
+    speculative rounds then commit identical tokens."""
+    draft_config = ModelConfig(
+        max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+        dtype=jnp.float32,
+    )
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    draft = init_params(draft_config, jax.random.PRNGKey(7))
+    requests = _mixed_requests(5, CONFIG.vocab_size, rng_seed=29)
+    serial, batched, _ = _serve_both(
+        params, requests, slots=2, page_size=4, prompt_bucket=8,
+        draft_params=draft, draft_config=draft_config, gamma=3,
+    )
+    _assert_identical(serial, batched)
+
+
+def test_batched_matches_serial_pipelined():
+    """Pipelined stepping composes: freshly admitted rows inject their
+    host-side first token exactly as under serial admission."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    requests = _mixed_requests(6, CONFIG.vocab_size, rng_seed=31)
+    serial, batched, _ = _serve_both(
+        params, requests, slots=2, page_size=4, prompt_bucket=12, chunk=4,
+        pipelined=True,
+    )
+    _assert_identical(serial, batched)
+
+
+def test_batched_instant_retirement_and_backpressure():
+    """max_new_tokens=1 retirements roll their tentative page commitment
+    back and re-plan within the same step (the serial pass's freed-
+    budget-within-a-pass behavior) — same admissions, same tokens,
+    under a pool sized for ~one request."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(37)
+    requests = [
+        (list(rng.integers(0, CONFIG.vocab_size, 7)), 1 if i % 2 else 8)
+        for i in range(6)
+    ]
+    serial, batched, (es, eb) = _serve_both(
+        params, requests, slots=2, page_size=4, prompt_bucket=8, chunk=4,
+        n_pages=8,
+    )
+    _assert_identical(serial, batched)
+    assert eb.ctrl.used_pages == 0
+
+
+def test_batched_matches_serial_eos_at_admission():
+    """A first token that IS the eos retires at admission on both paths
+    (emission folded into the post-readback loop)."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    probe = ServeEngine(params, CONFIG, slots=1, page_size=4, prompt_bucket=8)
+    rid = probe.submit([1, 2, 3], 1)
+    eos = probe.run()[rid][0]  # the token the prompt emits first
+    requests = [([1, 2, 3], 10), ([4, 5, 6], 6)]
+
+    def submit(engine):
+        return [
+            engine.submit(p, n, eos_token=eos) for p, n in requests
+        ]
+
+    serial, batched, _ = _serve_both(
+        params, None, submit=submit, slots=2, page_size=4, prompt_bucket=8,
+        chunk=4,
+    )
+    _assert_identical(serial, batched)
+    assert len(serial[list(serial)[0]]) == 1  # really retired at admission
+
+
+def test_batched_matches_serial_under_tp_mesh():
+    """The explicitly-sharded TP chunked-prefill program family emits
+    the same tokens as serial TP admission."""
+    from workloads.train import make_mesh
+
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    mesh = make_mesh(2, model_parallel=2)
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    requests = _mixed_requests(4, CONFIG.vocab_size, rng_seed=41, p_hi=16)
+    serial, batched, (es, eb) = _serve_both(
+        params, requests, slots=2, page_size=4, prompt_bucket=8, chunk=4,
+        mesh=mesh,
+    )
+    _assert_identical(serial, batched)
+    assert eb.prefill_sweeps > 0
+
+
+def test_completed_ring_bounded_and_drainable():
+    """engine.completed is a bounded deque under ``completed_limit`` and
+    drain_completed() hands the window back — the unbounded-growth fix."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8, chunk=4,
+        completed_limit=3,
+    )
+    for i in range(5):
+        engine.submit([1 + i, 2, 3], 2)
+    engine.run()
+    assert len(engine.completed) == 3  # oldest two evicted by maxlen
+    drained = engine.drain_completed()
+    assert len(drained) == 3 and len(engine.completed) == 0
+    # Unbounded default still collects everything.
+    engine2 = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8, chunk=4,
+    )
+    for i in range(4):
+        engine2.submit([1 + i, 2], 2)
+    engine2.run()
+    assert len(engine2.completed) == 4

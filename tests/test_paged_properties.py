@@ -8,9 +8,14 @@ These are pure host-side structures (no jax), so hundreds of random
 op sequences run in milliseconds — the control-plane complement of
 tests/test_serve_fuzz.py's compute-path sweep."""
 
-from hypothesis import given, settings, strategies as st
+import pytest
 
-from workloads.paged import PagePool, PrefixCache
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from workloads.paged import PagePool, PrefixCache  # noqa: E402
 
 N_PAGES, PAGE_SIZE = 12, 4
 

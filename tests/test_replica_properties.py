@@ -5,9 +5,14 @@ invariants for ALL inputs: completeness, membership, no-double-spend of
 replica IDs, correctness of the uniqueness verdict, and determinism.
 """
 
-from hypothesis import given, settings, strategies as st
+import pytest
 
-from tpu_device_plugin.replica import (
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from tpu_device_plugin.replica import (  # noqa: E402
     AllocationError,
     prioritize_devices,
     strip_replica,

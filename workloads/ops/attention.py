@@ -42,12 +42,10 @@ _STATS_LANES = 128
 # Shared by all three kernels: batch*heads and the outer block axis fan out
 # across cores; the innermost axis is the sequential accumulation walk the
 # VMEM scratch carries state across.
-_SEQ_INNER_SEMANTICS = pltpu.CompilerParams(
-    dimension_semantics=(
-        pltpu.GridDimensionSemantics.PARALLEL,
-        pltpu.GridDimensionSemantics.PARALLEL,
-        pltpu.GridDimensionSemantics.ARBITRARY,
-    ),
+from .pallas_compat import ARBITRARY, PARALLEL, dimension_semantics_params
+
+_SEQ_INNER_SEMANTICS = dimension_semantics_params(
+    PARALLEL, PARALLEL, ARBITRARY
 )
 
 

@@ -50,6 +50,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .attention import NEG_INF, _STATS_LANES, _check_gqa, _default_interpret
+from .pallas_compat import ARBITRARY, PARALLEL, dimension_semantics_params
 
 
 def _paged_decode_kernel(
@@ -274,12 +275,7 @@ def paged_attention(
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(
-                pltpu.GridDimensionSemantics.PARALLEL,
-                pltpu.GridDimensionSemantics.ARBITRARY,
-            ),
-        ),
+        compiler_params=dimension_semantics_params(PARALLEL, ARBITRARY),
         interpret=interpret,
     )(tables.astype(jnp.int32), lengths.astype(jnp.int32), q, k_pages, v_pages)
     return out

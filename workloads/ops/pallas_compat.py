@@ -1,0 +1,31 @@
+"""Version-tolerant aliases for the Pallas TPU compiler-params API.
+
+The kernels declare grid dimension semantics (which axes fan out across
+cores vs. walk sequentially) through an API JAX has renamed twice:
+newer releases spell it ``pltpu.CompilerParams`` with a
+``GridDimensionSemantics`` enum, while the pinned 0.4.x line spells it
+``pltpu.TPUCompilerParams`` taking plain strings.  Resolving the names
+HERE — once, at import time — keeps every kernel module importable on
+either line; without it, 16 test modules fail collection with an
+``AttributeError`` before a single test runs.
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+# The params dataclass: new name first, old name as the fallback.
+CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+# Dimension-semantics values: the enum where it exists, the strings the
+# old dataclass accepts otherwise.
+_GRID_ENUM = getattr(pltpu, "GridDimensionSemantics", None)
+PARALLEL = _GRID_ENUM.PARALLEL if _GRID_ENUM is not None else "parallel"
+ARBITRARY = _GRID_ENUM.ARBITRARY if _GRID_ENUM is not None else "arbitrary"
+
+
+def dimension_semantics_params(*semantics) -> "CompilerParams":
+    """CompilerParams carrying the given dimension semantics (each one
+    of the PARALLEL/ARBITRARY aliases above), built against whichever
+    API this JAX exposes."""
+    return CompilerParams(dimension_semantics=tuple(semantics))

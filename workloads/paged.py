@@ -1103,6 +1103,7 @@ def paged_prefill_chunk(
     cover_pages: int,
     emit: bool,
     lora=None,
+    row_start: jax.Array | None = None,
 ):
     """CHUNKED prefill: one fixed-width slice of a long prompt through
     the paged pools — prompts longer than a single prefill bucket are
@@ -1127,7 +1128,30 @@ def paged_prefill_chunk(
     every chunk and selects per row where ``start <= length-1 < start+C``
     (pinned by tests).  emit=False skips the unembed entirely.
 
+    ``row_start`` ([batch] int32 pages, traced) marks table columns
+    BEFORE each row's own start as already written — typically by the
+    prefix cache, whose adopted pages may be SHARED with other live
+    sequences.  Reads still see them (the gather uses the real pages);
+    only the chunk's scatter-back redirects those columns to the trash
+    page, so a ragged multi-row sweep where rows skip different cached
+    depths can never rewrite a shared physical page.  The recomputed
+    values would be identical bytes — the guard is about write traffic
+    into shared pages, not correctness of the values.
+
     Returns (logits | None, pools); pools are DONATED."""
+    return _prefill_chunk_core(
+        params, pools, tables, chunk_tokens, lengths, config, start_page,
+        cover_pages, emit, lora=lora, row_start=row_start,
+    )
+
+
+def _prefill_chunk_core(
+    params, pools, tables, chunk_tokens, lengths, config, start_page,
+    cover_pages, emit, lora=None, row_start=None,
+):
+    """paged_prefill_chunk's body, un-jitted so the tensor-parallel path
+    can re-jit it with explicit shardings (workloads/tp_serve.py
+    make_tp_prefill_chunk — the batched-admission sweep under a mesh)."""
     k_pages, v_pages = pools
     batch, C = chunk_tokens.shape
     page_size = k_pages.shape[3]
@@ -1169,10 +1193,18 @@ def paged_prefill_chunk(
             params["unembed"], jnp.float32
         )
 
-    # Scatter back ONLY the pages this chunk wrote (its own columns).
+    # Scatter back ONLY the pages this chunk wrote (its own columns);
+    # with row_start, columns a row already has cached k/v for redirect
+    # to the trash page (they may be SHARED — reads used them above).
+    t_write = t_cov
+    if row_start is not None:
+        col = jnp.arange(t_cov.shape[1])[None, :]
+        t_write = jnp.where(
+            col < row_start.astype(jnp.int32)[:, None], trash, t_cov
+        )
     return logits, (
-        _scatter_view(k_pages, view[:, 0], t_cov, page_size, start_page),
-        _scatter_view(v_pages, view[:, 1], t_cov, page_size, start_page),
+        _scatter_view(k_pages, view[:, 0], t_write, page_size, start_page),
+        _scatter_view(v_pages, view[:, 1], t_write, page_size, start_page),
     )
 
 

@@ -2,13 +2,15 @@
 
 One process, one chip, many requests: ``ServeEngine`` holds a fixed set
 of batch SLOTS (static shapes — nothing ever recompiles as traffic
-changes), admits pending requests into free slots with a per-row prefill,
-decodes every occupied slot in page-size CHUNKS (one device dispatch per
-chunk, not per token), and retires finished sequences mid-stream — a new
-request takes over the slot at the next chunk boundary instead of waiting
-for the whole batch to drain.  That slot turnover is continuous batching,
-and it is what makes a mixed-length request stream sustain higher
-throughput than lockstep admission batches (pinned by tests).
+changes), admits pending requests into free slots with a BATCHED ragged
+prefill (every admission in a step rides one multi-row sweep and one
+fused first-token readback — see _admit), decodes every occupied slot in
+page-size CHUNKS (one device dispatch per chunk, not per token), and
+retires finished sequences mid-stream — a new request takes over the
+slot at the next chunk boundary instead of waiting for the whole batch
+to drain.  That slot turnover is continuous batching, and it is what
+makes a mixed-length request stream sustain higher throughput than
+lockstep admission batches (pinned by tests).
 
 The compute path is per-row throughout: per-row positions, per-row
 lengths in the Pallas paged-attention kernel, per-row true-length logits
@@ -65,6 +67,7 @@ from .paged import (
     paged_decode_chunk,
     paged_decode_step,
     paged_prefill,
+    paged_prefill_chunk,
     table_array,
 )
 
@@ -114,9 +117,10 @@ class ServeEngine:
     """Continuous-batching serving engine over the paged KV cache.
 
     Static once constructed: ``slots`` batch rows, a ``prompt_bucket``
-    prefill width, a ``chunk`` decode length, and a page pool.  Exactly
-    three programs compile (prefill, chunk, first-token sampler) no
-    matter how requests arrive, finish, or interleave.
+    prefill width, a ``chunk`` decode length, and a page pool.  A fixed
+    program set compiles (the [slots]-row prefill sweep per chunk index,
+    the decode chunk, the fused first-token sampler) no matter how
+    requests arrive, finish, or interleave.
 
     Pass ``mesh`` (a ("data", "model") Mesh with data degree 1) to serve
     tensor-parallel across chips: params and page pools shard over the
@@ -148,6 +152,8 @@ class ServeEngine:
         prefix_cache: bool = False,
         adapters: dict[str, list] | None = None,
         lora_alpha: float = 1.0,
+        batched_admission: bool = True,
+        completed_limit: int | None = None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -271,18 +277,28 @@ class ServeEngine:
         self._slot_commit: dict[int, int] = {}
         # Fan-out groups (submit_fanout): gid -> admission bookkeeping.
         self._groups: dict[str, dict] = {}
+        # Batched admission (the default): all admissions in one step()
+        # coalesce into a single multi-row prefill sweep and ONE fused
+        # first-token readback; False keeps the serial one-dispatch-per-
+        # admission path (the parity/bench reference).
+        self.batched_admission = batched_admission
         # Telemetry for benchmarking and tests.
         self.chunks_run = 0
         self.generated_tokens = 0
         self.prefills_run = 0
         self.prefill_tokens = 0  # prompt tokens actually forwarded
+        self.prefill_sweeps = 0  # batched-admission sweeps executed
+        self.prefill_dispatches = 0  # TARGET prefill program dispatches
+        self.admission_readbacks = 0  # first-token host syncs
         self.spec_rounds = 0
         # Finished Request objects, in retirement order, carrying their
         # t_submit/t_first/t_done latency stamps — the TTFT/e2e source
         # for the bench and tests.  Tiny host objects, but unbounded for
-        # an unbounded stream: long-running callers should drain it
-        # (e.g. ``engine.completed.clear()``) between measurement windows.
-        self.completed: list[Request] = []
+        # an unbounded stream unless ``completed_limit`` bounds the
+        # deque; long-running callers should either set the limit or
+        # drain it (``engine.drain_completed()``) between measurement
+        # windows.
+        self.completed: deque[Request] = deque(maxlen=completed_limit)
         # Pipelined stepping: the not-yet-read previous chunk (device
         # tokens + the slot->request snapshot at dispatch) and the
         # device-chained last-token array; speculative rounds keep their
@@ -302,9 +318,34 @@ class ServeEngine:
             )
 
         self._first_token = first_token
+
+        @jax.jit
+        def first_token_batch(logits, keys, temperature, top_k, top_p):
+            # The FUSED admission sampler: one decision per row of
+            # [slots, vocab] logits under that row's OWN key — vmapping
+            # the single-row sampler keeps every row's draw bit-identical
+            # to the serial path's per-request sample_logits call
+            # (random primitives commute with vmap over keys; pinned by
+            # the batched-admission parity tests).
+            if sampling:
+                return jax.vmap(
+                    lambda lg, kk: sample_logits(
+                        lg[None], kk, temperature, top_k, top_p
+                    )[0]
+                )(logits, keys)
+            return sample_logits(logits, None, temperature, top_k, top_p)
+
+        self._first_token_batch = first_token_batch
         self._mesh = mesh
         if mesh is None:
             self._prefill = partial(paged_prefill, config=self.config)
+            self._prefill_chunk = partial(
+                paged_prefill_chunk, config=self.config
+            )
+            if draft_params is not None:
+                self._d_prefill_chunk = partial(
+                    paged_prefill_chunk, config=self.draft_config
+                )
             self._chunk = partial(
                 paged_decode_chunk, config=self.config, chunk=self.chunk,
                 sampling=self.sampling,
@@ -351,6 +392,20 @@ class ServeEngine:
                 self._prefill, self._chunk = _wrap(tp_prefill), _wrap(tp_chunk)
             else:
                 self._prefill, self._chunk = tp_prefill, tp_chunk
+            # Batched-admission sweep under the mesh: the chunked prefill
+            # program family with the SAME explicit shardings as the
+            # batch-1 prefill (params by param_specs, pools by the
+            # kv-heads cut, batch axis replicated).
+            from .tp_serve import make_tp_prefill_chunk
+
+            self._prefill_chunk = make_tp_prefill_chunk(
+                self.config, mesh, lora_stacked=self._stacked_adapters,
+                lora_alpha=self.lora_alpha,
+            )
+            if draft_params is not None:
+                self._d_prefill_chunk = make_tp_prefill_chunk(
+                    draft_config, mesh
+                )
             self.params, self.pools = shard_serving_state(
                 self.params, self.pools, self.config, mesh
             )
@@ -516,13 +571,12 @@ class ServeEngine:
         self._adapter_idx[slot] = 0
         return req
 
-    def _admit_group_member(self, req: Request, seq, n: int) -> jax.Array:
-        """Admit one fan-out member: fork the group's shared full prompt
-        pages read-only; the FIRST member runs the prefill and the group
-        caches its logits and retains its partial tail page, so later
-        members just copy that one page and reuse the logits — shared
-        memory AND shared compute.  Returns the member's first-token
-        logits."""
+    def _group_admit_pages(self, req: Request, seq, n: int):
+        """The page bookkeeping every fan-out member needs at admission
+        (shared by serial and batched admission, so the two paths cannot
+        drift): allocate the group's shared pages once, fork them
+        read-only into the member's table, cover the partial tail.
+        Returns (group dict, shared token count)."""
         g = self._groups[req.group]
         shared = (n // self.page_size) * self.page_size
         gseq = ("group", req.group)
@@ -535,6 +589,69 @@ class ServeEngine:
                 self._extend_evicting(seq, n)
         else:  # prompt shorter than one page: nothing shareable
             self._allocate_evicting(seq, n)
+        return g, shared
+
+    def _group_cleanup(self, gid: str) -> None:
+        """Drop a fully-admitted group's bookkeeping: the retained tail
+        page and the group's own table — pages stay alive through the
+        members' refcounts."""
+        g = self._groups[gid]
+        if g.get("tail_page") is not None:
+            self.ctrl.release_page(g["tail_page"])
+        if g["allocated"]:
+            self.ctrl.release(("group", gid))
+        del self._groups[gid]
+
+    def _group_member_done(self, g: dict, gid: str) -> None:
+        """Post-admission group countdown (shared by both paths): after
+        the last member admits, clean the group up."""
+        g["members_left"] -= 1
+        if g["members_left"] == 0:
+            self._group_cleanup(gid)
+
+    def _prefix_admit_pages(self, req: Request, seq, n: int, aidx: int) -> int:
+        """Prefix-cache admission bookkeeping (shared by serial and
+        batched admission): look the prompt up under the adapter salt,
+        adopt any hit pages and extend past them (or allocate fresh),
+        and register the prompt's full pages in the index.  The insert
+        happens BEFORE the prefill runs — promissory — which is
+        behaviorally identical in both paths: nothing can look the pages
+        up until after this admission's prefill has written them (serial
+        prefills inline before the next lookup; the batched sweep's
+        chunk order writes every column before a later row's chunks
+        read it).  Returns the row's start page (0 on a miss)."""
+        # Adapter-salted prefix keys: the cached pages hold ADAPTED k/v,
+        # so the same tokens under different adapters must never share
+        # pages.
+        salt = f"lora:{aidx}" if aidx else ""
+        shared_pages = []
+        if self.prefix is not None:
+            # Cap hits to (a) leave >= 1 prompt token computed (the
+            # last position's logits feed the first sample) and (b)
+            # a bucket-aligned page count, so the partial prefill
+            # reuses the chunked programs' static shapes.
+            bp = self.prompt_bucket // self.page_size
+            cap = (n - 1) // self.page_size // bp * bp
+            shared_pages = self.prefix.lookup(
+                req.prompt, cap, granularity=bp, salt=salt
+            )
+        if shared_pages:
+            self.ctrl.adopt(seq, shared_pages)
+            self._extend_evicting(seq, n)
+        else:
+            self._allocate_evicting(seq, n)
+        if self.prefix is not None:
+            self.prefix.insert(req.prompt, self.ctrl.tables[seq], salt=salt)
+        return len(shared_pages)
+
+    def _admit_group_member(self, req: Request, seq, n: int) -> jax.Array:
+        """Admit one fan-out member: fork the group's shared full prompt
+        pages read-only; the FIRST member runs the prefill and the group
+        caches its logits and retains its partial tail page, so later
+        members just copy that one page and reuse the logits — shared
+        memory AND shared compute.  Returns the member's first-token
+        logits."""
+        g, shared = self._group_admit_pages(req, seq, n)
         table = table_array(
             [self.ctrl.tables[seq]], self.max_pages, fill=self.ctrl.trash
         )
@@ -560,14 +677,7 @@ class ServeEngine:
                     self.d_pools = copy_page(
                         self.d_pools, g["tail_page"], dst
                     )
-        g["members_left"] -= 1
-        if g["members_left"] == 0:
-            # Pages stay alive through the members' refcounts.
-            if g.get("tail_page") is not None:
-                self.ctrl.release_page(g["tail_page"])
-            if g["allocated"]:
-                self.ctrl.release(gseq)
-            del self._groups[req.group]
+        self._group_member_done(g, req.group)
         return logits
 
     def _run_prefill(
@@ -595,7 +705,7 @@ class ServeEngine:
             )
         logits, pools = self._prefill_into(
             self.params, self.config, self.pools, self._prefill, table,
-            prompt_tokens, start_page, lora,
+            prompt_tokens, start_page, lora, count=True,
         )
         if self.d_pools is not None:
             _, self.d_pools = self._prefill_into(
@@ -607,7 +717,7 @@ class ServeEngine:
 
     def _prefill_into(
         self, params, config, pools, prefill_program, table, prompt_tokens,
-        start_page: int = 0, lora=None,
+        start_page: int = 0, lora=None, count: bool = False,
     ):
         n = len(prompt_tokens)
         B = self.prompt_bucket
@@ -626,6 +736,8 @@ class ServeEngine:
         # untouched.
         lora_kw = {} if lora is None else {"lora": lora}
         if start_page == 0 and n <= B:
+            if count:
+                self.prefill_dispatches += 1
             prompt = np.zeros((1, B), np.int32)
             prompt[0, :n] = prompt_tokens
             return prefill_program(
@@ -640,6 +752,8 @@ class ServeEngine:
         n_chunks = -(-n // B)
         logits = None
         for ci in range(start_page // bucket_pages, n_chunks):
+            if count:
+                self.prefill_dispatches += 1
             start = ci * B
             chunk = np.zeros((1, B), np.int32)
             width = min(B, n - start)
@@ -652,11 +766,49 @@ class ServeEngine:
             )
         return logits, pools
 
+    def drain_completed(self) -> list[Request]:
+        """Hand back (and clear) the finished-request telemetry ring —
+        the API long-running callers use between measurement windows so
+        ``completed`` never grows with the stream."""
+        out = list(self.completed)
+        self.completed.clear()
+        return out
+
     def _admit(self) -> list[Request]:
-        """Fill free slots from the pending queue: allocate pages for the
-        true prompt, prefill (one compiled batch-1 call per admission),
-        sample the first token.  Returns requests that finished AT
-        admission (max_new_tokens == 1 or instant EOS)."""
+        """Fill free slots from the pending queue.
+
+        The default BATCHED path coalesces every admission this step
+        into one multi-row prefill sweep plus one fused first-token
+        readback (plan -> sweep -> finish below); the serial path (one
+        compiled batch-1 prefill dispatch and one ``int(token)``
+        round-trip PER admission) remains as the parity and bench
+        reference.  Both return the requests that finished AT admission
+        (max_new_tokens == 1 or instant EOS), with bit-identical token
+        streams (same per-request RNG key order; pinned by tests)."""
+        if not self.batched_admission:
+            return self._admit_serial()
+        finished: list[Request] = []
+        used: set[int] = set()
+        while True:
+            plans = self._plan_admissions(used)
+            if not plans:
+                return finished
+            used.update(p["slot"] for p in plans)
+            emitted = self._sweep_prefill(plans)
+            batch_finished, retry = self._finish_admissions(plans, emitted)
+            finished += batch_finished
+            if not retry:
+                return finished
+            # An at-admission retirement released its tentative page
+            # commitment — requests the budget deferred may now fit, on
+            # slots this pass has not touched (the serial loop's
+            # freed-budget-within-a-pass behavior, which the plan cannot
+            # see before the readback).
+
+    def _admit_serial(self) -> list[Request]:
+        """Serial admission: allocate pages for the true prompt, prefill
+        (one compiled batch-1 call per admission), sample the first
+        token with a per-request readback."""
         finished = []
         for slot in range(self.slots):
             if self._occupied[slot] or not self.pending:
@@ -676,38 +828,15 @@ class ServeEngine:
             if req.group is not None:
                 logits = self._admit_group_member(req, seq, n)
             else:
-                # Adapter-salted prefix keys: the cached pages hold
-                # ADAPTED k/v, so the same tokens under different
-                # adapters must never share pages.
-                salt = f"lora:{aidx}" if aidx else ""
-                shared_pages = []
-                if self.prefix is not None:
-                    # Cap hits to (a) leave >= 1 prompt token computed (the
-                    # last position's logits feed the first sample) and (b)
-                    # a bucket-aligned page count, so the partial prefill
-                    # reuses the chunked programs' static shapes.
-                    bp = self.prompt_bucket // self.page_size
-                    cap = (n - 1) // self.page_size // bp * bp
-                    shared_pages = self.prefix.lookup(
-                        req.prompt, cap, granularity=bp, salt=salt
-                    )
-                if shared_pages:
-                    self.ctrl.adopt(seq, shared_pages)
-                    self._extend_evicting(seq, n)
-                else:
-                    self._allocate_evicting(seq, n)
+                start_page = self._prefix_admit_pages(req, seq, n, aidx)
                 table = table_array(
                     [self.ctrl.tables[seq]], self.max_pages,
                     fill=self.ctrl.trash,
                 )
                 logits, self.pools = self._run_prefill(
-                    table, req.prompt, start_page=len(shared_pages),
+                    table, req.prompt, start_page=start_page,
                     adapter_idx=aidx,
                 )
-                if self.prefix is not None:
-                    self.prefix.insert(
-                        req.prompt, self.ctrl.tables[seq], salt=salt
-                    )
             tok = int(
                 self._first_token(
                     logits, self._next_key(),
@@ -715,6 +844,7 @@ class ServeEngine:
                     jnp.float32(self.top_p),
                 )[0]
             )
+            self.admission_readbacks += 1
             req.tokens.append(tok)
             req.t_first = time.perf_counter()  # first token, queue wait included
             self.generated_tokens += 1
@@ -735,6 +865,248 @@ class ServeEngine:
             self._positions[slot] = n
             self._tokens[slot] = tok
         return finished
+
+    # ---- batched admission: plan -> sweep -> finish ---------------------
+
+    def _plan_admissions(self, used: set) -> list[dict]:
+        """The PLAN half of batched admission: scan the pending queue in
+        the serial loop's exact order (free slots ascending, FIFO queue,
+        break on the first request the page budget defers) doing every
+        piece of host-side bookkeeping — worst-case page commitment,
+        prefix-cache lookup/adopt, fan-out group forks, table
+        construction — but NO device work.  ``used`` excludes slots this
+        step's earlier rounds already admitted into (the serial pass
+        touches each slot once).
+
+        Returns one plan dict per admissible request; the commitment is
+        taken TENTATIVELY here and rolled back in _finish_admissions for
+        requests that retire at admission (where the serial path simply
+        never commits)."""
+        plans: list[dict] = []
+        for slot in range(self.slots):
+            if slot in used or self._occupied[slot] or not self.pending:
+                continue
+            head = self.pending[0]
+            need = self._worst_case_pages(len(head.prompt), head.max_new_tokens)
+            if self._committed_pages + need > self.ctrl.n_pages:
+                # Not enough uncommitted budget yet; admission is FIFO
+                # (no queue-jumping by smaller requests — starvation-free
+                # beats marginally fuller slots).
+                break
+            req = self.pending.popleft()
+            seq = self._seq_id(slot, req)
+            n = len(req.prompt)
+            plan = {
+                "slot": slot, "req": req, "seq": seq, "n": n,
+                "aidx": self._adapter_ids.get(req.adapter, 0),
+                "need": need, "start_page": 0, "prefill": True,
+                "logits_from": None, "tail_copy": None, "group_done": None,
+            }
+            if req.group is not None:
+                self._plan_group_member(req, seq, n, plan)
+            else:
+                plan["start_page"] = self._prefix_admit_pages(
+                    req, seq, n, plan["aidx"]
+                )
+            self._committed_pages += need
+            plans.append(plan)
+        return plans
+
+    def _plan_group_member(self, req: Request, seq, n: int, plan: dict):
+        """Fan-out bookkeeping for one planned member (the plan-phase
+        split of the serial _admit_group_member): fork the group's
+        shared full prompt pages read-only; the FIRST member joins the
+        prefill sweep and the group caches its logits row and retains
+        its partial tail page, so later members just schedule a
+        one-page copy and reuse the cached logits."""
+        g, shared = self._group_admit_pages(req, seq, n)
+        if g.get("logits") is None and "logits_slot" not in g:
+            # First member: its sweep row becomes the group's cached
+            # logits (resolved post-sweep in _finish_admissions).
+            g["logits_slot"] = plan["slot"]
+            if n > shared:
+                tail = self.ctrl.tables[seq][-1]
+                self.ctrl.retain_page(tail)
+                g["tail_page"] = tail
+        else:
+            plan["prefill"] = False
+            plan["logits_from"] = g
+            if n > shared:
+                plan["tail_copy"] = (
+                    g["tail_page"], self.ctrl.tables[seq][-1]
+                )
+        g["members_left"] -= 1
+        if g["members_left"] == 0:
+            # Cleanup is DEFERRED to _finish_admissions (after the tail
+            # copies): releasing the retained tail page here could free
+            # it before the copy reads it.
+            plan["group_done"] = req.group
+
+    def _sweep_prefill(self, plans: list[dict]):
+        """The EXECUTE half: stack this round's prefilling rows into one
+        ragged [slots, bucket] batch and drive paged_prefill_chunk over
+        a shared page-aligned sweep — emit on every chunk, each row's
+        true-last-position logits selected where its prompt actually
+        ends (the kernel layer's documented multi-row calling
+        convention).  Rows with prefix-cache hits ride the same sweep:
+        ``row_start`` guards their shared cached pages from the
+        scatter-back while their remainder chunks read them.  The
+        speculative draft pools run the same sweep (no emit, no LoRA).
+
+        Returns the per-slot emitted logits buffer ([slots, vocab]), or
+        None when no planned row needs prefill (pure group-logit
+        reuse)."""
+        rows = [p for p in plans if p["prefill"]]
+        if not rows:
+            return None
+        # A lone admission still rides the [slots, B] sweep: dead rows
+        # compute on trash tables exactly as parked rows do in every
+        # decode chunk (occupancy is data, not shape) — one program to
+        # warm, and the warmup a single submitted request performs
+        # covers the multi-admission steps behind it.  Callers who are
+        # compute-bound at low load keep batched_admission=False.
+        B, ps, S = self.prompt_bucket, self.page_size, self.slots
+        bp = B // ps
+        lengths = np.zeros(S, np.int32)
+        starts = np.zeros(S, np.int32)
+        tables = np.full((S, self.max_pages), self.ctrl.trash, np.int32)
+        for p in rows:
+            s = p["slot"]
+            lengths[s] = p["n"]
+            starts[s] = p["start_page"]
+            t = self.ctrl.tables[p["seq"]]
+            tables[s, : len(t)] = t
+            self.prefills_run += 1
+            self.prefill_tokens += p["n"] - p["start_page"] * ps
+        # A chunk index is dispatched only if some row's UNCACHED span
+        # covers it (start_page//bp <= ci < ceil(n/B)); indices covered
+        # solely by cached prefixes or already-finished rows are skipped
+        # outright — a hit row batched with a miss row keeps its
+        # prefix-cache compute saving (riding rows inside an active
+        # chunk still recompute, value-identically, writes trashed).
+        active = sorted(
+            {
+                ci
+                for p in rows
+                for ci in range(p["start_page"] // bp, -(-p["n"] // B))
+            }
+        )
+        tables_dev = jnp.asarray(tables)
+        lengths_dev = jnp.asarray(lengths)
+        row_start = jnp.asarray(starts)
+        lora = None
+        if self._stacked_adapters is not None:
+            aidx = np.zeros(S, np.int32)
+            for p in rows:
+                aidx[p["slot"]] = p["aidx"]
+            lora = (self._stacked_adapters, jnp.asarray(aidx), self.lora_alpha)
+        emitted = jnp.zeros((S, self.config.vocab_size), jnp.float32)
+        self.prefill_sweeps += 1
+        for ci in active:
+            start = ci * B
+            chunk = np.zeros((S, B), np.int32)
+            for p in rows:
+                width = min(B, p["n"] - start)
+                if width > 0:
+                    chunk[p["slot"], :width] = p["req"].prompt[
+                        start : start + width
+                    ]
+            logits, self.pools = self._prefill_chunk(
+                self.params, self.pools, tables_dev, jnp.asarray(chunk),
+                lengths_dev, start_page=ci * bp, cover_pages=(ci + 1) * bp,
+                emit=True, lora=lora, row_start=row_start,
+            )
+            self.prefill_dispatches += 1
+            # Per-row emit selection: a row's last true position falls
+            # in this chunk iff start < length <= start + B.
+            emit_mask = (lengths > start) & (lengths <= start + B)
+            emitted = jnp.where(jnp.asarray(emit_mask)[:, None], logits, emitted)
+            if self.d_pools is not None:
+                _, self.d_pools = self._d_prefill_chunk(
+                    self.draft_params, self.d_pools, tables_dev,
+                    jnp.asarray(chunk), lengths_dev, start_page=ci * bp,
+                    cover_pages=(ci + 1) * bp, emit=False,
+                    row_start=row_start,
+                )
+        return emitted
+
+    def _finish_admissions(
+        self, plans: list[dict], emitted
+    ) -> tuple[list[Request], bool]:
+        """The FINISH half: resolve group logits rows out of the sweep
+        buffer, run the deferred tail-page copies and group cleanups,
+        sample EVERY row's first token in one fused call under
+        per-request keys (drawn in the serial path's slot order, so the
+        engine RNG stream is identical), read the whole batch back ONCE,
+        then apply emission and at-admission retirement per request.
+
+        Returns (requests finished at admission, whether a retirement
+        rolled back its tentative page commitment — the signal for
+        _admit to re-plan deferred requests)."""
+        if emitted is None:
+            emitted = jnp.zeros(
+                (self.slots, self.config.vocab_size), jnp.float32
+            )
+        # Cache the first member's logits row on its group, then splice
+        # reuse rows into the buffer.
+        for p in plans:
+            if p["prefill"] and p["req"].group is not None:
+                g = self._groups[p["req"].group]
+                if g.get("logits_slot") == p["slot"]:
+                    g["logits"] = emitted[p["slot"]][None]
+                    del g["logits_slot"]
+        for p in plans:
+            if not p["prefill"]:
+                emitted = emitted.at[p["slot"]].set(p["logits_from"]["logits"][0])
+        for p in plans:
+            if p["tail_copy"] is not None:
+                src, dst = p["tail_copy"]
+                self.pools = copy_page(self.pools, src, dst)
+                if self.d_pools is not None:
+                    self.d_pools = copy_page(self.d_pools, src, dst)
+        for p in plans:
+            if p["group_done"] is not None:
+                self._group_cleanup(p["group_done"])
+        # One key per admitted request, in slot order — the exact
+        # _next_key() sequence the serial path draws.
+        key_rows = {p["slot"]: self._next_key() for p in plans}
+        zero_key = jnp.zeros_like(self._rng)
+        keys = jnp.stack(
+            [key_rows.get(s, zero_key) for s in range(self.slots)]
+        )
+        toks = np.asarray(
+            self._first_token_batch(
+                emitted, keys, jnp.float32(self.temperature),
+                jnp.int32(self.top_k), jnp.float32(self.top_p),
+            )
+        )  # the ONE first-token readback for the whole admission batch
+        self.admission_readbacks += 1
+        finished, retry = [], False
+        for p in plans:
+            slot, req, seq = p["slot"], p["req"], p["seq"]
+            tok = int(toks[slot])
+            req.tokens.append(tok)
+            req.t_first = time.perf_counter()  # first token, queue wait included
+            self.generated_tokens += 1
+            if req.max_new_tokens == 1 or tok == req.eos_token:
+                req.done = True
+                req.t_done = req.t_first
+                self.ctrl.release(seq)
+                self._committed_pages -= p["need"]  # tentative roll-back
+                finished.append(req)
+                self.completed.append(req)
+                retry = True
+                continue
+            self._slot_req[slot] = req
+            self._occupied[slot] = True
+            self._adapter_idx[slot] = p["aidx"]
+            self._fresh_slots.add(slot)
+            self._slot_commit[slot] = p["need"]
+            table = self.ctrl.tables[seq]
+            self._tables[slot, : len(table)] = table
+            self._positions[slot] = p["n"]
+            self._tokens[slot] = tok
+        return finished, retry
 
     def _dev(self, mirror: np.ndarray) -> jax.Array:
         """A host mirror crossing into a dispatch, COPIED first: on the

@@ -42,7 +42,12 @@ except ImportError:  # pragma: no cover - older jax
 from .generate import decode_step, init_kv_cache
 from .model import ModelConfig, param_specs
 from .ops.paged_attention import paged_attention
-from .paged import _chunk_core, _prefill_core, _spec_round_core
+from .paged import (
+    _chunk_core,
+    _prefill_chunk_core,
+    _prefill_core,
+    _spec_round_core,
+)
 
 
 def _check_tp(config: ModelConfig, mesh: Mesh) -> int:
@@ -220,6 +225,85 @@ def make_tp_serve_programs(
         )
 
     return tp_prefill, tp_chunk
+
+
+def make_tp_prefill_chunk(
+    config: ModelConfig, mesh: Mesh, lora_stacked=None, lora_alpha: float = 1.0,
+):
+    """Tensor-parallel CHUNKED prefill for the batched-admission sweep:
+    the ragged multi-row paged_prefill_chunk under the SAME explicit
+    shardings as the batch-1 prefill program — params by param_specs,
+    pools by the kv-heads cut, the batch/tables/tokens axes replicated.
+
+    Returns ``call(params, pools, tables, chunk_tokens, lengths, *,
+    start_page, cover_pages, emit, lora=None, row_start=None)`` with the
+    module-level paged_prefill_chunk's keyword interface (minus the
+    config, baked in).  One pjit program compiles per static
+    (start_page, cover_pages, emit) triple — the same compile family the
+    single-device jit's static args produce.  With ``lora_stacked``
+    (multi-tenant LoRA) every call must pass ``lora=(stacked, idx,
+    alpha)``; the per-row index array rides replicated (adapter indices
+    are data, not shape)."""
+    _check_tp(config, mesh)
+    param_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs(config)
+    )
+    pool_sh = NamedSharding(mesh, _POOL_SPEC)
+    rep = lambda *axes: NamedSharding(mesh, P(*axes))  # noqa: E731
+    lora_sh = (
+        ()
+        if lora_stacked is None
+        else (jax.tree.map(lambda _: rep(), lora_stacked), rep(None))
+    )
+    programs: dict = {}
+
+    def build(start_page: int, cover_pages: int, emit: bool):
+        in_sh = (
+            param_sh, (pool_sh, pool_sh), rep(None, None), rep(None, None),
+            rep(None), rep(None), *lora_sh,
+        )
+        out_sh = (
+            ((rep(None, None),) if emit else ()) + ((pool_sh, pool_sh),)
+        )
+
+        @partial(
+            jax.jit, donate_argnums=(1,), in_shardings=in_sh,
+            out_shardings=out_sh,
+        )
+        def prog(params, pools, tables, chunk_tokens, lengths, row_start,
+                 *lora_args):
+            lora = (
+                (lora_args[0], lora_args[1], lora_alpha) if lora_args
+                else None
+            )
+            logits, pools = _prefill_chunk_core(
+                params, pools, tables, chunk_tokens, lengths, config,
+                start_page, cover_pages, emit, lora=lora,
+                row_start=row_start,
+            )
+            # A tuple WITHOUT a None leaf either way, so out_shardings
+            # can spec every output explicitly.
+            return ((logits,) if emit else ()) + (pools,)
+
+        return prog
+
+    def call(
+        params, pools, tables, chunk_tokens, lengths, *, start_page,
+        cover_pages, emit, lora=None, row_start=None,
+    ):
+        key = (start_page, cover_pages, emit)
+        if key not in programs:
+            programs[key] = build(*key)
+        if row_start is None:
+            row_start = jnp.zeros(chunk_tokens.shape[0], jnp.int32)
+        lora_ops = () if lora is None else (lora[0], lora[1])
+        out = programs[key](
+            params, pools, tables, chunk_tokens, lengths, row_start,
+            *lora_ops,
+        )
+        return (out[0], out[1]) if emit else (None, out[0])
+
+    return call
 
 
 def make_tp_spec_program(
