@@ -27,7 +27,12 @@ coverage: native
 # tracked metric dropping >2% vs the newest committed BENCH_r*.json
 # prints a WARN (tools/bench_diff.py; the diff never fails the build —
 # but a failing bench.py still fails the target before the diff runs,
-# which a `| tee` pipeline would have swallowed).
+# which a `| tee` pipeline would have swallowed).  A FULL-FIDELITY run
+# (perf fields present, i.e. on the TPU) additionally rewrites
+# docs/bench-builder-latest.json and re-renders README/PARITY/SERVING in
+# the same code path (bench.py render_docs_atomically) — the artifact
+# and the docs that quote it can only move together; partial (off-TPU)
+# runs leave both untouched.  BENCH_SKIP_RENDER=1 opts out.
 bench: native
 	$(PYTHON) bench.py > .bench-latest.json
 	@cat .bench-latest.json

@@ -11,7 +11,10 @@ import pytest
 
 from workloads.perfbench import (
     BenchScale,
+    _publish_ratio_spread,
+    derive_breakeven,
     device_peak_flops,
+    measure_slope_samples,
     measure_slope_secs,
     train_step_flops,
 )
@@ -103,6 +106,50 @@ def test_measure_slope_grows_until_window():
     assert 0.002 < secs < 0.006
 
 
+def test_derive_breakeven():
+    """The break-even derivation: log2 interpolation at the win->lose
+    crossing, a floor at the largest measured batch when every batch
+    wins, 0 when even batch 1 loses."""
+    # Crossing between 2 and 4 at equal distance -> log-midpoint 2.83.
+    assert derive_breakeven([1, 2, 4, 8], [1.3, 1.1, 0.9, 0.7]) == 2.83
+    # Exactly break-even at a measured batch interpolates to it.
+    assert derive_breakeven([1, 2, 4], [1.2, 1.0, 0.8]) == 2.0
+    assert derive_breakeven([1, 2, 4, 8], [1.3, 1.2, 1.1, 1.05]) == 8.0
+    assert derive_breakeven([1, 2], [0.9, 0.7]) == 0.0
+    # Non-monotone noise: the FIRST crossing wins (conservative).
+    assert derive_breakeven([1, 2, 4], [1.1, 0.9, 1.02]) < 2.0
+
+
+def test_publish_ratio_spread_pools_across_runs():
+    """Current samples pool with the prior artifact's persisted ones —
+    a genuinely separate process — and the scope field says which kind
+    of range was published."""
+    out = {}
+    _publish_ratio_spread(out, "r", [1.30, 1.35], None)
+    assert out["r_samples"] == [1.3, 1.35]
+    assert (out["r_min"], out["r_max"]) == (1.3, 1.35)
+    assert out["r_spread_scope"] == "within-run"
+    prior = {"r_samples": [1.2, 1.4, "junk"]}
+    out = {}
+    _publish_ratio_spread(out, "r", [1.30, 1.35], prior)
+    assert (out["r_min"], out["r_max"]) == (1.2, 1.4)
+    assert out["r_spread_scope"] == "pooled-cross-run"
+    # The persisted samples are the CURRENT run's (next round pools them).
+    assert out["r_samples"] == [1.3, 1.35]
+
+
+def test_measure_slope_samples_returns_per_repeat_slopes():
+    def run_chain(n):
+        time.sleep(0.05 + n * 0.02)
+
+    median, samples = measure_slope_samples(
+        run_chain, n_lo=2, n_hi=8, repeats=3, min_window_secs=0.05
+    )
+    assert len(samples) == 3
+    assert min(samples) <= median <= max(samples)
+    assert all(0.01 < s < 0.04 for s in samples)
+
+
 @pytest.mark.slow
 def test_perfbench_tiny_end_to_end():
     """The whole suite runs on CPU at tiny scale and produces the schema
@@ -126,9 +173,39 @@ def test_perfbench_tiny_end_to_end():
         "serve_tokens_per_sec",
         "serve_requests_per_sec",
         "serve_pool_peak_fraction",
+        # Round-6 speculation economics family.
+        "spec_breakeven_batch",
+        "spec_phase_dominant",
+        "spec_phase_tokens_per_round",
+        "spec_draft_ms_b1",
+        "spec_verify_ms_b1",
+        "spec_commit_ms_b1",
+        "spec_phase_ratio_b1",
+        "spec_engine_vs_plain_b1",
+        "spec_engine_vs_plain_b4",
+        "spec_engine_best_k",
+        # Cross-run-poolable ratio spreads.
+        "paged_vs_contiguous_decode_samples",
+        "paged_vs_contiguous_decode_min",
+        "decode_int8_speedup_samples",
+        "flash_vs_xla_speedup_samples",
+        "flash_window_speedup_samples",
     ):
         assert key in out, key
     assert 0.0 < out["serve_pool_peak_fraction"] <= 1.0
+    assert out["spec_phase_dominant"] in ("draft", "verify", "commit")
+    assert out["spec_breakeven_batch"] >= 0.0
+    for b in out["spec_phase_batches"]:
+        assert f"spec_verify_ms_b{b}" in out
+    # No spread pooling source passed -> within-run scope.
+    assert out["paged_vs_contiguous_decode_spread_scope"] == "within-run"
+    # Median-of-medians ratio sits inside the sample range (odd repeat
+    # counts guarantee it; the epsilon absorbs the published rounding).
+    assert (
+        out["paged_vs_contiguous_decode_min"] - 0.001
+        <= out["paged_vs_contiguous_decode"]
+        <= out["paged_vs_contiguous_decode_max"] + 0.001
+    )
     if jax.devices()[0].platform != "tpu":
         assert out["mfu"] is None  # no known peak -> omitted, not guessed
     assert out["train_step_ms"] >= 0

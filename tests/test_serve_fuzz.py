@@ -1,8 +1,11 @@
 """Feature-matrix fuzz for the serving engine: randomized streams
 through randomized engine configurations (prefix cache x pipelined x
-speculative x multi-LoRA x fan-out x eos x chunked prefill), every
-request pinned exactly against the dense reference model it should be
-equivalent to.  Deterministic seeds — failures reproduce."""
+speculative x adaptive spec="auto" x multi-LoRA x fan-out x eos x
+chunked prefill), every request pinned exactly against the dense
+reference model it should be equivalent to — for spec="auto" that means
+bit-identical to the per-regime oracle across mode switches, fan-out /
+LoRA / prefix-cache admissions straddling a switch included.
+Deterministic seeds — failures reproduce."""
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +53,13 @@ def _run_one(seed: int, params, draft, adapters) -> None:
                   # Lookahead supersteps (k rounds per dispatch) must be
                   # emission-invariant for every k.
                   spec_lookahead=int(rng.choice([1, 1, 2, 3])))
+        if rng.integers(2):
+            # Adaptive arm: injected thresholds force always-plain
+            # (0.0), always-spec (slots) and mid-stream switching —
+            # tokens must stay the per-regime oracle's in every case.
+            kw.update(spec="auto", spec_breakeven=float(
+                rng.choice([0.0, 1.0, 1.5, kw["slots"]])
+            ))
     else:
         # chunk != page_size exercises the overshoot/boundary accounting.
         kw["chunk"] = int(kw["page_size"] * rng.choice([1, 2]))

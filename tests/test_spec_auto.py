@@ -1,0 +1,198 @@
+"""Adaptive speculation (ServeEngine(spec="auto")): the engine keeps
+both decode programs resident and dispatches speculative vs plain per
+step from live slot occupancy against the break-even threshold.  Pins:
+the mode actually switches when occupancy crosses the threshold; token
+parity with the dense oracle across switches (fan-out, LoRA and
+prefix-cache admissions straddling a switch, pipelined and lookahead
+compositions); the threshold extremes reduce to the pure per-regime
+engines; the startup calibration path; and the constructor contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+DRAFT_CONFIG = ModelConfig(
+    max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def models():
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    draft = init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
+    return params, draft
+
+
+def _engine(params, draft, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prompt_bucket", 8)
+    return ServeEngine(
+        params, CONFIG, draft_params=draft, draft_config=DRAFT_CONFIG,
+        gamma=3, spec="auto", **kw,
+    )
+
+
+def _ref(model, prompt, new):
+    return [int(t) for t in np.asarray(generate(
+        model, jnp.asarray([prompt], jnp.int32), CONFIG, max_new_tokens=new,
+    )[0])]
+
+
+def test_mode_switches_when_occupancy_crosses_threshold(models):
+    """slots=3, threshold 1.5: three concurrent requests decode plainly;
+    retirements drop occupancy to 1 and the engine flips to speculation.
+    The per-step trace must agree with the policy at every step, and
+    every stream with the dense oracle in both regimes."""
+    params, draft = models
+    engine = _engine(params, draft, slots=3, spec_breakeven=1.5)
+    expected = {}
+    for prompt, new in (([5, 6, 7], 24), ([1, 2], 6), ([9], 4)):
+        expected[engine.submit(prompt, new)] = (prompt, new)
+    out = engine.run()
+    assert engine.plain_mode_steps > 0, "never decoded above the threshold"
+    assert engine.spec_mode_steps > 0, "never decoded below the threshold"
+    assert engine.mode_switches >= 1
+    for occ, mode in engine.decode_mode_trace:
+        assert (mode == "spec") == (occ <= 1.5), (occ, mode)
+    for rid, (prompt, new) in expected.items():
+        assert list(out[rid]) == _ref(params, prompt, new), rid
+
+
+def test_threshold_extremes_reduce_to_the_pure_engines(models):
+    """breakeven=0 never speculates (spec_rounds stays 0); breakeven=
+    slots always does (no plain chunks after admission) — and both emit
+    the same oracle stream."""
+    params, draft = models
+    prompts = [([1, 2, 3], 8), ([4, 5], 8)]
+
+    def run(breakeven):
+        engine = _engine(params, draft, slots=2, spec_breakeven=breakeven)
+        rids = [engine.submit(p, n) for p, n in prompts]
+        out = engine.run()
+        return engine, [list(out[r]) for r in rids]
+
+    never, toks_never = run(0.0)
+    assert never.spec_mode_steps == 0 and never.spec_rounds == 0
+    assert never.plain_mode_steps > 0 and never.chunks_run > 0
+    always, toks_always = run(2.0)
+    assert always.plain_mode_steps == 0 and always.chunks_run == 0
+    assert always.spec_mode_steps > 0 and always.spec_rounds > 0
+    assert toks_never == toks_always
+    for (prompt, new), got in zip(prompts, toks_never):
+        assert got == _ref(params, prompt, new)
+
+
+def test_admissions_straddling_a_switch(models):
+    """Fan-out, LoRA and prefix-cache admissions land on BOTH sides of
+    mode switches (pipelined, so the boundary drains real in-flight
+    state); every stream still matches its merged-model oracle."""
+    from workloads.lora import merge_lora
+    from workloads.multi_lora import synthetic_adapters
+
+    params, draft = models
+    adapters = synthetic_adapters(CONFIG, 2, rank=4, scale=0.3, seed=3)
+    name = sorted(adapters)[0]
+    engine = ServeEngine(
+        params, CONFIG, draft_params=draft, draft_config=DRAFT_CONFIG,
+        gamma=3, spec="auto", spec_breakeven=1.0, slots=2, page_size=4,
+        prompt_bucket=8, prefix_cache=True, adapters=adapters,
+        pipelined=True,
+    )
+    prefix = list(range(10, 22))
+    expected = {}
+    # r1 outlasts every other request by several chunks, so the tail
+    # decodes it ALONE — dispatches genuinely below the threshold, not
+    # just drained there.
+    r1 = engine.submit(prefix + [1], 40)
+    expected[r1] = (prefix + [1], 40, None)
+    r2 = engine.submit(prefix + [2], 8, adapter=name)
+    expected[r2] = (prefix + [2], 8, name)
+    for rid in engine.submit_fanout([3, 4, 5], 6, n_samples=2):
+        expected[rid] = ([3, 4, 5], 6, None)
+    out = engine.run()
+    assert set(out) == set(expected)
+    assert engine.mode_switches >= 1, "the stream never crossed the threshold"
+    merged = merge_lora(params, adapters[name], dtype=jnp.float32)
+    for rid, (prompt, new, adapter) in expected.items():
+        model = merged if adapter else params
+        assert list(out[rid]) == _ref(model, prompt, new), rid
+
+
+def test_lookahead_composes_with_auto(models):
+    """spec_lookahead > 1 under auto: supersteps below the threshold,
+    plain chunks above, same oracle tokens."""
+    params, draft = models
+    engine = _engine(
+        params, draft, slots=2, spec_breakeven=1.0, spec_lookahead=2,
+        pipelined=True,
+    )
+    expected = {}
+    for prompt, new in (([7, 8, 9], 16), ([2, 3], 6)):
+        expected[engine.submit(prompt, new)] = (prompt, new)
+    out = engine.run()
+    for rid, (prompt, new) in expected.items():
+        assert list(out[rid]) == _ref(params, prompt, new), rid
+    assert engine.spec_mode_steps > 0 and engine.plain_mode_steps > 0
+
+
+def test_tp_auto_matches_greedy(models):
+    """spec="auto" under tensor parallelism: both TP programs (the
+    decode chunk and make_tp_spec_superstep) dispatch by occupancy on
+    the model mesh, and the mixed stream still matches plain greedy."""
+    from workloads.train import make_mesh
+
+    params, draft = models
+    mesh = make_mesh(2, model_parallel=2)
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+        draft_params=draft, draft_config=DRAFT_CONFIG, gamma=3,
+        mesh=mesh, pipelined=True, spec="auto", spec_breakeven=1.0,
+    )
+    requests = [([1, 2, 3, 4], 14), ([5, 6], 6)]
+    rids = [engine.submit(p, n) for p, n in requests]
+    served = engine.run()
+    for rid, (p, n) in zip(rids, requests):
+        assert list(served[rid]) == _ref(params, p, n), rid
+    assert engine.spec_mode_steps > 0 and engine.plain_mode_steps > 0
+    assert engine.ctrl.used_pages == 0
+
+
+def test_calibration_path(models):
+    """No injected threshold: the engine calibrates at its first decode
+    step (binary verdict at its own static shape), records the timings,
+    and the stream is still the oracle's."""
+    params, draft = models
+    engine = _engine(params, draft, slots=2)
+    assert engine.spec_breakeven is None
+    rid = engine.submit([1, 2, 3], 6)
+    out = engine.run()
+    assert engine.spec_breakeven in (0.0, 2.0)
+    assert engine.spec_calibration is not None
+    assert engine.spec_calibration["threshold"] == engine.spec_breakeven
+    assert engine.spec_calibration["plain_dispatch_ms"] > 0
+    assert engine.spec_calibration["spec_dispatch_ms"] > 0
+    assert list(out[rid]) == _ref(params, [1, 2, 3], 6)
+
+
+def test_auto_contract(models):
+    params, draft = models
+    with pytest.raises(ValueError, match="spec"):
+        ServeEngine(params, CONFIG, spec="auto")
+    with pytest.raises(ValueError, match="spec"):
+        ServeEngine(
+            params, CONFIG, draft_params=draft, draft_config=DRAFT_CONFIG,
+            spec="bogus",
+        )
+    with pytest.raises(ValueError, match="spec_breakeven"):
+        ServeEngine(
+            params, CONFIG, draft_params=draft, draft_config=DRAFT_CONFIG,
+            spec_breakeven=2.0,
+        )

@@ -44,6 +44,7 @@ TRACKED_UP = [
     "prefix_serve_speedup",
     "spec_serve_tokens_per_sec",
     "spec_serve_lookahead_tokens_per_sec",
+    "spec_engine_vs_plain_b1",
     "aggregate_chip_busy_fraction",
     "aggregate_tokens_per_sec",
 ]

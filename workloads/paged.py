@@ -1054,6 +1054,108 @@ def _spec_round_core(
     return committed, n, new_cur, new_pos, t_pools, d_pools
 
 
+# ---- per-phase speculation economics probes ---------------------------
+#
+# A speculative round is three phases — DRAFT (gamma+1 cheap-weight
+# decode steps), VERIFY (one rowwise block forward through the target),
+# COMMIT (the accept/correct bookkeeping) — and the round's economics
+# flip sign with batch because the phases scale differently: the draft
+# and verify weight STREAMS are batch-independent while the verify
+# COMPUTE grows with rows x (gamma+1).  These probes isolate each phase
+# as its own chainable dispatch so the perf bench can time them
+# separately across batch shapes and derive the measured break-even
+# (workloads/perfbench.py measure_spec_phases); they mirror
+# _spec_round_core's phases operation-for-operation, so their sum tracks
+# the fused round.
+
+
+@partial(
+    jax.jit, static_argnames=("d_config", "gamma", "cover_pages"),
+    donate_argnums=(1,),
+)
+def paged_spec_draft_phase(
+    d_params: dict,
+    d_pools: tuple[jax.Array, jax.Array],
+    tables: jax.Array,
+    cur: jax.Array,
+    positions: jax.Array,
+    d_config: ModelConfig,
+    gamma: int,
+    cover_pages: int | None = None,
+):
+    """JUST the draft phase of a speculative round: gamma+1 chained
+    draft decode steps from ``cur`` at per-row ``positions`` (the extra
+    step writes the final proposal's k/v, exactly as the fused round
+    does).  Returns (drafts [batch, gamma], last [batch], d_pools);
+    chain timing loops on ``last`` (data-dependent, so dispatches
+    serialize) with ``positions`` held fixed (the same cache slots are
+    rewritten, bounding state for arbitrarily long chains).  Pools are
+    DONATED."""
+    if cover_pages is not None:
+        tables = tables[:, :cover_pages]
+
+    def draft_one(carry, i):
+        d_pools, tok = carry
+        logits, d_pools = _decode_core(
+            d_params, d_pools, tables, tok, positions + i, d_config
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (d_pools, nxt), nxt
+
+    (d_pools, last), proposals = jax.lax.scan(
+        draft_one, (d_pools, cur), jnp.arange(gamma + 1)
+    )
+    drafts = jnp.transpose(proposals, (1, 0))[:, :gamma]
+    return drafts, last, d_pools
+
+
+@partial(
+    jax.jit, static_argnames=("t_config", "cover_pages"), donate_argnums=(1,)
+)
+def paged_spec_verify_phase(
+    t_params: dict,
+    t_pools: tuple[jax.Array, jax.Array],
+    tables: jax.Array,
+    block: jax.Array,
+    positions: jax.Array,
+    t_config: ModelConfig,
+    cover_pages: int | None = None,
+):
+    """JUST the verify phase: one rowwise block forward scoring
+    ``block`` [batch, gamma+1] through the TARGET (its weights stream
+    once — the phase whose compute grows with batch x gamma while its
+    stream saving does not).  Returns (picks [batch, gamma+1], t_pools);
+    chain timing feeds ``picks`` back as the next block.  Pools are
+    DONATED."""
+    if cover_pages is not None:
+        tables = tables[:, :cover_pages]
+    t_logits, t_pools = _rowwise_block_core(
+        t_params, t_pools, tables, block, positions, t_config
+    )
+    return jnp.argmax(t_logits, axis=-1).astype(jnp.int32), t_pools
+
+
+@jax.jit
+def spec_commit_phase(drafts: jax.Array, picks: jax.Array):
+    """JUST the commit phase: the greedy accept/correct bookkeeping —
+    longest agreeing prefix per row, correction spliced at its own n
+    (identical ops to the fused round's commit).  Returns (committed
+    [batch, gamma+1], n [batch]); chain timing feeds
+    ``committed[:, :gamma]`` back as the next drafts."""
+    batch, gamma = drafts.shape
+    agree = drafts == picks[:, :-1]
+    n = jnp.argmin(
+        jnp.concatenate([agree, jnp.zeros((batch, 1), bool)], axis=1), axis=1
+    ).astype(jnp.int32)
+    committed = jnp.concatenate(
+        [drafts, jnp.zeros((batch, 1), jnp.int32)], axis=1
+    )
+    committed = committed.at[jnp.arange(batch), n].set(
+        picks[jnp.arange(batch), n]
+    )
+    return committed, n
+
+
 @partial(jax.jit, static_argnames=("config",), donate_argnums=(1,))
 def paged_prefill(
     params: dict,

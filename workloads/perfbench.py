@@ -55,6 +55,41 @@ def device_peak_flops() -> float | None:
     return None
 
 
+def measure_slope_samples(
+    run_chain,
+    n_lo: int,
+    n_hi: int,
+    repeats: int = 3,
+    min_window_secs: float = 0.25,
+    max_n: int = 4096,
+) -> tuple[float, list[float]]:
+    """measure_slope_secs, additionally returning the per-repeat slope
+    SAMPLES (floored at 1e-9 like the median) — callers pair two arms'
+    samples index-for-index into per-repeat ratios, which persist in the
+    bench artifact so the next run can pool a genuinely cross-process
+    spread (VERDICT r5 weak #2: within-run ranges understated cross-run
+    drift)."""
+    import statistics
+
+    while True:
+        run_chain(n_lo)  # warm: compile + any one-time transfer
+        run_chain(n_hi)
+        slopes, windows = [], []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            run_chain(n_lo)
+            t_lo = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            run_chain(n_hi)
+            t_hi = time.perf_counter() - t0
+            slopes.append((t_hi - t_lo) / (n_hi - n_lo))
+            windows.append(t_hi - t_lo)
+        if statistics.median(windows) >= min_window_secs or n_hi >= max_n:
+            samples = [max(s, 1e-9) for s in slopes]
+            return max(statistics.median(slopes), 1e-9), samples
+        n_lo, n_hi = n_lo * 2, n_hi * 2
+
+
 def measure_slope_secs(
     run_chain,
     n_lo: int,
@@ -73,24 +108,9 @@ def measure_slope_secs(
     window dwarfs that jitter — fast iterations need long chains before
     the slope rises above it.  Each (n_lo, n_hi) pair is warmed untimed
     first so per-length compilation never lands inside a timed window."""
-    import statistics
-
-    while True:
-        run_chain(n_lo)  # warm: compile + any one-time transfer
-        run_chain(n_hi)
-        slopes, windows = [], []
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            run_chain(n_lo)
-            t_lo = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            run_chain(n_hi)
-            t_hi = time.perf_counter() - t0
-            slopes.append((t_hi - t_lo) / (n_hi - n_lo))
-            windows.append(t_hi - t_lo)
-        if statistics.median(windows) >= min_window_secs or n_hi >= max_n:
-            return max(statistics.median(slopes), 1e-9)
-        n_lo, n_hi = n_lo * 2, n_hi * 2
+    return measure_slope_samples(
+        run_chain, n_lo, n_hi, repeats, min_window_secs, max_n
+    )[0]
 
 
 @dataclass(frozen=True)
@@ -111,6 +131,11 @@ class BenchScale:
     decode_lens: tuple[int, int]
     page_size: int
     serve_chunks: tuple[int, int]
+    # Speculation economics: batch shapes for the per-phase breakdown
+    # (draft/verify/commit timed separately at each) and the lookahead
+    # depths the engine-vs-engine arm sweeps for its measured-best k.
+    spec_phase_batches: tuple[int, ...]
+    spec_engine_ks: tuple[int, ...]
 
     @classmethod
     def named(cls, name: str) -> "BenchScale":
@@ -122,6 +147,12 @@ class BenchScale:
                 seq=2048, batch=8, attn_heads=8,
                 attn_seqs=(1024, 2048, 4096), decode_prompt=32,
                 decode_lens=(64, 512), page_size=64, serve_chunks=(1, 8),
+                spec_phase_batches=(1, 2, 4, 8),
+                # k must be large enough that a superstep's committed
+                # tokens rival a plain chunk's (the link amortization the
+                # r05 lookahead measurement proved) — the sweep finds
+                # where the device-side win shows through the RTT.
+                spec_engine_ks=(8, 16, 32),
             )
         if name == "tiny":
             # n_heads=4 so the tensor-parallel cut divides even on the
@@ -131,6 +162,7 @@ class BenchScale:
                 seq=128, batch=2, attn_heads=2,
                 attn_seqs=(128,), decode_prompt=4, decode_lens=(4, 12),
                 page_size=4, serve_chunks=(1, 3),
+                spec_phase_batches=(1, 2), spec_engine_ks=(2,),
             )
         raise ValueError(f"unknown bench scale {name!r} (full|tiny)")
 
@@ -221,8 +253,9 @@ def measure_train(scale: BenchScale) -> dict:
     }
 
 
-def _time_attention_grad(attn_fn, q, k, v) -> float:
-    """Per-call seconds of value+grad through ``attn_fn(q, k, v)``.
+def _time_attention_grad(attn_fn, q, k, v) -> tuple[float, list[float]]:
+    """Per-call seconds of value+grad through ``attn_fn(q, k, v)`` —
+    (median, per-repeat samples).
 
     The whole n-iteration chain runs device-side in one ``lax.fori_loop``
     dispatch (grad feeds back into q, so iterations cannot be elided or
@@ -247,13 +280,14 @@ def _time_attention_grad(attn_fn, q, k, v) -> float:
             chains[n] = chain
         return float(chains[n](q, k, v)[0, 0, 0, 0])
 
-    return measure_slope_secs(run_chain, n_lo=4, n_hi=16)
+    return measure_slope_samples(run_chain, n_lo=4, n_hi=16)
 
 
 def measure_flash_vs_xla(scale: BenchScale) -> dict:
     """flash_attention (Pallas fwd + Pallas bwd) vs the dense masked
     XLA core it replaces, fwd+bwd, per sequence length.  Identical
-    chain/slope timing on both sides."""
+    chain/slope timing on both sides; per-repeat ratio samples ride
+    along so the headline speedup carries a poolable spread."""
     head_dim = 128
     results = {}
     for seq in scale.attn_seqs:
@@ -263,12 +297,15 @@ def measure_flash_vs_xla(scale: BenchScale) -> dict:
             mask = jnp.tril(jnp.ones((q.shape[1], q.shape[1]), bool))[None, None]
             return masked_attention(q, k, v, mask, head_dim)
 
-        t_flash = _time_attention_grad(flash_attention, q, k, v)
-        t_dense = _time_attention_grad(dense, q, k, v)
+        t_flash, flash_s = _time_attention_grad(flash_attention, q, k, v)
+        t_dense, dense_s = _time_attention_grad(dense, q, k, v)
         results[seq] = {
             "flash_ms": round(t_flash * 1000, 3),
             "xla_ms": round(t_dense * 1000, 3),
             "speedup": round(t_dense / t_flash, 3),
+            "speedup_samples": [
+                round(d / f, 3) for d, f in zip(dense_s, flash_s)
+            ],
         }
     return results
 
@@ -294,14 +331,17 @@ def measure_window(scale: BenchScale) -> dict:
             lambda q, k, v: flash_attention(q, k, v, True, window=w), q, k, v
         )
 
-    t_full = timed(None)
-    t_win = timed(window)
+    t_full, full_s = timed(None)
+    t_win, win_s = timed(window)
     return {
         "window_seq": seq,
         "window_size": window,
         "flash_full_ms": round(t_full * 1000, 3),
         "flash_window_ms": round(t_win * 1000, 3),
         "flash_window_speedup": round(t_full / t_win, 3),
+        "flash_window_speedup_samples": [
+            round(f / w, 3) for f, w in zip(full_s, win_s)
+        ],
     }
 
 
@@ -324,7 +364,7 @@ def measure_decode(scale: BenchScale) -> dict:
     )
     lo, hi = scale.decode_lens
 
-    def time_decode(p, batch: int) -> float:
+    def time_decode(p, batch: int) -> tuple[float, list[float]]:
         prompt = jax.random.randint(
             jax.random.PRNGKey(1), (batch, scale.decode_prompt), 0,
             config.vocab_size, jnp.int32,
@@ -336,32 +376,42 @@ def measure_decode(scale: BenchScale) -> dict:
 
         # max_n pins the chain lengths: growing them would recompile and
         # could push prompt+n_new past max_seq_len.
-        return measure_slope_secs(
+        return measure_slope_samples(
             run, n_lo=lo, n_hi=hi, min_window_secs=0.0, max_n=hi
         )
 
-    per_token = time_decode(params, scale.batch)
+    per_token, per_token_s = time_decode(params, scale.batch)
     # The int8 A/B runs at batch 1, where every decode step is a pure
     # weight stream: that is the regime the weight-only quantization
     # exists for (at larger batches per-op overheads hide the saving).
-    lat_fp = time_decode(params, 1)
-    lat_int8 = time_decode(quantize_params(params), 1)
+    lat_fp, fp_s = time_decode(params, 1)
+    lat_int8, int8_s = time_decode(quantize_params(params), 1)
     return {
         "decode_ms_per_token": round(per_token * 1000, 4),
         "decode_tokens_per_sec": round(scale.batch / per_token, 1),
+        "decode_tokens_per_sec_samples": [
+            round(scale.batch / s, 1) for s in per_token_s
+        ],
         "decode_b1_ms_per_token": round(lat_fp * 1000, 4),
         "decode_b1_ms_per_token_int8": round(lat_int8 * 1000, 4),
         "decode_int8_speedup": round(lat_fp / lat_int8, 3),
+        "decode_int8_speedup_samples": [
+            round(f / i, 3) for f, i in zip(fp_s, int8_s)
+        ],
     }
 
 
-def measure_paged_decode(scale: BenchScale) -> dict:
-    """Paged chunked decode (Pallas block-table kernel, one dispatch per
-    page-size chunk) vs the contiguous scan decode at the same batch —
-    the VERDICT round-2 bar: paged must not cost throughput for its
-    allocation-on-demand win.  Greedy, same weights/dtype discipline as
-    measure_decode; per-token seconds from the slope over CHUNK counts
-    (prefill and constant dispatch costs cancel)."""
+def _time_paged_chunks(
+    params, config: ModelConfig, *, batch: int, prompt_len: int,
+    page_size: int, chunk: int, n_lo: int, n_hi: int,
+) -> tuple[float, list[float]]:
+    """Steady-state seconds per paged_decode_chunk dispatch at ``batch``
+    — greedy, slope over CHUNK counts so prefill and constant dispatch
+    costs cancel.  This is the engine's ACTUAL plain decode program;
+    the helper is shared by measure_paged_decode and
+    measure_spec_phases so the break-even's plain baseline can never
+    drift from the published paged number.  Returns (median secs/chunk,
+    per-repeat samples)."""
     import numpy as np
 
     from .paged import (
@@ -372,15 +422,7 @@ def measure_paged_decode(scale: BenchScale) -> dict:
         table_array,
     )
 
-    config = _model_config(scale)
-    params = jax.tree.map(
-        lambda w: w.astype(config.dtype), init_params(config, jax.random.PRNGKey(0))
-    )
-    batch, ps = scale.batch, scale.page_size
-    chunk = ps
-    lo, hi = scale.serve_chunks
-    prompt_len = scale.decode_prompt
-    max_pages = -(-(prompt_len + 1 + hi * chunk) // ps)
+    max_pages = -(-(prompt_len + 1 + n_hi * chunk) // page_size)
     n_pages = batch * max_pages
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (batch, prompt_len), 0, config.vocab_size,
@@ -391,8 +433,8 @@ def measure_paged_decode(scale: BenchScale) -> dict:
     key = jax.random.PRNGKey(2)
 
     def run_chunks(n_chunks: int) -> float:
-        ctrl = PagePool(n_pages=n_pages, page_size=ps)
-        pools = init_page_pools(config, n_pages, ps)
+        ctrl = PagePool(n_pages=n_pages, page_size=page_size)
+        pools = init_page_pools(config, n_pages, page_size)
         for b in range(batch):
             ctrl.allocate(b, prompt_len)
         tables = table_array(
@@ -420,13 +462,36 @@ def measure_paged_decode(scale: BenchScale) -> dict:
             positions += chunk
         return float(tok[0])
 
-    secs_per_chunk = measure_slope_secs(
-        run_chunks, n_lo=lo, n_hi=hi, min_window_secs=0.0, max_n=hi
+    return measure_slope_samples(
+        run_chunks, n_lo=n_lo, n_hi=n_hi, min_window_secs=0.0, max_n=n_hi
+    )
+
+
+def measure_paged_decode(scale: BenchScale) -> dict:
+    """Paged chunked decode (Pallas block-table kernel, one dispatch per
+    page-size chunk) vs the contiguous scan decode at the same batch —
+    the VERDICT round-2 bar: paged must not cost throughput for its
+    allocation-on-demand win.  Greedy, same weights/dtype discipline as
+    measure_decode; per-token seconds from the slope over CHUNK counts
+    (prefill and constant dispatch costs cancel)."""
+    config = _model_config(scale)
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype), init_params(config, jax.random.PRNGKey(0))
+    )
+    batch, ps = scale.batch, scale.page_size
+    chunk = ps
+    lo, hi = scale.serve_chunks
+    secs_per_chunk, chunk_s = _time_paged_chunks(
+        params, config, batch=batch, prompt_len=scale.decode_prompt,
+        page_size=ps, chunk=chunk, n_lo=lo, n_hi=hi,
     )
     per_token = secs_per_chunk / chunk
     return {
         "paged_decode_ms_per_token": round(per_token * 1000, 4),
         "paged_decode_tokens_per_sec": round(batch / per_token, 1),
+        "paged_decode_tokens_per_sec_samples": [
+            round(batch / (s / chunk), 1) for s in chunk_s
+        ],
         "paged_page_size": ps,
     }
 
@@ -930,6 +995,340 @@ def measure_spec_economics(scale: BenchScale) -> dict:
     return results
 
 
+def measure_spec_phases(scale: BenchScale) -> dict:
+    """WHY the speculative win flips sign with batch (VERDICT r5 weak #4
+    feeding missing #1): a round's three phases — DRAFT (gamma+1
+    cheap-weight decode steps through the int8 self-draft), VERIFY (one
+    dense target block forward), COMMIT (the accept bookkeeping) — timed
+    device-side in ISOLATION at each batch shape via chained dispatches
+    (paged.paged_spec_draft_phase / paged_spec_verify_phase /
+    spec_commit_phase mirror the fused round op-for-op, so their sum
+    tracks it), next to the engine's actual plain decode program
+    (paged_decode_chunk) at the same batch.  The draft and verify
+    WEIGHT STREAMS are batch-independent
+    while the verify COMPUTE grows with rows x (gamma+1) — these fields
+    show which phase eats the win as batch grows, and from
+    (tokens/round x plain_step / round) per batch the bench derives the
+    measured break-even batch: the occupancy threshold
+    ``ServeEngine(spec="auto")`` consumes (``spec_breakeven_batch``)."""
+    import numpy as np
+
+    from .paged import (
+        PagePool,
+        init_page_pools,
+        paged_prefill,
+        paged_spec_draft_phase,
+        paged_spec_round_chained,
+        paged_spec_verify_phase,
+        spec_commit_phase,
+        table_array,
+    )
+    from .quant import quantize_params
+
+    gamma = 4
+    prompt_len = 32
+    k_count = 8  # synced acceptance-counting rounds (budget must cover)
+    ps = scale.page_size
+    batches = tuple(scale.spec_phase_batches)
+    chunk_lo, chunk_hi = scale.serve_chunks
+    budget = prompt_len + max(
+        chunk_hi * ps + ps + 1, (k_count + 2) * (gamma + 1)
+    )
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
+        n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=-(-budget // ps) * ps,
+    )
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype),
+        init_params(config, jax.random.PRNGKey(0)),
+    )
+    draft = quantize_params(params)
+    cover = -(-config.max_seq_len // ps)
+
+    def state(batch: int):
+        """Prefilled pools/tables with the full budget allocated, the
+        measure_spec_economics pattern: the phase chains hold positions
+        FIXED (rewriting the same slots), so any chain length fits."""
+        n_pages = batch * cover
+        ctrl = PagePool(n_pages=n_pages, page_size=ps)
+        pools = init_page_pools(config, n_pages, ps)
+        d_pools = init_page_pools(config, n_pages, ps)
+        for b in range(batch):
+            ctrl.allocate(b, config.max_seq_len)
+        tables = table_array(
+            [ctrl.tables[b] for b in range(batch)], cover, fill=ctrl.trash
+        )
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, prompt_len), 0,
+            config.vocab_size, jnp.int32,
+        )
+        lengths = jnp.full((batch,), prompt_len, jnp.int32)
+        logits, pools = paged_prefill(
+            params, pools, tables, prompt, lengths, config
+        )
+        _, d_pools = paged_prefill(
+            draft, d_pools, tables, prompt, lengths, config
+        )
+        cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        pos = jnp.full((batch,), prompt_len, jnp.int32)
+        return pools, d_pools, tables, cur, pos
+
+    def plain_step_secs(batch: int) -> float:
+        """Per-token seconds of the engine's ACTUAL plain decode program
+        — the shared _time_paged_chunks helper (measure_paged_decode's
+        methodology), NOT the contiguous-cache generate scan: this
+        break-even feeds ServeEngine(spec="auto")'s dispatch policy, so
+        both sides of the ratio must be the programs the engine
+        dispatches (the generate baseline would fold the
+        paged-vs-contiguous factor into the threshold)."""
+        secs_per_chunk, _ = _time_paged_chunks(
+            params, config, batch=batch, prompt_len=prompt_len,
+            page_size=ps, chunk=ps, n_lo=chunk_lo, n_hi=chunk_hi,
+        )
+        return secs_per_chunk / ps
+
+    out: dict = {
+        "spec_phase_gamma": gamma,
+        "spec_phase_batches": list(batches),
+        "spec_phase_draft": "int8-self",
+    }
+    phase_ms: dict[str, dict[int, float]] = {
+        "draft": {}, "verify": {}, "commit": {},
+    }
+    ratios: dict[int, float] = {}
+    tokens_per_round = None
+    for batch in batches:
+        pools, d_pools, tables, cur, pos = state(batch)
+        if tokens_per_round is None:
+            # tokens/round from measured acceptance, counted once at the
+            # smallest batch (acceptance is per-row draft/target
+            # agreement — batch shape does not move it).
+            occ = jnp.ones((batch,), bool)
+            accepted = []
+            c, p = cur, pos
+            for _ in range(k_count):
+                _, n, c, p, pools, d_pools = paged_spec_round_chained(
+                    params, draft, pools, d_pools, tables, c, p, occ,
+                    t_config=config, d_config=config, gamma=gamma,
+                    cover_pages=cover,
+                )
+                accepted.append(np.asarray(n))
+            tokens_per_round = float(np.mean(accepted)) + 1.0
+            # Fresh state: the counting pass advanced positions.
+            pools, d_pools, tables, cur, pos = state(batch)
+
+        boxes = {"d_pools": d_pools, "t_pools": pools, "cur": cur}
+
+        def draft_chain(n: int) -> float:
+            c = boxes["cur"]
+            for _ in range(n):
+                _, c, boxes["d_pools"] = paged_spec_draft_phase(
+                    draft, boxes["d_pools"], tables, c, pos,
+                    d_config=config, gamma=gamma, cover_pages=cover,
+                )
+            boxes["cur"] = c
+            return float(c[0])
+
+        block0 = jnp.zeros((batch, gamma + 1), jnp.int32)
+        vbox = {"block": block0}
+
+        def verify_chain(n: int) -> float:
+            b = vbox["block"]
+            for _ in range(n):
+                b, boxes["t_pools"] = paged_spec_verify_phase(
+                    params, boxes["t_pools"], tables, b, pos,
+                    t_config=config, cover_pages=cover,
+                )
+            vbox["block"] = b
+            return float(b[0, 0])
+
+        picks0 = jnp.zeros((batch, gamma + 1), jnp.int32)
+        cbox = {"drafts": jnp.zeros((batch, gamma), jnp.int32)}
+
+        def commit_chain(n: int) -> float:
+            d = cbox["drafts"]
+            for _ in range(n):
+                committed, _ = spec_commit_phase(d, picks0)
+                d = committed[:, :gamma]
+            cbox["drafts"] = d
+            return float(d[0, 0])
+
+        phase_ms["draft"][batch] = measure_slope_secs(
+            draft_chain, n_lo=4, n_hi=12
+        ) * 1000
+        phase_ms["verify"][batch] = measure_slope_secs(
+            verify_chain, n_lo=4, n_hi=12
+        ) * 1000
+        phase_ms["commit"][batch] = measure_slope_secs(
+            commit_chain, n_lo=4, n_hi=12
+        ) * 1000
+        round_ms = sum(phase_ms[ph][batch] for ph in phase_ms)
+        plain_ms = plain_step_secs(batch) * 1000
+        # tokens/sec through speculation over tokens/sec plain, at this
+        # batch: batch cancels, leaving tokens/round x plain/round.
+        ratios[batch] = tokens_per_round * plain_ms / max(round_ms, 1e-9)
+        out[f"spec_draft_ms_b{batch}"] = round(phase_ms["draft"][batch], 3)
+        out[f"spec_verify_ms_b{batch}"] = round(phase_ms["verify"][batch], 3)
+        out[f"spec_commit_ms_b{batch}"] = round(phase_ms["commit"][batch], 3)
+        out[f"spec_phase_plain_step_ms_b{batch}"] = round(plain_ms, 4)
+        out[f"spec_phase_ratio_b{batch}"] = round(ratios[batch], 3)
+    out["spec_phase_tokens_per_round"] = round(tokens_per_round, 2)
+    bs = list(batches)
+    out["spec_breakeven_batch"] = derive_breakeven(bs, [ratios[b] for b in bs])
+    # The phase that eats the win: largest absolute ms growth from the
+    # smallest to the largest measured batch.
+    out["spec_phase_dominant"] = max(
+        phase_ms, key=lambda ph: phase_ms[ph][bs[-1]] - phase_ms[ph][bs[0]]
+    )
+    return out
+
+
+def derive_breakeven(batches: list[int], ratios: list[float]) -> float:
+    """The measured break-even batch from per-batch spec/plain ratios:
+    the occupancy at which speculation's tokens/sec crosses the plain
+    path's, log2-interpolated between the last winning and first losing
+    batch.  All batches winning reports the largest measured batch (a
+    ">= max" floor, not a claim beyond the sweep); none winning reports
+    0 (never speculate)."""
+    import math
+
+    if ratios[0] < 1.0:
+        return 0.0
+    if all(r >= 1.0 for r in ratios):
+        return float(batches[-1])
+    j = next(
+        i for i in range(len(batches) - 1)
+        if ratios[i] >= 1.0 and ratios[i + 1] < 1.0
+    )
+    x0, x1 = math.log2(batches[j]), math.log2(batches[j + 1])
+    t = (ratios[j] - 1.0) / (ratios[j] - ratios[j + 1])
+    return round(2 ** (x0 + t * (x1 - x0)), 2)
+
+
+def measure_spec_engine(scale: BenchScale, breakeven: float) -> dict:
+    """ENGINE vs ENGINE (VERDICT r5 missing #1: two rounds of
+    speculative machinery never reached the composed serving default):
+    ``ServeEngine(spec="auto")`` — int8 self-draft, lookahead at the
+    measured-best k from a swept candidate set — against the plain
+    engine on the SAME request stream, at slots=1 (below break-even:
+    auto speculates) and slots=4 (above: auto dispatches the plain
+    decode program, so the default never pays the losing regime).
+    Greedy, pipelined on both sides (each arm at its best dispatch
+    amortization); interleaved repeats, median-of-pairs with spread.
+    The engines' own mode telemetry rides along as proof that auto
+    engaged below the threshold and fell back above it."""
+    import statistics
+
+    from .quant import quantize_params
+    from .serve import ServeEngine
+
+    gamma = 4
+    ps = scale.page_size
+    prompt_len = scale.decode_prompt
+    ks = tuple(scale.spec_engine_ks)
+    # Enough generation per request for several supersteps at the
+    # deepest k (and several chunks for the plain arm).
+    max_new = max(4 * (gamma + 1) * max(ks), 2 * ps)
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
+        n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=prompt_len + max_new + 1,
+    )
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype),
+        init_params(config, jax.random.PRNGKey(0)),
+    )
+    draft = quantize_params(params)
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(7), (prompt_len,), 0, config.vocab_size, jnp.int32
+    )]
+    bucket = -(-prompt_len // ps) * ps
+    mode_steps: dict[int, tuple[int, int]] = {}
+
+    def stream(engine, n_req: int) -> float:
+        engine.submit(prompt, max_new)  # warm every compile at full depth
+        engine.run()
+        before = engine.generated_tokens
+        t0 = time.perf_counter()
+        for _ in range(n_req):
+            engine.submit(prompt, max_new)
+        engine.run()
+        return (engine.generated_tokens - before) / (
+            time.perf_counter() - t0
+        )
+
+    def plain(slots: int) -> float:
+        engine = ServeEngine(
+            params, config, slots=slots, page_size=ps, chunk=ps,
+            prompt_bucket=bucket, pipelined=True,
+        )
+        return stream(engine, 3 * slots)
+
+    def auto(slots: int, k: int) -> float:
+        engine = ServeEngine(
+            params, config, slots=slots, page_size=ps, chunk=ps,
+            prompt_bucket=bucket, pipelined=True, draft_params=draft,
+            draft_config=config, gamma=gamma, spec="auto",
+            spec_breakeven=breakeven, spec_lookahead=k,
+        )
+        rate = stream(engine, 3 * slots)
+        # Captured per call; the sweep keeps only the winning k's counts
+        # (published next to that k's headline ratio — they must
+        # describe the same configuration).
+        mode_steps[slots] = (engine.spec_mode_steps, engine.plain_mode_steps)
+        return rate
+
+    # slots=1: sweep k, each candidate interleaved with its own plain
+    # runs (back-to-back pairs under the same link drift).
+    best = None
+    for k in ks:
+        plain_s, auto_s = _interleaved_repeats(
+            lambda: plain(1), lambda: auto(1, k),
+            repeats=2 if len(ks) > 1 else 3,
+        )
+        pairs = [a / max(p, 1e-9) for p, a in zip(plain_s, auto_s)]
+        cand = {
+            "k": k,
+            "rate": statistics.median(auto_s),
+            "plain": statistics.median(plain_s),
+            "pairs": pairs,
+            "mode_steps": mode_steps[1],
+        }
+        if best is None or cand["rate"] > best["rate"]:
+            best = cand
+    mode_steps[1] = best["mode_steps"]
+    b4_plain_s, b4_auto_s = _interleaved_repeats(
+        lambda: plain(4), lambda: auto(4, best["k"])
+    )
+    b4_pairs = [a / max(p, 1e-9) for p, a in zip(b4_plain_s, b4_auto_s)]
+    return {
+        "spec_engine_vs_plain_b1": round(statistics.median(best["pairs"]), 3),
+        "spec_engine_vs_plain_b1_min": round(min(best["pairs"]), 3),
+        "spec_engine_vs_plain_b1_max": round(max(best["pairs"]), 3),
+        "spec_engine_vs_plain_b4": round(statistics.median(b4_pairs), 3),
+        "spec_engine_vs_plain_b4_min": round(min(b4_pairs), 3),
+        "spec_engine_vs_plain_b4_max": round(max(b4_pairs), 3),
+        "spec_engine_tokens_per_sec_b1": round(best["rate"], 1),
+        "spec_engine_plain_tokens_per_sec_b1": round(best["plain"], 1),
+        "spec_engine_tokens_per_sec_b4": round(
+            statistics.median(b4_auto_s), 1
+        ),
+        "spec_engine_plain_tokens_per_sec_b4": round(
+            statistics.median(b4_plain_s), 1
+        ),
+        "spec_engine_best_k": best["k"],
+        "spec_engine_breakeven": round(float(breakeven), 2),
+        "spec_engine_gamma": gamma,
+        # Auto-mode proof from the engine's own telemetry (last run per
+        # shape): decode steps dispatched speculatively vs plainly.
+        "spec_engine_spec_steps_b1": mode_steps.get(1, (0, 0))[0],
+        "spec_engine_plain_steps_b1": mode_steps.get(1, (0, 0))[1],
+        "spec_engine_spec_steps_b4": mode_steps.get(4, (0, 0))[0],
+        "spec_engine_plain_steps_b4": mode_steps.get(4, (0, 0))[1],
+    }
+
+
 def measure_multi_lora(scale: BenchScale) -> dict:
     """Multi-tenant LoRA serving overhead: the serve loop with requests
     round-robining across 4 rank-16 adapters (per-row activation deltas,
@@ -1071,11 +1470,40 @@ def measure_prefix_serve(scale: BenchScale) -> dict:
     }
 
 
-def run(scale_name: str = "full") -> dict:
+def _publish_ratio_spread(
+    out: dict, key: str, samples: list[float], prior: dict | None
+) -> None:
+    """Persist a headline ratio's per-repeat samples and publish its
+    min–max POOLED with the previous artifact's persisted samples — a
+    genuinely separate process, so the range bounds cross-run drift
+    (VERDICT r5 weak #2: the r05 driver's prefix 1.059 fell below a
+    published within-run min).  When no prior samples exist the range is
+    honestly annotated as within-run."""
+    samples = [round(float(s), 3) for s in samples]
+    out[f"{key}_samples"] = samples
+    prev = [
+        s for s in ((prior or {}).get(f"{key}_samples") or [])
+        if isinstance(s, (int, float))
+    ]
+    pooled = samples + prev
+    if not pooled:
+        return
+    out[f"{key}_min"] = round(min(pooled), 3)
+    out[f"{key}_max"] = round(max(pooled), 3)
+    out[f"{key}_spread_scope"] = (
+        "pooled-cross-run" if prev else "within-run"
+    )
+
+
+def run(scale_name: str = "full", pool_with: dict | None = None) -> dict:
     """The full perf suite as one flat dict (bench.py merges it into the
-    JSON line)."""
+    JSON line).  ``pool_with`` is the previous committed artifact (when
+    parseable): point-valued headline ratios pool their per-repeat
+    samples with its persisted ones so the published min–max spans >= 2
+    fresh processes."""
     scale = BenchScale.named(scale_name)
-    out = measure_train(scale)
+    out = {"perf_scale": scale_name}
+    out.update(measure_train(scale))
     attn = measure_flash_vs_xla(scale)
     # Headline speedup: the largest sequence length measured both ways —
     # where the O(seq^2)-HBM dense path hurts most of what's measured.
@@ -1099,7 +1527,28 @@ def run(scale_name: str = "full") -> dict:
     out.update(measure_prefix_serve(scale))
     out.update(measure_spec_serve(scale))
     out.update(measure_spec_economics(scale))
+    phases = measure_spec_phases(scale)
+    out.update(phases)
+    out.update(
+        measure_spec_engine(scale, breakeven=phases["spec_breakeven_batch"])
+    )
     out.update(measure_multi_lora(scale))
+    for key, samples in (
+        ("flash_vs_xla_speedup", attn[top_seq]["speedup_samples"]),
+        ("flash_window_speedup", out["flash_window_speedup_samples"]),
+        ("decode_int8_speedup", out["decode_int8_speedup_samples"]),
+        (
+            "paged_vs_contiguous_decode",
+            [
+                round(p / d, 3)
+                for p, d in zip(
+                    out["paged_decode_tokens_per_sec_samples"],
+                    out["decode_tokens_per_sec_samples"],
+                )
+            ],
+        ),
+    ):
+        _publish_ratio_spread(out, key, samples, pool_with)
     return out
 
 

@@ -24,9 +24,11 @@ stream), paged memory with on-demand allocation, temperature/top-k/top-p
 sampling (traced knobs), fan-out sampling (shared prompt pages AND
 prefill), cross-request prefix caching (``prefix_cache=True``,
 adapter-salted), batched speculative decoding (``draft_params=``, with
-optionally PIPELINED rounds chained on device), multi-tenant LoRA
-serving (``adapters=``: per-row activation deltas over one base), and
-tensor parallelism (``mesh=``).  Every composition is supported and
+optionally PIPELINED rounds chained on device, and ``spec="auto"``
+letting the engine pick speculative vs plain decode per step from live
+slot occupancy against a measured break-even threshold), multi-tenant
+LoRA serving (``adapters=``: per-row activation deltas over one base),
+and tensor parallelism (``mesh=``).  Every composition is supported and
 parity-pinned — including speculative x LoRA x TP three-ways
 (tests/test_multi_lora.py pins those; tests/test_serve_fuzz.py sweeps
 the single-device matrix).  Speculation composes with sampling too:
@@ -148,6 +150,8 @@ class ServeEngine:
         draft_config: ModelConfig | None = None,
         gamma: int = 4,
         spec_lookahead: int = 1,
+        spec: str = "on",
+        spec_breakeven: float | None = None,
         pipelined: bool = False,
         prefix_cache: bool = False,
         adapters: dict[str, list] | None = None,
@@ -182,6 +186,18 @@ class ServeEngine:
                 "spec_lookahead > 1 is a speculative-serving mode; pass "
                 "draft_params/draft_config"
             )
+        if spec not in ("on", "auto"):
+            raise ValueError(f'spec must be "on" or "auto", got {spec!r}')
+        if spec == "auto" and draft_params is None:
+            raise ValueError(
+                'spec="auto" chooses between the plain and speculative '
+                "decode programs per step; pass draft_params/draft_config"
+            )
+        if spec_breakeven is not None and spec != "auto":
+            raise ValueError(
+                'spec_breakeven is the spec="auto" occupancy threshold; '
+                'it has no effect with spec="on"'
+            )
         self.params, self.config = params, config
         self.draft_params, self.draft_config = draft_params, draft_config
         self.gamma = gamma
@@ -207,6 +223,26 @@ class ServeEngine:
         # additionally needs bucket-aligned page coverage.
         self.pipelined = pipelined
         self.spec_lookahead = spec_lookahead
+        # Adaptive speculation (spec="auto"): BOTH decode programs stay
+        # resident (the plain chunk and the spec superstep are built
+        # below regardless), and every decode step dispatches whichever
+        # side of the break-even threshold the live slot occupancy lands
+        # on — speculation trades verify-phase compute for fewer target
+        # weight streams, a trade whose sign flips with batch occupancy
+        # (the bench's spec_vs_plain_decode_b1 > 1 > _b4).  The
+        # threshold is the measured break-even (inject the artifact's
+        # spec_breakeven_batch), or calibrated at the first decode step
+        # when left None (_calibrate_breakeven).
+        self.spec = spec
+        self.spec_breakeven = spec_breakeven
+        self.spec_calibration: dict | None = None
+        # Auto-mode telemetry: per-decode-step mode counts, switch count,
+        # and a bounded (occupancy, mode) trace for tests and debugging.
+        self.spec_mode_steps = 0
+        self.plain_mode_steps = 0
+        self.mode_switches = 0
+        self._last_mode: str | None = None
+        self.decode_mode_trace: deque = deque(maxlen=256)
         self._overshoot = max(
             self.chunk * (2 if pipelined else 1),
             ((gamma + 1) * spec_lookahead * (2 if pipelined else 1))
@@ -1118,7 +1154,9 @@ class ServeEngine:
 
     def step(self) -> list[Request]:
         """One engine iteration: admit into free slots, run one decode
-        chunk (or one speculative round, when a draft model is loaded)
+        chunk (or one speculative superstep, when a draft model is
+        loaded — with ``spec="auto"`` whichever mode the step's live
+        occupancy puts on the winning side of the break-even threshold)
         for every occupied slot, retire finished requests.  Returns the
         requests that finished during this step.
 
@@ -1140,7 +1178,27 @@ class ServeEngine:
                 self._pending_spec = None
                 finished += self._consume_spec(arrs, snapshot)
             return finished
-        if self.draft_params is not None:
+        use_spec = self._decide_spec()
+        if use_spec:
+            # Mode boundary (spec="auto"): a superstep dispatches from
+            # the host mirrors, so the plain path's in-flight chunk must
+            # consume (syncing the mirrors) first.
+            finished += self._drain_pending_plain()
+        else:
+            # The other direction: consume any in-flight superstep before
+            # the plain chunk dispatches from the host mirrors.  That
+            # drain can retire slots PAST the threshold, so re-decide on
+            # the post-drain occupancy — drains only lower it, so the
+            # decision moves plain -> spec at most once.
+            finished += self._drain_pending_spec()
+            if self._occupied.any():
+                use_spec = self._decide_spec()
+                if use_spec:
+                    finished += self._drain_pending_plain()
+        if not self._occupied.any():
+            return finished  # the drains retired every slot
+        self._record_mode(use_spec)
+        if use_spec:
             return finished + self._step_spec()
         # Page coverage for the whole chunk, allocated on demand.  Each
         # dispatch needs exactly ONE chunk past the current position (the
@@ -1224,6 +1282,208 @@ class ServeEngine:
             if req.done:
                 finished.append(self._retire(slot))
         return finished
+
+    # ---- adaptive speculation (spec="auto") -----------------------------
+
+    def _decide_spec(self) -> bool:
+        """The decode-mode decision at the CURRENT occupancy.  ``spec=
+        "on"`` (the default with a draft loaded) always speculates;
+        ``spec="auto"`` speculates only while the live slot occupancy
+        sits at or below the break-even threshold — below it a decode
+        step is weight-stream-bound and speculation's one-verify-per-
+        round saves target streams, above it the verify forward's
+        compute (which grows with rows x gamma while the stream saving
+        does not) eats the win.  Token streams are unaffected either
+        way: both modes emit the target model's own tokens (greedy
+        identical, sampling distributionally identical), so the mode
+        choice is pure economics — pinned by the auto-mode fuzz arm.
+        No telemetry here: step() records the mode it actually
+        dispatches, post-drain (_record_mode)."""
+        if self.draft_params is None:
+            return False
+        if self.spec == "on":
+            return True
+        if self.spec_breakeven is None:
+            self.spec_breakeven = self._calibrate_breakeven()
+        return int(self._occupied.sum()) <= self.spec_breakeven
+
+    def _record_mode(self, use_spec: bool) -> None:
+        """Auto-mode telemetry for a decode dispatch that actually runs
+        (steps the drains emptied never reach here — the counters the
+        bench publishes as mode proof must count dispatches, not
+        intentions)."""
+        if self.spec != "auto":
+            return
+        occ = int(self._occupied.sum())
+        mode = "spec" if use_spec else "plain"
+        if self._last_mode is not None and mode != self._last_mode:
+            self.mode_switches += 1
+        self._last_mode = mode
+        self.decode_mode_trace.append((occ, mode))
+        if use_spec:
+            self.spec_mode_steps += 1
+        else:
+            self.plain_mode_steps += 1
+
+    def _drain_pending_plain(self) -> list[Request]:
+        """Mode-boundary handoff, plain -> spec: consume the pipelined
+        plain path's in-flight chunk (syncing the host position/token
+        mirrors) and drop its device-chained token — after the consume
+        the mirrors are value-identical to the chained array, so the
+        superstep dispatches from them.  The extra host sync is the
+        switch's cost; tokens are unaffected (pinned by tests)."""
+        if self._pending_read is None and self._chained_tok is None:
+            return []
+        finished: list[Request] = []
+        if self._pending_read is not None:
+            toks_dev, snapshot = self._pending_read
+            self._pending_read = None
+            finished = self._consume_chunk(toks_dev, snapshot)
+        self._chained_tok = None
+        return finished
+
+    def _drain_pending_spec(self) -> list[Request]:
+        """Mode-boundary handoff, spec -> plain: consume the in-flight
+        superstep (advancing the host mirrors by the device's committed
+        lengths) and drop the chained (cur, pos) device pair — the
+        mirrors now hold the same values, so the next plain chunk
+        dispatches from them."""
+        if self._pending_spec is None and self._spec_chained is None:
+            return []
+        finished: list[Request] = []
+        if self._pending_spec is not None:
+            arrs, snapshot = self._pending_spec
+            self._pending_spec = None
+            finished = self._consume_spec(arrs, snapshot)
+        self._spec_chained = None
+        return finished
+
+    def _calibrate_breakeven(self) -> float:
+        """Startup calibration for ``spec="auto"`` when no threshold was
+        injected: time a few DEAD dispatches of each resident decode
+        program — occupancy all-False parks every row, so the dispatch
+        runs the full compute against trash tables without touching any
+        request state (occupancy is data, not shape: a dead dispatch
+        costs exactly what a live one costs) — and compare
+        tokens-per-second at this engine's static shape.
+
+        The per-dispatch cost of either program does not vary with
+        occupancy, so calibration can only answer "does speculation pay
+        at this engine's shape on this link": the verdict is binary
+        (threshold = slots, i.e. always speculate, or 0, never).  The
+        finer per-occupancy policy needs the perf bench's measured
+        break-even across batch shapes — inject the artifact's
+        ``spec_breakeven_batch`` via ``spec_breakeven=``.  Acceptance is
+        unknowable before real traffic; the spec side assumes 0.75 (the
+        conservative middle of the measured int8-self-draft range).
+        Uses a private RNG key so the served sampling stream's key
+        schedule is untouched (parity with injected-threshold engines)."""
+        k = self.spec_lookahead
+        u = (self.gamma + 1) * k
+        # The superstep's verify gather is O(cover), and production's
+        # cover grows with row positions (from ~prompt pages toward
+        # max_pages) — calibrating at position 0 would time a smaller
+        # kernel than the engine ever dispatches and bias the verdict
+        # toward speculation.  A mid-life position is the representative
+        # choice (the plain chunk has no such term: it sees the
+        # full-width tables in calibration and production alike).
+        mid_pos = self.config.max_seq_len // 2
+        need = -(-(mid_pos + u) // self.page_size)
+        cover = min(self.max_pages, -(-need // 4) * 4)
+        tables = jnp.full(
+            (self.slots, self.max_pages), self.ctrl.trash, jnp.int32
+        )
+        occ = jnp.zeros(self.slots, bool)
+        zeros = jnp.zeros(self.slots, jnp.int32)
+        key = jax.random.PRNGKey(0)  # private; never self._next_key()
+        chunk_kw = {}
+        lora_ops = ()
+        t_lora = None
+        if self._stacked_adapters is not None:
+            idx = jnp.zeros(self.slots, jnp.int32)
+            t_lora = (self._stacked_adapters, idx, self.lora_alpha)
+            chunk_kw["lora"] = t_lora
+            lora_ops = (self._stacked_adapters, idx)
+        samp_ops = (
+            (key, jnp.float32(self.temperature), jnp.int32(self.top_k),
+             jnp.float32(self.top_p))
+            if self.sampling else ()
+        )
+
+        def plain_once(tok):
+            toks, self.pools = self._chunk(
+                self.params, self.pools, tables, tok, zeros, occ, key,
+                jnp.float32(self.temperature), jnp.int32(self.top_k),
+                jnp.float32(self.top_p), **chunk_kw,
+            )
+            return toks[:, -1]
+
+        def spec_once(cur):
+            from .paged import paged_spec_superstep
+
+            if self._mesh is None:
+                out = paged_spec_superstep(
+                    self.params, self.draft_params, self.pools,
+                    self.d_pools, tables, cur, zeros, occ,
+                    t_config=self.config, d_config=self.draft_config,
+                    gamma=self.gamma, k=k, cover_pages=cover,
+                    t_lora=t_lora, sampling=self.sampling,
+                    rng=key if self.sampling else None,
+                    temperature=jnp.float32(self.temperature),
+                    top_k=jnp.int32(self.top_k),
+                    top_p=jnp.float32(self.top_p),
+                )
+            else:
+                out = self._tp_spec(
+                    self.params, self.draft_params, self.pools,
+                    self.d_pools, tables, cur, zeros, occ, *lora_ops,
+                    *samp_ops, cover,
+                )
+            _, _, new_cur, _, self.pools, self.d_pools = out
+            return new_cur
+
+        def timed(once, n: int) -> float:
+            tok = zeros
+            t0 = time.perf_counter()
+            for _ in range(n):
+                tok = once(tok)
+            np.asarray(tok)  # one readback closes the chain
+            return time.perf_counter() - t0
+
+        n_lo, n_hi = 2, 6
+        for once in (plain_once, spec_once):
+            timed(once, 1)  # warm: compile + transfer, untimed
+        # Two-length slope, MEDIAN over interleaved repeats: the
+        # constant dispatch/readback round-trip cancels in each pair and
+        # the median rides out its jitter (the perfbench discipline —
+        # this verdict binds the engine for its lifetime, so one tunnel
+        # spike must not be able to flip it).
+        import statistics
+
+        plain_slopes, spec_slopes = [], []
+        for _ in range(3):
+            plain_slopes.append(
+                (timed(plain_once, n_hi) - timed(plain_once, n_lo))
+                / (n_hi - n_lo)
+            )
+            spec_slopes.append(
+                (timed(spec_once, n_hi) - timed(spec_once, n_lo))
+                / (n_hi - n_lo)
+            )
+        per_plain = max(statistics.median(plain_slopes), 1e-9)
+        per_spec = max(statistics.median(spec_slopes), 1e-9)
+        tokens_plain = float(self.chunk)
+        tokens_spec = (1.0 + 0.75 * self.gamma) * k
+        spec_wins = tokens_spec / per_spec > tokens_plain / per_plain
+        threshold = float(self.slots) if spec_wins else 0.0
+        self.spec_calibration = {
+            "plain_dispatch_ms": per_plain * 1000,
+            "spec_dispatch_ms": per_spec * 1000,
+            "plain_tokens_per_dispatch": tokens_plain,
+            "spec_tokens_per_dispatch_assumed": tokens_spec,
+            "threshold": threshold,
+        }
+        return threshold
 
     def _step_spec(self) -> list[Request]:
         """One speculative SUPERSTEP: ``spec_lookahead`` chained rounds
@@ -1472,6 +1732,15 @@ def main(argv=None) -> int:
                         "divides the per-round host round-trip tax by k on "
                         "high-latency links at the cost of up to k rounds "
                         "of emission lag")
+    parser.add_argument("--spec-auto", action="store_true",
+                        help="adaptive speculation: keep both decode "
+                        "programs resident and pick speculative vs plain "
+                        "per step from live occupancy against the "
+                        "break-even threshold (requires --spec-int8-draft)")
+    parser.add_argument("--spec-breakeven", type=float, default=None,
+                        help="occupancy threshold for --spec-auto (e.g. "
+                        "the bench artifact's spec_breakeven_batch); "
+                        "omit to calibrate at the first decode step")
     parser.add_argument("--lora-adapters", type=int, default=0,
                         help="serve N synthetic LoRA adapters multi-tenant "
                         "(requests round-robin across them + the base)")
@@ -1527,6 +1796,10 @@ def main(argv=None) -> int:
             draft_config=config, gamma=args.gamma,
             spec_lookahead=args.spec_lookahead,
         )
+        if args.spec_auto:
+            spec_kw.update(spec="auto", spec_breakeven=args.spec_breakeven)
+    if args.spec_auto and not args.spec_int8_draft:
+        parser.error("--spec-auto needs --spec-int8-draft (a draft model)")
     engine = ServeEngine(
         params, config, slots=args.slots, page_size=page_size,
         prompt_bucket=bucket,

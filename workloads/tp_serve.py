@@ -415,7 +415,13 @@ def make_tp_spec_superstep(
     """Tensor-parallel speculative SUPERSTEP: ``k`` chained rounds in one
     dispatch under the model mesh (a lax.scan of the chained round's
     body — scan-of-shard_map for the draft kernel, GSPMD for the dense
-    verify).  Operand order matches make_tp_spec_program's chained form
+    verify).  Under ``ServeEngine(spec="auto")`` this program stays
+    resident NEXT TO the tensor-parallel decode chunk and the engine
+    dispatches whichever side of the break-even the step's occupancy
+    lands on — both programs emit the target model's own tokens, so the
+    per-step choice is parity-safe (tests/test_spec_auto.py pins the
+    mixed TP stream against the greedy oracle across switches).
+    Operand order matches make_tp_spec_program's chained form
     (occupancy always present, then optional lora pair, then optional
     sampling quad, then the static cover_pages last); returns
     (committed [k, b, gamma+1], n [k, b], new_cur, new_pos, t_pools,
