@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check fmt-check
 
 all: native
 
@@ -51,7 +51,15 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat test
+check: check-compat obs-check test
+
+# Observability tripwires (docs/OBSERVABILITY.md): the metrics lint —
+# every name the plugin or the engine bridge emits has describe() help
+# and render() parses as valid exposition format — plus a round-trip
+# schema check of the chrome-trace exporter.  Both jax-free and fast.
+obs-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_metrics_lint.py -q
+	JAX_PLATFORMS=cpu $(PYTHON) tools/trace_export.py --selfcheck
 
 # Fast kernel-layer API tripwire: importing workloads.ops pulls every
 # Pallas kernel module through its module-level API surface (compiler

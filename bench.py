@@ -548,6 +548,7 @@ COMPACT_KEYS = [
     "serve_tokens_per_sec", "serve_requests_per_sec",
     "serve_ttft_p50_ms", "serve_ttft_p99_ms",
     "serve_e2e_p50_ms", "serve_e2e_p99_ms",
+    "obs_overhead_pct", "obs_on_tokens_per_sec",
     "admission_tokens_per_sec", "admission_speedup",
     "admission_dispatches_per_request",
     "prefix_serve_speedup", "prefix_prefill_speedup",

@@ -36,6 +36,9 @@ _FAST_DESPITE_JAX = {
     # Pure host-side control-plane properties (PagePool/PrefixCache):
     # imports workloads.paged but never traces a jax program.
     "test_paged_properties",
+    # Metrics-name lint + exposition-format parsing: imports
+    # workloads.obs (deliberately jax-free) and scans source text.
+    "test_metrics_lint",
 }
 _JAX_IMPORT_RE = re.compile(r"^\s*(?:import|from)\s+(?:jax|workloads)\b", re.MULTILINE)
 _slow_file_cache: dict[str, bool] = {}

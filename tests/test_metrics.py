@@ -129,6 +129,53 @@ def test_daemon_serves_device_gauge_and_allocation_counters(tmp_path):
         kubelet.stop()
 
 
+def test_per_family_bucket_override():
+    """describe(name, help, buckets=...) overrides LATENCY_BUCKETS for
+    that family only: serve e2e latencies (> 1 s) get a seconds-scale
+    ladder instead of all collapsing into +Inf, while undescribed
+    families keep the Allocate-tuned default."""
+    reg = Registry()
+    reg.describe("engine_e2e_seconds", "serve e2e", buckets=(1.0, 30.0, 60.0))
+    reg.observe_seconds("engine_e2e", 4.2, {"engine": "0"})
+    reg.observe_seconds("allocate", 4.2)
+    out = reg.render()
+    assert 'engine_e2e_seconds_bucket{engine="0",le="30.0"} 1' in out
+    assert 'engine_e2e_seconds_bucket{engine="0",le="60.0"} 1' in out
+    # Default ladder not applied to the override family (labels render
+    # alphabetically: engine before le).
+    assert 'engine="0",le="0.0005"' not in out
+    # The undescribed family still rides the default ladder: 4.2 s is
+    # +Inf-only there.
+    assert 'allocate_seconds_bucket{le="1.0"} 0' not in out
+    assert 'allocate_seconds_bucket{le="+Inf"} 1' in out
+    assert 'allocate_seconds_bucket{le="30.0"}' not in out
+
+
+def test_bucket_override_rejects_bad_ladders():
+    reg = Registry()
+    for bad in ((), (0.5, 0.1), (1.0, 1.0), (-1.0, 2.0), (float("inf"),)):
+        with pytest.raises(ValueError):
+            reg.describe("x_seconds", "x", buckets=bad)
+
+
+def test_metrics_server_port_zero_reports_bound_port():
+    """Port 0 binds an ephemeral port; start() returns it AND updates
+    .port, so serve-workload tests can scrape without port collisions
+    under parallel CI."""
+    server = MetricsServer(0, Registry())
+    assert server.port == 0
+    port = server.start()
+    try:
+        assert port > 0
+        assert server.port == port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz"
+        ).read()
+        assert body == b"ok\n"
+    finally:
+        server.stop()
+
+
 def test_observe_seconds_emits_histogram_buckets():
     from tpu_device_plugin.metrics import Registry
 

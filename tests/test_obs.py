@@ -1,0 +1,257 @@
+"""Engine observability (workloads/obs.py): the observer is INERT —
+token streams bit-identical on/off — while its step records, lifecycle
+spans, Prometheus bridge and chrome-trace export all describe the run
+faithfully; plus the mode-trace knob/drain and the export guard."""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_device_plugin.metrics import MetricsServer, Registry
+from workloads.model import ModelConfig, init_params
+from workloads.obs import EngineObserver, trace_events
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+DRAFT_CONFIG = ModelConfig(
+    max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def models():
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    draft = init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
+    return params, draft
+
+
+def _engine(params, observer=None, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prompt_bucket", 8)
+    return ServeEngine(params, CONFIG, observer=observer, **kw)
+
+
+# A backpressured mixed stream: queue wait, instant finish
+# (max_new_tokens=1), mid-stream retirement and slot turnover all occur.
+STREAM = (([1, 2, 3], 10), ([4, 5], 6), ([7, 8, 9, 10], 4), ([6], 1))
+
+
+def _run_stream(engine):
+    rids = [engine.submit(p, n) for p, n in STREAM]
+    out = engine.run()
+    return [list(out[r]) for r in rids]
+
+
+def test_token_streams_bit_identical_observer_on_off(models):
+    """The tentpole guarantee: the observer (rings AND registry bridge
+    live) changes no token, no telemetry counter, under sampling —
+    where any RNG-order disturbance would show instantly."""
+    params, _ = models
+
+    def run(observer):
+        engine = _engine(
+            params, observer, temperature=0.8, top_k=5,
+            rng=jax.random.PRNGKey(3),
+        )
+        return engine, _run_stream(engine)
+
+    obs = EngineObserver()
+    obs.bind_registry(Registry())
+    e_on, streams_on = run(obs)
+    e_off, streams_off = run(None)
+    assert streams_on == streams_off
+    for attr in (
+        "generated_tokens", "chunks_run", "prefill_dispatches",
+        "admission_readbacks", "requests_admitted", "requests_retired",
+    ):
+        assert getattr(e_on, attr) == getattr(e_off, attr), attr
+
+
+def test_step_records_describe_the_run(models):
+    params, _ = models
+    obs = EngineObserver()
+    engine = _engine(params, obs)
+    _run_stream(engine)
+    steps = obs.drain_steps()
+    assert steps and not obs.steps  # drained clear
+    assert [r.index for r in steps] == list(range(len(steps)))
+    assert sum(r.tokens for r in steps) == engine.generated_tokens
+    assert sum(r.admitted for r in steps) == engine.requests_admitted == 4
+    assert sum(r.retired for r in steps) == engine.requests_retired == 4
+    assert sum(r.decode_dispatches for r in steps) == engine.chunks_run
+    assert sum(r.sweeps for r in steps) == engine.prefill_sweeps
+    for r in steps:
+        assert r.mode in ("plain", "idle")
+        assert 0 <= r.occupancy <= engine.slots
+        assert r.dur_secs >= r.readback_secs >= 0.0
+    assert obs.dropped_steps == 0
+
+
+def test_request_spans_and_segments(models):
+    params, _ = models
+    obs = EngineObserver()
+    engine = _engine(params, obs)
+    _run_stream(engine)
+    spans = obs.drain_spans()
+    assert len(spans) == 4 and not obs.spans
+    by_rid = {s.rid: s for s in spans}
+    for (prompt, n), rid in zip(STREAM, ("req-0", "req-1", "req-2", "req-3")):
+        span = by_rid[rid]
+        assert span.n_tokens <= n
+        # Stamp ordering -> non-negative segments that add up to e2e.
+        assert span.queue_wait_secs >= 0
+        assert span.prefill_secs >= 0
+        assert span.decode_secs >= 0
+        total = span.queue_wait_secs + span.prefill_secs + span.decode_secs
+        assert total == pytest.approx(span.e2e_secs, abs=1e-9)
+        assert span.ttft_secs == pytest.approx(
+            span.queue_wait_secs + span.prefill_secs, abs=1e-9
+        )
+    # The instant-EOS-shaped request (max_new_tokens=1) finished AT
+    # admission: first token is last token.
+    assert by_rid["req-3"].t_first == by_rid["req-3"].t_done
+    # Later waves queued behind the first: someone actually waited.
+    assert max(s.queue_wait_secs for s in spans) > 0
+
+
+def test_prometheus_bridge_scrapes_next_to_plugin_metrics(models):
+    """The engine series land on a shared registry, scrapeable over the
+    REAL MetricsServer — on an ephemeral port that the server reports
+    back (the port-0 contract parallel CI relies on)."""
+    params, _ = models
+    reg = Registry()
+    reg.describe("allocations_total", "plugin-side neighbour")
+    reg.inc("allocations_total", {"resource": "google.com/tpu"}, 2)
+    obs = EngineObserver(name="scrape")
+    obs.bind_registry(reg)
+    engine = _engine(params, obs)
+    _run_stream(engine)
+    server = MetricsServer(0, reg)
+    assert server.port == 0
+    port = server.start()
+    try:
+        assert port > 0 and server.port == port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics"
+        ).read().decode()
+    finally:
+        server.stop()
+    assert 'tpu_device_plugin_allocations_total{resource="google.com/tpu"} 2' in body
+    assert (
+        f'tpu_device_plugin_engine_tokens_total{{engine="scrape"}} '
+        f"{engine.generated_tokens}" in body
+    )
+    assert 'engine_requests_admitted_total{engine="scrape"} 4' in body
+    assert 'engine_queue_depth{engine="scrape"} 0' in body
+    assert 'engine_slots{engine="scrape"} 2' in body
+    # Serve histograms carry the seconds-scale ladder, not the
+    # sub-second Allocate default.
+    assert 'engine_e2e_seconds_bucket{engine="scrape",le="60.0"}' in body
+    assert "TYPE tpu_device_plugin_engine_e2e_seconds histogram" in body
+
+
+def test_unbind_registry_releases_gauges_and_engine(models):
+    """A retiring engine must not keep scraping as live state: unbind
+    removes the gauge collectors (whose closures pin the engine) while
+    the accumulated counter/histogram series stay, monotonic."""
+    params, _ = models
+    reg = Registry()
+    obs = EngineObserver()
+    obs.bind_registry(reg)
+    engine = _engine(params, obs)
+    _run_stream(engine)
+    before = reg.render()
+    assert 'engine_slots{engine="0"} 2' in before
+    tokens_line = f'engine_tokens_total{{engine="0"}} {engine.generated_tokens}'
+    assert tokens_line in before
+    obs.unbind_registry()
+    after = reg.render()
+    assert "engine_slots{" not in after  # dead engine's gauges gone
+    assert "engine_queue_depth{" not in after
+    assert tokens_line in after  # counters persist, monotonic
+    assert obs._engine is None and obs._registry is None
+    obs.unbind_registry()  # idempotent
+
+
+def test_export_trace_covers_spec_mode_switches(models, tmp_path):
+    """A spec="auto" run whose occupancy crosses the threshold: the
+    exported timeline is schema-valid trace_event JSON carrying BOTH
+    decode modes' step events plus every request's lanes."""
+    from tools.trace_export import validate_file
+
+    params, draft = models
+    obs = EngineObserver(name="trace")
+    engine = ServeEngine(
+        params, CONFIG, slots=3, page_size=4, prompt_bucket=8,
+        draft_params=draft, draft_config=DRAFT_CONFIG, gamma=3,
+        spec="auto", spec_breakeven=1.5, observer=obs,
+    )
+    for prompt, new in (([5, 6, 7], 24), ([1, 2], 6), ([9], 4)):
+        engine.submit(prompt, new)
+    engine.run()
+    assert engine.mode_switches >= 1  # the crossing actually happened
+    path = tmp_path / "trace.json"
+    n = engine.export_trace(str(path))
+    assert validate_file(str(path)) == []
+    trace = json.loads(path.read_text())
+    events = trace["traceEvents"]
+    assert len(events) == n
+    step_names = {e["name"] for e in events if e.get("cat") == "step"}
+    assert "step[plain]" in step_names and "step[spec]" in step_names
+    lanes = {e["args"]["rid"] for e in events if e.get("cat") == "request"}
+    assert lanes == {"req-0", "req-1", "req-2"}
+    segs = {e["name"] for e in events if e.get("cat") == "request"}
+    assert segs == {"queued", "prefill", "decode"}
+    counters = {e["name"] for e in events if e["ph"] == "C"}
+    assert counters == {"occupancy", "queue_depth"}
+    # trace_events is non-destructive: the rings still hold the run.
+    assert obs.steps and obs.spans
+    assert trace == trace_events(obs)
+
+
+def test_mode_trace_knob_and_drain(models):
+    """The decode_mode_trace bound is a constructor knob with a
+    drain-style API — history is handed back, not silently dropped."""
+    params, draft = models
+
+    def spec_engine(**kw):
+        engine = ServeEngine(
+            params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+            draft_params=draft, draft_config=DRAFT_CONFIG, gamma=3,
+            spec="auto", spec_breakeven=2.0, **kw,
+        )
+        engine.submit([1, 2, 3], 12)
+        engine.run()
+        return engine
+
+    bounded = spec_engine(mode_trace_limit=2)
+    assert bounded.decode_mode_trace.maxlen == 2
+    assert len(bounded.decode_mode_trace) <= 2
+    unbounded = spec_engine(mode_trace_limit=None)
+    assert unbounded.decode_mode_trace.maxlen is None
+    assert len(unbounded.decode_mode_trace) == (
+        unbounded.spec_mode_steps + unbounded.plain_mode_steps
+    )
+    drained = unbounded.drain_mode_trace()
+    assert drained and not unbounded.decode_mode_trace
+    for occ, mode in drained:
+        assert mode in ("spec", "plain") and 1 <= occ <= 2
+    with pytest.raises(ValueError, match="mode_trace_limit"):
+        _engine(params, mode_trace_limit=0)
+
+
+def test_export_trace_without_observer_is_a_loud_error(models):
+    params, _ = models
+    engine = _engine(params)
+    with pytest.raises(RuntimeError, match="observer"):
+        engine.export_trace("/tmp/never-written.json")
+
+
+def test_observer_constructor_validates_ring_bounds():
+    with pytest.raises(ValueError, match="step_limit"):
+        EngineObserver(step_limit=0)

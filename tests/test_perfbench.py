@@ -173,6 +173,10 @@ def test_perfbench_tiny_end_to_end():
         "serve_tokens_per_sec",
         "serve_requests_per_sec",
         "serve_pool_peak_fraction",
+        # Observability overhead arm (docs/OBSERVABILITY.md).
+        "obs_overhead_pct",
+        "obs_on_tokens_per_sec",
+        "obs_off_tokens_per_sec",
         # Round-6 speculation economics family.
         "spec_breakeven_batch",
         "spec_phase_dominant",

@@ -39,6 +39,7 @@ TRACKED_UP = [
     "paged_vs_contiguous_decode",
     "serve_tokens_per_sec",
     "serve_requests_per_sec",
+    "obs_on_tokens_per_sec",
     "admission_tokens_per_sec",
     "admission_speedup",
     "prefix_serve_speedup",

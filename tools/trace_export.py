@@ -1,0 +1,176 @@
+"""Chrome trace_event tooling for the engine observer's timeline export.
+
+The event building lives with the data (workloads/obs.py trace_events /
+EngineObserver.export_trace / ServeEngine.export_trace); this tool is
+the validation and CLI side:
+
+    python tools/trace_export.py --validate run.json   # schema-check a file
+    python tools/trace_export.py --selfcheck           # round-trip check
+                                                       # (make obs-check)
+
+The validator enforces the subset of the Trace Event Format that
+chrome://tracing / Perfetto actually require to load a file: a JSON
+object with a ``traceEvents`` array whose entries carry a legal ``ph``
+with the fields that phase needs (``X`` duration events: name/ts/dur,
+``C`` counters: numeric args, ``M`` metadata), numeric non-negative
+timestamps, and JSON-serialisable args.  ``--selfcheck`` fabricates an
+observer timeline (no engine, no jax — workloads/obs.py is jax-free),
+exports it through the SAME code path the engine uses, re-reads the
+file and validates it: the round-trip tripwire `make obs-check` runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_REQUIRED = {
+    # phase -> fields every event of that phase must carry (beyond
+    # pid/tid, required for all).
+    "X": ("name", "ts", "dur"),
+    "C": ("name", "ts", "args"),
+    "M": ("name", "args"),
+    "B": ("name", "ts"),
+    "E": ("ts",),
+    "i": ("name", "ts"),
+}
+
+
+def validate_trace(obj) -> list[str]:
+    """Return a list of schema violations (empty = valid)."""
+    errors: list[str] = []
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        return ["top level must be an object with a 'traceEvents' array"]
+    events = obj["traceEvents"]
+    if not isinstance(events, list):
+        return ["'traceEvents' must be an array"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _REQUIRED:
+            errors.append(f"{where}: unknown/missing phase ph={ph!r}")
+            continue
+        for key in ("pid", "tid"):
+            if not isinstance(ev.get(key), int):
+                errors.append(f"{where}: {key} must be an integer")
+        for key in _REQUIRED[ph]:
+            if key not in ev:
+                errors.append(f"{where}: ph={ph} needs {key!r}")
+        for key in ("ts", "dur"):
+            if key in ev:
+                v = ev[key]
+                if not isinstance(v, (int, float)) or v < 0:
+                    errors.append(
+                        f"{where}: {key} must be a non-negative number, "
+                        f"got {v!r}"
+                    )
+        if ph == "C":
+            args = ev.get("args")
+            if not isinstance(args, dict) or not args or any(
+                not isinstance(v, (int, float)) for v in args.values()
+            ):
+                errors.append(
+                    f"{where}: counter args must be a non-empty "
+                    "name -> number mapping"
+                )
+        if "args" in ev:
+            try:
+                json.dumps(ev["args"])
+            except (TypeError, ValueError) as e:
+                errors.append(f"{where}: args not JSON-serialisable: {e}")
+    return errors
+
+
+def validate_file(path: str) -> list[str]:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable or not JSON: {e}"]
+    return validate_trace(obj)
+
+
+def _synthetic_observer():
+    """A small fabricated timeline exercising every event shape the
+    exporter emits: multi-request spans (one finished at admission:
+    t_first == t_done), step records in all three modes, a mode
+    switch."""
+    from workloads.obs import EngineObserver, RequestSpan, StepRecord
+
+    obs = EngineObserver(name="selfcheck")
+    t = 1000.0
+    obs.spans.extend([
+        RequestSpan("req-0", t, t + 0.01, t + 0.05, t + 0.40, 12),
+        RequestSpan("req-1", t + 0.02, t + 0.06, t + 0.11, t + 0.11, 1),
+        RequestSpan("req-2", t + 0.03, None, None, t + 0.50, 0),
+    ])
+    for i, mode in enumerate(("plain", "plain", "spec", "idle")):
+        obs.steps.append(StepRecord(
+            index=i, t_start=t + 0.05 * i, dur_secs=0.045,
+            occupancy=2 - (i > 2), queue_depth=max(0, 2 - i),
+            admitted=1 if i == 0 else 0, retired=1 if i == 3 else 0,
+            mode=mode, prefill_dispatches=1 if i == 0 else 0,
+            decode_dispatches=0 if mode == "idle" else 1,
+            sweeps=1 if i == 0 else 0, tokens=4,
+            readback_secs=0.002,
+        ))
+    return obs
+
+
+def selfcheck() -> int:
+    obs = _synthetic_observer()
+    fd, path = tempfile.mkstemp(prefix="trace-selfcheck-", suffix=".json")
+    os.close(fd)
+    try:
+        n = obs.export_trace(path)
+        errors = validate_file(path)
+    finally:
+        os.unlink(path)
+    if errors:
+        for e in errors:
+            print(f"trace_export selfcheck: {e}", file=sys.stderr)
+        return 1
+    if n < len(obs.spans) + len(obs.steps):
+        print(
+            f"trace_export selfcheck: only {n} events for "
+            f"{len(obs.spans)} spans + {len(obs.steps)} steps",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"trace_export selfcheck OK ({n} events round-tripped)")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    group = parser.add_mutually_exclusive_group(required=True)
+    group.add_argument("--validate", metavar="PATH",
+                       help="schema-check a trace_event JSON file")
+    group.add_argument("--selfcheck", action="store_true",
+                       help="export a synthetic timeline and validate it "
+                       "(the make obs-check round trip)")
+    args = parser.parse_args(argv)
+    if args.selfcheck:
+        return selfcheck()
+    errors = validate_file(args.validate)
+    if errors:
+        for e in errors:
+            print(f"trace_export: {e}", file=sys.stderr)
+        return 1
+    with open(args.validate) as f:
+        n = len(json.load(f)["traceEvents"])
+    print(f"trace_export: {args.validate} OK ({n} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -31,10 +31,33 @@ class Registry:
         self._counters: dict[tuple[str, tuple[tuple[str, str], ...]], float] = defaultdict(float)
         self._gauges: list[tuple[str, Callable[[], list[tuple[dict, float]]]]] = []
         self._help: dict[str, str] = {}
+        self._buckets: dict[str, tuple[float, ...]] = {}
 
-    def describe(self, name: str, help_text: str) -> None:
+    def describe(
+        self, name: str, help_text: str,
+        buckets: tuple[float, ...] | None = None,
+    ) -> None:
+        """Register a family's help text and, for histogram families, an
+        optional per-family bucket ladder overriding LATENCY_BUCKETS.
+        ``name`` is the rendered family name (histograms:
+        ``<x>_seconds``); ``observe_seconds("<x>", ...)`` picks the
+        override up.  The default ladder is tuned for Allocate handler
+        latency (capped at 1.0 s) — serve-side e2e latencies need a
+        seconds-scale ladder or they all collapse into +Inf."""
+        if buckets is not None:
+            buckets = tuple(float(b) for b in buckets)
+            if not buckets or any(
+                not math.isfinite(b) or b <= 0 for b in buckets
+            ) or list(buckets) != sorted(set(buckets)):
+                raise ValueError(
+                    f"buckets for {name!r} must be a non-empty strictly "
+                    f"ascending ladder of finite positive bounds, got "
+                    f"{buckets}"
+                )
         with self._lock:
             self._help[name] = help_text
+            if buckets is not None:
+                self._buckets[name] = buckets
 
     @staticmethod
     def _key(name: str, labels: dict | None) -> tuple:
@@ -53,23 +76,29 @@ class Registry:
     def observe_seconds(self, name: str, seconds: float, labels: dict | None = None) -> None:
         """Record one timed event as a standard Prometheus histogram family
         ``<name>_seconds``: _bucket{le=...} / _sum / _count.  All series
-        update under one lock acquisition so a concurrent scrape can never
-        observe non-cumulative buckets."""
-        updates: list[tuple[str, dict | None, float]] = [
-            (f"{name}_seconds_sum", labels, seconds),
-            (f"{name}_seconds_count", labels, 1.0),
-        ]
-        for le in self.LATENCY_BUCKETS:
-            if seconds <= le:
-                updates.append(
-                    (f"{name}_seconds_bucket", {**(labels or {}), "le": str(le)}, 1.0)
-                )
-        updates.append(
-            (f"{name}_seconds_bucket", {**(labels or {}), "le": "+Inf"}, 1.0)
-        )
+        update under one lock acquisition (the bucket-ladder lookup
+        included) so a concurrent scrape can never observe
+        non-cumulative buckets and the hot handler path pays one lock
+        round-trip.  The bucket ladder is the per-family override
+        registered via ``describe(f"{name}_seconds", ..., buckets=...)``
+        when present, LATENCY_BUCKETS otherwise."""
         with self._lock:
-            for series, lab, value in updates:
-                self._counters[self._key(series, lab)] += value
+            buckets = self._buckets.get(f"{name}_seconds", self.LATENCY_BUCKETS)
+            self._counters[self._key(f"{name}_seconds_sum", labels)] += seconds
+            self._counters[self._key(f"{name}_seconds_count", labels)] += 1.0
+            for le in buckets:
+                if seconds <= le:
+                    self._counters[
+                        self._key(
+                            f"{name}_seconds_bucket",
+                            {**(labels or {}), "le": str(le)},
+                        )
+                    ] += 1.0
+            self._counters[
+                self._key(
+                    f"{name}_seconds_bucket", {**(labels or {}), "le": "+Inf"}
+                )
+            ] += 1.0
 
     def register_gauge(self, name: str, collect: Callable[[], list[tuple[dict, float]]]) -> None:
         """collect() returns (labels, value) pairs evaluated at scrape time.
@@ -226,6 +255,11 @@ class MetricsServer:
         )
         self._thread.start()
         bound = self._httpd.server_address[1]
+        # Report the bound port back on the instance too: with port=0 the
+        # OS picks an ephemeral port, and callers holding only the server
+        # object (serve-workload tests scraping under parallel CI) need
+        # the real port, not the 0 they asked with.
+        self.port = bound
         log.info("metrics endpoint on :%d (/metrics, /healthz)", bound)
         return bound
 

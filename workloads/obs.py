@@ -1,0 +1,486 @@
+"""Engine observability: request-lifecycle spans, per-step records, and
+a Prometheus bridge for ServeEngine.
+
+The plugin half of this repo treats observability as a subsystem
+(tpu_device_plugin/metrics.py: a dependency-free Registry + /metrics
+endpoint); this module gives the serving half the same surface.  An
+``EngineObserver`` is OPT-IN (``ServeEngine(observer=...)``) and records
+at the engine's existing seams — admission, decode dispatch (spec vs
+plain), readbacks, retirement — three views of the same run:
+
+  1. **Request lifecycle spans** (``RequestSpan``): queued → admitted →
+     first-token → done, with the queue-wait / prefill / decode segments
+     derived from the Request's host-side stamps (``t_submit`` /
+     ``t_admit`` / ``t_first`` / ``t_done``).
+  2. **Per-step engine records** (``StepRecord``): step index, slot
+     occupancy, admissions coalesced, retirements, decode mode,
+     dispatch counts, host readback time — in a bounded ring with a
+     ``drain_steps()`` API mirroring ``engine.drain_completed()``.
+  3. **A Prometheus bridge** (``bind_registry``): counters, scrape-time
+     gauges and seconds-scale histograms on the shared Registry, served
+     by the existing MetricsServer next to the plugin's own metrics.
+
+The observer is deliberately INERT: it never touches device state, RNG
+keys, scheduling or page accounting, so token streams are bit-identical
+with it on or off (pinned by tests/test_obs.py) and its cost is priced
+by the perf bench (``obs_overhead_pct``).  ``trace_events`` renders the
+rings as a chrome://tracing-loadable timeline (tools/trace_export.py is
+the CLI/validator side).
+
+This module is importable WITHOUT jax — it handles host-side stamps and
+counters only — so the metrics lint and trace tooling stay fast.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, field, fields
+
+# Seconds-scale histogram ladder for serving latencies.  The Registry's
+# default LATENCY_BUCKETS top out at 1.0 s (tuned for Allocate handler
+# latency); serve TTFT/e2e routinely exceed that, so the engine families
+# override per-family buckets (metrics.Registry.describe(buckets=...)).
+SERVE_SECONDS_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0,
+)
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    """One engine metric family as exposed on the Registry — the single
+    source for bind_registry, the metrics lint test, and the rendered
+    docs/OBSERVABILITY.md catalog (render_bench_docs)."""
+
+    name: str
+    type: str  # "counter" | "gauge" | "histogram"
+    labels: tuple[str, ...]
+    help: str
+
+
+# Every family the bridge ever emits.  The lint test
+# (tests/test_metrics_lint.py) cross-checks this catalog against the
+# names the code actually inc()s / observe_seconds()s, and the rendered
+# metric catalog in docs/OBSERVABILITY.md is generated from it — three
+# consumers, one spec, no drift.
+ENGINE_METRICS: tuple[MetricSpec, ...] = (
+    MetricSpec(
+        "engine_tokens_total", "counter", ("engine",),
+        "tokens emitted by the serving engine",
+    ),
+    MetricSpec(
+        "engine_requests_admitted_total", "counter", ("engine",),
+        "requests admitted into engine slots (instant-finish included)",
+    ),
+    MetricSpec(
+        "engine_requests_retired_total", "counter", ("engine",),
+        "requests retired by the serving engine",
+    ),
+    MetricSpec(
+        "engine_mode_switches_total", "counter", ("engine",),
+        'spec="auto" decode-mode boundary crossings (each drains the '
+        "other mode's pipelined in-flight state)",
+    ),
+    MetricSpec(
+        "engine_decode_steps_total", "counter", ("engine", "mode"),
+        "decode dispatches by mode (plain chunk vs speculative superstep)",
+    ),
+    MetricSpec(
+        "engine_prefill_dispatches_total", "counter", ("engine",),
+        "target prefill program dispatches (admission sweeps and chunks)",
+    ),
+    MetricSpec(
+        "engine_queue_depth", "gauge", ("engine",),
+        "requests waiting in the pending queue (scrape-time)",
+    ),
+    MetricSpec(
+        "engine_slot_occupancy", "gauge", ("engine",),
+        "batch slots currently decoding a request (scrape-time)",
+    ),
+    MetricSpec(
+        "engine_slots", "gauge", ("engine",),
+        "total batch slots the engine was built with",
+    ),
+    MetricSpec(
+        "engine_resident_pages", "gauge", ("engine",),
+        "KV-cache pages currently held by live sequences (scrape-time)",
+    ),
+    MetricSpec(
+        "engine_ttft_seconds", "histogram", ("engine",),
+        "submission -> first observed token (queue wait included)",
+    ),
+    MetricSpec(
+        "engine_e2e_seconds", "histogram", ("engine",),
+        "submission -> retirement end-to-end latency",
+    ),
+    MetricSpec(
+        "engine_step_seconds", "histogram", ("engine",),
+        "wall time of one engine step() (admit + dispatch + consume)",
+    ),
+)
+
+
+@dataclass
+class RequestSpan:
+    """One finished request's lifecycle, flattened from its Request
+    stamps at retirement.  Segment invariants (``t_submit <= t_admit <=
+    t_first <= t_done``) hold whenever the engine stamped all four;
+    requests that finish AT admission have ``t_first == t_done``."""
+
+    rid: str
+    t_submit: float
+    t_admit: float | None
+    t_first: float | None
+    t_done: float
+    n_tokens: int
+
+    @property
+    def queue_wait_secs(self) -> float | None:
+        if self.t_admit is None:
+            return None
+        return self.t_admit - self.t_submit
+
+    @property
+    def prefill_secs(self) -> float | None:
+        """Admission -> first token: the prefill + first-sample segment
+        (under batched admission this includes riding the step's shared
+        sweep)."""
+        if self.t_admit is None or self.t_first is None:
+            return None
+        return self.t_first - self.t_admit
+
+    @property
+    def decode_secs(self) -> float | None:
+        if self.t_first is None:
+            return None
+        return self.t_done - self.t_first
+
+    @property
+    def ttft_secs(self) -> float | None:
+        if self.t_first is None:
+            return None
+        return self.t_first - self.t_submit
+
+    @property
+    def e2e_secs(self) -> float:
+        return self.t_done - self.t_submit
+
+    @classmethod
+    def from_request(cls, req) -> "RequestSpan":
+        return cls(
+            rid=req.rid, t_submit=req.t_submit, t_admit=req.t_admit,
+            t_first=req.t_first, t_done=req.t_done,
+            n_tokens=len(req.tokens),
+        )
+
+
+@dataclass
+class StepRecord:
+    """One engine ``step()`` as the observer saw it.  ``mode`` is the
+    decode program the step actually DISPATCHED ("plain" chunk, "spec"
+    superstep) or "idle" (pure admission / drain / nothing-to-do
+    steps).  ``readback_secs`` sums the host syncs the step performed
+    (first-token readbacks + chunk/superstep consumes)."""
+
+    index: int
+    t_start: float
+    dur_secs: float
+    occupancy: int
+    queue_depth: int
+    admitted: int
+    retired: int
+    mode: str
+    prefill_dispatches: int
+    decode_dispatches: int
+    sweeps: int
+    tokens: int
+    readback_secs: float
+
+
+class EngineObserver:
+    """Opt-in observability for one ServeEngine.
+
+    Construct it, pass it to the engine (``ServeEngine(...,
+    observer=obs)``), and optionally ``bind_registry()`` it to a
+    metrics Registry.  The engine drives the ``_step_begin`` /
+    ``_step_end`` / ``_note_readback`` hooks; everything user-facing is
+    the rings (``steps`` / ``spans``), their ``drain_*`` APIs, and
+    ``export_trace``.
+
+    Ring bounds: both rings are bounded (``step_limit`` /
+    ``span_limit``); evictions are COUNTED (``dropped_steps`` /
+    ``dropped_spans``) so a long-running caller who forgot to drain can
+    see exactly how much history it lost rather than silently reading a
+    truncated timeline."""
+
+    def __init__(
+        self,
+        *,
+        step_limit: int = 2048,
+        span_limit: int = 2048,
+        name: str = "0",
+    ):
+        if step_limit < 1 or span_limit < 1:
+            raise ValueError(
+                f"step_limit/span_limit must be >= 1, got "
+                f"{step_limit}/{span_limit}"
+            )
+        self.name = name
+        self.steps: deque[StepRecord] = deque(maxlen=step_limit)
+        self.spans: deque[RequestSpan] = deque(maxlen=span_limit)
+        self.dropped_steps = 0
+        self.dropped_spans = 0
+        self._step_index = 0
+        self._readback_secs = 0.0
+        self._registry = None
+        self._labels: dict = {}
+        self._engine = None
+
+    # ---- registry bridge -------------------------------------------------
+
+    def bind_registry(self, reg, labels: dict | None = None) -> None:
+        """Attach this observer to a metrics Registry: describe every
+        family in ENGINE_METRICS (histograms get the seconds-scale
+        bucket ladder), register the scrape-time gauges, and start
+        pushing counter/histogram updates from the step hooks.  All
+        series carry an ``engine=<name>`` label so several engines can
+        share one registry (gauge registration replaces by name — give
+        concurrent engines distinct observer names and bind the LAST
+        one, or separate registries).  ``unbind_registry()`` detaches
+        when the engine retires."""
+        self._registry = reg
+        self._labels = dict(labels or {})
+        self._labels.setdefault("engine", self.name)
+        for m in ENGINE_METRICS:
+            if m.type == "histogram":
+                reg.describe(m.name, m.help, buckets=SERVE_SECONDS_BUCKETS)
+            else:
+                reg.describe(m.name, m.help)
+        for name, reader in self._GAUGE_READERS.items():
+            reg.register_gauge(
+                name, lambda reader=reader: self._gauge(reader)
+            )
+
+    # One engine reader per gauge family in ENGINE_METRICS — bind and
+    # unbind both iterate this mapping, so a new gauge cannot be
+    # registered without also being unregistered (and the lint test
+    # pins it against the catalog).
+    _GAUGE_READERS = {
+        "engine_queue_depth": lambda e: len(e.pending),
+        "engine_slot_occupancy": lambda e: int(e._occupied.sum()),
+        "engine_slots": lambda e: e.slots,
+        "engine_resident_pages": lambda e: e.ctrl.used_pages,
+    }
+
+    def unbind_registry(self) -> None:
+        """Detach from the bound registry: unregister the gauge
+        collectors (whose closures otherwise pin this observer — and
+        through it the engine's params and KV page pools — on the
+        registry forever) and stop pushing counters.  Call it when the
+        engine retires in a long-lived process; already-accumulated
+        counter/histogram series stay on the registry, monotonic, but
+        no dead engine keeps scraping as live state.  Gauge
+        registration replaces by name, so unbind the retiring observer
+        BEFORE binding its successor — unbinding afterwards would
+        remove the successor's collectors."""
+        reg, self._registry = self._registry, None
+        if reg is None:
+            return
+        for name in self._GAUGE_READERS:
+            reg.unregister_gauge(name)
+        self._engine = None
+
+    def _gauge(self, value_fn) -> list[tuple[dict, float]]:
+        eng = self._engine
+        if eng is None:
+            return []
+        try:
+            return [(dict(self._labels), float(value_fn(eng)))]
+        except Exception:
+            # A gauge must never fail a scrape mid-teardown; the
+            # Registry logs collector failures, an empty read is honest.
+            return []
+
+    # ---- engine-facing hooks --------------------------------------------
+
+    def _bind(self, engine) -> None:
+        self._engine = engine
+
+    def _note_readback(self, secs: float) -> None:
+        """Called by the engine around every host sync (first-token
+        readbacks, chunk/superstep consumes) while an observer is
+        attached."""
+        self._readback_secs += secs
+
+    def _step_begin(self, engine) -> tuple:
+        self._readback_secs = 0.0
+        return (
+            time.perf_counter(),
+            engine.generated_tokens,
+            engine.requests_admitted,
+            engine.requests_retired,
+            engine.prefill_dispatches,
+            engine.prefill_sweeps,
+            engine.chunks_run,
+            engine.spec_rounds,
+            engine.mode_switches,
+        )
+
+    def _step_end(self, engine, snap: tuple, finished) -> StepRecord:
+        (t0, tokens0, adm0, ret0, pd0, sw0, ch0, sr0, ms0) = snap
+        dur = time.perf_counter() - t0
+        tokens = engine.generated_tokens - tokens0
+        admitted = engine.requests_admitted - adm0
+        retired = engine.requests_retired - ret0
+        chunk_d = engine.chunks_run - ch0
+        spec_rounds_d = engine.spec_rounds - sr0
+        spec_d = spec_rounds_d // max(engine.spec_lookahead, 1)
+        # The mode the step actually DISPATCHED: the engine runs at most
+        # one decode program per step (drains only consume in-flight
+        # work; they never dispatch).
+        mode = "spec" if spec_d else ("plain" if chunk_d else "idle")
+        rec = StepRecord(
+            index=self._step_index,
+            t_start=t0,
+            dur_secs=dur,
+            occupancy=int(engine._occupied.sum()),
+            queue_depth=len(engine.pending),
+            admitted=admitted,
+            retired=retired,
+            mode=mode,
+            prefill_dispatches=engine.prefill_dispatches - pd0,
+            decode_dispatches=chunk_d + spec_d,
+            sweeps=engine.prefill_sweeps - sw0,
+            tokens=tokens,
+            readback_secs=self._readback_secs,
+        )
+        self._step_index += 1
+        if len(self.steps) == self.steps.maxlen:
+            self.dropped_steps += 1
+        self.steps.append(rec)
+        new_spans = [RequestSpan.from_request(req) for req in finished]
+        for span in new_spans:
+            if len(self.spans) == self.spans.maxlen:
+                self.dropped_spans += 1
+            self.spans.append(span)
+        reg = self._registry
+        if reg is not None:
+            labels = self._labels
+            if tokens:
+                reg.inc("engine_tokens_total", labels, tokens)
+            if admitted:
+                reg.inc("engine_requests_admitted_total", labels, admitted)
+            if retired:
+                reg.inc("engine_requests_retired_total", labels, retired)
+            if rec.prefill_dispatches:
+                reg.inc(
+                    "engine_prefill_dispatches_total", labels,
+                    rec.prefill_dispatches,
+                )
+            switches = engine.mode_switches - ms0
+            if switches:
+                reg.inc("engine_mode_switches_total", labels, switches)
+            if mode != "idle":
+                reg.inc(
+                    "engine_decode_steps_total", {**labels, "mode": mode}
+                )
+            reg.observe_seconds("engine_step", dur, labels)
+            for span in new_spans:
+                if span.ttft_secs is not None:
+                    reg.observe_seconds(
+                        "engine_ttft", span.ttft_secs, labels
+                    )
+                reg.observe_seconds("engine_e2e", span.e2e_secs, labels)
+        return rec
+
+    # ---- drains ---------------------------------------------------------
+
+    def drain_steps(self) -> list[StepRecord]:
+        """Hand back (and clear) the step-record ring — the same
+        between-measurement-windows contract as
+        ``engine.drain_completed()``."""
+        out = list(self.steps)
+        self.steps.clear()
+        return out
+
+    def drain_spans(self) -> list[RequestSpan]:
+        """Hand back (and clear) the finished-request span ring."""
+        out = list(self.spans)
+        self.spans.clear()
+        return out
+
+    # ---- chrome trace export --------------------------------------------
+
+    def export_trace(self, path: str) -> int:
+        """Write the recorded timeline as chrome://tracing-loadable
+        trace_event JSON.  Returns the number of trace events written."""
+        trace = trace_events(self)
+        with open(path, "w") as f:
+            json.dump(trace, f)
+            f.write("\n")
+        return len(trace["traceEvents"])
+
+
+def _us(t: float, t0: float) -> float:
+    return round((t - t0) * 1e6, 3)
+
+
+def trace_events(observer: EngineObserver) -> dict:
+    """Render an observer's rings (NON-destructively — drains are the
+    caller's business) as a Chrome trace_event object: request lifecycle
+    spans as complete ("X") events on a per-request lane under the
+    "requests" process, step records as "X" events plus occupancy /
+    queue-depth counter ("C") tracks under the "engine" process.  Load
+    the written file in chrome://tracing or https://ui.perfetto.dev."""
+    steps = list(observer.steps)
+    spans = list(observer.spans)
+    stamps = [s.t_start for s in steps] + [sp.t_submit for sp in spans]
+    t0 = min(stamps) if stamps else 0.0
+    events: list[dict] = [
+        {"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+         "args": {"name": f"requests (engine {observer.name})"}},
+        {"ph": "M", "pid": 2, "tid": 0, "name": "process_name",
+         "args": {"name": f"engine {observer.name} steps"}},
+        {"ph": "M", "pid": 2, "tid": 1, "name": "thread_name",
+         "args": {"name": "step()"}},
+    ]
+    for lane, span in enumerate(spans, start=1):
+        events.append(
+            {"ph": "M", "pid": 1, "tid": lane, "name": "thread_name",
+             "args": {"name": span.rid}}
+        )
+        segments = (
+            ("queued", span.t_submit, span.t_admit),
+            ("prefill", span.t_admit, span.t_first),
+            ("decode", span.t_first, span.t_done),
+        )
+        for name, start, end in segments:
+            if start is None or end is None:
+                continue
+            events.append({
+                "ph": "X", "pid": 1, "tid": lane, "cat": "request",
+                "name": name, "ts": _us(start, t0),
+                "dur": max(_us(end, t0) - _us(start, t0), 0.0),
+                "args": {"rid": span.rid, "tokens": span.n_tokens},
+            })
+    for rec in steps:
+        events.append({
+            "ph": "X", "pid": 2, "tid": 1, "cat": "step",
+            "name": f"step[{rec.mode}]", "ts": _us(rec.t_start, t0),
+            "dur": max(round(rec.dur_secs * 1e6, 3), 0.0),
+            "args": {
+                f.name: getattr(rec, f.name)
+                for f in fields(rec) if f.name not in ("t_start", "index")
+            },
+        })
+        for counter, value in (
+            ("occupancy", rec.occupancy),
+            ("queue_depth", rec.queue_depth),
+        ):
+            events.append({
+                "ph": "C", "pid": 2, "tid": 1, "name": counter,
+                "ts": _us(rec.t_start, t0), "args": {counter: value},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

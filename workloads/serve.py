@@ -81,12 +81,15 @@ class Request:
     or on ``eos_token``.  ``group`` ties fan-out siblings to their shared
     prompt pages (see ServeEngine.submit_fanout).
 
-    ``t_submit``/``t_first``/``t_done`` are host-side perf_counter stamps
-    (submission, first token OBSERVED host-side, retirement) — the
-    latency telemetry behind the TTFT/e2e percentiles the bench reports.
-    Under pipelined stepping emission lags a chunk, so t_first is the
-    time the engine could actually have streamed the token out — the
-    honest client-visible TTFT, queueing and pipeline lag included."""
+    ``t_submit``/``t_admit``/``t_first``/``t_done`` are host-side
+    perf_counter stamps (submission, admission out of the pending queue,
+    first token OBSERVED host-side, retirement) — the latency telemetry
+    behind the TTFT/e2e percentiles the bench reports and the
+    queue-wait/prefill/decode segments the observer's lifecycle spans
+    derive (workloads/obs.py).  Under pipelined stepping emission lags a
+    chunk, so t_first is the time the engine could actually have
+    streamed the token out — the honest client-visible TTFT, queueing
+    and pipeline lag included."""
 
     rid: str
     prompt: list[int]
@@ -97,6 +100,7 @@ class Request:
     group: str | None = None
     adapter: str | None = None  # multi-LoRA: which adapter serves this
     t_submit: float | None = None
+    t_admit: float | None = None
     t_first: float | None = None
     t_done: float | None = None
 
@@ -113,6 +117,14 @@ class Request:
         if self.t_submit is None or self.t_done is None:
             return None
         return self.t_done - self.t_submit
+
+    @property
+    def queue_wait_secs(self) -> float | None:
+        """Submission -> admission out of the pending queue (None until
+        admitted): the backpressure/full-slots segment of TTFT."""
+        if self.t_submit is None or self.t_admit is None:
+            return None
+        return self.t_admit - self.t_submit
 
 
 class ServeEngine:
@@ -158,9 +170,16 @@ class ServeEngine:
         lora_alpha: float = 1.0,
         batched_admission: bool = True,
         completed_limit: int | None = None,
+        mode_trace_limit: int | None = 256,
+        observer=None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if mode_trace_limit is not None and mode_trace_limit < 1:
+            raise ValueError(
+                f"mode_trace_limit must be >= 1 or None (unbounded), got "
+                f"{mode_trace_limit}"
+            )
         if (draft_params is None) != (draft_config is None):
             raise ValueError(
                 "draft_params and draft_config come together (speculative "
@@ -238,11 +257,15 @@ class ServeEngine:
         self.spec_calibration: dict | None = None
         # Auto-mode telemetry: per-decode-step mode counts, switch count,
         # and a bounded (occupancy, mode) trace for tests and debugging.
+        # The trace bound is a constructor knob (None = unbounded), and
+        # drain_mode_trace() hands history back before the ring can drop
+        # it — long-running callers use one or the other, same contract
+        # as completed_limit/drain_completed.
         self.spec_mode_steps = 0
         self.plain_mode_steps = 0
         self.mode_switches = 0
         self._last_mode: str | None = None
-        self.decode_mode_trace: deque = deque(maxlen=256)
+        self.decode_mode_trace: deque = deque(maxlen=mode_trace_limit)
         self._overshoot = max(
             self.chunk * (2 if pipelined else 1),
             ((gamma + 1) * spec_lookahead * (2 if pipelined else 1))
@@ -327,6 +350,16 @@ class ServeEngine:
         self.prefill_dispatches = 0  # TARGET prefill program dispatches
         self.admission_readbacks = 0  # first-token host syncs
         self.spec_rounds = 0
+        self.requests_admitted = 0  # popped off pending (instant-finish too)
+        self.requests_retired = 0  # finished, at admission or mid-stream
+        # Opt-in observability (workloads/obs.py): lifecycle spans, step
+        # records, Prometheus bridge.  Inert — never touches device
+        # state, keys or scheduling; streams are bit-identical on/off
+        # (pinned by tests/test_obs.py), cost priced by the bench
+        # (obs_overhead_pct).
+        self._obs = observer
+        if observer is not None:
+            observer._bind(self)
         # Finished Request objects, in retirement order, carrying their
         # t_submit/t_first/t_done latency stamps — the TTFT/e2e source
         # for the bench and tests.  Tiny host objects, but unbounded for
@@ -597,6 +630,7 @@ class ServeEngine:
     def _retire(self, slot: int) -> Request:
         req = self._slot_req.pop(slot)
         req.t_done = time.perf_counter()
+        self.requests_retired += 1
         self.completed.append(req)
         self.ctrl.release(self._seq_id(slot, req))
         self._committed_pages -= self._slot_commit.pop(slot)
@@ -810,6 +844,27 @@ class ServeEngine:
         self.completed.clear()
         return out
 
+    def drain_mode_trace(self) -> list[tuple[int, str]]:
+        """Hand back (and clear) the (occupancy, mode) decode trace —
+        same contract as drain_completed: drain between measurement
+        windows, or bound it at construction (``mode_trace_limit``),
+        so a long stream can't silently overwrite history."""
+        out = list(self.decode_mode_trace)
+        self.decode_mode_trace.clear()
+        return out
+
+    def export_trace(self, path: str) -> int:
+        """Write the observer's recorded timeline (request lifecycle
+        spans + step records) as chrome://tracing-loadable trace_event
+        JSON; returns the event count.  Requires the engine to have been
+        constructed with ``observer=EngineObserver()``."""
+        if self._obs is None:
+            raise RuntimeError(
+                "export_trace needs an observer: construct the engine "
+                "with observer=workloads.obs.EngineObserver()"
+            )
+        return self._obs.export_trace(path)
+
     def _admit(self) -> list[Request]:
         """Fill free slots from the pending queue.
 
@@ -858,6 +913,8 @@ class ServeEngine:
                 # beats marginally fuller slots).
                 break
             req = self.pending.popleft()
+            req.t_admit = time.perf_counter()
+            self.requests_admitted += 1
             seq = self._seq_id(slot, req)
             n = len(req.prompt)
             aidx = self._adapter_ids.get(req.adapter, 0)
@@ -873,6 +930,7 @@ class ServeEngine:
                     table, req.prompt, start_page=start_page,
                     adapter_idx=aidx,
                 )
+            t_rb = time.perf_counter() if self._obs is not None else 0.0
             tok = int(
                 self._first_token(
                     logits, self._next_key(),
@@ -880,6 +938,8 @@ class ServeEngine:
                     jnp.float32(self.top_p),
                 )[0]
             )
+            if self._obs is not None:
+                self._obs._note_readback(time.perf_counter() - t_rb)
             self.admission_readbacks += 1
             req.tokens.append(tok)
             req.t_first = time.perf_counter()  # first token, queue wait included
@@ -889,6 +949,7 @@ class ServeEngine:
                 req.t_done = req.t_first
                 self.ctrl.release(seq)
                 finished.append(req)
+                self.requests_retired += 1
                 self.completed.append(req)
                 continue
             self._slot_req[slot] = req
@@ -930,6 +991,8 @@ class ServeEngine:
                 # beats marginally fuller slots).
                 break
             req = self.pending.popleft()
+            req.t_admit = time.perf_counter()
+            self.requests_admitted += 1
             seq = self._seq_id(slot, req)
             n = len(req.prompt)
             plan = {
@@ -1110,12 +1173,15 @@ class ServeEngine:
         keys = jnp.stack(
             [key_rows.get(s, zero_key) for s in range(self.slots)]
         )
+        t_rb = time.perf_counter() if self._obs is not None else 0.0
         toks = np.asarray(
             self._first_token_batch(
                 emitted, keys, jnp.float32(self.temperature),
                 jnp.int32(self.top_k), jnp.float32(self.top_p),
             )
         )  # the ONE first-token readback for the whole admission batch
+        if self._obs is not None:
+            self._obs._note_readback(time.perf_counter() - t_rb)
         self.admission_readbacks += 1
         finished, retry = [], False
         for p in plans:
@@ -1130,6 +1196,7 @@ class ServeEngine:
                 self.ctrl.release(seq)
                 self._committed_pages -= p["need"]  # tentative roll-back
                 finished.append(req)
+                self.requests_retired += 1
                 self.completed.append(req)
                 retry = True
                 continue
@@ -1166,7 +1233,20 @@ class ServeEngine:
         readback round-trip overlaps the next chunk's compute instead of
         idling the device (worth ~a round-trip per chunk on a tunnelled
         chip).  Emission/retirement decisions lag one chunk; tokens are
-        identical."""
+        identical.
+
+        With an observer attached the step is bracketed by its
+        begin/end hooks (one StepRecord per call); without one this is
+        a zero-cost passthrough."""
+        obs = self._obs
+        if obs is None:
+            return self._step_impl()
+        snap = obs._step_begin(self)
+        finished = self._step_impl()
+        obs._step_end(self, snap, finished)
+        return finished
+
+    def _step_impl(self) -> list[Request]:
         finished = self._admit()
         if not self._occupied.any():
             if self._pending_read is not None:
@@ -1270,7 +1350,10 @@ class ServeEngine:
         """Read a chunk's tokens back (the host sync point: tokens stream
         out) and apply emission/eos/retirement for the slots as they were
         at dispatch."""
+        t_rb = time.perf_counter() if self._obs is not None else 0.0
         toks = np.asarray(toks_dev)
+        if self._obs is not None:
+            self._obs._note_readback(time.perf_counter() - t_rb)
         finished = []
         for slot, req in snapshot.items():
             if req.done:
@@ -1594,7 +1677,10 @@ class ServeEngine:
         mirrors advance by the DEVICE's total advance (emission stops at
         eos/max_new; rounds past a row's retirement point are the
         superstep's documented dead compute)."""
+        t_rb = time.perf_counter() if self._obs is not None else 0.0
         committed, n_acc = (np.asarray(a) for a in arrs)
+        if self._obs is not None:
+            self._obs._note_readback(time.perf_counter() - t_rb)
         if committed.ndim == 2:  # single round -> a 1-round superstep
             committed, n_acc = committed[None], n_acc[None]
         finished = []
@@ -1745,9 +1831,20 @@ def main(argv=None) -> int:
                         help="serve N synthetic LoRA adapters multi-tenant "
                         "(requests round-robin across them + the base)")
     parser.add_argument("--lora-rank", type=int, default=8)
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="expose the engine observer's Prometheus "
+                        "metrics (plus the plugin registry) on this port's "
+                        "/metrics; 0 binds an ephemeral port and prints it; "
+                        "omit to disable (docs/OBSERVABILITY.md)")
+    parser.add_argument("--trace-out", default=None, metavar="PATH",
+                        help="write the run's chrome://tracing timeline "
+                        "(request spans + step records) to PATH at exit; "
+                        "enables the observer")
     args = parser.parse_args(argv)
     if args.requests < 1 or args.slots < 1:
         parser.error("--requests and --slots must be >= 1")
+    if args.metrics_port is not None and not 0 <= args.metrics_port <= 65535:
+        parser.error("--metrics-port must be in [0, 65535] (0 = ephemeral)")
 
     from . import lease
 
@@ -1800,12 +1897,29 @@ def main(argv=None) -> int:
             spec_kw.update(spec="auto", spec_breakeven=args.spec_breakeven)
     if args.spec_auto and not args.spec_int8_draft:
         parser.error("--spec-auto needs --spec-int8-draft (a draft model)")
+    # Opt-in observability: the observer records spans/step records for
+    # --trace-out, and --metrics-port serves its Prometheus bridge on
+    # the SHARED plugin registry (engine series land next to any plugin
+    # series this process carries).
+    observer = None
+    metrics_server = None
+    if args.metrics_port is not None or args.trace_out:
+        from .obs import EngineObserver
+
+        observer = EngineObserver()
+    if args.metrics_port is not None:
+        from tpu_device_plugin.metrics import MetricsServer, registry
+
+        observer.bind_registry(registry)
+        metrics_server = MetricsServer(args.metrics_port)
+        bound = metrics_server.start()
+        print(f"metrics: http://127.0.0.1:{bound}/metrics")
     engine = ServeEngine(
         params, config, slots=args.slots, page_size=page_size,
         prompt_bucket=bucket,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         rng=jax.random.PRNGKey(42), pipelined=args.pipelined,
-        adapters=adapters, **spec_kw,
+        adapters=adapters, observer=observer, **spec_kw,
     )
     key = jax.random.PRNGKey(7)
     for i in range(args.requests):
@@ -1844,6 +1958,14 @@ def main(argv=None) -> int:
         f"pool={engine.ctrl.n_pages} pages, "
         f"pages in use after drain: {engine.ctrl.used_pages})"
     )
+    if args.trace_out:
+        n_events = engine.export_trace(args.trace_out)
+        print(
+            f"trace: {n_events} events -> {args.trace_out} "
+            "(load in chrome://tracing or https://ui.perfetto.dev)"
+        )
+    if metrics_server is not None:
+        metrics_server.stop()
     return 0
 
 
