@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check fmt-check
 
 all: native
 
@@ -51,7 +51,18 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check test
+check: check-compat obs-check faults-check test
+
+# Fault-tolerance tripwires (docs/SERVING.md "Fault tolerance"): the
+# injector's determinism/scheduling contracts (jax-free, sub-second)
+# plus a SHORT chaos-fuzz smoke — one seeded round of randomized
+# cancels/deadlines/injected seam faults through a tiny engine,
+# asserting the lifecycle invariants (no page/slot leak, one terminal
+# status per rid, bit-identical replays).  The full multi-seed chaos
+# arm runs with the slow suite (tests/test_serve_fuzz.py).
+faults-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m workloads.faults --selfcheck
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_serve_fuzz.py::test_engine_fault_chaos_smoke" -q -o addopts=
 
 # Observability tripwires (docs/OBSERVABILITY.md): the metrics lint —
 # every name the plugin or the engine bridge emits has describe() help

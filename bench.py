@@ -549,6 +549,7 @@ COMPACT_KEYS = [
     "serve_ttft_p50_ms", "serve_ttft_p99_ms",
     "serve_e2e_p50_ms", "serve_e2e_p99_ms",
     "obs_overhead_pct", "obs_on_tokens_per_sec",
+    "fault_recovery_ms", "fault_injector_off_overhead_pct",
     "admission_tokens_per_sec", "admission_speedup",
     "admission_dispatches_per_request",
     "prefix_serve_speedup", "prefix_prefill_speedup",

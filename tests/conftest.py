@@ -61,3 +61,26 @@ def pytest_collection_modifyitems(config, items):
         name = item.module.__name__.rsplit(".", 1)[-1]
         if name not in _FAST_DESPITE_JAX and _is_slow_module(str(item.fspath)):
             item.add_marker(pytest.mark.slow)
+
+
+# Hang watchdog: the tier-1 driver kills a silent suite at its timeout
+# and all diagnosis is lost.  faulthandler dumps every thread's stack to
+# stderr shortly BEFORE that deadline instead (repeat=False: one dump,
+# then the run continues to its natural timeout), so a wedged test —
+# a deadlocked health-fanout thread, a stuck device readback — leaves
+# its stacks behind.  TEST_WATCHDOG_SECS overrides; 0 disables.
+def pytest_configure(config):
+    import faulthandler
+
+    try:
+        secs = float(os.environ.get("TEST_WATCHDOG_SECS", "780"))
+    except ValueError:
+        secs = 780.0
+    if secs > 0:
+        faulthandler.dump_traceback_later(secs, repeat=False, file=sys.stderr)
+
+
+def pytest_unconfigure(config):
+    import faulthandler
+
+    faulthandler.cancel_dump_traceback_later()

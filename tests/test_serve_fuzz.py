@@ -147,3 +147,168 @@ def test_engine_feature_matrix_fuzz():
     adapters = synthetic_adapters(CONFIG, 2, rank=4, scale=0.3, seed=3)
     for seed in range(8):
         _run_one(seed, params, draft, adapters)
+
+
+# ---- fault-tolerance chaos arm ------------------------------------------
+#
+# Randomized cancels, deadlines and injected seam faults interleaved with
+# normal traffic (spec="auto" included), asserting the lifecycle
+# invariants: every accepted rid reaches EXACTLY one terminal status, no
+# page/slot/commitment leak survives the stream, and every emitted token
+# is a true prefix of the dense reference's greedy stream — replays after
+# a quarantine are bit-identical, so even a request that faulted twice
+# must finish with the uninterrupted stream.  Greedy-only: sampled
+# replays are distributionally (not bitwise) equivalent, so they have no
+# pathwise oracle.  Deterministic seeds — failures reproduce.
+
+TERMINAL = {"ok", "cancelled", "expired", "failed"}
+
+
+def _run_chaos(seed: int, params, draft, adapters) -> None:
+    from workloads.errors import QueueFull
+    from workloads.faults import FaultInjector
+
+    rng = np.random.default_rng(seed + 4096)
+    spec = bool(rng.integers(2))
+    use_adapters = bool(rng.integers(2))
+    kw = dict(
+        slots=int(rng.integers(1, 4)),
+        page_size=int(rng.choice([4, 8])),
+        prefix_cache=bool(rng.integers(2)),
+        pipelined=bool(rng.integers(2)),
+    )
+    kw["prompt_bucket"] = int(kw["page_size"] * rng.choice([2, 3]))
+    if spec:
+        kw.update(draft_params=draft, draft_config=DRAFT_CONFIG,
+                  gamma=int(rng.integers(2, 5)),
+                  spec_lookahead=int(rng.choice([1, 2])))
+        if rng.integers(2):
+            kw.update(spec="auto", spec_breakeven=float(
+                rng.choice([0.0, 1.0, kw["slots"]])
+            ))
+    else:
+        kw["chunk"] = int(kw["page_size"] * rng.choice([1, 2]))
+    injector = FaultInjector.random(
+        seed=seed, rate=0.04, max_fires=int(rng.integers(1, 5))
+    )
+    engine = ServeEngine(
+        params, CONFIG, adapters=adapters if use_adapters else None,
+        fault_injector=injector, max_retries=2,
+        max_pending=int(rng.choice([3, 16])), **kw,
+    )
+    names = [None] + (sorted(adapters) if use_adapters else [])
+    expected = {}  # rid -> (prompt, max_new, adapter)
+    for i in range(int(rng.integers(4, 8))):
+        plen = int(rng.integers(1, 25))
+        prompt = [int(t) for t in rng.integers(0, CONFIG.vocab_size, plen)]
+        new = int(rng.integers(2, min(24, CONFIG.max_seq_len - plen) + 1))
+        adapter = names[int(rng.integers(len(names)))]
+        deadline = 0.02 if rng.integers(5) == 0 else None
+        try:
+            if rng.integers(4) == 0:
+                rids = engine.submit_fanout(
+                    prompt, new, n_samples=2, adapter=adapter,
+                    deadline_s=deadline,
+                )
+            else:
+                rids = [engine.submit(
+                    prompt, new, adapter=adapter, deadline_s=deadline,
+                )]
+        except QueueFull:
+            continue  # bounded admission did its job; nothing entered
+        for rid in rids:
+            expected[rid] = (prompt, new, adapter)
+    terminal: dict[str, str] = {}
+    steps = 0
+    while not engine.idle:
+        steps += 1
+        assert steps < 800, (seed, kw, "engine failed to converge")
+        live = [r for r in expected if r not in terminal]
+        if live and rng.integers(8) == 0:
+            engine.cancel(str(rng.choice(live)))
+        for req in engine.step():
+            assert req.rid not in terminal, (seed, req.rid, "double terminal")
+            assert req.status in TERMINAL, (seed, req.rid, req.status)
+            terminal[req.rid] = req.status
+    assert set(terminal) == set(expected), (
+        seed, kw, set(expected) - set(terminal), set(terminal) - set(expected),
+    )
+    merged_cache: dict = {}
+
+    def model_for(adapter):
+        if adapter is None:
+            return params
+        if adapter not in merged_cache:
+            merged_cache[adapter] = merge_lora(
+                params, adapters[adapter], dtype=jnp.float32
+            )
+        return merged_cache[adapter]
+
+    by_rid = {r.rid: r for r in engine.completed}
+    for rid, (prompt, new, adapter) in expected.items():
+        req = by_rid[rid]
+        ref = [int(t) for t in np.asarray(generate(
+            model_for(adapter), jnp.asarray([prompt], jnp.int32), CONFIG,
+            max_new_tokens=new,
+        )[0])]
+        got = list(req.tokens)
+        if terminal[rid] == "ok":
+            # Bit-identical INCLUDING any quarantine replays mid-stream.
+            assert got == ref, (seed, rid, kw, req.retries)
+        else:
+            # Interrupted terminally: whatever was emitted must still be
+            # a true prefix of the uninterrupted stream.
+            assert got == ref[: len(got)], (seed, rid, terminal[rid], kw)
+    # Hygiene: no slot, page, or commitment leak; fan-out bookkeeping
+    # fully unwound; only prefix-cache pins may remain.
+    assert not engine._occupied.any(), (seed, kw)
+    assert engine._committed_pages == 0, (seed, kw)
+    assert not engine._groups, (seed, kw)
+    pinned = engine.prefix.cached_pages if engine.prefix is not None else 0
+    assert engine.ctrl.used_pages == pinned, (seed, kw)
+
+
+def test_engine_fault_chaos_smoke():
+    """ONE cheap seeded chaos round — the `make faults-check` smoke
+    (plain decode, no draft model, so the compile bill stays small)."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    adapters = synthetic_adapters(CONFIG, 2, rank=4, scale=0.3, seed=3)
+    _run_chaos(2, params, None, adapters)
+
+
+def test_engine_fault_chaos_fuzz():
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    draft = init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
+    adapters = synthetic_adapters(CONFIG, 2, rank=4, scale=0.3, seed=3)
+    for seed in range(6):
+        _run_chaos(seed, params, draft, adapters)
+
+
+def test_injector_off_streams_bit_identical():
+    """The fault-tolerance machinery at rest is INERT: an armed-but-
+    never-firing injector plus live lifecycle knobs produce streams
+    bit-identical to an engine with none of it — sampling on, so the
+    whole RNG key schedule is pinned too (this is the pre-PR stream:
+    the feature-matrix fuzz above pins that same path against the dense
+    reference)."""
+    from workloads.faults import FaultInjector
+
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    prompt = [int(t) for t in range(1, 12)]
+
+    def run(**extra):
+        engine = ServeEngine(
+            params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+            temperature=0.8, top_k=40, rng=jax.random.PRNGKey(5),
+            pipelined=True, **extra,
+        )
+        for i in range(4):
+            engine.submit(prompt[: 3 + i], 8 + i)
+        return engine.run()
+
+    plain = run()
+    guarded = run(
+        fault_injector=FaultInjector(), max_pending=64, max_retries=5,
+        retry_backoff_s=0.5,
+    )
+    assert plain == guarded

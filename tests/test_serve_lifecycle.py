@@ -1,0 +1,464 @@
+"""Request-lifecycle fault tolerance (docs/SERVING.md "Fault
+tolerance"): the typed error taxonomy, cancellation and deadlines,
+bounded admission, close()/context-manager shutdown, the HealthFanout
+bridge, and step-level quarantine + bit-identical replay under injected
+seam faults — each contract pinned in isolation (tests/test_serve_fuzz
+sweeps them interleaved)."""
+
+import queue
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads import (
+    EngineClosed,
+    InvalidRequest,
+    QueueFull,
+    RequestTooLarge,
+    ServeError,
+)
+from workloads.faults import SEAMS, FaultInjector, InjectedFault
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+DRAFT_CONFIG = ModelConfig(
+    max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+PROMPT = [1, 2, 3, 4, 5, 6, 7]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CONFIG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def draft():
+    return init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
+
+
+def _engine(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prompt_bucket", 8)
+    return ServeEngine(params, CONFIG, **kw)
+
+
+def _ref(params, prompt, n):
+    return [int(t) for t in np.asarray(generate(
+        params, jnp.asarray([prompt], jnp.int32), CONFIG, max_new_tokens=n,
+    )[0])]
+
+
+def _statuses(engine):
+    return {r.rid: r.status for r in engine.completed}
+
+
+# ---- typed error taxonomy ----------------------------------------------
+
+
+def test_error_taxonomy_types_and_messages(params):
+    engine = _engine(params)
+    # Size errors are RequestTooLarge AND (for back-compat) ValueError,
+    # with the historical messages intact.
+    with pytest.raises(RequestTooLarge, match="prompt length"):
+        engine.submit([])
+    with pytest.raises(ValueError, match="prompt length"):
+        engine.submit([1] * CONFIG.max_seq_len)
+    with pytest.raises(RequestTooLarge, match="exceeds max_seq_len"):
+        engine.submit(PROMPT, CONFIG.max_seq_len)
+    small = ServeEngine(
+        params, CONFIG, slots=1, page_size=4, prompt_bucket=8, n_pages=2
+    )
+    with pytest.raises(RequestTooLarge, match="never be admitted"):
+        small.submit(PROMPT, 40)
+    with pytest.raises(InvalidRequest, match="unknown adapter"):
+        engine.submit(PROMPT, 2, adapter="nope")
+    with pytest.raises(InvalidRequest, match="max_new_tokens"):
+        engine.submit(PROMPT, 0)
+    with pytest.raises(InvalidRequest, match="deadline_s"):
+        engine.submit(PROMPT, 2, deadline_s=0)
+    with pytest.raises(InvalidRequest, match="n_samples"):
+        engine.submit_fanout(PROMPT, 2, n_samples=0)
+    engine.submit(PROMPT, 2, rid="dup")
+    with pytest.raises(InvalidRequest, match="already in flight"):
+        engine.submit(PROMPT, 2, rid="dup")
+    # Everything is a ServeError; the hierarchy is importable from the
+    # package root.
+    for exc in (InvalidRequest, RequestTooLarge, QueueFull, EngineClosed):
+        assert issubclass(exc, ServeError)
+    assert issubclass(RequestTooLarge, InvalidRequest)
+    engine.run()
+
+
+def test_queue_full_is_typed_and_counted(params):
+    engine = _engine(params, slots=1, max_pending=2)
+    engine.submit(PROMPT, 2)
+    engine.submit(PROMPT, 2)
+    with pytest.raises(QueueFull) as exc_info:
+        engine.submit(PROMPT, 2)
+    assert exc_info.value.request.status == "rejected"
+    with pytest.raises(QueueFull):
+        engine.submit_fanout(PROMPT, 2, n_samples=2)
+    assert engine.queue_rejections == 2
+    served = engine.run()
+    assert len(served) == 2  # the accepted ones, untouched
+    assert set(_statuses(engine).values()) == {"ok"}
+
+
+# ---- cancellation and deadlines ----------------------------------------
+
+
+def test_cancel_queued_and_running(params):
+    engine = _engine(params, slots=1, pipelined=True)
+    r1 = engine.submit(PROMPT, 20)
+    r2 = engine.submit(PROMPT, 20)
+    engine.step()
+    engine.step()
+    assert engine.cancel(r2) is True  # still queued: never admitted
+    assert engine.cancel(r1) is True  # running: drained, slot recycled
+    assert engine.cancel(r1) is False  # already terminal
+    assert engine.cancel("ghost") is False
+    out = engine.run()
+    sts = _statuses(engine)
+    assert sts == {r1: "cancelled", r2: "cancelled"}
+    by_rid = {r.rid: r for r in engine.completed}
+    assert by_rid[r2].tokens == [] and by_rid[r2].t_admit is None
+    # The running request keeps its already-emitted prefix of the true
+    # stream (cancel stops it, it does not rewrite history).
+    ref = _ref(params, PROMPT, 20)
+    assert by_rid[r1].tokens == ref[: len(by_rid[r1].tokens)]
+    assert set(out) == {r1, r2}
+    assert engine.ctrl.used_pages == 0 and not engine._occupied.any()
+    assert engine.requests_cancelled == 2
+
+
+def test_cancel_pending_fanout_member_unwinds_group(params):
+    engine = _engine(params, slots=1)
+    ga, gb = engine.submit_fanout(PROMPT, 6, n_samples=2)
+    engine.step()  # one slot: ga admits, gb still pending
+    assert engine.cancel(gb)
+    engine.run()
+    sts = _statuses(engine)
+    assert sts == {ga: "ok", gb: "cancelled"}
+    assert not engine._groups  # countdown ran despite the cancel
+    assert engine.ctrl.used_pages == 0
+
+
+def test_deadline_expires_queued_and_running(params):
+    engine = _engine(params, slots=1)
+    ra = engine.submit(PROMPT, 30)
+    rb = engine.submit(PROMPT, 30, deadline_s=0.001)  # starves in queue
+    time.sleep(0.01)
+    engine.run()
+    sts = _statuses(engine)
+    assert sts == {ra: "ok", rb: "expired"}
+    assert engine.requests_expired == 1
+
+    engine2 = _engine(params, slots=1, pipelined=True)
+    rc = engine2.submit(PROMPT, 40, deadline_s=0.05)
+    t0 = time.perf_counter()
+    while not engine2.idle and time.perf_counter() - t0 < 30:
+        engine2.step()
+    sts = _statuses(engine2)
+    by_rid = {r.rid: r for r in engine2.completed}
+    # Fast hosts may finish all 40 tokens inside the deadline; either
+    # way the terminal status is single and the state drains.
+    assert sts[rc] in ("ok", "expired")
+    if sts[rc] == "expired":
+        ref = _ref(params, PROMPT, 40)
+        assert by_rid[rc].tokens == ref[: len(by_rid[rc].tokens)]
+    assert engine2.ctrl.used_pages == 0 and not engine2._occupied.any()
+
+
+# ---- close() / context manager -----------------------------------------
+
+
+def test_close_fails_inflight_and_is_idempotent(params):
+    engine = _engine(params, slots=1, prefix_cache=True)
+    r1 = engine.submit(PROMPT, 30)
+    r2 = engine.submit(PROMPT, 30)
+    engine.step()
+    engine.close()
+    engine.close()  # idempotent
+    assert engine.closed
+    sts = {r.rid: (r.status, r.error) for r in engine.completed}
+    for rid in (r1, r2):
+        assert sts[rid][0] == "failed" and "EngineClosed" in sts[rid][1]
+    assert engine.ctrl.used_pages == 0  # prefix pins flushed too
+    assert engine._committed_pages == 0
+    with pytest.raises(EngineClosed):
+        engine.submit(PROMPT, 2)
+    with pytest.raises(EngineClosed):
+        engine.step()
+    with pytest.raises(EngineClosed):
+        engine.cancel(r1)
+
+
+def test_context_manager_closes_and_unbinds_gauges(params):
+    from tpu_device_plugin.metrics import Registry
+    from workloads.obs import EngineObserver
+
+    reg = Registry()
+    obs = EngineObserver()
+    obs.bind_registry(reg)
+    assert any(n.startswith("engine_") for n, _ in reg._gauges)
+    with _engine(params, observer=obs) as engine:
+        rid = engine.submit(PROMPT, 4)
+        engine.run()
+    assert engine.closed
+    # close() released the gauge collectors (they would otherwise pin
+    # the engine — and its params/pools — on the registry forever).
+    assert not any(n.startswith("engine_") for n, _ in reg._gauges)
+    assert _statuses(engine)[rid] == "ok"
+    # Lifecycle counters reached the registry through the bridge.
+    assert "engine_requests_retired_total" in reg.render()
+
+
+def test_close_leaves_engine_idle_and_flushes_counters(params):
+    """close() must not strand state step() can never drain: the closed
+    engine reads idle, and the close-failed requests' counter deltas and
+    spans reach the registry BEFORE the gauges unbind (step() refuses to
+    run afterwards, so the step-boundary push can never fire again)."""
+    from tpu_device_plugin.metrics import PREFIX, Registry
+    from workloads.obs import EngineObserver
+
+    reg = Registry()
+    obs = EngineObserver(name="closing")
+    obs.bind_registry(reg)
+    engine = _engine(params, slots=1, observer=obs)
+    r1 = engine.submit(PROMPT, 30)
+    r2 = engine.submit(PROMPT, 30)
+    engine.step()
+    engine.close()
+    assert engine.idle  # nothing left that a step could ever surface
+    assert engine.requests_failed == 2
+    assert (
+        f"{PREFIX}_engine_requests_failed_total{{engine=\"closing\"}} 2"
+        in reg.render()
+    )
+    spans = {s.rid: s for s in obs.drain_spans()}
+    assert spans[r1].status == "failed"
+    assert spans[r2].status == "failed"
+
+
+# ---- fault injection: quarantine + replay ------------------------------
+
+
+def test_fault_replay_is_bit_identical_per_seam(params):
+    ref = _ref(params, PROMPT, 12)
+    baseline = None
+    for seam, crossing in (
+        # One sweep admits the whole two-request stream, so the prefill
+        # seams fault on their FIRST crossing; decode seams mid-stream.
+        ("prefill_dispatch", 1), ("prefill_readback", 1),
+        ("decode_dispatch", 2), ("decode_readback", 2),
+    ):
+        engine = _engine(
+            params, pipelined=True,
+            fault_injector=FaultInjector({seam: [crossing]}),
+        )
+        r1 = engine.submit(PROMPT, 12)
+        r2 = engine.submit(PROMPT[:3], 8)
+        out = engine.run()
+        assert out[r1] == ref, (seam, out[r1])
+        if baseline is None:
+            baseline = out
+        assert out == baseline, seam
+        assert engine.steps_quarantined >= 1, seam
+        assert len(engine.fault_recovery_s) >= 1, seam
+        assert set(_statuses(engine).values()) == {"ok"}, seam
+        assert engine.ctrl.used_pages == 0 and engine._committed_pages == 0
+
+
+def test_fault_replay_spec_seams(params, draft):
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+        draft_params=draft, draft_config=DRAFT_CONFIG, gamma=3,
+        pipelined=True,
+        fault_injector=FaultInjector(
+            {"spec_dispatch": [2], "spec_readback": [3]}
+        ),
+    )
+    rid = engine.submit(PROMPT, 12)
+    out = engine.run()
+    assert out[rid] == _ref(params, PROMPT, 12)
+    assert engine.steps_quarantined == 2
+    assert engine.ctrl.used_pages == 0 and not engine._occupied.any()
+
+
+def test_retry_budget_exhaustion_fails_terminally(params):
+    engine = _engine(
+        params, slots=1, max_retries=2,
+        fault_injector=FaultInjector(
+            {"decode_dispatch": list(range(1, 20))}
+        ),
+    )
+    rid = engine.submit(PROMPT, 12)
+    engine.run()
+    req = {r.rid: r for r in engine.completed}[rid]
+    assert req.status == "failed"
+    assert req.retries == 3  # budget + the final straw
+    assert "InjectedFault" in req.error
+    assert engine.requests_failed == 1
+    assert engine.ctrl.used_pages == 0 and engine._committed_pages == 0
+
+
+def test_injector_seams_are_exactly_the_engine_seams():
+    """Every seam the injector knows is one the engine actually crosses
+    (grep the source for the check call), and vice versa — a renamed
+    seam string would otherwise never fire."""
+    import os
+    import re
+
+    src = open(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "workloads", "serve.py",
+    ), encoding="utf-8").read()
+    crossed = set(re.findall(r'_maybe_fault\("([a-z_]+)"\)', src))
+    assert crossed == set(SEAMS)
+
+
+def test_injected_fault_carries_seam_and_crossing():
+    inj = FaultInjector({"decode_readback": 1})
+    with pytest.raises(InjectedFault) as exc_info:
+        inj.check("decode_readback")
+    assert exc_info.value.seam == "decode_readback"
+    assert exc_info.value.crossing == 1
+
+
+# ---- health bridge ------------------------------------------------------
+
+
+def test_health_bridge_pauses_requeues_and_resumes(params):
+    from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+    from tpu_device_plugin.device import HealthEvent
+
+    q = queue.Queue()
+    engine = _engine(params, health_events=q, pipelined=True)
+    rid = engine.submit(PROMPT, 12)
+    engine.step()
+    engine.step()
+    q.put(HealthEvent(chip_id="chip-0", health=UNHEALTHY, code=2))
+    engine.step()
+    assert engine.paused
+    assert not engine._occupied.any()  # in-flight work requeued
+    assert engine.pending and engine.pending[0].rid == rid
+    assert engine.pending[0].retries == 0  # no retry-budget charge
+    occupancy_during_pause = engine._occupied.any()
+    engine.step()  # held: no admission happens
+    assert not occupancy_during_pause and not engine._occupied.any()
+    # A second failing class while down must not flip anything.
+    q.put(HealthEvent(chip_id="chip-1", health=UNHEALTHY, code=0))
+    engine.step()
+    assert engine.paused
+    q.put(HealthEvent(chip_id="chip-0", health=HEALTHY, code=2))
+    engine.step()
+    assert engine.paused  # chip-1 still down
+    q.put(HealthEvent(chip_id="chip-1", health=HEALTHY, code=0))
+    out = engine.run()
+    assert not engine.paused
+    assert out[rid] == _ref(params, PROMPT, 12)  # replay bit-identical
+    assert _statuses(engine)[rid] == "ok"
+    assert engine.requests_retried >= 1
+
+
+def test_health_unattributed_events_mix_with_per_chip(params):
+    """HealthEvent's chip_id="" means "all chips" (the event could not
+    be attributed).  On a raw health_events= queue an unattributed
+    Healthy is the all-clear that lifts EVERY mark — a mixed-attribution
+    stream must never strand the engine paused."""
+    from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+    from tpu_device_plugin.device import HealthEvent
+
+    q = queue.Queue()
+    engine = _engine(params, health_events=q)
+    rid = engine.submit(PROMPT, 8)
+    # Per-chip fault, unattributed all-clear.
+    q.put(HealthEvent(chip_id="chip-0", health=UNHEALTHY, code=2))
+    engine.step()
+    assert engine.paused
+    q.put(HealthEvent(chip_id="", health=HEALTHY))
+    engine.step()
+    assert not engine.paused
+    # Unattributed fault: only the unattributed all-clear lifts it.
+    q.put(HealthEvent(chip_id="", health=UNHEALTHY, code=2))
+    engine.step()
+    assert engine.paused
+    q.put(HealthEvent(chip_id="chip-0", health=HEALTHY, code=2))
+    engine.step()
+    assert engine.paused  # the fault was never attributed to chip-0
+    q.put(HealthEvent(chip_id="", health=HEALTHY))
+    out = engine.run()
+    assert not engine.paused
+    assert out[rid] == _ref(params, PROMPT, 8)
+    assert _statuses(engine)[rid] == "ok"
+
+
+def test_bind_health_subscribes_and_close_unsubscribes(params):
+    class FakeFanout:
+        def __init__(self):
+            self.q = queue.Queue()
+            self.unsubscribed = None
+
+        def subscribe(self):
+            return self.q
+
+        def unsubscribe(self, q):
+            self.unsubscribed = q
+
+    fanout = FakeFanout()
+    engine = _engine(params)
+    engine.bind_health(fanout)
+    with pytest.raises(RuntimeError, match="already bound"):
+        engine.bind_health(fanout)
+    rid = engine.submit(PROMPT, 4)
+    engine.run()
+    engine.close()
+    assert fanout.unsubscribed is fanout.q
+    assert _statuses(engine)[rid] == "ok"
+
+
+# ---- observer integration ----------------------------------------------
+
+
+def test_span_status_and_lifecycle_counters_on_registry(params):
+    from tpu_device_plugin.metrics import PREFIX, Registry
+    from workloads.obs import EngineObserver
+
+    reg = Registry()
+    obs = EngineObserver(name="ft")
+    obs.bind_registry(reg)
+    engine = _engine(
+        params, slots=1, max_pending=2, observer=obs,
+        fault_injector=FaultInjector({"decode_dispatch": [2]}),
+    )
+    r1 = engine.submit(PROMPT, 10)
+    r2 = engine.submit(PROMPT, 10, deadline_s=0.001)
+    with pytest.raises(QueueFull):
+        engine.submit(PROMPT, 2)
+    time.sleep(0.01)
+    engine.run()
+    spans = {s.rid: s for s in obs.drain_spans()}
+    assert spans[r1].status == "ok"
+    assert spans[r2].status == "expired"
+    text = reg.render()
+    assert f"{PREFIX}_engine_requests_expired_total" in text
+    assert f"{PREFIX}_engine_queue_rejections_total" in text
+    assert f"{PREFIX}_engine_requests_retried_total" in text
+    # The trace export carries the terminal status per request lane.
+    from workloads.obs import trace_events
+
+    obs.spans.extend(spans.values())
+    trace = trace_events(obs)
+    span_args = [
+        e["args"] for e in trace["traceEvents"]
+        if e.get("cat") == "request"
+    ]
+    assert any(a.get("status") == "expired" for a in span_args)

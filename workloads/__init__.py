@@ -12,4 +12,25 @@ chips handed out by the plugin.  TPU-native equivalents:
     so oversubscribed pods must coordinate; SURVEY.md §7 hard part #1).
   * ``busy_probe`` — measures aggregate chip-busy %, the BASELINE.md
     north-star metric the reference never had instrumentation for.
+
+The serving engine's typed error taxonomy (workloads/errors.py) is
+re-exported here so callers can ``from workloads import QueueFull``
+without knowing the module layout; errors.py is dependency-free, so
+this package stays importable without jax for host-only tooling.
 """
+
+from .errors import (  # noqa: F401
+    EngineClosed,
+    InvalidRequest,
+    QueueFull,
+    RequestTooLarge,
+    ServeError,
+)
+
+__all__ = [
+    "ServeError",
+    "InvalidRequest",
+    "RequestTooLarge",
+    "QueueFull",
+    "EngineClosed",
+]

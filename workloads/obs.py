@@ -92,6 +92,28 @@ ENGINE_METRICS: tuple[MetricSpec, ...] = (
         "target prefill program dispatches (admission sweeps and chunks)",
     ),
     MetricSpec(
+        "engine_requests_cancelled_total", "counter", ("engine",),
+        "requests cancelled via engine.cancel (queued or running)",
+    ),
+    MetricSpec(
+        "engine_requests_expired_total", "counter", ("engine",),
+        "requests whose deadline_s passed before completion",
+    ),
+    MetricSpec(
+        "engine_requests_failed_total", "counter", ("engine",),
+        "requests failed terminally (retry budget exhausted, or the "
+        "engine closed over them)",
+    ),
+    MetricSpec(
+        "engine_requests_retried_total", "counter", ("engine",),
+        "replay requeues after a quarantined step (prompt + emitted "
+        "tokens re-prefilled; greedy streams resume bit-identically)",
+    ),
+    MetricSpec(
+        "engine_queue_rejections_total", "counter", ("engine",),
+        "submissions rejected by bounded admission (max_pending)",
+    ),
+    MetricSpec(
         "engine_queue_depth", "gauge", ("engine",),
         "requests waiting in the pending queue (scrape-time)",
     ),
@@ -127,7 +149,11 @@ class RequestSpan:
     """One finished request's lifecycle, flattened from its Request
     stamps at retirement.  Segment invariants (``t_submit <= t_admit <=
     t_first <= t_done``) hold whenever the engine stamped all four;
-    requests that finish AT admission have ``t_first == t_done``."""
+    requests that finish AT admission have ``t_first == t_done``.
+
+    ``status`` is the request's terminal status ("ok" / "cancelled" /
+    "expired" / "failed") — non-ok spans may be missing admit/first
+    stamps (a request cancelled while queued never admitted)."""
 
     rid: str
     t_submit: float
@@ -135,6 +161,7 @@ class RequestSpan:
     t_first: float | None
     t_done: float
     n_tokens: int
+    status: str = "ok"
 
     @property
     def queue_wait_secs(self) -> float | None:
@@ -173,6 +200,7 @@ class RequestSpan:
             rid=req.rid, t_submit=req.t_submit, t_admit=req.t_admit,
             t_first=req.t_first, t_done=req.t_done,
             n_tokens=len(req.tokens),
+            status=getattr(req, "status", "ok"),
         )
 
 
@@ -237,6 +265,12 @@ class EngineObserver:
         self._registry = None
         self._labels: dict = {}
         self._engine = None
+        # Last value pushed to the registry per lifecycle counter: these
+        # engine counters can also move BETWEEN steps (cancel(),
+        # QueueFull rejections at submit time), so per-step snapshot
+        # deltas would drop those increments — each _step_end pushes
+        # the difference against the engine's running total instead.
+        self._pushed: dict[str, float] = {}
 
     # ---- registry bridge -------------------------------------------------
 
@@ -272,6 +306,17 @@ class EngineObserver:
         "engine_slot_occupancy": lambda e: int(e._occupied.sum()),
         "engine_slots": lambda e: e.slots,
         "engine_resident_pages": lambda e: e.ctrl.used_pages,
+    }
+
+    # Lifecycle counter families -> the ServeEngine attribute carrying
+    # the running total (fault-tolerance telemetry; the catalog, the
+    # lint test and the rendered docs all see these via ENGINE_METRICS).
+    _LIFECYCLE_COUNTERS = {
+        "engine_requests_cancelled_total": "requests_cancelled",
+        "engine_requests_expired_total": "requests_expired",
+        "engine_requests_failed_total": "requests_failed",
+        "engine_requests_retried_total": "requests_retried",
+        "engine_queue_rejections_total": "queue_rejections",
     }
 
     def unbind_registry(self) -> None:
@@ -360,11 +405,7 @@ class EngineObserver:
         if len(self.steps) == self.steps.maxlen:
             self.dropped_steps += 1
         self.steps.append(rec)
-        new_spans = [RequestSpan.from_request(req) for req in finished]
-        for span in new_spans:
-            if len(self.spans) == self.spans.maxlen:
-                self.dropped_spans += 1
-            self.spans.append(span)
+        new_spans = self._record_spans(finished)
         reg = self._registry
         if reg is not None:
             labels = self._labels
@@ -382,6 +423,7 @@ class EngineObserver:
             switches = engine.mode_switches - ms0
             if switches:
                 reg.inc("engine_mode_switches_total", labels, switches)
+            self._push_lifecycle(engine, reg, labels)
             if mode != "idle":
                 reg.inc(
                     "engine_decode_steps_total", {**labels, "mode": mode}
@@ -394,6 +436,46 @@ class EngineObserver:
                     )
                 reg.observe_seconds("engine_e2e", span.e2e_secs, labels)
         return rec
+
+    def _record_spans(self, finished) -> list[RequestSpan]:
+        """Append one RequestSpan per finished request to the bounded
+        ring, counting drops; returns the new spans."""
+        new_spans = [RequestSpan.from_request(req) for req in finished]
+        for span in new_spans:
+            if len(self.spans) == self.spans.maxlen:
+                self.dropped_spans += 1
+            self.spans.append(span)
+        return new_spans
+
+    def _push_lifecycle(self, engine, reg, labels) -> None:
+        """Push the lifecycle counter families as deltas against the
+        engine's running totals (totals, not per-step increments, so
+        between-step transitions — cancels, rejections, close-time
+        fails — land on the registry too)."""
+        for metric, attr in self._LIFECYCLE_COUNTERS.items():
+            total = float(getattr(engine, attr, 0))
+            delta = total - self._pushed.get(metric, 0.0)
+            if delta:
+                reg.inc(metric, labels, delta)
+                self._pushed[metric] = total
+
+    def _engine_closed(self, engine, finished) -> None:
+        """Final flush at ``engine.close()``: counters are pushed and
+        spans recorded at step boundaries, but close() fails in-flight
+        work and then refuses further steps — so the last lifecycle
+        deltas and the close-failed requests' spans land here, before
+        the registry unbinds (a shutdown that failed N requests must
+        not scrape as 0 failures)."""
+        new_spans = self._record_spans(finished)
+        reg = self._registry
+        if reg is None:
+            return
+        labels = self._labels
+        self._push_lifecycle(engine, reg, labels)
+        for span in new_spans:
+            if span.ttft_secs is not None:
+                reg.observe_seconds("engine_ttft", span.ttft_secs, labels)
+            reg.observe_seconds("engine_e2e", span.e2e_secs, labels)
 
     # ---- drains ---------------------------------------------------------
 
@@ -451,8 +533,12 @@ def trace_events(observer: EngineObserver) -> dict:
             {"ph": "M", "pid": 1, "tid": lane, "name": "thread_name",
              "args": {"name": span.rid}}
         )
+        # A request that reached a terminal status while still queued
+        # (cancelled/expired/failed-at-close) has no admit/first stamps;
+        # its queued segment runs to t_done so the lane still shows it.
         segments = (
-            ("queued", span.t_submit, span.t_admit),
+            ("queued", span.t_submit,
+             span.t_admit if span.t_admit is not None else span.t_done),
             ("prefill", span.t_admit, span.t_first),
             ("decode", span.t_first, span.t_done),
         )
@@ -463,7 +549,10 @@ def trace_events(observer: EngineObserver) -> dict:
                 "ph": "X", "pid": 1, "tid": lane, "cat": "request",
                 "name": name, "ts": _us(start, t0),
                 "dur": max(_us(end, t0) - _us(start, t0), 0.0),
-                "args": {"rid": span.rid, "tokens": span.n_tokens},
+                "args": {
+                    "rid": span.rid, "tokens": span.n_tokens,
+                    "status": span.status,
+                },
             })
     for rec in steps:
         events.append({

@@ -728,6 +728,125 @@ def measure_obs_overhead(scale: BenchScale) -> dict:
     }
 
 
+def measure_fault_recovery(scale: BenchScale) -> dict:
+    """Fault tolerance must be provably cheap AND provably fast: the
+    composed serve stream (int8 base, pipelined stepping, greedy so
+    replayed streams are bit-comparable) runs three ways —
+
+      1. no injector at all (the baseline),
+      2. an ARMED-BUT-INERT injector (every seam consults it, nothing
+         fires): the production cost of carrying the seam checks,
+         published as ``fault_injector_off_overhead_pct`` (interleaved
+         repeats, median per-pair loss with min/max spread; the docs'
+         within-noise claim reads from this field),
+      3. one injected ``decode_dispatch`` fault mid-stream: the engine
+         quarantines the step, requeues by replay, and the measured
+         quarantine -> first-good-readback window is published as
+         ``fault_recovery_ms`` (median over repeats with spread).
+
+    The faulted run's token streams are ASSERTED equal to the baseline's
+    (replay is bit-identical under greedy) — a recovery number for a
+    stream that lost tokens would be a lie."""
+    import statistics
+
+    from .faults import FaultInjector
+    from .quant import quantize_params
+    from .serve import ServeEngine
+
+    batch, ps = scale.batch, scale.page_size
+    chunk = ps
+    hi = scale.serve_chunks[1]
+    prompt_len = scale.decode_prompt
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
+        n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=prompt_len + 1 + hi * chunk,
+    )
+    params = quantize_params(
+        jax.tree.map(
+            lambda w: w.astype(config.dtype),
+            init_params(config, jax.random.PRNGKey(0)),
+        )
+    )
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(1), (prompt_len,), 0, config.vocab_size, jnp.int32
+    )]
+    n_req = 2 * batch
+
+    def serve(injector, schedule=None):
+        """One measured stream; returns (tokens/s, streams, engine).
+        ``schedule`` arms the injector only AFTER warmup (reset + arm),
+        so the scheduled fault lands at a deterministic mid-stream
+        dispatch regardless of how many seams warmup crossed."""
+        engine = ServeEngine(
+            params, config, slots=batch, page_size=ps, chunk=chunk,
+            prompt_bucket=-(-prompt_len // ps) * ps,
+            pipelined=True, fault_injector=injector, max_retries=4,
+        )
+        engine.submit(prompt, 1 + hi * chunk)  # warm every compile
+        engine.run()
+        if injector is not None:
+            injector.reset()
+            if schedule:
+                injector.arm(schedule)
+        before = engine.generated_tokens
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            engine.submit(prompt, 1 + chunk * (1 + i % hi))
+        streams = engine.run()
+        rate = (engine.generated_tokens - before) / (
+            time.perf_counter() - t0
+        )
+        return rate, streams, engine
+
+    off_s, armed_s = _interleaved_repeats(
+        lambda: serve(None)[0], lambda: serve(FaultInjector())[0]
+    )
+    overheads = [
+        (off - on) / max(off, 1e-9) * 100.0
+        for off, on in zip(off_s, armed_s)
+    ]
+
+    _, ref_streams, _ = serve(None)
+    recoveries: list[float] = []
+    retried = 0
+    for _ in range(3):
+        injector = FaultInjector()
+        _, streams, engine = serve(
+            injector, schedule={"decode_dispatch": [3]}
+        )
+        if streams != ref_streams:
+            # Guard, not assert (python -O): a recovery-latency number
+            # over a stream that lost or changed tokens is meaningless.
+            raise RuntimeError(
+                "fault-recovery bench: replayed streams diverged from "
+                "the baseline — replay is supposed to be bit-identical"
+            )
+        if len(engine.fault_recovery_s) != 1 or engine.steps_quarantined != 1:
+            raise RuntimeError(
+                f"fault-recovery bench expected exactly one quarantine/"
+                f"recovery, saw {engine.steps_quarantined}/"
+                f"{len(engine.fault_recovery_s)}"
+            )
+        recoveries.extend(engine.fault_recovery_s)
+        retried += engine.requests_retried
+    rec_ms = [r * 1000 for r in recoveries]
+    return {
+        "fault_recovery_ms": round(statistics.median(rec_ms), 2),
+        "fault_recovery_ms_min": round(min(rec_ms), 2),
+        "fault_recovery_ms_max": round(max(rec_ms), 2),
+        "fault_recovery_requeued": retried,
+        "fault_injector_off_overhead_pct": round(
+            statistics.median(overheads), 2
+        ),
+        "fault_injector_off_overhead_pct_min": round(min(overheads), 2),
+        "fault_injector_off_overhead_pct_max": round(max(overheads), 2),
+        "fault_baseline_tokens_per_sec": round(statistics.median(off_s), 1),
+        "fault_armed_tokens_per_sec": round(statistics.median(armed_s), 1),
+        "fault_requests": n_req,
+    }
+
+
 def measure_admission(scale: BenchScale) -> dict:
     """Admission throughput: serial (one batch-1 prefill dispatch + one
     first-token readback PER admitted request) vs BATCHED (one multi-row
@@ -1601,6 +1720,7 @@ def run(scale_name: str = "full", pool_with: dict | None = None) -> dict:
     out.update(measure_serve(scale))
     out.update(measure_serve_latency(scale))
     out.update(measure_obs_overhead(scale))
+    out.update(measure_fault_recovery(scale))
     out.update(measure_admission(scale))
     out.update(measure_prefix_serve(scale))
     out.update(measure_spec_serve(scale))

@@ -59,6 +59,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .errors import (
+    EngineClosed,
+    InvalidRequest,
+    QueueFull,
+    RequestTooLarge,
+)
 from .generate import sample_logits
 from .model import ModelConfig, init_params
 from .paged import (
@@ -89,7 +95,18 @@ class Request:
     derive (workloads/obs.py).  Under pipelined stepping emission lags a
     chunk, so t_first is the time the engine could actually have
     streamed the token out — the honest client-visible TTFT, queueing
-    and pipeline lag included."""
+    and pipeline lag included.
+
+    ``status`` is the request lifecycle: ``"queued"`` -> ``"running"``
+    -> exactly ONE terminal status — ``"ok"`` (finished normally),
+    ``"cancelled"`` (engine.cancel), ``"expired"`` (``deadline_s``
+    passed), or ``"failed"`` (retry budget exhausted after seam faults,
+    or the engine closed).  A ``QueueFull`` rejection never constructs
+    an engine-side Request, so ``"rejected"`` lives only on the object
+    attached to the raised exception.  ``error`` carries the terminal
+    failure's description; ``retries`` counts fault-recovery replays
+    (each replay re-prefills prompt + already-emitted tokens, so the
+    resumed greedy stream is bit-identical to an uninterrupted one)."""
 
     rid: str
     prompt: list[int]
@@ -103,6 +120,11 @@ class Request:
     t_admit: float | None = None
     t_first: float | None = None
     t_done: float | None = None
+    status: str = "queued"
+    error: str | None = None
+    retries: int = 0
+    deadline_s: float | None = None
+    t_deadline: float | None = None  # absolute perf_counter deadline
 
     @property
     def ttft_secs(self) -> float | None:
@@ -172,9 +194,25 @@ class ServeEngine:
         completed_limit: int | None = None,
         mode_trace_limit: int | None = 256,
         observer=None,
+        max_pending: int | None = None,
+        fault_injector=None,
+        max_retries: int = 2,
+        retry_backoff_s: float = 0.0,
+        health_events=None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_pending is not None and max_pending < 1:
+            raise ValueError(
+                f"max_pending must be >= 1 or None (unbounded), got "
+                f"{max_pending}"
+            )
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {max_retries}")
+        if retry_backoff_s < 0:
+            raise ValueError(
+                f"retry_backoff_s must be >= 0, got {retry_backoff_s}"
+            )
         if mode_trace_limit is not None and mode_trace_limit < 1:
             raise ValueError(
                 f"mode_trace_limit must be >= 1 or None (unbounded), got "
@@ -352,6 +390,42 @@ class ServeEngine:
         self.spec_rounds = 0
         self.requests_admitted = 0  # popped off pending (instant-finish too)
         self.requests_retired = 0  # finished, at admission or mid-stream
+        # Request-lifecycle fault tolerance (docs/SERVING.md "Fault
+        # tolerance"): bounded admission, cancellation/deadlines, and
+        # step-level recovery — a dispatch/readback failure quarantines
+        # the step (pages released, slots recycled, pipelined state
+        # dropped) and requeues the affected requests by REPLAY
+        # (prompt + already-emitted tokens re-prefilled, so the resumed
+        # greedy stream is bit-identical) under a bounded retry budget.
+        self.max_pending = max_pending
+        self.max_retries = max_retries
+        self.retry_backoff_s = float(retry_backoff_s)
+        self._faults = fault_injector
+        self._closed = False
+        # Terminal-status counters (mirrored onto the metrics registry
+        # by the observer: engine_requests_{cancelled,expired,failed,
+        # retried}_total, engine_queue_rejections_total).
+        self.requests_cancelled = 0
+        self.requests_expired = 0
+        self.requests_failed = 0
+        self.requests_retried = 0  # replay requeues after a quarantine
+        self.queue_rejections = 0
+        self.steps_quarantined = 0
+        self.fault_recovery_s: list[float] = []  # quarantine -> next good readback
+        self._t_last_fault: float | None = None
+        self._consecutive_faults = 0
+        # Requests finished OUTSIDE step()'s own return path (cancel(),
+        # deadline expiry, health-bridge requeues that exhaust the retry
+        # budget) surface through the next step()'s return value.
+        self._finished_buffer: list[Request] = []
+        # Health bridge: a queue.Queue of tpu_device_plugin HealthEvents
+        # (HealthFanout.subscribe()) polled non-blockingly each step; an
+        # Unhealthy chip pauses admission and requeues in-flight work,
+        # recovery resumes it.  bind_health() wires a fanout directly.
+        self._health_events = health_events
+        self._health_fanout = None
+        self._unhealthy_chips: set[str] = set()
+        self._paused = False
         # Opt-in observability (workloads/obs.py): lifecycle spans, step
         # records, Prometheus bridge.  Inert — never touches device
         # state, keys or scheduling; streams are bit-identical on/off
@@ -509,16 +583,19 @@ class ServeEngine:
         eos_token: int | None = None,
         rid: str | None = None,
         adapter: str | None = None,
+        deadline_s: float | None = None,
     ) -> str:
+        if self._closed:
+            raise EngineClosed("engine is closed; submissions are refused")
         prompt = [int(t) for t in prompt]
         if adapter is not None and adapter not in self._adapter_ids:
-            raise ValueError(
+            raise InvalidRequest(
                 f"unknown adapter {adapter!r}: engine serves "
                 f"{sorted(self._adapter_ids) or '(base only)'}"
             )
         limit = self.config.max_seq_len - 1
         if not 1 <= len(prompt) <= limit:
-            raise ValueError(
+            raise RequestTooLarge(
                 f"prompt length {len(prompt)} must be in [1, {limit}] "
                 "(max_seq_len minus one generated token; prompts beyond "
                 "the bucket prefill in page-aligned chunks)"
@@ -526,19 +603,47 @@ class ServeEngine:
         if max_new_tokens is None:
             max_new_tokens = self.config.max_seq_len - len(prompt)
         if max_new_tokens < 1:
-            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+            raise InvalidRequest(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
         if len(prompt) + max_new_tokens > self.config.max_seq_len:
-            raise ValueError(
+            raise RequestTooLarge(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_seq_len {self.config.max_seq_len}"
             )
         if self._worst_case_pages(len(prompt), max_new_tokens) > self.ctrl.n_pages:
-            raise ValueError(
+            raise RequestTooLarge(
                 f"request needs up to "
                 f"{self._worst_case_pages(len(prompt), max_new_tokens)} pages "
                 f"but the pool holds {self.ctrl.n_pages} — it could never be "
                 "admitted"
             )
+        if deadline_s is not None and deadline_s <= 0:
+            raise InvalidRequest(
+                f"deadline_s must be > 0 (or None), got {deadline_s}"
+            )
+        if (
+            self.max_pending is not None
+            and len(self.pending) >= self.max_pending
+        ):
+            # Bounded admission: reject instead of queueing without
+            # bound.  The rejected request never enters the engine; the
+            # exception carries a terminal-status record so callers who
+            # track lifecycles see exactly one status per attempt.
+            self.queue_rejections += 1
+            rejected = Request(
+                rid if rid is not None else "(rejected)", prompt,
+                max_new_tokens, eos_token, adapter=adapter,
+                t_submit=time.perf_counter(), status="rejected",
+                error="QueueFull",
+            )
+            exc = QueueFull(
+                f"pending queue is full ({len(self.pending)} >= "
+                f"max_pending {self.max_pending}); resubmit after "
+                "retirements drain it"
+            )
+            exc.request = rejected
+            raise exc
         rid = rid if rid is not None else f"req-{next(self._ids)}"
         in_flight = {r.rid for r in self.pending} | {
             r.rid for r in self._slot_req.values()
@@ -546,10 +651,14 @@ class ServeEngine:
         if rid in in_flight:
             # Loud at the call site: a duplicate would silently overwrite
             # one request's tokens in run()'s {rid: tokens} result.
-            raise ValueError(f"request id {rid!r} is already in flight")
+            raise InvalidRequest(f"request id {rid!r} is already in flight")
+        t_submit = time.perf_counter()
         req = Request(
             rid, prompt, max_new_tokens, eos_token, adapter=adapter,
-            t_submit=time.perf_counter(),
+            t_submit=t_submit, deadline_s=deadline_s,
+            t_deadline=(
+                t_submit + deadline_s if deadline_s is not None else None
+            ),
         )
         self.pending.append(req)
         return rid
@@ -562,6 +671,7 @@ class ServeEngine:
         *,
         eos_token: int | None = None,
         adapter: str | None = None,
+        deadline_s: float | None = None,
     ) -> list[str]:
         """N independent samples of one prompt SHARING its prompt pages
         AND its prefill.
@@ -576,7 +686,21 @@ class ServeEngine:
         members emit the same greedy tokens (pinned by tests); sampling
         makes them diverge.  Returns the member request ids."""
         if n_samples < 1:
-            raise ValueError(f"n_samples must be >= 1, got {n_samples}")
+            raise InvalidRequest(f"n_samples must be >= 1, got {n_samples}")
+        if self._closed:
+            raise EngineClosed("engine is closed; submissions are refused")
+        if (
+            self.max_pending is not None
+            and len(self.pending) + n_samples > self.max_pending
+        ):
+            # All-or-nothing bound check up front: a mid-fanout QueueFull
+            # would strand earlier members in a half-submitted group.
+            self.queue_rejections += 1
+            raise QueueFull(
+                f"pending queue cannot take {n_samples} fan-out members "
+                f"({len(self.pending)} queued, max_pending "
+                f"{self.max_pending}); resubmit after retirements drain it"
+            )
         gid = f"grp-{next(self._ids)}"
         # submit() validates on (prompt, max_new_tokens) alone and the
         # rids are engine-generated here, so if the FIRST submit passes
@@ -585,7 +709,8 @@ class ServeEngine:
         rids = []
         for _ in range(n_samples):
             rid = self.submit(
-                prompt, max_new_tokens, eos_token=eos_token, adapter=adapter
+                prompt, max_new_tokens, eos_token=eos_token, adapter=adapter,
+                deadline_s=deadline_s,
             )
             self.pending[-1].group = gid  # appended last by submit()
             rids.append(rid)
@@ -627,11 +752,13 @@ class ServeEngine:
         )
         return self.ctrl.extend(seq, n_tokens)
 
-    def _retire(self, slot: int) -> Request:
+    def _release_slot(self, slot: int) -> Request:
+        """Reclaim one occupied slot WITHOUT deciding the request's fate:
+        pages released, worst-case commitment rolled back, mirrors
+        parked.  Callers either retire the request (``_retire``), finish
+        it terminally (cancel/expire/close), or requeue it for replay
+        (quarantine/health drain)."""
         req = self._slot_req.pop(slot)
-        req.t_done = time.perf_counter()
-        self.requests_retired += 1
-        self.completed.append(req)
         self.ctrl.release(self._seq_id(slot, req))
         self._committed_pages -= self._slot_commit.pop(slot)
         self._occupied[slot] = False
@@ -639,7 +766,385 @@ class ServeEngine:
         self._positions[slot] = 0
         self._tokens[slot] = 0
         self._adapter_idx[slot] = 0
+        self._fresh_slots.discard(slot)
         return req
+
+    def _retire(self, slot: int) -> Request:
+        req = self._release_slot(slot)
+        req.status = "ok"
+        req.t_done = time.perf_counter()
+        self.requests_retired += 1
+        self.completed.append(req)
+        return req
+
+    def _finish_terminal(
+        self, req: Request, status: str, error: str | None = None
+    ) -> Request:
+        """Move a request to a NON-ok terminal status (its slot/queue
+        membership must already be gone).  One terminal status per rid:
+        callers only reach this for requests that are not yet done."""
+        req.status = status
+        req.error = error
+        req.done = True
+        req.t_done = time.perf_counter()
+        counter = {
+            "cancelled": "requests_cancelled",
+            "expired": "requests_expired",
+            "failed": "requests_failed",
+        }.get(status)
+        if counter is not None:
+            setattr(self, counter, getattr(self, counter) + 1)
+        self.completed.append(req)
+        return req
+
+    def _group_abandon(self, req: Request) -> None:
+        """A PENDING fan-out member leaves the engine before admission
+        (cancel/deadline/close): run the group countdown it will never
+        run at admission, so siblings that did admit still clean the
+        group up."""
+        gid = req.group
+        req.group = None
+        if gid is None or gid not in self._groups:
+            return
+        self._group_member_done(self._groups[gid], gid)
+
+    def _dissolve_groups(self) -> None:
+        """Drop EVERY fan-out group's bookkeeping (retained tail pages,
+        group page tables, cached logits) and detach pending members —
+        the admission-quarantine/teardown path, where partially-written
+        group state cannot be trusted; detached members replay solo
+        (same greedy tokens: group members share exactly the logits a
+        solo admission computes)."""
+        for gid, g in list(self._groups.items()):
+            if g.get("tail_page") is not None:
+                self.ctrl.release_page(g["tail_page"])
+            if g.get("allocated"):
+                self.ctrl.release(("group", gid))
+        self._groups.clear()
+        for req in self.pending:
+            req.group = None
+
+    # ---- fault tolerance ------------------------------------------------
+
+    def _maybe_fault(self, seam: str) -> None:
+        """The injector hook at each dispatch/readback seam (inert
+        no-op without an injector — production cost is one attribute
+        test)."""
+        if self._faults is not None:
+            self._faults.check(seam)
+
+    def _note_recovery(self) -> None:
+        """Called after every SUCCESSFUL host readback: closes the
+        recovery-latency window opened by the last quarantine and resets
+        the backoff ladder."""
+        self._consecutive_faults = 0
+        if self._t_last_fault is not None:
+            self.fault_recovery_s.append(
+                time.perf_counter() - self._t_last_fault
+            )
+            self._t_last_fault = None
+
+    def _requeue_or_fail(
+        self, req: Request, exc: BaseException, *, count_retry: bool = True
+    ) -> Request | None:
+        """Route one quarantined request: requeue it at the FRONT of the
+        pending queue for replay (prompt + already-emitted tokens — the
+        resumed greedy stream is bit-identical to an uninterrupted one),
+        or fail it terminally once the retry budget is spent.  Health
+        drains pass ``count_retry=False``: a sick chip is not the
+        request's fault and must not eat its budget.  Returns the
+        request iff it terminally failed."""
+        req.group = None  # replays are solo; group state is gone or stale
+        if count_retry:
+            req.retries += 1
+            if req.retries > self.max_retries:
+                self._finish_terminal(
+                    req, "failed",
+                    error=f"{type(exc).__name__}: {exc} "
+                          f"(after {self.max_retries} retries)",
+                )
+                return req
+        req.status = "queued"
+        self.requests_retried += 1
+        self.pending.appendleft(req)
+        return None
+
+    def _quarantine_step(
+        self, exc: BaseException, extra: list[Request] | None = None,
+        *, count_retry: bool = True,
+    ) -> list[Request]:
+        """Step-level recovery: a dispatch or readback seam failed (an
+        injected fault, a real XLA error, a dead link).  Device-facing
+        transient state cannot be trusted, so it is DROPPED, not drained
+        — pipelined in-flight reads, chained token arrays, every
+        occupied slot's pages — and the affected requests (plus
+        ``extra``: admission-batch requests whose slots were never
+        occupied) requeue for replay under the retry budget.  Returns
+        the requests that terminally failed."""
+        self.steps_quarantined += 1
+        self._consecutive_faults += 1
+        self._pending_read = None
+        self._chained_tok = None
+        self._pending_spec = None
+        self._spec_chained = None
+        self._fresh_slots.clear()
+        self._last_mode = None
+        victims: list[Request] = []
+        for slot in sorted(self._slot_req):
+            victims.append(self._release_slot(slot))
+        victims.extend(extra or [])
+        finished: list[Request] = []
+        # appendleft in reverse keeps the victims' FIFO order at the
+        # queue front — replays go before newer submissions.
+        for req in reversed(victims):
+            failed = self._requeue_or_fail(req, exc, count_retry=count_retry)
+            if failed is not None:
+                finished.append(failed)
+        self._t_last_fault = time.perf_counter()
+        if self.retry_backoff_s and count_retry:
+            time.sleep(
+                min(
+                    self.retry_backoff_s * (2 ** (self._consecutive_faults - 1)),
+                    30 * self.retry_backoff_s,
+                )
+            )
+        return finished
+
+    def _quarantine_admissions(
+        self, plans: list[dict], exc: BaseException
+    ) -> list[Request]:
+        """Admission-batch recovery: the sweep (or its fused readback)
+        failed with ``plans`` mid-flight — their pages are allocated but
+        possibly unwritten.  Roll back each plan's tentative page
+        commitment and sequence, dissolve fan-out groups (their shared
+        pages may be half-written) and flush the prefix cache (its
+        promissory inserts may index unwritten pages), then hand the
+        planned requests plus every occupied slot to the step
+        quarantine."""
+        extra = []
+        for p in plans:
+            if p["seq"] in self.ctrl.tables:
+                self.ctrl.release(p["seq"])
+            self._committed_pages -= p["need"]
+            if p["slot"] not in self._slot_req:
+                extra.append(p["req"])
+        self._dissolve_groups()
+        if self.prefix is not None:
+            self.prefix.clear()
+        return self._quarantine_step(exc, extra)
+
+    def cancel(self, rid: str) -> bool:
+        """Cancel one request: queued requests leave the queue
+        unstarted; running requests stop at the current step boundary —
+        any pipelined in-flight work is DRAINED first (the PR-2
+        mode-boundary rules: device arrays sync before a slot is
+        reclaimed), then the slot's pages release and the slot recycles.
+        Tokens already emitted stay on the request.  Returns True iff
+        the rid was live (queued or running); an unknown or
+        already-terminal rid returns False.  Finished-by-cancel requests
+        surface on the NEXT step()'s return (and are on ``completed``
+        immediately)."""
+        if self._closed:
+            raise EngineClosed("engine is closed")
+        for req in self.pending:
+            if req.rid == rid:
+                self.pending.remove(req)
+                self._group_abandon(req)
+                self._finished_buffer.append(
+                    self._finish_terminal(req, "cancelled")
+                )
+                return True
+        target = None
+        for slot, req in self._slot_req.items():
+            if req.rid == rid:
+                target = slot
+                break
+        if target is None:
+            return False
+        # Sync pipelined device state before touching the slot; the
+        # drain may RETIRE the request (its in-flight chunk finished it —
+        # nothing left to cancel) or QUARANTINE it back into the queue
+        # (a fault fired mid-drain — cancel it there instead).
+        self._finished_buffer.extend(self._drain_all_pending())
+        if target in self._slot_req and self._slot_req[target].rid == rid:
+            req = self._release_slot(target)
+            self._finished_buffer.append(
+                self._finish_terminal(req, "cancelled")
+            )
+            return True
+        for req in self.pending:
+            if req.rid == rid:
+                self.pending.remove(req)
+                self._finished_buffer.append(
+                    self._finish_terminal(req, "cancelled")
+                )
+                return True
+        return False
+
+    def _drain_all_pending(self) -> list[Request]:
+        """Consume any pipelined in-flight chunk AND superstep (host
+        mirrors sync; the slot-reclaim precondition for cancel/expiry).
+        A seam failure during the drain falls through to the step
+        quarantine."""
+        try:
+            return self._drain_pending_plain() + self._drain_pending_spec()
+        except Exception as exc:  # noqa: BLE001 — recovery seam
+            return self._quarantine_step(exc)
+
+    def _expire_deadlines(self) -> list[Request]:
+        """Flip queued and running requests whose deadline passed to the
+        ``expired`` terminal status (checked once per step; queued
+        expiry needs no device work, running expiry drains pipelined
+        state first, exactly like cancel)."""
+        now = time.perf_counter()
+        finished: list[Request] = []
+        expired_q = [
+            r for r in self.pending
+            if r.t_deadline is not None and now >= r.t_deadline
+        ]
+        for req in expired_q:
+            self.pending.remove(req)
+            self._group_abandon(req)
+            finished.append(self._finish_terminal(req, "expired"))
+        expired_slots = [
+            slot for slot, r in self._slot_req.items()
+            if r.t_deadline is not None and now >= r.t_deadline
+        ]
+        if expired_slots:
+            finished.extend(self._drain_all_pending())
+            for slot in expired_slots:
+                req = self._slot_req.get(slot)
+                if (
+                    req is None or req.t_deadline is None
+                    or now < req.t_deadline
+                ):
+                    continue  # the drain retired or replaced it
+                req = self._release_slot(slot)
+                finished.append(self._finish_terminal(req, "expired"))
+        return finished
+
+    # ---- health bridge --------------------------------------------------
+
+    def bind_health(self, fanout) -> None:
+        """Subscribe this engine to a tpu_device_plugin HealthFanout:
+        chip-unhealthy transitions pause admission and requeue in-flight
+        work (no retry-budget charge); all-clear resumes.  close()
+        unsubscribes."""
+        if self._health_fanout is not None:
+            raise RuntimeError("engine is already bound to a health fanout")
+        self._health_fanout = fanout
+        self._health_events = fanout.subscribe()
+
+    def unbind_health(self) -> None:
+        if self._health_fanout is not None:
+            self._health_fanout.unsubscribe(self._health_events)
+            self._health_fanout = None
+        self._health_events = None
+
+    def _poll_health(self) -> list[Request]:
+        """Drain the health-event queue (non-blocking) and apply
+        pause/resume: any Unhealthy chip pauses admission and drops +
+        requeues in-flight work (the device may be wedged — its answers
+        cannot be trusted, so this is the quarantine path, not a drain);
+        every chip back Healthy resumes.  Requeues do not charge the
+        requests' retry budgets."""
+        q = self._health_events
+        if q is None:
+            return []
+        from tpu_device_plugin.api.constants import UNHEALTHY
+
+        import queue as _queue
+
+        changed = False
+        while True:
+            try:
+                ev = q.get_nowait()
+            except _queue.Empty:
+                break
+            # HealthEvent contract: chip_id == "" means "all chips" (the
+            # event could not be attributed).  HealthFanout expands such
+            # events per-chip before delivery, so the sentinel paths only
+            # run for raw health_events= queues.
+            if ev.health == UNHEALTHY:
+                self._unhealthy_chips.add(ev.chip_id or "*all*")
+            elif not ev.chip_id:
+                # Unattributed all-clear: every mark lifts — per-chip and
+                # sentinel alike — so a mixed-attribution stream cannot
+                # strand the engine paused.
+                self._unhealthy_chips.clear()
+            else:
+                self._unhealthy_chips.discard(ev.chip_id)
+            changed = True
+        if not changed:
+            return []
+        finished: list[Request] = []
+        if self._unhealthy_chips and not self._paused:
+            self._paused = True
+            finished = self._quarantine_step(
+                RuntimeError(
+                    f"chip(s) unhealthy: {sorted(self._unhealthy_chips)}"
+                ),
+                count_retry=False,
+            )
+        elif not self._unhealthy_chips and self._paused:
+            self._paused = False
+        return finished
+
+    @property
+    def paused(self) -> bool:
+        """True while the health bridge holds admission (an Unhealthy
+        chip without a recovery event yet)."""
+        return self._paused
+
+    # ---- shutdown -------------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        """Idempotent shutdown: pending and running requests fail
+        terminally with ``EngineClosed`` recorded, committed pages
+        release, fan-out/prefix bookkeeping drops, the observer's
+        registry gauges unbind (they would otherwise pin this engine's
+        params and pools on the registry forever), and any health
+        subscription tears down.  After close, submit/step raise
+        ``EngineClosed``; drains of ``completed`` remain available."""
+        if self._closed:
+            return
+        self._closed = True
+        self._pending_read = None
+        self._chained_tok = None
+        self._pending_spec = None
+        self._spec_chained = None
+        self._fresh_slots.clear()
+        err = "EngineClosed: engine closed with the request in flight"
+        # step() refuses to run after close, so these can never surface
+        # through _finished_buffer — they land on `completed` only (and
+        # the buffer clears so `idle` reads True on a drained engine).
+        closed_now: list[Request] = []
+        for slot in sorted(self._slot_req):
+            req = self._release_slot(slot)
+            closed_now.append(self._finish_terminal(req, "failed", error=err))
+        while self.pending:
+            req = self.pending.popleft()
+            req.group = None
+            closed_now.append(self._finish_terminal(req, "failed", error=err))
+        self._finished_buffer.clear()
+        self._dissolve_groups()
+        if self.prefix is not None:
+            self.prefix.clear()
+        if self._obs is not None:
+            self._obs._engine_closed(self, closed_now)
+            self._obs.unbind_registry()
+        self.unbind_health()
+
+    def __enter__(self) -> "ServeEngine":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False
 
     def _group_admit_pages(self, req: Request, seq, n: int):
         """The page bookkeeping every fan-out member needs at admission
@@ -679,7 +1184,10 @@ class ServeEngine:
         if g["members_left"] == 0:
             self._group_cleanup(gid)
 
-    def _prefix_admit_pages(self, req: Request, seq, n: int, aidx: int) -> int:
+    def _prefix_admit_pages(
+        self, req: Request, seq, n: int, aidx: int,
+        tokens: list[int] | None = None,
+    ) -> int:
         """Prefix-cache admission bookkeeping (shared by serial and
         batched admission): look the prompt up under the adapter salt,
         adopt any hit pages and extend past them (or allocate fresh),
@@ -694,6 +1202,7 @@ class ServeEngine:
         # so the same tokens under different adapters must never share
         # pages.
         salt = f"lora:{aidx}" if aidx else ""
+        tokens = tokens if tokens is not None else req.prompt
         shared_pages = []
         if self.prefix is not None:
             # Cap hits to (a) leave >= 1 prompt token computed (the
@@ -703,7 +1212,7 @@ class ServeEngine:
             bp = self.prompt_bucket // self.page_size
             cap = (n - 1) // self.page_size // bp * bp
             shared_pages = self.prefix.lookup(
-                req.prompt, cap, granularity=bp, salt=salt
+                tokens, cap, granularity=bp, salt=salt
             )
         if shared_pages:
             self.ctrl.adopt(seq, shared_pages)
@@ -711,7 +1220,7 @@ class ServeEngine:
         else:
             self._allocate_evicting(seq, n)
         if self.prefix is not None:
-            self.prefix.insert(req.prompt, self.ctrl.tables[seq], salt=salt)
+            self.prefix.insert(tokens, self.ctrl.tables[seq], salt=salt)
         return len(shared_pages)
 
     def _admit_group_member(self, req: Request, seq, n: int) -> jax.Array:
@@ -885,8 +1394,11 @@ class ServeEngine:
             if not plans:
                 return finished
             used.update(p["slot"] for p in plans)
-            emitted = self._sweep_prefill(plans)
-            batch_finished, retry = self._finish_admissions(plans, emitted)
+            try:
+                emitted = self._sweep_prefill(plans)
+                batch_finished, retry = self._finish_admissions(plans, emitted)
+            except Exception as exc:  # noqa: BLE001 — recovery seam
+                return finished + self._quarantine_admissions(plans, exc)
             finished += batch_finished
             if not retry:
                 return finished
@@ -896,6 +1408,14 @@ class ServeEngine:
             # freed-budget-within-a-pass behavior, which the plan cannot
             # see before the readback).
 
+    def _admission_tokens(self, req: Request) -> list[int]:
+        """The tokens an admission prefills: the prompt, plus — for a
+        quarantine/health REPLAY — every token already emitted, so the
+        resumed stream continues exactly where the client's stream
+        stopped (greedy continuation of prompt+emitted is bit-identical
+        to the uninterrupted stream; pinned by the fault tests)."""
+        return req.prompt + req.tokens if req.tokens else req.prompt
+
     def _admit_serial(self) -> list[Request]:
         """Serial admission: allocate pages for the true prompt, prefill
         (one compiled batch-1 call per admission), sample the first
@@ -904,8 +1424,10 @@ class ServeEngine:
         for slot in range(self.slots):
             if self._occupied[slot] or not self.pending:
                 continue
+            head = self._admission_tokens(self.pending[0])
             need = self._worst_case_pages(
-                len(self.pending[0].prompt), self.pending[0].max_new_tokens
+                len(head),
+                self.pending[0].max_new_tokens - len(self.pending[0].tokens),
             )
             if self._committed_pages + need > self.ctrl.n_pages:
                 # Not enough uncommitted budget yet; admission is FIFO
@@ -914,39 +1436,53 @@ class ServeEngine:
                 break
             req = self.pending.popleft()
             req.t_admit = time.perf_counter()
+            req.status = "running"
             self.requests_admitted += 1
             seq = self._seq_id(slot, req)
-            n = len(req.prompt)
+            prompt = self._admission_tokens(req)
+            n = len(prompt)
             aidx = self._adapter_ids.get(req.adapter, 0)
-            if req.group is not None:
-                logits = self._admit_group_member(req, seq, n)
-            else:
-                start_page = self._prefix_admit_pages(req, seq, n, aidx)
-                table = table_array(
-                    [self.ctrl.tables[seq]], self.max_pages,
-                    fill=self.ctrl.trash,
+            try:
+                self._maybe_fault("prefill_dispatch")
+                if req.group is not None:
+                    logits = self._admit_group_member(req, seq, n)
+                else:
+                    start_page = self._prefix_admit_pages(
+                        req, seq, n, aidx, tokens=prompt
+                    )
+                    table = table_array(
+                        [self.ctrl.tables[seq]], self.max_pages,
+                        fill=self.ctrl.trash,
+                    )
+                    logits, self.pools = self._run_prefill(
+                        table, prompt, start_page=start_page,
+                        adapter_idx=aidx,
+                    )
+                t_rb = time.perf_counter() if self._obs is not None else 0.0
+                self._maybe_fault("prefill_readback")
+                tok = int(
+                    self._first_token(
+                        logits, self._next_key(),
+                        jnp.float32(self.temperature), jnp.int32(self.top_k),
+                        jnp.float32(self.top_p),
+                    )[0]
                 )
-                logits, self.pools = self._run_prefill(
-                    table, req.prompt, start_page=start_page,
-                    adapter_idx=aidx,
-                )
-            t_rb = time.perf_counter() if self._obs is not None else 0.0
-            tok = int(
-                self._first_token(
-                    logits, self._next_key(),
-                    jnp.float32(self.temperature), jnp.int32(self.top_k),
-                    jnp.float32(self.top_p),
-                )[0]
-            )
+            except Exception as exc:  # noqa: BLE001 — recovery seam
+                plan = {"slot": slot, "req": req, "seq": seq, "need": 0}
+                return finished + self._quarantine_admissions([plan], exc)
             if self._obs is not None:
                 self._obs._note_readback(time.perf_counter() - t_rb)
             self.admission_readbacks += 1
+            self._note_recovery()
             req.tokens.append(tok)
-            req.t_first = time.perf_counter()  # first token, queue wait included
+            first_now = req.t_first is None  # False on a replay admission
+            if first_now:
+                req.t_first = time.perf_counter()  # first token, queue wait incl.
             self.generated_tokens += 1
-            if req.max_new_tokens == 1 or tok == req.eos_token:
+            if len(req.tokens) >= req.max_new_tokens or tok == req.eos_token:
                 req.done = True
-                req.t_done = req.t_first
+                req.status = "ok"
+                req.t_done = req.t_first if first_now else time.perf_counter()
                 self.ctrl.release(seq)
                 finished.append(req)
                 self.requests_retired += 1
@@ -984,7 +1520,10 @@ class ServeEngine:
             if slot in used or self._occupied[slot] or not self.pending:
                 continue
             head = self.pending[0]
-            need = self._worst_case_pages(len(head.prompt), head.max_new_tokens)
+            need = self._worst_case_pages(
+                len(self._admission_tokens(head)),
+                head.max_new_tokens - len(head.tokens),
+            )
             if self._committed_pages + need > self.ctrl.n_pages:
                 # Not enough uncommitted budget yet; admission is FIFO
                 # (no queue-jumping by smaller requests — starvation-free
@@ -992,11 +1531,14 @@ class ServeEngine:
                 break
             req = self.pending.popleft()
             req.t_admit = time.perf_counter()
+            req.status = "running"
             self.requests_admitted += 1
             seq = self._seq_id(slot, req)
-            n = len(req.prompt)
+            prompt = self._admission_tokens(req)
+            n = len(prompt)
             plan = {
                 "slot": slot, "req": req, "seq": seq, "n": n,
+                "prompt": prompt,
                 "aidx": self._adapter_ids.get(req.adapter, 0),
                 "need": need, "start_page": 0, "prefill": True,
                 "logits_from": None, "tail_copy": None, "group_done": None,
@@ -1005,7 +1547,7 @@ class ServeEngine:
                 self._plan_group_member(req, seq, n, plan)
             else:
                 plan["start_page"] = self._prefix_admit_pages(
-                    req, seq, n, plan["aidx"]
+                    req, seq, n, plan["aidx"], tokens=prompt
                 )
             self._committed_pages += need
             plans.append(plan)
@@ -1058,6 +1600,7 @@ class ServeEngine:
         rows = [p for p in plans if p["prefill"]]
         if not rows:
             return None
+        self._maybe_fault("prefill_dispatch")
         # A lone admission still rides the [slots, B] sweep: dead rows
         # compute on trash tables exactly as parked rows do in every
         # decode chunk (occupancy is data, not shape) — one program to
@@ -1107,7 +1650,7 @@ class ServeEngine:
             for p in rows:
                 width = min(B, p["n"] - start)
                 if width > 0:
-                    chunk[p["slot"], :width] = p["req"].prompt[
+                    chunk[p["slot"], :width] = p["prompt"][
                         start : start + width
                     ]
             logits, self.pools = self._prefill_chunk(
@@ -1174,6 +1717,7 @@ class ServeEngine:
             [key_rows.get(s, zero_key) for s in range(self.slots)]
         )
         t_rb = time.perf_counter() if self._obs is not None else 0.0
+        self._maybe_fault("prefill_readback")
         toks = np.asarray(
             self._first_token_batch(
                 emitted, keys, jnp.float32(self.temperature),
@@ -1183,16 +1727,20 @@ class ServeEngine:
         if self._obs is not None:
             self._obs._note_readback(time.perf_counter() - t_rb)
         self.admission_readbacks += 1
+        self._note_recovery()
         finished, retry = [], False
         for p in plans:
             slot, req, seq = p["slot"], p["req"], p["seq"]
             tok = int(toks[slot])
             req.tokens.append(tok)
-            req.t_first = time.perf_counter()  # first token, queue wait included
+            first_now = req.t_first is None  # False on a replay admission
+            if first_now:
+                req.t_first = time.perf_counter()  # first token, queue wait incl.
             self.generated_tokens += 1
-            if req.max_new_tokens == 1 or tok == req.eos_token:
+            if len(req.tokens) >= req.max_new_tokens or tok == req.eos_token:
                 req.done = True
-                req.t_done = req.t_first
+                req.status = "ok"
+                req.t_done = req.t_first if first_now else time.perf_counter()
                 self.ctrl.release(seq)
                 self._committed_pages -= p["need"]  # tentative roll-back
                 finished.append(req)
@@ -1247,7 +1795,34 @@ class ServeEngine:
         return finished
 
     def _step_impl(self) -> list[Request]:
-        finished = self._admit()
+        if self._closed:
+            raise EngineClosed("engine is closed; no further steps")
+        # Requests finished outside step() (cancel, deadline expiry at a
+        # previous poll) surface here.
+        finished = list(self._finished_buffer)
+        self._finished_buffer.clear()
+        finished += self._poll_health()
+        finished += self._expire_deadlines()
+        if self._paused:
+            # Health hold: no admission, no dispatch — in-flight work was
+            # requeued when the chip went Unhealthy; recovery resumes.
+            return finished
+        finished += self._admit()
+        # _step_decode accumulates into a member alias so retirements
+        # that happened BEFORE a later seam faulted still surface in
+        # this step's return (they are already terminal in `completed`;
+        # losing them from the return would desync run()).
+        self._decode_finished: list[Request] = []
+        try:
+            return finished + self._step_decode()
+        except Exception as exc:  # noqa: BLE001 — recovery seam
+            return (
+                finished + list(self._decode_finished)
+                + self._quarantine_step(exc)
+            )
+
+    def _step_decode(self) -> list[Request]:
+        finished = self._decode_finished  # alias: survives a mid-step fault
         if not self._occupied.any():
             if self._pending_read is not None:
                 toks_dev, snapshot = self._pending_read
@@ -1313,6 +1888,7 @@ class ServeEngine:
                 self._stacked_adapters, self._dev(self._adapter_idx),
                 self.lora_alpha,
             )
+        self._maybe_fault("decode_dispatch")
         toks, self.pools = self._chunk(
             self.params, self.pools,
             self._dev(self._tables), tok_in,
@@ -1351,9 +1927,11 @@ class ServeEngine:
         out) and apply emission/eos/retirement for the slots as they were
         at dispatch."""
         t_rb = time.perf_counter() if self._obs is not None else 0.0
+        self._maybe_fault("decode_readback")
         toks = np.asarray(toks_dev)
         if self._obs is not None:
             self._obs._note_readback(time.perf_counter() - t_rb)
+        self._note_recovery()
         finished = []
         for slot, req in snapshot.items():
             if req.done:
@@ -1621,6 +2199,7 @@ class ServeEngine:
              jnp.float32(self.top_p))
             if self.sampling else ()
         )
+        self._maybe_fault("spec_dispatch")
         cur = self._dev(self._tokens)
         pos = self._dev(self._positions)
         if self.pipelined and self._spec_chained is not None:
@@ -1678,9 +2257,11 @@ class ServeEngine:
         eos/max_new; rounds past a row's retirement point are the
         superstep's documented dead compute)."""
         t_rb = time.perf_counter() if self._obs is not None else 0.0
+        self._maybe_fault("spec_readback")
         committed, n_acc = (np.asarray(a) for a in arrs)
         if self._obs is not None:
             self._obs._note_readback(time.perf_counter() - t_rb)
+        self._note_recovery()
         if committed.ndim == 2:  # single round -> a 1-round superstep
             committed, n_acc = committed[None], n_acc[None]
         finished = []
@@ -1708,15 +2289,22 @@ class ServeEngine:
             and not self._occupied.any()
             and self._pending_read is None
             and self._pending_spec is None
+            and not self._finished_buffer
         )
 
     def run(self) -> dict[str, list[int]]:
-        """Drive step() until every submitted request has finished;
-        returns {rid: generated tokens}."""
+        """Drive step() until every submitted request has reached a
+        terminal status; returns {rid: generated tokens} (cancelled /
+        expired / failed requests appear with whatever tokens they
+        emitted before their terminal transition — ``engine.completed``
+        carries the statuses).  While the health bridge holds admission
+        the loop idles briefly between polls instead of spinning."""
         out = {}
         while not self.idle:
             for req in self.step():
                 out[req.rid] = req.tokens
+            if self._paused:
+                time.sleep(0.001)  # health hold: poll, don't spin
         return out
 
 
@@ -1840,6 +2428,28 @@ def main(argv=None) -> int:
                         help="write the run's chrome://tracing timeline "
                         "(request spans + step records) to PATH at exit; "
                         "enables the observer")
+    parser.add_argument("--max-pending", type=int, default=None,
+                        help="bounded admission: reject (typed QueueFull) "
+                        "instead of queueing more than N pending requests "
+                        "(docs/SERVING.md Fault tolerance)")
+    parser.add_argument("--deadline-s", type=float, default=None,
+                        help="per-request deadline in seconds; requests "
+                        "still queued or running past it expire "
+                        "terminally")
+    parser.add_argument("--max-retries", type=int, default=2,
+                        help="replay retries per request after a "
+                        "quarantined step before it fails terminally")
+    parser.add_argument("--retry-backoff-s", type=float, default=0.0,
+                        help="exponential host-side backoff between "
+                        "consecutive quarantines (0 = none)")
+    parser.add_argument("--inject-fault", action="append", default=None,
+                        metavar="SEAM:N",
+                        help="deterministic fault injection: raise at the "
+                        "named seam's Nth crossing (repeatable; seams: "
+                        "prefill_dispatch, prefill_readback, "
+                        "decode_dispatch, decode_readback, spec_dispatch, "
+                        "spec_readback) — exercises quarantine + replay "
+                        "end-to-end")
     args = parser.parse_args(argv)
     if args.requests < 1 or args.slots < 1:
         parser.error("--requests and --slots must be >= 1")
@@ -1914,14 +2524,35 @@ def main(argv=None) -> int:
         metrics_server = MetricsServer(args.metrics_port)
         bound = metrics_server.start()
         print(f"metrics: http://127.0.0.1:{bound}/metrics")
+    injector = None
+    if args.inject_fault:
+        from .faults import FaultInjector
+
+        schedule: dict[str, list[int]] = {}
+        for spec_arg in args.inject_fault:
+            seam, _, n = spec_arg.partition(":")
+            if not n.isdigit() or int(n) < 1:
+                parser.error(
+                    f"--inject-fault wants SEAM:N with N >= 1, got "
+                    f"{spec_arg!r}"
+                )
+            schedule.setdefault(seam, []).append(int(n))
+        try:
+            injector = FaultInjector(schedule)
+        except ValueError as e:
+            parser.error(str(e))
     engine = ServeEngine(
         params, config, slots=args.slots, page_size=page_size,
         prompt_bucket=bucket,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         rng=jax.random.PRNGKey(42), pipelined=args.pipelined,
-        adapters=adapters, observer=observer, **spec_kw,
+        adapters=adapters, observer=observer,
+        max_pending=args.max_pending, fault_injector=injector,
+        max_retries=args.max_retries,
+        retry_backoff_s=args.retry_backoff_s, **spec_kw,
     )
     key = jax.random.PRNGKey(7)
+    rejected = 0
     for i in range(args.requests):
         key, k_prompt, k_len = jax.random.split(key, 3)
         plen = int(jax.random.randint(k_len, (), 1, args.prompt_len + 1))
@@ -1930,9 +2561,14 @@ def main(argv=None) -> int:
         )
         # Mixed lengths: the stream the engine's slot turnover exists for.
         new = max(1, args.max_new_tokens // (1 + i % 3))
-        engine.submit(
-            [int(t) for t in prompt], new, adapter=names[i % len(names)]
-        )
+        try:
+            engine.submit(
+                [int(t) for t in prompt], new,
+                adapter=names[i % len(names)],
+                deadline_s=args.deadline_s,
+            )
+        except QueueFull:
+            rejected += 1
 
     # Warm the three compiled programs on the first step, then time the
     # rest against a wall clock whose endpoints are REAL host readbacks
@@ -1958,6 +2594,19 @@ def main(argv=None) -> int:
         f"pool={engine.ctrl.n_pages} pages, "
         f"pages in use after drain: {engine.ctrl.used_pages})"
     )
+    if (
+        rejected or engine.steps_quarantined or engine.requests_expired
+        or engine.requests_failed or engine.requests_cancelled
+    ):
+        from collections import Counter
+
+        statuses = Counter(r.status for r in engine.completed)
+        print(
+            f"lifecycle: statuses={dict(statuses)} rejected={rejected} "
+            f"quarantined_steps={engine.steps_quarantined} "
+            f"replays={engine.requests_retried} "
+            f"recoveries_ms={[round(s * 1000, 1) for s in engine.fault_recovery_s]}"
+        )
     if args.trace_out:
         n_events = engine.export_trace(args.trace_out)
         print(
