@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fmt-check
 
 all: native
 
@@ -51,7 +51,15 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check test
+check: check-compat obs-check faults-check prefill-check test
+
+# Budgeted chunked-prefill tripwires (docs/SERVING.md "Chunked prefill
+# & interleaving"): greedy streams bit-identical budget on/off across
+# serial/batched/pipelined/spec="auto", ≤ budget chunk dispatches per
+# step, and no page/slot/commitment leak after mid-prefill
+# cancel/deadline/fault/health-pause/close (tests/test_chunked_prefill.py).
+prefill-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chunked_prefill.py -q -o addopts=
 
 # Fault-tolerance tripwires (docs/SERVING.md "Fault tolerance"): the
 # injector's determinism/scheduling contracts (jax-free, sub-second)

@@ -548,6 +548,9 @@ COMPACT_KEYS = [
     "serve_tokens_per_sec", "serve_requests_per_sec",
     "serve_ttft_p50_ms", "serve_ttft_p99_ms",
     "serve_e2e_p50_ms", "serve_e2e_p99_ms",
+    "serve_queue_wait_p50_ms", "serve_queue_wait_p99_ms",
+    "interleave_ttft_p99_ratio", "interleave_decode_dip_pct",
+    "interleave_prefill_budget",
     "obs_overhead_pct", "obs_on_tokens_per_sec",
     "fault_recovery_ms", "fault_injector_off_overhead_pct",
     "admission_tokens_per_sec", "admission_speedup",
@@ -630,7 +633,7 @@ def compact_headline(result: dict) -> str:
     # The compact set is curated to sit well under the capture window; if
     # a future field pushes it over, shed UNTRACKED detail first (the
     # tripwire's metrics are the last thing this line may lose), loudly.
-    tracked = set(bench_diff.TRACKED_UP)
+    tracked = set(bench_diff.TRACKED_UP) | set(bench_diff.TRACKED_DOWN)
     while len(line.encode()) > 1900:
         untracked = [k for k in picked if k not in tracked]
         victim = untracked[-1] if untracked else list(picked)[-1]

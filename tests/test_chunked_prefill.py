@@ -1,0 +1,402 @@
+"""Budgeted chunked-prefill / decode interleaving (workloads/serve.py
+``prefill_budget``): admission becomes RESUMABLE — each step dispatches
+at most the budget's worth of prompt-bucket prefill chunks and carries
+partially-prefilled admissions across steps — with greedy token streams
+BIT-IDENTICAL to run-to-completion admission across serial / batched /
+pipelined / spec="auto" engines, and no page/slot/commitment leak after
+a mid-prefill cancel, deadline, fault replay, health pause, or close."""
+
+import queue
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+DRAFT_CONFIG = ModelConfig(
+    max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CONFIG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    return init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
+
+
+def _mixed_requests(n, rng_seed=7, p_lo=2, p_hi=31):
+    """Mixed prompt lengths, long multi-chunk prompts included — the
+    head-of-line-blocking shape the budget exists to defuse."""
+    rng = np.random.default_rng(rng_seed)
+    out = []
+    for _ in range(n):
+        plen = int(rng.integers(p_lo, p_hi))
+        new = int(rng.integers(2, 13))
+        out.append(([int(t) for t in rng.integers(
+            0, CONFIG.vocab_size, plen)], new))
+    return out
+
+
+def _hygiene(engine):
+    """No slot, page, commitment, group, or in-flight-prefill leak;
+    only prefix-cache pins may remain."""
+    assert not engine._occupied.any()
+    assert engine._committed_pages == 0
+    assert not engine._inflight_prefill
+    assert not engine._groups
+    pinned = engine.prefix.cached_pages if engine.prefix is not None else 0
+    assert engine.ctrl.used_pages == pinned
+
+
+def _serve(params, requests, budget, **kw):
+    engine = ServeEngine(
+        params, CONFIG, slots=kw.pop("slots", 2), page_size=4,
+        prompt_bucket=8, prefill_budget=budget, **kw,
+    )
+    rids = [engine.submit(p, n) for p, n in requests]
+    served = engine.run()
+    _hygiene(engine)
+    return {r: served[r] for r in rids}, engine
+
+
+# ---- parity pins: budget on/off is bit-identical ------------------------
+
+
+@pytest.mark.parametrize("engine_kw", [
+    {},                                      # batched (default)
+    {"batched_admission": False},            # serial reference
+    {"pipelined": True},
+    {"pipelined": True, "prefix_cache": True},
+], ids=["batched", "serial", "pipelined", "pipelined-prefix"])
+def test_budget_streams_bit_identical(params, engine_kw):
+    """The core pin: with a prefill_budget set, greedy streams are
+    bit-identical to prefill_budget=None — chunked prefill is per-row
+    math, so WHEN a chunk dispatches cannot change WHAT it computes.
+    (A budget always routes through the plan/sweep machinery, including
+    under batched_admission=False: the serial one-dispatch-per-admission
+    path cannot park a half-prefilled prompt.)"""
+    requests = _mixed_requests(6, rng_seed=3)
+    base, _ = _serve(params, requests, None, **engine_kw)
+    for budget in (8, 16, 1):
+        got, engine = _serve(params, requests, budget, **engine_kw)
+        assert got == base, (engine_kw, budget)
+    # The smallest budget genuinely parked work across steps.
+    _, engine = _serve(params, requests, 8, **engine_kw)
+    assert engine.prefill_deferred_tokens > 0
+
+
+def test_budget_streams_bit_identical_spec_auto(params, draft_params):
+    """Budgeted admission composes with adaptive speculation: the
+    spec="auto" engine sees budget-deferred admissions (occupancy climbs
+    as parked rows finish), and greedy streams stay pinned."""
+    requests = _mixed_requests(5, rng_seed=11)
+    kw = dict(
+        draft_params=draft_params, draft_config=DRAFT_CONFIG, gamma=3,
+        spec="auto", spec_breakeven=1.0, pipelined=True,
+    )
+    base, _ = _serve(params, requests, None, **kw)
+    got, engine = _serve(params, requests, 8, **kw)
+    assert got == base
+    # The draft pools swept the same remainder: spec rounds ran after
+    # budget-parked admissions finished.
+    assert engine.spec_rounds > 0
+
+
+def test_budget_fanout_streams_bit_identical(params):
+    """Fan-out groups sweep the same budgeted remainder: the first
+    member prefills across steps, siblings wait for its logits row to
+    resolve, tail pages copy at finish — tokens pinned against the
+    unbudgeted group path."""
+    rng = np.random.default_rng(5)
+    long_prompt = [int(t) for t in rng.integers(0, CONFIG.vocab_size, 27)]
+    short = [int(t) for t in rng.integers(0, CONFIG.vocab_size, 5)]
+
+    def run(budget):
+        engine = ServeEngine(
+            params, CONFIG, slots=3, page_size=4, prompt_bucket=8,
+            prefill_budget=budget, pipelined=True,
+        )
+        rids = engine.submit_fanout(long_prompt, 6, n_samples=3)
+        rids.append(engine.submit(short, 5))
+        served = engine.run()
+        _hygiene(engine)
+        return [served[r] for r in rids]
+
+    assert run(None) == run(8)
+
+
+def test_budget_sampled_streams_structurally_sound(params):
+    """Sampled streams under a budget have no bitwise oracle (the
+    engine key schedule legitimately differs when finishes cross step
+    boundaries) but every request still gets exactly its token budget,
+    in-vocab, with clean teardown."""
+    requests = _mixed_requests(5, rng_seed=2)
+    got, _ = _serve(
+        params, requests, 8, temperature=0.8, top_k=40,
+        rng=jax.random.PRNGKey(5), pipelined=True,
+    )
+    for (prompt, new), (rid, toks) in zip(requests, got.items()):
+        assert len(toks) == new
+        assert all(0 <= t < CONFIG.vocab_size for t in toks)
+
+
+# ---- budget accounting --------------------------------------------------
+
+
+def test_budget_bounds_chunk_dispatches_per_step(params):
+    """<= max(1, budget // prompt_bucket) prefill chunk dispatches per
+    step, however much prefill work is queued — the stall-free
+    contract's mechanical half."""
+    rng = np.random.default_rng(9)
+    long = [int(t) for t in rng.integers(0, CONFIG.vocab_size, 30)]
+    for budget, per_step in ((8, 1), (16, 2), (1, 1)):
+        engine = ServeEngine(
+            params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+            prefill_budget=budget,
+        )
+        for _ in range(2):
+            engine.submit(long, 4)
+        while not engine.idle:
+            pd0 = engine.prefill_dispatches
+            engine.step()
+            assert engine.prefill_dispatches - pd0 <= per_step, budget
+        _hygiene(engine)
+
+
+def test_budget_interleaves_decode_with_parked_prefill(params):
+    """The stall-free contract's point: while a long admission sits
+    parked mid-prefill, occupied slots keep DECODING — the unbudgeted
+    engine would run the whole multi-chunk sweep before the decode chunk
+    dispatches."""
+    rng = np.random.default_rng(4)
+    long = [int(t) for t in rng.integers(0, CONFIG.vocab_size, 30)]
+    short = [int(t) for t in rng.integers(0, CONFIG.vocab_size, 3)]
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+        prefill_budget=8,
+    )
+    engine.submit(short, 20)
+    engine.step()  # the short prompt occupies a slot and decodes
+    engine.submit(long, 4)
+    interleaved = 0
+    while not engine.idle:
+        ch0 = engine.chunks_run
+        engine.step()
+        if engine._inflight_prefill and engine.chunks_run > ch0:
+            interleaved += 1  # a decode chunk ran with prefill parked
+    assert interleaved > 0
+    _hygiene(engine)
+    assert engine.prefill_deferred_tokens > 0
+
+
+def test_budget_deferred_tokens_counter(params):
+    """prefill_deferred_tokens counts the prompt tokens each step's
+    budget parked; an unbudgeted engine never moves it."""
+    rng = np.random.default_rng(6)
+    long = [int(t) for t in rng.integers(0, CONFIG.vocab_size, 30)]
+    _, budgeted = _serve(params, [(long, 3)], 8)
+    assert budgeted.prefill_deferred_tokens > 0
+    _, unbudgeted = _serve(params, [(long, 3)], None)
+    assert unbudgeted.prefill_deferred_tokens == 0
+
+
+def test_budget_validation():
+    with pytest.raises(ValueError, match="prefill_budget"):
+        ServeEngine(
+            init_params(CONFIG, jax.random.PRNGKey(0)), CONFIG,
+            slots=1, prefill_budget=0,
+        )
+
+
+# ---- mid-prefill lifecycle: no leaks ------------------------------------
+
+
+def _park_one(params, **kw):
+    """An engine with one long admission parked mid-prefill."""
+    rng = np.random.default_rng(8)
+    long = [int(t) for t in rng.integers(0, CONFIG.vocab_size, 30)]
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+        prefill_budget=8, **kw,
+    )
+    rid = engine.submit(long, 6)
+    engine.step()
+    assert engine._inflight_prefill
+    return engine, rid, long
+
+
+def test_duplicate_rid_rejected_while_parked(params):
+    """A rid parked in _inflight_prefill is still in flight: resubmitting
+    it must raise instead of silently overwriting the original's tokens
+    in run()'s {rid: tokens} result."""
+    from workloads.errors import InvalidRequest
+
+    engine, rid, long = _park_one(params)
+    with pytest.raises(InvalidRequest, match="already in flight"):
+        engine.submit(long, 2, rid=rid)
+    engine.run()
+    _hygiene(engine)
+
+
+def test_cancel_mid_prefill_reclaims(params):
+    engine, rid, long = _park_one(params)
+    assert engine.cancel(rid)
+    assert not engine._inflight_prefill
+    engine.run()
+    _hygiene(engine)
+    statuses = {r.rid: r.status for r in engine.completed}
+    assert statuses[rid] == "cancelled"
+
+
+def test_cancel_mid_prefill_fanout_requeues_siblings_solo(params):
+    """Cancelling one mid-prefill fan-out member cannot leave the group
+    half-alive: in-flight siblings abort and requeue as solo replays
+    (no retry charge), and their streams still match the solo oracle."""
+    rng = np.random.default_rng(12)
+    long = [int(t) for t in rng.integers(0, CONFIG.vocab_size, 27)]
+    engine = ServeEngine(
+        params, CONFIG, slots=3, page_size=4, prompt_bucket=8,
+        prefill_budget=8,
+    )
+    rids = engine.submit_fanout(long, 5, n_samples=2)
+    engine.step()
+    assert engine._inflight_prefill
+    assert engine.cancel(rids[0])
+    served = engine.run()
+    _hygiene(engine)
+    statuses = {r.rid: r.status for r in engine.completed}
+    assert statuses[rids[0]] == "cancelled"
+    assert statuses[rids[1]] == "ok"
+    retried = {r.rid: r.retries for r in engine.completed}
+    assert retried[rids[1]] == 0  # requeue, not a retry charge
+    solo, _ = _serve(params, [(long, 5)], None)
+    assert served[rids[1]] == next(iter(solo.values()))
+
+
+def test_deadline_mid_prefill_expires(params):
+    rng = np.random.default_rng(13)
+    long = [int(t) for t in rng.integers(0, CONFIG.vocab_size, 30)]
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+        prefill_budget=8,
+    )
+    rid = engine.submit(long, 6, deadline_s=0.001)
+    engine.step()
+    time.sleep(0.01)
+    engine.run()
+    _hygiene(engine)
+    statuses = {r.rid: r.status for r in engine.completed}
+    assert statuses[rid] == "expired"
+
+
+def test_fault_mid_prefill_replays_bit_identical(params):
+    """A dispatch fault with admissions parked mid-prefill quarantines
+    them (pages dropped, commitment rolled back) and replays under the
+    retry budget — finished streams bit-identical to the fault-free
+    run."""
+    from workloads.faults import FaultInjector
+
+    requests = _mixed_requests(4, rng_seed=14)
+    base, _ = _serve(params, requests, None)
+    injector = FaultInjector(schedule={"prefill_dispatch": [2]})
+    got, engine = _serve(
+        params, requests, 8, fault_injector=injector, max_retries=2,
+    )
+    assert engine.steps_quarantined >= 1
+    assert got == base
+
+
+def test_fault_mid_prefill_exhausted_retries_fail_terminally(params):
+    """The retry budget still bounds budgeted replays: a seam that
+    fires every prefill dispatch drives each parked admission to the
+    `failed` terminal status with everything reclaimed."""
+    from workloads.faults import FaultInjector
+
+    rng = np.random.default_rng(15)
+    long = [int(t) for t in rng.integers(0, CONFIG.vocab_size, 30)]
+    injector = FaultInjector(
+        schedule={"prefill_dispatch": list(range(1, 50))}
+    )
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+        prefill_budget=8, fault_injector=injector, max_retries=1,
+    )
+    rid = engine.submit(long, 6)
+    engine.run()
+    _hygiene(engine)
+    statuses = {r.rid: r.status for r in engine.completed}
+    assert statuses[rid] == "failed"
+
+
+def test_health_pause_requeues_mid_prefill_without_charge(params):
+    """An Unhealthy chip with admissions parked mid-prefill requeues
+    them (no retry-budget charge), holds admission while paused, and
+    replays to the bit-identical stream on recovery."""
+    from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+    from tpu_device_plugin.device import HealthEvent
+
+    q = queue.Queue()
+    engine, rid, long = _park_one(params, health_events=q)
+    q.put(HealthEvent(chip_id="chip-0", health=UNHEALTHY, code=2))
+    engine.step()
+    assert engine.paused
+    assert not engine._inflight_prefill  # parked row requeued
+    assert engine.pending and engine.pending[0].rid == rid
+    assert engine.pending[0].retries == 0  # no retry-budget charge
+    engine.step()
+    assert not engine._inflight_prefill  # held: no admission
+    q.put(HealthEvent(chip_id="chip-0", health=HEALTHY, code=2))
+    served = engine.run()
+    _hygiene(engine)
+    base, _ = _serve(params, [(long, 6)], None)
+    assert served[rid] == next(iter(base.values()))
+
+
+def test_close_mid_prefill_reclaims(params):
+    engine, rid, _ = _park_one(params)
+    engine.close()
+    _hygiene(engine)
+    statuses = {r.rid: r.status for r in engine.completed}
+    assert statuses[rid] == "failed"
+
+
+# ---- prefix-cache composition -------------------------------------------
+
+
+def test_budget_defers_prefix_insert_until_pages_written(params):
+    """The budgeted path defers prefix-cache inserts to admission
+    finish: a lookup landing while the writer is still parked
+    mid-prefill must MISS (a promissory entry could serve half-written
+    pages across steps), and a lookup after the writer finished must
+    HIT with bit-identical tokens."""
+    rng = np.random.default_rng(16)
+    prefix = [int(t) for t in rng.integers(0, CONFIG.vocab_size, 24)]
+    tail = [int(t) for t in rng.integers(0, CONFIG.vocab_size, 4)]
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+        prefill_budget=8, prefix_cache=True,
+    )
+    r1 = engine.submit(prefix + tail, 4)
+    engine.step()
+    assert engine._inflight_prefill
+    # While r1 sits parked, its prompt must not be adoptable.
+    assert engine.prefix.lookup(prefix + tail, 6, granularity=2) == []
+    served = engine.run()
+    _hygiene(engine)
+    # After finish the insert landed: a repeat admission hits the cache
+    # and the stream stays pinned against the uncached oracle.
+    r2 = engine.submit(prefix + tail, 4)
+    served2 = engine.run()
+    assert engine.prefix.hits >= 1
+    base, _ = _serve(params, [(prefix + tail, 4)], None)
+    assert served[r1] == served2[r2] == next(iter(base.values()))

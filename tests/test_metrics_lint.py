@@ -223,6 +223,7 @@ def test_engine_bridge_render_is_valid_exposition():
         generated_tokens=0, requests_admitted=0, requests_retired=0,
         prefill_dispatches=0, prefill_sweeps=0, chunks_run=0, spec_rounds=0,
         mode_switches=0, admission_readbacks=0, spec_lookahead=1,
+        prefill_deferred_tokens=0, _inflight_prefill=[],
         pending=[], _occupied=np.zeros(4, bool), slots=4,
         ctrl=_Ctrl(used_pages=0),
     )
@@ -239,6 +240,10 @@ def test_engine_bridge_render_is_valid_exposition():
             eng.requests_admitted += 2
             eng.prefill_dispatches += 1
             eng.prefill_sweeps += 1
+            # A budget-deferred admission: the counter pushes as a
+            # step delta and the in-flight gauge reads it.
+            eng.prefill_deferred_tokens += 16
+            eng._inflight_prefill = [SimpleNamespace()]
         done = []
         if i == 2:
             eng.requests_retired += 1
@@ -248,6 +253,12 @@ def test_engine_bridge_render_is_valid_exposition():
         obs._step_end(eng, snap, done)
     families = _parse_exposition(reg.render())
     assert families[f"{PREFIX}_engine_tokens_total"]["samples"][0][2] == 12.0
+    assert (
+        families[f"{PREFIX}_engine_prefill_deferred_tokens_total"][
+            "samples"
+        ][0][2]
+        == 16.0
+    )
     for fam in (
         f"{PREFIX}_engine_ttft_seconds",
         f"{PREFIX}_engine_e2e_seconds",

@@ -47,6 +47,15 @@ def _run_one(seed: int, params, draft, adapters) -> None:
             rng=jax.random.PRNGKey(seed),
         )
     kw["prompt_bucket"] = int(kw["page_size"] * rng.choice([2, 3]))
+    # Budgeted chunked-prefill interleaving: greedy streams must stay
+    # pinned against the dense reference for ANY budget (including 1
+    # token/step — every admission parks mid-prefill); sampled budgeted
+    # streams keep the structural checks only (the engine key schedule
+    # legitimately shifts when finishes cross step boundaries).
+    if rng.integers(2):
+        kw["prefill_budget"] = int(
+            rng.choice([1, kw["prompt_bucket"], 2 * kw["prompt_bucket"]])
+        )
     if spec:
         kw.update(draft_params=draft, draft_config=DRAFT_CONFIG,
                   gamma=int(rng.integers(2, 5)),
@@ -178,6 +187,13 @@ def _run_chaos(seed: int, params, draft, adapters) -> None:
         pipelined=bool(rng.integers(2)),
     )
     kw["prompt_bucket"] = int(kw["page_size"] * rng.choice([2, 3]))
+    # Budgeted chunked-prefill under chaos: mid-prefill cancels,
+    # deadline expiries and seam faults must reclaim parked admissions
+    # (the leak assertions below) and replays must stay bit-identical.
+    if rng.integers(2):
+        kw["prefill_budget"] = int(
+            rng.choice([1, kw["prompt_bucket"], 2 * kw["prompt_bucket"]])
+        )
     if spec:
         kw.update(draft_params=draft, draft_config=DRAFT_CONFIG,
                   gamma=int(rng.integers(2, 5)),

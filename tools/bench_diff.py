@@ -25,9 +25,10 @@ import os
 import re
 import sys
 
-# Higher-is-better metrics worth a round-over-round eye.  Latencies are
-# deliberately absent: the p50s sit at ~1% of target and their jitter
-# would drown the signal.
+# Higher-is-better metrics worth a round-over-round eye.  Latency p50s
+# are deliberately absent (they sit at ~1% of target and their jitter
+# would drown the signal); the serving TAIL latencies are tracked
+# separately in TRACKED_DOWN with spread-derived thresholds.
 TRACKED_UP = [
     "mfu",
     "train_tokens_per_sec",
@@ -49,6 +50,50 @@ TRACKED_UP = [
     "aggregate_chip_busy_fraction",
     "aggregate_tokens_per_sec",
 ]
+
+# Lower-is-better serving guardrails (the chunked-prefill PR's SLO
+# tripwire): TTFT tail and the budgeted/unbudgeted interleave ratio.
+# Latency p50s stay untracked (jitter at ~1% of target would drown the
+# signal); the p99 tail and the paired ratio are what the interleaving
+# work moves, so a silent regression there is exactly what this diff
+# exists to catch.
+TRACKED_DOWN = [
+    "serve_ttft_p99_ms",
+    "serve_queue_wait_p99_ms",
+    "interleave_ttft_p99_ratio",
+]
+
+# The serving keys whose thresholds derive from the artifact's own
+# pooled ratio spreads (below) instead of the flat default.
+SPREAD_GUARDED = set(TRACKED_DOWN) | {"serve_tokens_per_sec"}
+
+
+def spread_threshold(old: dict, floor: float) -> float:
+    """A noise band for the serving guardrails derived from the
+    artifact's OWN pooled ratio spreads: every ``<key>_samples`` family
+    persists per-repeat samples pooled across >= 2 fresh processes
+    (perfbench._publish_ratio_spread), so the median relative
+    half-width of those families is a measured cross-run noise floor
+    for this link/host — a WARN threshold below it would fire on
+    drift, one far above it would sleep through real regressions.
+    Falls back to ``floor`` when the artifact predates the samples."""
+    widths = []
+    for key in old:
+        if not key.endswith("_samples"):
+            continue
+        base = key[: -len("_samples")]
+        lo, hi, mid = (
+            old.get(base + "_min"), old.get(base + "_max"), old.get(base)
+        )
+        if (
+            all(isinstance(v, (int, float)) for v in (lo, hi, mid))
+            and mid
+        ):
+            widths.append((hi - lo) / (2 * abs(mid)))
+    if not widths:
+        return floor
+    widths.sort()
+    return max(floor, widths[len(widths) // 2])
 
 
 def latest_committed(repo_root: str) -> str | None:
@@ -155,7 +200,10 @@ def diff(new: dict, old: dict, threshold: float) -> list[str]:
     # versa) is a platform change, not a regression — flag it as such.
     plat_new, plat_old = new.get("busy_platform"), old.get("busy_platform")
     busy_comparable = plat_new == plat_old
-    for key in TRACKED_UP:
+    guarded = spread_threshold(old, threshold)
+    for key, sign in [(k, 1) for k in TRACKED_UP] + [
+        (k, -1) for k in TRACKED_DOWN
+    ]:
         if key.startswith("aggregate") and not busy_comparable:
             continue
         a, b = old.get(key), new.get(key)
@@ -163,15 +211,20 @@ def diff(new: dict, old: dict, threshold: float) -> list[str]:
             continue
         if a <= 0:
             continue
-        change = (b - a) / a
-        if change < -threshold:
+        limit = guarded if key in SPREAD_GUARDED else threshold
+        # ``sign`` orients the comparison so "change < -limit" always
+        # means "got worse": throughput dropping, or latency rising.
+        change = sign * (b - a) / a
+        verb_bad = "dropped" if sign > 0 else "rose"
+        verb_good = "improved"
+        if change < -limit:
             lines.append(
-                f"WARN bench_diff: {key} dropped {-change * 100:.1f}% "
+                f"WARN bench_diff: {key} {verb_bad} {-change * 100:.1f}% "
                 f"({a} -> {b})"
             )
-        elif change > threshold:
+        elif change > limit:
             lines.append(
-                f"INFO bench_diff: {key} improved {change * 100:.1f}% "
+                f"INFO bench_diff: {key} {verb_good} {change * 100:.1f}% "
                 f"({a} -> {b})"
             )
     if plat_new != plat_old and (plat_new or plat_old):

@@ -14,6 +14,11 @@ corresponding device interaction):
 
   * ``prefill_dispatch`` / ``prefill_readback`` — the admission sweep
     (or serial per-request prefill) and its fused first-token readback.
+    Under a ``prefill_budget`` the dispatch seam is crossed once per
+    BUDGETED sweep (each step's ≤-budget chunk batch), so a fault can
+    land with admissions parked mid-prefill across steps — the
+    quarantine drops and replays them like occupied slots (pinned by
+    tests/test_chunked_prefill.py and the chaos fuzz's budget arm).
   * ``decode_dispatch`` / ``decode_readback``  — the plain decode chunk
     and its token consume.
   * ``spec_dispatch``   / ``spec_readback``    — the speculative

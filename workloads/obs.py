@@ -92,6 +92,16 @@ ENGINE_METRICS: tuple[MetricSpec, ...] = (
         "target prefill program dispatches (admission sweeps and chunks)",
     ),
     MetricSpec(
+        "engine_prefill_deferred_tokens_total", "counter", ("engine",),
+        "prompt tokens whose prefill the per-step budget parked for a "
+        "later step (prefill_budget chunked-prefill interleaving)",
+    ),
+    MetricSpec(
+        "engine_prefill_inflight", "gauge", ("engine",),
+        "admissions currently parked mid-prefill by the step budget "
+        "(scrape-time)",
+    ),
+    MetricSpec(
         "engine_requests_cancelled_total", "counter", ("engine",),
         "requests cancelled via engine.cancel (queued or running)",
     ),
@@ -173,7 +183,9 @@ class RequestSpan:
     def prefill_secs(self) -> float | None:
         """Admission -> first token: the prefill + first-sample segment
         (under batched admission this includes riding the step's shared
-        sweep)."""
+        sweep; under a ``prefill_budget`` it spans every step the
+        admission sat parked mid-prefill — the trace's prefill segment
+        is the honest budget-stretched window)."""
         if self.t_admit is None or self.t_first is None:
             return None
         return self.t_first - self.t_admit
@@ -225,6 +237,12 @@ class StepRecord:
     sweeps: int
     tokens: int
     readback_secs: float
+    # Budgeted chunked-prefill interleaving (prefill_budget): admissions
+    # parked mid-prefill when the step ended, and the prompt tokens the
+    # budget deferred THIS step (defaults keep records from unbudgeted
+    # engines and older tooling identical).
+    prefill_inflight: int = 0
+    deferred_tokens: int = 0
 
 
 class EngineObserver:
@@ -306,6 +324,9 @@ class EngineObserver:
         "engine_slot_occupancy": lambda e: int(e._occupied.sum()),
         "engine_slots": lambda e: e.slots,
         "engine_resident_pages": lambda e: e.ctrl.used_pages,
+        "engine_prefill_inflight": (
+            lambda e: len(getattr(e, "_inflight_prefill", ()))
+        ),
     }
 
     # Lifecycle counter families -> the ServeEngine attribute carrying
@@ -371,10 +392,11 @@ class EngineObserver:
             engine.chunks_run,
             engine.spec_rounds,
             engine.mode_switches,
+            getattr(engine, "prefill_deferred_tokens", 0),
         )
 
     def _step_end(self, engine, snap: tuple, finished) -> StepRecord:
-        (t0, tokens0, adm0, ret0, pd0, sw0, ch0, sr0, ms0) = snap
+        (t0, tokens0, adm0, ret0, pd0, sw0, ch0, sr0, ms0, dt0) = snap
         dur = time.perf_counter() - t0
         tokens = engine.generated_tokens - tokens0
         admitted = engine.requests_admitted - adm0
@@ -400,6 +422,10 @@ class EngineObserver:
             sweeps=engine.prefill_sweeps - sw0,
             tokens=tokens,
             readback_secs=self._readback_secs,
+            prefill_inflight=len(getattr(engine, "_inflight_prefill", ())),
+            deferred_tokens=(
+                getattr(engine, "prefill_deferred_tokens", 0) - dt0
+            ),
         )
         self._step_index += 1
         if len(self.steps) == self.steps.maxlen:
@@ -419,6 +445,11 @@ class EngineObserver:
                 reg.inc(
                     "engine_prefill_dispatches_total", labels,
                     rec.prefill_dispatches,
+                )
+            if rec.deferred_tokens:
+                reg.inc(
+                    "engine_prefill_deferred_tokens_total", labels,
+                    rec.deferred_tokens,
                 )
             switches = engine.mode_switches - ms0
             if switches:

@@ -191,6 +191,7 @@ class ServeEngine:
         adapters: dict[str, list] | None = None,
         lora_alpha: float = 1.0,
         batched_admission: bool = True,
+        prefill_budget: int | None = None,
         completed_limit: int | None = None,
         mode_trace_limit: int | None = 256,
         observer=None,
@@ -212,6 +213,11 @@ class ServeEngine:
         if retry_backoff_s < 0:
             raise ValueError(
                 f"retry_backoff_s must be >= 0, got {retry_backoff_s}"
+            )
+        if prefill_budget is not None and prefill_budget < 1:
+            raise ValueError(
+                f"prefill_budget must be >= 1 token/step or None "
+                f"(unbudgeted), got {prefill_budget}"
             )
         if mode_trace_limit is not None and mode_trace_limit < 1:
             raise ValueError(
@@ -379,6 +385,26 @@ class ServeEngine:
         # first-token readback; False keeps the serial one-dispatch-per-
         # admission path (the parity/bench reference).
         self.batched_admission = batched_admission
+        # Budgeted chunked-prefill / decode interleaving (Sarathi-style;
+        # docs/SERVING.md "Chunked prefill & interleaving"): with a
+        # ``prefill_budget`` (tokens per step) admission becomes
+        # RESUMABLE — each step dispatches at most
+        # max(1, budget // prompt_bucket) prompt-bucket prefill chunks,
+        # and admissions whose prompts need more carry over in
+        # ``_inflight_prefill`` (pages committed, per-row chunk cursor)
+        # so one long prompt can no longer head-of-line-block the
+        # step's decode chunk.  A budget always rides the plan/sweep
+        # machinery (the serial one-dispatch-per-admission path cannot
+        # park a half-prefilled prompt), so ``batched_admission=False``
+        # with a budget still sweeps; greedy token streams are
+        # bit-identical budget on/off (pinned by
+        # tests/test_chunked_prefill.py).
+        self.prefill_budget = prefill_budget
+        # Mid-prefill admissions carried across steps: plan dicts (the
+        # _plan_admissions shape plus "cursor"/"last_ci"), in admission
+        # order.  Their slots are reserved (excluded from planning) but
+        # NOT occupied — decode parks them until the first token lands.
+        self._inflight_prefill: list[dict] = []
         # Telemetry for benchmarking and tests.
         self.chunks_run = 0
         self.generated_tokens = 0
@@ -386,6 +412,7 @@ class ServeEngine:
         self.prefill_tokens = 0  # prompt tokens actually forwarded
         self.prefill_sweeps = 0  # batched-admission sweeps executed
         self.prefill_dispatches = 0  # TARGET prefill program dispatches
+        self.prefill_deferred_tokens = 0  # prompt tokens the budget parked
         self.admission_readbacks = 0  # first-token host syncs
         self.spec_rounds = 0
         self.requests_admitted = 0  # popped off pending (instant-finish too)
@@ -645,9 +672,11 @@ class ServeEngine:
             exc.request = rejected
             raise exc
         rid = rid if rid is not None else f"req-{next(self._ids)}"
-        in_flight = {r.rid for r in self.pending} | {
-            r.rid for r in self._slot_req.values()
-        }
+        in_flight = (
+            {r.rid for r in self.pending}
+            | {r.rid for r in self._slot_req.values()}
+            | {p["req"].rid for p in self._inflight_prefill}
+        )
         if rid in in_flight:
             # Loud at the call site: a duplicate would silently overwrite
             # one request's tokens in run()'s {rid: tokens} result.
@@ -815,12 +844,8 @@ class ServeEngine:
         group state cannot be trusted; detached members replay solo
         (same greedy tokens: group members share exactly the logits a
         solo admission computes)."""
-        for gid, g in list(self._groups.items()):
-            if g.get("tail_page") is not None:
-                self.ctrl.release_page(g["tail_page"])
-            if g.get("allocated"):
-                self.ctrl.release(("group", gid))
-        self._groups.clear()
+        for gid in list(self._groups):
+            self._group_cleanup(gid)
         for req in self.pending:
             req.group = None
 
@@ -892,6 +917,19 @@ class ServeEngine:
         victims: list[Request] = []
         for slot in sorted(self._slot_req):
             victims.append(self._release_slot(slot))
+        # Mid-prefill admissions are device-facing transient state too:
+        # their pages may be half-written, so they drop and replay like
+        # occupied slots (their prefix-cache inserts are DEFERRED, so
+        # no cache entry can index the abandoned pages).  A partial
+        # fan-out member poisons its group's shared state — dissolve.
+        partials, self._inflight_prefill = self._inflight_prefill, []
+        had_group = False
+        for p in partials:
+            req = self._abort_partial(p)
+            had_group = had_group or req.group is not None
+            victims.append(req)
+        if had_group:
+            self._dissolve_groups()
         victims.extend(extra or [])
         finished: list[Request] = []
         # appendleft in reverse keeps the victims' FIFO order at the
@@ -923,11 +961,9 @@ class ServeEngine:
         quarantine."""
         extra = []
         for p in plans:
-            if p["seq"] in self.ctrl.tables:
-                self.ctrl.release(p["seq"])
-            self._committed_pages -= p["need"]
+            req = self._abort_partial(p)
             if p["slot"] not in self._slot_req:
-                extra.append(p["req"])
+                extra.append(req)
         self._dissolve_groups()
         if self.prefix is not None:
             self.prefix.clear()
@@ -950,6 +986,16 @@ class ServeEngine:
             if req.rid == rid:
                 self.pending.remove(req)
                 self._group_abandon(req)
+                self._finished_buffer.append(
+                    self._finish_terminal(req, "cancelled")
+                )
+                return True
+        for plan in self._inflight_prefill:
+            if plan["req"].rid == rid:
+                # Mid-prefill: no device sync needed — the row has no
+                # in-flight readback (its chunks only write pages, which
+                # release here; orphaned group siblings requeue solo).
+                req = self._reclaim_partial(plan)
                 self._finished_buffer.append(
                     self._finish_terminal(req, "cancelled")
                 )
@@ -998,14 +1044,33 @@ class ServeEngine:
         state first, exactly like cancel)."""
         now = time.perf_counter()
         finished: list[Request] = []
-        expired_q = [
-            r for r in self.pending
-            if r.t_deadline is not None and now >= r.t_deadline
+
+        def expire_queued() -> None:
+            expired_q = [
+                r for r in self.pending
+                if r.t_deadline is not None and now >= r.t_deadline
+            ]
+            for req in expired_q:
+                self.pending.remove(req)
+                self._group_abandon(req)
+                finished.append(self._finish_terminal(req, "expired"))
+
+        expire_queued()
+        expired_p = [
+            p for p in list(self._inflight_prefill)
+            if p["req"].t_deadline is not None and now >= p["req"].t_deadline
         ]
-        for req in expired_q:
-            self.pending.remove(req)
-            self._group_abandon(req)
+        for p in expired_p:
+            if not any(q is p for q in self._inflight_prefill):
+                continue  # a sibling's reclaim already dissolved it
+            req = self._reclaim_partial(p)
             finished.append(self._finish_terminal(req, "expired"))
+        if expired_p:
+            # _reclaim_partial requeues a dissolved group's in-flight
+            # siblings at the pending front; a group usually shares its
+            # deadline, so they are expired too — catch them now rather
+            # than admitting and prefilling them for one wasted step.
+            expire_queued()
         expired_slots = [
             slot for slot, r in self._slot_req.items()
             if r.t_deadline is not None and now >= r.t_deadline
@@ -1126,6 +1191,10 @@ class ServeEngine:
         for slot in sorted(self._slot_req):
             req = self._release_slot(slot)
             closed_now.append(self._finish_terminal(req, "failed", error=err))
+        for plan in list(self._inflight_prefill):
+            req = self._abort_partial(plan)
+            req.group = None  # _dissolve_groups below drops the shared state
+            closed_now.append(self._finish_terminal(req, "failed", error=err))
         while self.pending:
             req = self.pending.popleft()
             req.group = None
@@ -1186,7 +1255,7 @@ class ServeEngine:
 
     def _prefix_admit_pages(
         self, req: Request, seq, n: int, aidx: int,
-        tokens: list[int] | None = None,
+        tokens: list[int] | None = None, insert: bool = True,
     ) -> int:
         """Prefix-cache admission bookkeeping (shared by serial and
         batched admission): look the prompt up under the adapter salt,
@@ -1197,7 +1266,13 @@ class ServeEngine:
         up until after this admission's prefill has written them (serial
         prefills inline before the next lookup; the batched sweep's
         chunk order writes every column before a later row's chunks
-        read it).  Returns the row's start page (0 on a miss)."""
+        read it).  Returns the row's start page (0 on a miss).
+
+        ``insert=False`` skips the promissory insert (the lookup/adopt
+        half still runs): the BUDGETED path defers inserts to admission
+        FINISH, because its sweeps span steps — a promissory entry
+        could otherwise serve half-written pages to a lookup in a later
+        step while the writer is still parked mid-prefill."""
         # Adapter-salted prefix keys: the cached pages hold ADAPTED k/v,
         # so the same tokens under different adapters must never share
         # pages.
@@ -1219,7 +1294,7 @@ class ServeEngine:
             self._extend_evicting(seq, n)
         else:
             self._allocate_evicting(seq, n)
-        if self.prefix is not None:
+        if self.prefix is not None and insert:
             self.prefix.insert(tokens, self.ctrl.tables[seq], salt=salt)
         return len(shared_pages)
 
@@ -1325,9 +1400,9 @@ class ServeEngine:
         # The chunked path contains no Pallas call, so under a mesh it
         # needs no dedicated program: the module-level jit picks the
         # partitioning up from the sharded pools/params (GSPMD), and the
-        # pool shardings propagate through the scatter back out.
-        from .paged import paged_prefill_chunk
-
+        # pool shardings propagate through the scatter back out
+        # (paged_prefill_chunk is the module-level import — this loop is
+        # the chunked-prefill hot path, one iteration per dispatch).
         n_chunks = -(-n // B)
         logits = None
         for ci in range(start_page // bucket_pages, n_chunks):
@@ -1384,7 +1459,16 @@ class ServeEngine:
         round-trip PER admission) remains as the parity and bench
         reference.  Both return the requests that finished AT admission
         (max_new_tokens == 1 or instant EOS), with bit-identical token
-        streams (same per-request RNG key order; pinned by tests)."""
+        streams (same per-request RNG key order; pinned by tests).
+
+        With a ``prefill_budget`` admission routes through the RESUMABLE
+        budgeted path instead: at most the budget's worth of prefill
+        chunks dispatch this step and the remainder carries over in
+        ``_inflight_prefill`` (greedy streams stay bit-identical —
+        chunked prefill is per-row math, so WHEN a chunk runs cannot
+        change WHAT it computes)."""
+        if self.prefill_budget is not None:
+            return self._admit_budgeted()
         if not self.batched_admission:
             return self._admit_serial()
         finished: list[Request] = []
@@ -1501,7 +1585,9 @@ class ServeEngine:
 
     # ---- batched admission: plan -> sweep -> finish ---------------------
 
-    def _plan_admissions(self, used: set) -> list[dict]:
+    def _plan_admissions(
+        self, used: set, defer_prefix_insert: bool = False
+    ) -> list[dict]:
         """The PLAN half of batched admission: scan the pending queue in
         the serial loop's exact order (free slots ascending, FIFO queue,
         break on the first request the page budget defers) doing every
@@ -1542,13 +1628,18 @@ class ServeEngine:
                 "aidx": self._adapter_ids.get(req.adapter, 0),
                 "need": need, "start_page": 0, "prefill": True,
                 "logits_from": None, "tail_copy": None, "group_done": None,
+                "prefix_insert": None,
             }
             if req.group is not None:
                 self._plan_group_member(req, seq, n, plan)
             else:
                 plan["start_page"] = self._prefix_admit_pages(
-                    req, seq, n, plan["aidx"], tokens=prompt
+                    req, seq, n, plan["aidx"], tokens=prompt,
+                    insert=not defer_prefix_insert,
                 )
+                if defer_prefix_insert and self.prefix is not None:
+                    salt = f"lora:{plan['aidx']}" if plan["aidx"] else ""
+                    plan["prefix_insert"] = (prompt, salt)
             self._committed_pages += need
             plans.append(plan)
         return plans
@@ -1583,6 +1674,71 @@ class ServeEngine:
             # it before the copy reads it.
             plan["group_done"] = req.group
 
+    def _prefill_row_arrays(self, rows: list[dict]):
+        """The multi-row prefill sweep's per-row device inputs —
+        lengths/tables/row_start (parked rows keep trash tables and
+        zero lengths, exactly like empty decode rows) and the stacked
+        per-row LoRA gather — shared by the unbudgeted sweep and the
+        budgeted scheduler so the calling convention cannot drift."""
+        S = self.slots
+        lengths = np.zeros(S, np.int32)
+        starts = np.zeros(S, np.int32)
+        tables = np.full((S, self.max_pages), self.ctrl.trash, np.int32)
+        for p in rows:
+            s = p["slot"]
+            lengths[s] = p["n"]
+            starts[s] = p["start_page"]
+            t = self.ctrl.tables[p["seq"]]
+            tables[s, : len(t)] = t
+        lora = None
+        if self._stacked_adapters is not None:
+            aidx = np.zeros(S, np.int32)
+            for p in rows:
+                aidx[p["slot"]] = p["aidx"]
+            lora = (
+                self._stacked_adapters, jnp.asarray(aidx), self.lora_alpha,
+            )
+        return (
+            lengths, jnp.asarray(tables), jnp.asarray(lengths),
+            jnp.asarray(starts), lora,
+        )
+
+    def _dispatch_prefill_ci(
+        self, rows: list[dict], ci: int, lengths: np.ndarray,
+        tables_dev, lengths_dev, row_start, lora, emitted,
+    ):
+        """ONE [slots, bucket] prefill chunk dispatch at absolute chunk
+        index ``ci`` for ``rows`` — target program, draft pools (no
+        emit, no LoRA), and the per-row emit-mask merge (a row's
+        true-last-position logits land where its prompt ends inside
+        this chunk).  The single source of truth for the chunk calling
+        convention: the unbudgeted sweep and the budgeted scheduler
+        both dispatch through here, so the budget-on/off bit-identical
+        parity pin cannot drift between two copies."""
+        B, bp = self.prompt_bucket, self.prompt_bucket // self.page_size
+        start = ci * B
+        chunk = np.zeros((self.slots, B), np.int32)
+        for p in rows:
+            width = min(B, p["n"] - start)
+            if width > 0:
+                chunk[p["slot"], :width] = p["prompt"][start : start + width]
+        logits, self.pools = self._prefill_chunk(
+            self.params, self.pools, tables_dev, jnp.asarray(chunk),
+            lengths_dev, start_page=ci * bp, cover_pages=(ci + 1) * bp,
+            emit=True, lora=lora, row_start=row_start,
+        )
+        self.prefill_dispatches += 1
+        emit_mask = (lengths > start) & (lengths <= start + B)
+        emitted = jnp.where(jnp.asarray(emit_mask)[:, None], logits, emitted)
+        if self.d_pools is not None:
+            _, self.d_pools = self._d_prefill_chunk(
+                self.draft_params, self.d_pools, tables_dev,
+                jnp.asarray(chunk), lengths_dev, start_page=ci * bp,
+                cover_pages=(ci + 1) * bp, emit=False,
+                row_start=row_start,
+            )
+        return emitted
+
     def _sweep_prefill(self, plans: list[dict]):
         """The EXECUTE half: stack this round's prefilling rows into one
         ragged [slots, bucket] batch and drive paged_prefill_chunk over
@@ -1609,15 +1765,7 @@ class ServeEngine:
         # compute-bound at low load keep batched_admission=False.
         B, ps, S = self.prompt_bucket, self.page_size, self.slots
         bp = B // ps
-        lengths = np.zeros(S, np.int32)
-        starts = np.zeros(S, np.int32)
-        tables = np.full((S, self.max_pages), self.ctrl.trash, np.int32)
         for p in rows:
-            s = p["slot"]
-            lengths[s] = p["n"]
-            starts[s] = p["start_page"]
-            t = self.ctrl.tables[p["seq"]]
-            tables[s, : len(t)] = t
             self.prefills_run += 1
             self.prefill_tokens += p["n"] - p["start_page"] * ps
         # A chunk index is dispatched only if some row's UNCACHED span
@@ -1633,43 +1781,16 @@ class ServeEngine:
                 for ci in range(p["start_page"] // bp, -(-p["n"] // B))
             }
         )
-        tables_dev = jnp.asarray(tables)
-        lengths_dev = jnp.asarray(lengths)
-        row_start = jnp.asarray(starts)
-        lora = None
-        if self._stacked_adapters is not None:
-            aidx = np.zeros(S, np.int32)
-            for p in rows:
-                aidx[p["slot"]] = p["aidx"]
-            lora = (self._stacked_adapters, jnp.asarray(aidx), self.lora_alpha)
+        lengths, tables_dev, lengths_dev, row_start, lora = (
+            self._prefill_row_arrays(rows)
+        )
         emitted = jnp.zeros((S, self.config.vocab_size), jnp.float32)
         self.prefill_sweeps += 1
         for ci in active:
-            start = ci * B
-            chunk = np.zeros((S, B), np.int32)
-            for p in rows:
-                width = min(B, p["n"] - start)
-                if width > 0:
-                    chunk[p["slot"], :width] = p["prompt"][
-                        start : start + width
-                    ]
-            logits, self.pools = self._prefill_chunk(
-                self.params, self.pools, tables_dev, jnp.asarray(chunk),
-                lengths_dev, start_page=ci * bp, cover_pages=(ci + 1) * bp,
-                emit=True, lora=lora, row_start=row_start,
+            emitted = self._dispatch_prefill_ci(
+                rows, ci, lengths, tables_dev, lengths_dev, row_start,
+                lora, emitted,
             )
-            self.prefill_dispatches += 1
-            # Per-row emit selection: a row's last true position falls
-            # in this chunk iff start < length <= start + B.
-            emit_mask = (lengths > start) & (lengths <= start + B)
-            emitted = jnp.where(jnp.asarray(emit_mask)[:, None], logits, emitted)
-            if self.d_pools is not None:
-                _, self.d_pools = self._d_prefill_chunk(
-                    self.draft_params, self.d_pools, tables_dev,
-                    jnp.asarray(chunk), lengths_dev, start_page=ci * bp,
-                    cover_pages=(ci + 1) * bp, emit=False,
-                    row_start=row_start,
-                )
         return emitted
 
     def _finish_admissions(
@@ -1689,6 +1810,16 @@ class ServeEngine:
             emitted = jnp.zeros(
                 (self.slots, self.config.vocab_size), jnp.float32
             )
+        # Budget-deferred prefix-cache inserts: the row's pages are all
+        # written once it reaches finish, so the entry can no longer
+        # serve a half-prefilled prompt to a later lookup.
+        for p in plans:
+            ins = p.get("prefix_insert")
+            if ins is not None and self.prefix is not None:
+                tokens, salt = ins
+                self.prefix.insert(
+                    tokens, self.ctrl.tables[p["seq"]], salt=salt
+                )
         # Cache the first member's logits row on its group, then splice
         # reuse rows into the buffer.
         for p in plans:
@@ -1758,6 +1889,186 @@ class ServeEngine:
             self._positions[slot] = p["n"]
             self._tokens[slot] = tok
         return finished, retry
+
+    # ---- budgeted chunked-prefill interleaving --------------------------
+
+    def _admit_budgeted(self) -> list[Request]:
+        """Resumable admission under a prefill token budget: plan new
+        admissions into free slots exactly as the unbudgeted path does
+        (FIFO, worst-case page commitment, prefix/fan-out bookkeeping —
+        prefix inserts deferred to finish), then dispatch at most
+        ``max(1, prefill_budget // prompt_bucket)`` prompt-bucket chunks
+        across ALL in-flight rows, finish the rows whose last chunk
+        landed (one fused first-token readback), and carry the rest in
+        ``_inflight_prefill`` for the next step.  Under ``pipelined``
+        the in-flight decode readback is consumed BETWEEN the sweep
+        dispatch and the fused readback, so it overlaps the prefill
+        compute instead of serializing behind it.
+
+        Unlike the unbudgeted loop there is no same-step re-plan after
+        an at-admission retirement: freed budget admits next step (the
+        budget already bounds this step's prefill work)."""
+        budget = max(1, self.prefill_budget // self.prompt_bucket)
+        finished: list[Request] = []
+        bp = self.prompt_bucket // self.page_size
+        used = {p["slot"] for p in self._inflight_prefill}
+        new_plans = self._plan_admissions(used, defer_prefix_insert=True)
+        for p in new_plans:
+            p["cursor"] = p["start_page"] // bp
+            p["last_ci"] = -(-p["n"] // self.prompt_bucket) - 1
+            if p["prefill"]:
+                self.prefills_run += 1
+                self.prefill_tokens += (
+                    p["n"] - p["start_page"] * self.page_size
+                )
+        self._inflight_prefill.extend(new_plans)
+        if not self._inflight_prefill:
+            return finished
+        try:
+            emitted = self._sweep_prefill_budgeted(budget)
+            if self.pipelined:
+                # Overlap: the sweep's chunks are queued on device; read
+                # the previous decode chunk / superstep back NOW, while
+                # they compute (the chained device tokens stay in place,
+                # so the next decode dispatch still chains on device).
+                if self._pending_read is not None:
+                    toks_dev, snapshot = self._pending_read
+                    self._pending_read = None
+                    finished += self._consume_chunk(toks_dev, snapshot)
+                if self._pending_spec is not None:
+                    arrs, snapshot = self._pending_spec
+                    self._pending_spec = None
+                    finished += self._consume_spec(arrs, snapshot)
+            done_slots = {
+                p["slot"] for p in self._inflight_prefill
+                if p["prefill"] and p["cursor"] > p["last_ci"]
+            }
+            # A reuse (fan-out) row finishes when its group's logits
+            # resolve: cached from an earlier step, or its source row's
+            # emitting chunk landed this step.
+            completed = [
+                p for p in self._inflight_prefill
+                if (p["prefill"] and p["cursor"] > p["last_ci"])
+                or (not p["prefill"] and (
+                    p["logits_from"].get("logits") is not None
+                    or p["logits_from"].get("logits_slot") in done_slots
+                ))
+            ]
+            if completed:
+                batch_finished, _ = self._finish_admissions(
+                    completed, emitted
+                )
+                finished += batch_finished
+                done_ids = {id(p) for p in completed}
+                self._inflight_prefill = [
+                    p for p in self._inflight_prefill
+                    if id(p) not in done_ids
+                ]
+        except Exception as exc:  # noqa: BLE001 — recovery seam
+            plans = list(self._inflight_prefill)
+            self._inflight_prefill = []
+            return finished + self._quarantine_admissions(plans, exc)
+        for p in self._inflight_prefill:
+            if p["prefill"]:
+                self.prefill_deferred_tokens += max(
+                    0, p["n"] - p["cursor"] * self.prompt_bucket
+                )
+        return finished
+
+    def _sweep_prefill_budgeted(self, max_chunks: int):
+        """Dispatch up to ``max_chunks`` prompt-bucket prefill chunks
+        across the in-flight admission rows, FIFO: the oldest incomplete
+        row's next chunk index goes first, and every row whose cursor
+        sits at the same index rides the same [slots, bucket] dispatch
+        (a chunk index is an absolute position, so same-cursor rows
+        share the program's static start_page/cover_pages).  Rows not in
+        the dispatch keep trash tables and zero lengths — parked exactly
+        like empty decode rows.  The speculative draft pools run every
+        dispatch too (no emit, no LoRA), and ``row_start`` keeps
+        guarding prefix-cache hit pages.  Returns the per-slot emitted
+        logits buffer ([slots, vocab]); a row's emit lands in the step
+        its LAST chunk dispatches, which is the step it finishes."""
+        S = self.slots
+        emitted = jnp.zeros((S, self.config.vocab_size), jnp.float32)
+        if not any(
+            p["prefill"] and p["cursor"] <= p["last_ci"]
+            for p in self._inflight_prefill
+        ):
+            return emitted
+        self._maybe_fault("prefill_dispatch")
+        self.prefill_sweeps += 1
+        dispatched = 0
+        # The per-row device inputs depend only on the dispatch group's
+        # row set (pages are all allocated at admission), so consecutive
+        # chunks of an unchanged group — the common long-prompt case —
+        # reuse one upload instead of paying a host->device transfer of
+        # the [slots, max_pages] table array per chunk.
+        group_key, arrays = None, None
+        while dispatched < max_chunks:
+            todo = [
+                p for p in self._inflight_prefill
+                if p["prefill"] and p["cursor"] <= p["last_ci"]
+            ]
+            if not todo:
+                break
+            ci = todo[0]["cursor"]  # FIFO: oldest admission first
+            group = [p for p in todo if p["cursor"] == ci]
+            key = tuple(id(p) for p in group)
+            if key != group_key:
+                arrays = self._prefill_row_arrays(group)
+                group_key = key
+            lengths, tables_dev, lengths_dev, row_start, lora = arrays
+            emitted = self._dispatch_prefill_ci(
+                group, ci, lengths, tables_dev, lengths_dev, row_start,
+                lora, emitted,
+            )
+            for p in group:
+                p["cursor"] += 1
+            dispatched += 1
+        return emitted
+
+    def _abort_partial(self, plan: dict) -> Request:
+        """Low-level mid-prefill teardown: drop the plan from the
+        in-flight list, release its sequence pages and roll back its
+        worst-case page commitment.  Group policy and the request's
+        fate are the caller's."""
+        self._inflight_prefill = [
+            q for q in self._inflight_prefill if q is not plan
+        ]
+        if plan["seq"] in self.ctrl.tables:
+            self.ctrl.release(plan["seq"])
+        self._committed_pages -= plan["need"]
+        return plan["req"]
+
+    def _reclaim_partial(self, plan: dict) -> Request:
+        """Reclaim one mid-prefill admission (cancel/deadline): release
+        its pages and commitment.  A fan-out group losing a mid-prefill
+        member cannot be trusted to resolve (the departing row may be
+        the shared-logits source, or its shared pages may be
+        half-written), so the group's OTHER in-flight members abort too
+        and requeue as SOLO replays at the queue front (no retry charge
+        — greedy group tokens equal solo tokens), pending members
+        detach, and the group's bookkeeping releases.  Members already
+        decoding keep their forked pages and are untouched."""
+        req = self._abort_partial(plan)
+        gid = req.group
+        req.group = None
+        if gid is not None and gid in self._groups:
+            # appendleft in reverse keeps the siblings' FIFO order at
+            # the queue front (the _quarantine_step victim rule).
+            for q in reversed([
+                q for q in self._inflight_prefill
+                if q["req"].group == gid
+            ]):
+                sib = self._abort_partial(q)
+                sib.group = None
+                sib.status = "queued"
+                self.pending.appendleft(sib)
+            for r in self.pending:
+                if r.group == gid:
+                    r.group = None
+            self._group_cleanup(gid)
+        return req
 
     def _dev(self, mirror: np.ndarray) -> jax.Array:
         """A host mirror crossing into a dispatch, COPIED first: on the
@@ -2258,7 +2569,15 @@ class ServeEngine:
         superstep's documented dead compute)."""
         t_rb = time.perf_counter() if self._obs is not None else 0.0
         self._maybe_fault("spec_readback")
-        committed, n_acc = (np.asarray(a) for a in arrs)
+        # ONE host sync for the whole round's array tuple: serial
+        # np.asarray calls would pay the link round-trip per array
+        # (measured ~116 ms readback against ~4.5 ms of round compute on
+        # the bench tunnel — spec_round_readback_ms); device_get
+        # transfers the tuple in a single fetch.  Values are identical,
+        # only the sync count changes.
+        committed, n_acc = (
+            np.asarray(a) for a in jax.device_get(arrs)
+        )
         if self._obs is not None:
             self._obs._note_readback(time.perf_counter() - t_rb)
         self._note_recovery()
@@ -2287,6 +2606,7 @@ class ServeEngine:
         return (
             not self.pending
             and not self._occupied.any()
+            and not self._inflight_prefill
             and self._pending_read is None
             and self._pending_spec is None
             and not self._finished_buffer
@@ -2387,6 +2707,15 @@ def main(argv=None) -> int:
                         help="serve int8 weight-only quantized weights")
     parser.add_argument("--kv-heads", type=int, default=None,
                         help="grouped-query kv heads (default: n_heads)")
+    parser.add_argument("--prefill-budget", type=int, default=None,
+                        metavar="TOKENS",
+                        help="stall-free chunked-prefill interleaving: cap "
+                        "prefill work at TOKENS per step (>= 1 chunk always "
+                        "dispatches) and carry the remainder of long-prompt "
+                        "admissions across steps, so one long prefill never "
+                        "head-of-line-blocks the decode chunk (docs/"
+                        "SERVING.md 'Chunked prefill & interleaving'; "
+                        "omit for run-to-completion admission)")
     parser.add_argument("--pipelined", action="store_true",
                         help="overlap each chunk's readback with the next "
                         "chunk's compute (same tokens, higher throughput)")
@@ -2455,6 +2784,8 @@ def main(argv=None) -> int:
         parser.error("--requests and --slots must be >= 1")
     if args.metrics_port is not None and not 0 <= args.metrics_port <= 65535:
         parser.error("--metrics-port must be in [0, 65535] (0 = ephemeral)")
+    if args.prefill_budget is not None and args.prefill_budget < 1:
+        parser.error("--prefill-budget must be >= 1 token per step")
 
     from . import lease
 
@@ -2546,6 +2877,7 @@ def main(argv=None) -> int:
         prompt_bucket=bucket,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         rng=jax.random.PRNGKey(42), pipelined=args.pipelined,
+        prefill_budget=args.prefill_budget,
         adapters=adapters, observer=observer,
         max_pending=args.max_pending, fault_injector=injector,
         max_retries=args.max_retries,
