@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check fmt-check
 
 all: native
 
@@ -51,7 +51,19 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check test
+check: check-compat obs-check faults-check prefill-check fleet-check test
+
+# Fleet-serving tripwires (docs/SERVING.md "Fleet serving & failover"):
+# one seeded router-chaos round — randomized replica crashes/hangs (the
+# replica seams of workloads/faults.py) plus health drains interleaved
+# with cancels/deadlines across N=2..4 replicas — asserting the fleet
+# contracts: exactly one terminal status per rid fleet-wide, no
+# slot/page/commitment leak on survivors, ok greedy streams
+# bit-identical to the dense oracle through failovers, interrupted
+# streams true prefixes.  The multi-seed chaos arm and the open-loop
+# fuzz ride the slow suite (tests/test_fleet.py, test_serve_fuzz.py).
+fleet-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_fleet.py::test_fleet_chaos_smoke" -q -o addopts=
 
 # Budgeted chunked-prefill tripwires (docs/SERVING.md "Chunked prefill
 # & interleaving"): greedy streams bit-identical budget on/off across

@@ -553,6 +553,8 @@ COMPACT_KEYS = [
     "interleave_prefill_budget",
     "obs_overhead_pct", "obs_on_tokens_per_sec",
     "fault_recovery_ms", "fault_injector_off_overhead_pct",
+    "fleet_tokens_per_sec", "fleet_ttft_p99_ms",
+    "router_overhead_ms", "failover_recovery_ms",
     "admission_tokens_per_sec", "admission_speedup",
     "admission_dispatches_per_request",
     "prefix_serve_speedup", "prefix_prefill_speedup",

@@ -1,0 +1,752 @@
+"""Fleet serving & failover contracts (workloads/fleet.py): N
+ServeEngine replicas behind the least-loaded/affinity router, each an
+isolated fault domain.
+
+The pinned contracts: exactly ONE terminal status per rid fleet-wide;
+replica crash/hang (and HealthFanout Unhealthy drains) fail in-flight
+work over to survivors via replay, with ok greedy streams bit-identical
+to the single-engine dense oracle and interrupted streams true
+prefixes; drains charge no failover budgets while true faults do; zero
+slot/page/commitment leaks on survivors; graceful drain/remove and live
+add; the HTTP/SSE front end streams real tokens; mixed-attribution
+health streams drain exactly the affected replicas and can never strand
+the whole fleet paused."""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+from tpu_device_plugin.device import HealthEvent
+from workloads.errors import EngineClosed, InvalidRequest, QueueFull
+from workloads.faults import REPLICA_SEAMS, FaultInjector
+from workloads.fleet import (
+    DEAD,
+    DRAINING,
+    Fleet,
+    FleetServer,
+    Router,
+    TrafficGen,
+    drive_open_loop,
+)
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+PARAMS = init_params(CONFIG, jax.random.PRNGKey(0))
+TERMINAL = {"ok", "cancelled", "expired", "failed"}
+
+
+def _engine(**kw):
+    base = dict(slots=2, page_size=4, prompt_bucket=8)
+    base.update(kw)
+    return ServeEngine(PARAMS, CONFIG, **base)
+
+
+def _fleet(n=2, *, engine_kw=None, **fleet_kw):
+    fleet_kw.setdefault(
+        "chip_ids", [f"chip-{i}" for i in range(n)]
+    )
+    # Wall-clock watchdog off by default: a loaded CI host's XLA compile
+    # times must never read as replica hangs.  The watchdog has its own
+    # dedicated test below.
+    fleet_kw.setdefault("hang_timeout_s", None)
+    return Fleet(
+        [_engine(**(engine_kw or {})) for _ in range(n)], **fleet_kw
+    )
+
+
+def _oracle(prompt, new):
+    return [int(t) for t in np.asarray(generate(
+        PARAMS, jnp.asarray([prompt], jnp.int32), CONFIG,
+        max_new_tokens=new,
+    )[0])]
+
+
+def _prompts(seed, n, lo=1, hi=20, new_lo=2, new_hi=16):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        plen = int(rng.integers(lo, hi))
+        prompt = [int(t) for t in rng.integers(0, CONFIG.vocab_size, plen)]
+        out.append((prompt, int(rng.integers(new_lo, new_hi))))
+    return out
+
+
+def _run_collecting(
+    fleet, expected, *, max_steps=600, mid_step=None, terminal=None,
+):
+    """Step to convergence, asserting one terminal status per rid."""
+    terminal = dict(terminal or {})
+    steps = 0
+    while not fleet.idle:
+        steps += 1
+        assert steps < max_steps, (fleet.states(), "failed to converge")
+        if mid_step is not None:
+            mid_step(steps)
+        for fr in fleet.step():
+            assert fr.rid not in terminal, (fr.rid, "double terminal")
+            assert fr.status in TERMINAL, (fr.rid, fr.status)
+            terminal[fr.rid] = fr.status
+    assert set(terminal) >= set(expected), set(expected) - set(terminal)
+    return terminal
+
+
+def _assert_no_leaks(fleet):
+    for rep in fleet.replicas:
+        if rep.state == DEAD:
+            continue
+        e = rep.engine
+        assert not e._occupied.any(), rep.index
+        assert e._committed_pages == 0, rep.index
+        assert not e._groups, rep.index
+        pinned = e.prefix.cached_pages if e.prefix is not None else 0
+        assert e.ctrl.used_pages == pinned, rep.index
+        assert not rep.rids, rep.index
+
+
+# ---- basic serving -------------------------------------------------------
+
+
+def test_fleet_serves_bit_identical_to_dense_oracle():
+    fleet = _fleet(2)
+    reqs = _prompts(0, 6)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    served = fleet.run()
+    for rid, (prompt, new) in zip(rids, reqs):
+        assert served[rid] == _oracle(prompt, new), rid
+    # Both replicas actually took work (least-loaded spreads).
+    assert all(r.engine.requests_admitted > 0 for r in fleet.replicas)
+    assert fleet.requests_ok == 6
+    _assert_no_leaks(fleet)
+    fleet.close()
+    assert all(r.state == DEAD for r in fleet.replicas)
+
+
+def test_router_least_loaded_and_session_affinity():
+    router = Router(affinity_slack=8)
+    fleet = _fleet(3, router=router)
+    reqs = _prompts(1, 9, new_lo=4)
+    # Three sessions, three requests each: affinity must pin a session
+    # to one replica (slack is generous), least-loaded must spread the
+    # three sessions across replicas.
+    placed: dict[str, set[int]] = {}
+    rids = {}
+    for i, (p, n) in enumerate(reqs):
+        sess = f"s{i % 3}"
+        rid = fleet.submit(p, n, session=sess)
+        rids[rid] = (p, n, sess)
+    fleet.step()  # one dispatch pass places everything queued
+    for rid, (_p, _n, sess) in rids.items():
+        fr = fleet._reqs[rid]
+        if fr.replica is not None:
+            placed.setdefault(sess, set()).add(fr.replica)
+    for sess, replicas in placed.items():
+        assert len(replicas) == 1, (sess, replicas)
+    assert len({next(iter(v)) for v in placed.values()}) == 3
+    assert router.affinity_hits >= 6
+    served = fleet.run()
+    for rid, (p, n, _s) in rids.items():
+        assert served.get(rid, fleet._reqs[rid].tokens) == _oracle(p, n)
+    fleet.close()
+
+
+def test_fleet_bounded_admission_and_validation():
+    fleet = _fleet(1, max_pending=2)
+    fleet.submit([1, 2], 2)
+    fleet.submit([3, 4], 2)
+    with pytest.raises(QueueFull):
+        fleet.submit([5, 6], 2)
+    assert fleet.queue_rejections == 1
+    with pytest.raises(InvalidRequest):
+        fleet.submit([1], 0)
+    with pytest.raises(Exception):
+        fleet.submit([], 2)
+    rid = "dup"
+    fleet.run()
+    fleet.submit([1], 1, rid=rid)
+    with pytest.raises(InvalidRequest):
+        fleet.submit([2], 1, rid=rid)
+    fleet.run()
+    fleet.close()
+    with pytest.raises(EngineClosed):
+        fleet.submit([1], 1)
+    with pytest.raises(EngineClosed):
+        fleet.step()
+    fleet.close()  # idempotent
+
+
+def test_fleet_cancel_and_deadline_one_terminal_each():
+    fleet = _fleet(2)
+    reqs = _prompts(2, 5, new_lo=8, new_hi=16)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    # Cancel one while still router-queued, one after dispatch.
+    assert fleet.cancel(rids[0]) is True
+    early = {fr.rid: fr.status for fr in fleet.step()}
+    assert fleet.cancel(rids[1]) is True
+    assert fleet.cancel("nope") is False
+    expired_rid = fleet.submit([7, 7, 7], 12, deadline_s=1e-4)
+    time.sleep(0.002)
+    terminal = _run_collecting(
+        fleet, rids + [expired_rid], terminal=early
+    )
+    assert terminal[rids[0]] == "cancelled"
+    assert terminal[rids[1]] == "cancelled"
+    assert terminal[expired_rid] == "expired"
+    for rid, (p, n) in list(zip(rids, reqs))[2:]:
+        assert fleet._reqs[rid].tokens == _oracle(p, n)
+    # Cancelling an already-terminal rid is a no-op, not a second status.
+    assert fleet.cancel(rids[0]) is False
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+# ---- failover: crash / hang / slow --------------------------------------
+
+
+def test_replica_crash_fails_over_bit_identically():
+    """The headline acceptance contract: N=4 under the open-loop
+    generator, a replica crash mid-stream — every rid one terminal
+    status, ok streams bit-identical to the single-engine oracle,
+    survivors leak-free, recovery latency recorded."""
+    n = 4
+    # Crossing 2n+1 = fleet step 3, replica 0 — mid-stream, in-flight.
+    injector = FaultInjector({"replica_crash": 2 * n + 1})
+    fleet = _fleet(n, fault_injector=injector, max_failovers=2)
+    reqs = _prompts(3, 12, lo=4, hi=20, new_lo=8, new_hi=16)
+    rids = [fleet.submit(p, nw) for p, nw in reqs]
+    terminal = _run_collecting(fleet, rids)
+    assert fleet.replica_crashes == 1
+    assert fleet.replicas[0].state == DEAD
+    assert fleet.failover_requeues >= 1
+    assert len(fleet.failover_recovery_s) == 1
+    assert fleet.failover_recovery_s[0] > 0
+    for rid, (p, nw) in zip(rids, reqs):
+        fr = fleet._reqs[rid]
+        ref = _oracle(p, nw)
+        if terminal[rid] == "ok":
+            assert fr.tokens == ref, (rid, fr.failovers, fr.segments)
+        else:
+            assert fr.tokens == ref[: len(fr.tokens)], rid
+    # At least one ok stream actually crossed the failover (segments>1).
+    crossed = [
+        r for r in rids
+        if fleet._reqs[r].segments > 1 and terminal[r] == "ok"
+    ]
+    assert crossed, "crash failed over no in-flight request"
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_replica_hang_counts_separately_and_fails_over():
+    injector = FaultInjector({"replica_hang": 3})  # step 2, replica 0
+    fleet = _fleet(2, fault_injector=injector)
+    reqs = _prompts(4, 6, new_lo=6)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    terminal = _run_collecting(fleet, rids)
+    assert fleet.replica_hangs == 1 and fleet.replica_crashes == 0
+    assert fleet.replicas[0].state == DEAD
+    for rid, (p, n) in zip(rids, reqs):
+        if terminal[rid] == "ok":
+            assert fleet._reqs[rid].tokens == _oracle(p, n)
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_slow_auto_drain_never_takes_the_last_dispatchable_replica():
+    """A 1-replica fleet under persistent replica_slow must keep
+    serving degraded — auto-draining the only dispatchable replica
+    would park the queue forever."""
+    injector = FaultInjector({"replica_slow": [1, 2, 3, 4, 5, 6]})
+    fleet = _fleet(
+        1, fault_injector=injector, slow_readback_s=0.0,
+        slow_drain_after=2,
+    )
+    reqs = _prompts(13, 4, new_lo=4)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    terminal = _run_collecting(fleet, rids)
+    assert fleet.replicas[0].state == "active"
+    assert all(terminal[r] == "ok" for r in rids)
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_harvested_complete_stream_finishes_ok_not_replayed():
+    """A replica dying between emitting a stream's last token and
+    retiring the request leaves a bit-complete harvest: the fleet must
+    finish it 'ok' — a zero-budget replay would InvalidRequest a
+    stream the client already received in full."""
+    from workloads.fleet import FleetRequest
+
+    fleet = _fleet(2)
+    fr = FleetRequest(rid="r-done", prompt=[1, 2], max_new_tokens=3)
+    fr.tokens = [5, 6, 7]  # complete
+    fleet._reqs[fr.rid] = fr
+    finished = fleet._requeue_victims([fr], charge=True)
+    assert [f.rid for f in finished] == ["r-done"]
+    assert fr.status == "ok" and fr.failovers == 0
+    assert not fleet.queue
+    # EOS-terminated harvest counts as complete too.
+    fr2 = FleetRequest(
+        rid="r-eos", prompt=[1], max_new_tokens=8, eos_token=9,
+    )
+    fr2.tokens = [4, 9]
+    fleet._reqs[fr2.rid] = fr2
+    finished = fleet._requeue_victims([fr2], charge=True)
+    assert fr2.status == "ok" and not fleet.queue
+    fleet.close()
+
+
+def test_replica_slow_auto_drains_not_kills():
+    injector = FaultInjector(
+        {"replica_slow": [1, 3, 5]}  # replica 0's first three steps
+    )
+    fleet = _fleet(
+        2, fault_injector=injector, slow_readback_s=0.0,
+        slow_drain_after=3,
+    )
+    reqs = _prompts(5, 6, new_lo=6)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    terminal = _run_collecting(fleet, rids)
+    assert fleet.replicas[0].state == DRAINING  # degraded, not dead
+    assert fleet.replica_crashes == 0 and fleet.replica_hangs == 0
+    assert all(terminal[r] == "ok" for r in rids)
+    for rid, (p, n) in zip(rids, reqs):
+        assert fleet._reqs[rid].tokens == _oracle(p, n)
+    # A drained replica takes no new work until resumed.
+    admitted0 = fleet.replicas[0].engine.requests_admitted
+    rid = fleet.submit([9, 9], 4)
+    fleet.step()
+    assert fleet.replicas[0].engine.requests_admitted == admitted0
+    assert fleet._reqs[rid].status in ("running", "ok")
+    fleet.resume(0)
+    assert fleet.replicas[0].state == "active"
+    fleet.run()
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_hang_watchdog_exempts_warmup_and_kills_wedged_steps():
+    """The wall-clock watchdog must not mistake one-time XLA compiles
+    for a wedge (a replica's FIRST step is exempt), but a genuinely
+    wedged later step kills the replica and fails its work over."""
+    # Warm-up exemption: first steps are compile-dominated and far
+    # exceed a tight timeout, yet no replica may die for it.
+    fleet = _fleet(2, hang_timeout_s=0.05)
+    for p, n in _prompts(11, 4, new_lo=4):
+        fleet.submit(p, n)
+    fleet.step()
+    assert fleet.replica_hangs == 0
+    assert all(r.state != DEAD for r in fleet.replicas)
+    fleet.close()
+
+    # Kill path: compiles warmed off the clock, then one wedged step.
+    # (A failover replay can compile a fresh prefill bucket on the
+    # survivor, which a tight watchdog may legitimately also count as
+    # a hang — so replica 0's death is pinned exactly, the rest of the
+    # fleet's fate only via the lifecycle invariants.)
+    fleet = _fleet(2, hang_timeout_s=None)
+    for p, n in _prompts(11, 4, new_lo=4):
+        fleet.submit(p, n)
+    fleet.run()
+    fleet.drain_completed()
+    fleet.hang_timeout_s = 0.5
+    real_step = fleet.replicas[0].engine.step
+
+    def wedged_step():
+        time.sleep(0.8)
+        return real_step()
+
+    fleet.replicas[0].engine.step = wedged_step
+    reqs = _prompts(12, 4, new_lo=6)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    terminal = _run_collecting(fleet, rids)
+    assert fleet.replica_hangs >= 1 and fleet.replica_crashes == 0
+    assert fleet.replicas[0].state == DEAD
+    for rid, (p, n) in zip(rids, reqs):
+        fr, ref = fleet._reqs[rid], _oracle(p, n)
+        if terminal[rid] == "ok":
+            assert fr.tokens == ref, rid
+        else:
+            assert fr.tokens == ref[: len(fr.tokens)], rid
+    if fleet.replicas[1].state != DEAD:
+        assert any(s == "ok" for s in terminal.values())
+        _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_failover_budget_exhaustion_fails_terminally():
+    """Every replica dies: requests charged past max_failovers (or left
+    with no live replica) fail terminally — never spin, never double."""
+    injector = FaultInjector({"replica_crash": [3, 4]})  # step 2: both die
+    fleet = _fleet(2, fault_injector=injector, max_failovers=1)
+    reqs = _prompts(6, 4, new_lo=8)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    terminal = _run_collecting(fleet, rids)
+    assert all(r.state == DEAD for r in fleet.replicas)
+    assert set(terminal.values()) <= {"failed", "ok"}
+    assert any(s == "failed" for s in terminal.values())
+    for rid, (p, n) in zip(rids, reqs):
+        fr = fleet._reqs[rid]
+        ref = _oracle(p, n)
+        assert fr.tokens == ref[: len(fr.tokens)], rid  # true prefix
+    fleet.close()
+
+
+# ---- health: fleet-scope HealthFanout contracts --------------------------
+
+
+def test_health_drain_is_uncharged_and_bit_identical():
+    """A HealthFanout Unhealthy on one chip drains exactly that
+    replica: its work fails over to survivors WITHOUT charging
+    failover budgets, streams stay oracle-identical, and the replica
+    resumes on recovery."""
+    fleet = _fleet(2)
+    reqs = _prompts(7, 6, new_lo=8, new_hi=16)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    early = {fr.rid: fr.status for fr in fleet.step()}  # dispatch + step
+    assert any(r for r in fleet.replicas[0].rids)
+    fleet.deliver_health([HealthEvent(chip_id="chip-0", health=UNHEALTHY)])
+    early.update((fr.rid, fr.status) for fr in fleet.step())
+    assert fleet.replicas[0].paused
+    assert fleet.replicas[1].dispatchable
+    # Drained, not charged: requeues counted on the drain side only.
+    assert fleet.failover_requeues == 0
+    assert fleet.drain_requeues >= 1
+    assert not fleet.replicas[0].rids  # nothing stranded on the sick one
+    terminal = _run_collecting(fleet, rids, terminal=early)
+    assert all(terminal[r] == "ok" for r in rids)
+    for rid, (p, n) in zip(rids, reqs):
+        assert fleet._reqs[rid].tokens == _oracle(p, n), rid
+    assert fleet.replicas[0].state == "active"  # drained, never dead
+    # Recovery: the replica serves again.
+    fleet.deliver_health([HealthEvent(chip_id="chip-0", health=HEALTHY)])
+    rid = fleet.submit([3, 1], 4, session=None)
+    fleet.run()
+    assert not fleet.replicas[0].paused
+    assert fleet._reqs[rid].status == "ok"
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_health_mixed_attribution_never_strands_the_fleet():
+    """The PR-4 all-chips contract at N engines: per-chip events drain
+    exactly the named replica; an unattributed Unhealthy pauses every
+    replica (work parks in place — nowhere to fail over to); a
+    per-chip Healthy cannot clear the unattributed mark; the
+    unattributed all-clear lifts every mark on every replica."""
+    fleet = _fleet(3)
+    # Long enough that nothing can finish before the fleet-wide pause
+    # (>= 14 tokens needs >= 4 decode chunks; only 2 steps run first).
+    reqs = _prompts(8, 6, new_lo=14, new_hi=16)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    fleet.step()
+    # Per-chip: only replica 1 pauses.
+    fleet.deliver_health([HealthEvent(chip_id="chip-1", health=UNHEALTHY)])
+    fleet.step()
+    assert [r.paused for r in fleet.replicas] == [False, True, False]
+    # Unattributed Unhealthy: everyone pauses; nothing bounces (no
+    # dispatchable survivor) and nothing reaches a terminal status.
+    fleet.deliver_health([HealthEvent(chip_id="", health=UNHEALTHY)])
+    drains_before = fleet.drain_requeues
+    fleet.step()
+    fleet.step()
+    assert all(r.paused for r in fleet.replicas)
+    assert fleet.drain_requeues == drains_before
+    assert not any(fleet._reqs[r].done for r in rids)
+    # A per-chip recovery cannot clear the unattributed mark.
+    fleet.deliver_health([HealthEvent(chip_id="chip-0", health=HEALTHY)])
+    fleet.step()
+    assert all(r.paused for r in fleet.replicas)
+    # The unattributed all-clear lifts every mark — fleet-wide resume.
+    fleet.deliver_health([HealthEvent(chip_id="", health=HEALTHY)])
+    terminal = _run_collecting(fleet, rids)
+    assert not any(r.paused for r in fleet.replicas)
+    assert all(terminal[r] == "ok" for r in rids)
+    for rid, (p, n) in zip(rids, reqs):
+        assert fleet._reqs[rid].tokens == _oracle(p, n), rid
+    assert fleet.replica_crashes == 0 and fleet.failover_requeues == 0
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_health_events_via_fanout_subscription():
+    """bind_health routes a real fanout-shaped subscription through the
+    same per-chip delivery (duck-typed fanout: subscribe/unsubscribe)."""
+    import queue as _queue
+
+    class _FakeFanout:
+        def __init__(self):
+            self.q = _queue.Queue()
+            self.unsubscribed = False
+
+        def subscribe(self):
+            return self.q
+
+        def unsubscribe(self, q):
+            self.unsubscribed = True
+
+    fanout = _FakeFanout()
+    fleet = _fleet(2)
+    fleet.bind_health(fanout)
+    rid = fleet.submit(list(range(1, 6)), 8)
+    fleet.step()
+    fanout.q.put(HealthEvent(chip_id="chip-0", health=UNHEALTHY))
+    fleet.step()
+    assert fleet.replicas[0].paused and not fleet.replicas[1].paused
+    fanout.q.put(HealthEvent(chip_id="chip-0", health=HEALTHY))
+    fleet.run()
+    assert fleet._reqs[rid].status == "ok"
+    fleet.close()
+    assert fanout.unsubscribed
+
+
+# ---- membership: drain / remove / add -----------------------------------
+
+
+def test_graceful_drain_remove_and_live_add():
+    fleet = _fleet(2)
+    reqs = _prompts(9, 6, new_lo=8)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    fleet.step()
+    fleet.drain(0)
+    assert fleet.replicas[0].state == DRAINING
+    with pytest.raises(RuntimeError):
+        fleet.remove(0)  # still holds work, not forced
+    # In-flight work finishes ON the draining replica (graceful).
+    on_drained = set(fleet.replicas[0].rids)
+    terminal = _run_collecting(fleet, rids)
+    assert all(terminal[r] == "ok" for r in rids)
+    assert fleet.drain_requeues == 0 and fleet.failover_requeues == 0
+    assert on_drained  # it really had work to finish
+    fleet.remove(0)
+    assert fleet.replicas[0].state == DEAD
+    assert fleet.replicas[0].engine.closed
+    # Live add: a fresh engine joins and takes work immediately.
+    idx = fleet.add_replica(_engine(), chip_id="chip-2")
+    assert idx == 2
+    more = _prompts(10, 4, new_lo=4)
+    rids2 = [fleet.submit(p, n) for p, n in more]
+    fleet.run()
+    assert fleet.replicas[2].engine.requests_admitted > 0
+    for rid, (p, n) in zip(rids2, more):
+        assert fleet._reqs[rid].status == "ok"
+        assert fleet._reqs[rid].tokens == _oracle(p, n)
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_forced_remove_fails_over_uncharged():
+    fleet = _fleet(2)
+    reqs = _prompts(11, 5, new_lo=10, new_hi=16)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    fleet.step()
+    assert fleet.replicas[0].rids  # it holds in-flight work
+    fleet.remove(0, force=True)
+    assert fleet.replicas[0].state == DEAD
+    terminal = _run_collecting(fleet, rids)
+    assert all(terminal[r] == "ok" for r in rids)
+    assert fleet.failover_requeues == 0  # operator action: uncharged
+    assert fleet.drain_requeues >= 1
+    for rid, (p, n) in zip(rids, reqs):
+        assert fleet._reqs[rid].tokens == _oracle(p, n), rid
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_engine_withdraw_is_statusless():
+    """The router's reclaim seam on the engine: a withdrawn pending
+    request keeps its lifecycle open (no terminal status, no counter),
+    while running requests refuse to withdraw."""
+    engine = _engine()
+    rid_q = engine.submit([1, 2, 3], 4)
+    rid_run = engine.submit([4, 5], 4)
+    req = engine.withdraw(rid_q)
+    assert req is not None and req.rid == rid_q
+    assert req.status == "queued" and not req.done
+    assert engine.requests_cancelled == 0
+    assert len(engine.completed) == 0
+    engine.step()  # rid_run admits
+    assert engine.withdraw(rid_run) is None
+    assert engine.withdraw("unknown") is None
+    engine.run()
+    engine.close()
+    with pytest.raises(EngineClosed):
+        engine.withdraw("x")
+
+
+# ---- traffic generator and open-loop drive ------------------------------
+
+
+def test_trafficgen_is_seeded_bursty_and_heavy_tailed():
+    gen = TrafficGen(seed=3, rate_rps=200.0, max_prompt=24, vocab=64)
+    a, b = gen.schedule(200), gen.schedule(200)
+    assert a == b  # deterministic per seed
+    assert a != TrafficGen(seed=4, rate_rps=200.0, vocab=64).schedule(200)
+    offsets = [t for t, _, _ in a]
+    assert offsets == sorted(offsets)
+    plens = [len(p) for _, p, _ in a]
+    # Heavy tail: mass at the floor AND excursions to the cap.
+    assert min(plens) == 1 and max(plens) == 24
+    assert sorted(plens)[len(plens) // 2] < 8
+    gaps = [b - a for a, b in zip(offsets, offsets[1:])]
+    # Bursty: the largest gap dwarfs the median one.
+    assert max(gaps) > 5 * sorted(gaps)[len(gaps) // 2]
+    for _, p, n in a:
+        assert all(0 <= t < 64 for t in p)
+        assert 1 <= n <= gen.max_new
+
+
+def test_open_loop_drive_serves_the_schedule():
+    fleet = _fleet(2)
+    gen = TrafficGen(
+        seed=1, rate_rps=500.0, max_prompt=16, max_new=8,
+        vocab=CONFIG.vocab_size,
+    )
+    served = drive_open_loop(
+        fleet, gen.schedule(10), session_every=3
+    )
+    assert len(served) == 10
+    assert fleet.requests_ok == 10
+    by_rid = {fr.rid: fr for fr in fleet.completed}
+    for rid, tokens in served.items():
+        fr = by_rid[rid]
+        assert tokens == _oracle(fr.prompt, fr.max_new_tokens), rid
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+# ---- HTTP/SSE front end --------------------------------------------------
+
+
+def test_sse_front_end_streams_real_tokens():
+    import urllib.error
+    import urllib.request
+
+    fleet = _fleet(2)
+    server = FleetServer(fleet, 0)
+    port = server.start()
+    try:
+        prompt, new = [5, 4, 3, 2, 1], 7
+        body = json.dumps({
+            "prompt": prompt, "max_new_tokens": new, "session": "s1",
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        tokens, final = [], None
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            for line in resp:
+                if not line.startswith(b"data: "):
+                    continue
+                ev = json.loads(line[6:])
+                if ev.get("done"):
+                    final = ev
+                    break
+                tokens.extend(ev["tokens"])
+        assert final is not None and final["status"] == "ok"
+        assert final["n_tokens"] == len(tokens)
+        assert tokens == _oracle(prompt, new)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as resp:
+            health = json.loads(resp.read())
+        assert health["ok"] is True
+        assert set(health["replicas"]) == {"0", "1"}
+        assert all(
+            v["state"] == "active" for v in health["replicas"].values()
+        )
+        # Validation maps to 400, not a wedged stream.
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate",
+            data=json.dumps({"prompt": []}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(bad, timeout=10)
+        assert err.value.code == 400
+    finally:
+        server.stop()
+        fleet.close()
+
+
+# ---- chaos smoke (make fleet-check) -------------------------------------
+
+
+def _run_fleet_chaos(seed: int) -> None:
+    """One seeded chaos round: open-loop-style traffic over N=2..4
+    replicas with randomized replica crashes/hangs/slow steps, engine
+    seam faults, health drains, cancels and deadlines — the lifecycle
+    invariants must hold throughout."""
+    rng = np.random.default_rng(seed + 9000)
+    n = int(rng.integers(2, 5))
+    fleet_inj = FaultInjector.random(
+        seed=seed, rate=0.03, seams=REPLICA_SEAMS,
+        max_fires=int(rng.integers(1, n)),  # never kills every replica
+    )
+    engines = []
+    for i in range(n):
+        eng_inj = (
+            FaultInjector.random(seed=seed * 7 + i, rate=0.02, max_fires=2)
+            if rng.integers(2) else None
+        )
+        engines.append(_engine(
+            slots=int(rng.integers(1, 3)),
+            prefix_cache=bool(rng.integers(2)),
+            pipelined=bool(rng.integers(2)),
+            fault_injector=eng_inj, max_retries=2,
+        ))
+    fleet = Fleet(
+        engines, chip_ids=[f"chip-{i}" for i in range(n)],
+        fault_injector=fleet_inj, max_failovers=2,
+        slow_readback_s=0.0,
+        # Deterministic chaos: hangs come from the injected seam, not
+        # the load-dependent wall-clock watchdog.
+        hang_timeout_s=None,
+    )
+    expected = {}
+    for p, nw in _prompts(seed, int(rng.integers(5, 9)), new_lo=2):
+        deadline = 0.05 if rng.integers(6) == 0 else None
+        sess = f"s{int(rng.integers(3))}" if rng.integers(2) else None
+        try:
+            rid = fleet.submit(p, nw, deadline_s=deadline, session=sess)
+        except QueueFull:
+            continue
+        expected[rid] = (p, nw)
+
+    def mid(step):
+        live = [r for r in expected if not fleet._reqs[r].done]
+        if live and rng.integers(10) == 0:
+            fleet.cancel(str(rng.choice(live)))
+        if rng.integers(12) == 0:
+            alive = fleet.alive
+            if len(alive) > 1:
+                fleet.deliver_health([HealthEvent(
+                    chip_id=alive[0].chip_id, health=UNHEALTHY,
+                )])
+        if rng.integers(12) == 0:
+            fleet.deliver_health([HealthEvent(chip_id="", health=HEALTHY)])
+
+    terminal = _run_collecting(fleet, expected, mid_step=mid)
+    assert set(terminal) == set(expected)
+    for rid, (p, nw) in expected.items():
+        fr = fleet._reqs[rid]
+        ref = _oracle(p, nw)
+        if terminal[rid] == "ok":
+            assert fr.tokens == ref, (seed, rid, fr.failovers)
+        else:
+            assert fr.tokens == ref[: len(fr.tokens)], (seed, rid)
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_fleet_chaos_smoke():
+    """ONE cheap seeded chaos round — the `make fleet-check` smoke."""
+    _run_fleet_chaos(1)

@@ -36,6 +36,7 @@ def _emitted_names() -> set[str]:
         if fn.endswith(".py") and fn != "metrics.py":  # skip definitions
             roots.append(os.path.join(plugin_dir, fn))
     roots.append(os.path.join(REPO, "workloads", "obs.py"))
+    roots.append(os.path.join(REPO, "workloads", "fleet.py"))
     for path in roots:
         text = open(path, encoding="utf-8").read()
         names |= set(_INC_RE.findall(text))
@@ -48,9 +49,13 @@ def _emitted_names() -> set[str]:
 
 def _described_names() -> set[str]:
     from tpu_device_plugin import metrics
-    from workloads.obs import ENGINE_METRICS
+    from workloads.obs import ENGINE_METRICS, FLEET_METRICS
 
-    return set(metrics.registry._help) | {m.name for m in ENGINE_METRICS}
+    return (
+        set(metrics.registry._help)
+        | {m.name for m in ENGINE_METRICS}
+        | {m.name for m in FLEET_METRICS}
+    )
 
 
 def test_every_emitted_metric_has_help_text():
@@ -92,6 +97,24 @@ def test_gauge_readers_match_the_catalog():
 
     catalog_gauges = {m.name for m in ENGINE_METRICS if m.type == "gauge"}
     assert catalog_gauges == set(EngineObserver._GAUGE_READERS)
+
+
+def test_fleet_gauge_readers_match_the_catalog():
+    """Same drift pin for the fleet bridge's gauge families."""
+    from workloads.obs import FLEET_METRICS, FleetObserver
+
+    catalog_gauges = {m.name for m in FLEET_METRICS if m.type == "gauge"}
+    assert catalog_gauges == set(FleetObserver._FLEET_GAUGE_READERS)
+
+
+def test_fleet_catalog_is_fully_described_on_bind():
+    from tpu_device_plugin.metrics import Registry
+    from workloads.obs import FLEET_METRICS, FleetObserver
+
+    reg = Registry()
+    FleetObserver().bind_registry(reg)
+    missing = {m.name for m in FLEET_METRICS} - set(reg._help)
+    assert not missing, missing
 
 
 # ---- exposition-format parsing -----------------------------------------
@@ -275,3 +298,136 @@ def test_engine_bridge_render_is_valid_exposition():
     }
     assert f"{PREFIX}_engine_queue_depth" in gauges
     assert f"{PREFIX}_engine_resident_pages" in gauges
+
+
+def _drive_fake_engine(obs, steps: int = 2):
+    """Minimal fake-engine bridge drive shared by the replica-label
+    pins (no jax: the hooks only read counters/mirrors)."""
+    import numpy as np
+
+    eng = SimpleNamespace(
+        generated_tokens=0, requests_admitted=0, requests_retired=0,
+        prefill_dispatches=0, prefill_sweeps=0, chunks_run=0, spec_rounds=0,
+        mode_switches=0, admission_readbacks=0, spec_lookahead=1,
+        prefill_deferred_tokens=0, _inflight_prefill=[],
+        pending=[], _occupied=np.zeros(2, bool), slots=2,
+        ctrl=SimpleNamespace(used_pages=0), paused=False,
+    )
+    obs._bind(eng)
+    for _ in range(steps):
+        snap = obs._step_begin(eng)
+        eng.generated_tokens += 3
+        eng.chunks_run += 1
+        obs._step_end(eng, snap, [])
+    return eng
+
+
+def test_single_engine_scrape_has_no_replica_label():
+    """The replica label is OPT-IN: with the default empty ``replica``
+    the rendered output carries no replica label anywhere and gauges
+    register name-keyed — single-engine scrape output stays
+    byte-compatible with the pre-fleet bridge (the multi-engine
+    collision fix must not move anyone's dashboards)."""
+    from tpu_device_plugin.metrics import Registry
+    from workloads.obs import EngineObserver
+
+    reg = Registry()
+    obs = EngineObserver(name="solo")
+    obs.bind_registry(reg)
+    _drive_fake_engine(obs)
+    text = reg.render()
+    assert 'engine="solo"' in text
+    assert "replica=" not in text
+    # Keyless gauges keep the replace-by-name contract: a successor
+    # observer's registration replaces, never duplicates.
+    obs2 = EngineObserver(name="solo2")
+    obs2.bind_registry(reg)
+    _drive_fake_engine(obs2)
+    depth_lines = [
+        ln for ln in reg.render().splitlines()
+        if ln.startswith("tpu_device_plugin_engine_queue_depth{")
+    ]
+    assert len(depth_lines) == 1, depth_lines
+
+
+def test_multi_replica_engines_share_one_registry():
+    """Fleet mode: N observers with distinct ``replica`` ids coexist on
+    one registry — every engine family series carries its replica
+    label, per-replica gauges all scrape (no last-binder-wins
+    collision), the exposition stays valid, and one replica unbinding
+    leaves its siblings' collectors alone."""
+    from tpu_device_plugin.metrics import PREFIX, Registry
+    from workloads.obs import EngineObserver
+
+    reg = Registry()
+    observers = [
+        EngineObserver(name=str(i), replica=str(i)) for i in range(3)
+    ]
+    for obs in observers:
+        obs.bind_registry(reg)
+        _drive_fake_engine(obs)
+    families = _parse_exposition(reg.render())
+    depth = families[f"{PREFIX}_engine_queue_depth"]["samples"]
+    assert {labels["replica"] for _, labels, _ in depth} == {"0", "1", "2"}
+    tokens = families[f"{PREFIX}_engine_tokens_total"]["samples"]
+    assert {labels["replica"] for _, labels, _ in tokens} == {"0", "1", "2"}
+    assert all(v == 6.0 for _, _, v in tokens)
+    # Replica 1 retires: its gauges go, 0 and 2 keep scraping.
+    observers[1].unbind_registry()
+    families = _parse_exposition(reg.render())
+    depth = families[f"{PREFIX}_engine_queue_depth"]["samples"]
+    assert {labels["replica"] for _, labels, _ in depth} == {"0", "2"}
+
+
+def test_fleet_bridge_render_is_valid_exposition():
+    """Drive the fleet bridge against a fake fleet (no jax) next to a
+    replica-labeled engine bridge and parse the render: fleet families
+    obey the exposition rules, per-replica state/paused gauges emit one
+    sample per live replica, and counters land as running-total
+    deltas."""
+    from tpu_device_plugin.metrics import PREFIX, Registry
+    from workloads.obs import FleetObserver
+
+    reg = Registry()
+    obs = FleetObserver(name="f0")
+    obs.bind_registry(reg)
+    replicas = [
+        SimpleNamespace(index=0, state="active", paused=False),
+        SimpleNamespace(index=1, state="draining", paused=True),
+        SimpleNamespace(index=2, state="dead", paused=False),
+    ]
+    fleet = SimpleNamespace(
+        queue=[1, 2], replicas=replicas, requests_submitted=5,
+        generated_tokens=40, failover_requeues=2, drain_requeues=1,
+        queue_rejections=3, replica_crashes=1, replica_hangs=0,
+    )
+    obs._bind(fleet)
+    finished = [SimpleNamespace(
+        queue_wait_secs=0.01, ttft_secs=0.05, e2e_secs=0.3,
+    )]
+    obs._fleet_step_end(fleet, finished)
+    obs._fleet_step_end(fleet, [])  # unchanged totals push no deltas
+    families = _parse_exposition(reg.render())
+    assert families[f"{PREFIX}_fleet_requests_total"]["samples"][0][2] == 5.0
+    assert families[f"{PREFIX}_fleet_tokens_total"]["samples"][0][2] == 40.0
+    assert families[f"{PREFIX}_fleet_failovers_total"]["samples"][0][2] == 2.0
+    crash = families[f"{PREFIX}_fleet_replica_failures_total"]["samples"]
+    assert [(labels["kind"], v) for _, labels, v in crash] == [("crash", 1.0)]
+    states = families[f"{PREFIX}_fleet_replica_state"]["samples"]
+    assert {
+        (labels["replica"], labels["state"]) for _, labels, _ in states
+    } == {("0", "active"), ("1", "draining")}
+    paused = families[f"{PREFIX}_fleet_replica_paused"]["samples"]
+    assert {
+        (labels["replica"], v) for _, labels, v in paused
+    } == {("0", 0.0), ("1", 1.0)}
+    by_state = families[f"{PREFIX}_fleet_replicas"]["samples"]
+    assert {
+        (labels["state"], v) for _, labels, v in by_state
+    } == {("active", 1.0), ("draining", 1.0), ("dead", 1.0)}
+    for fam in (
+        f"{PREFIX}_fleet_ttft_seconds",
+        f"{PREFIX}_fleet_e2e_seconds",
+        f"{PREFIX}_fleet_queue_wait_seconds",
+    ):
+        _assert_histogram_sound(fam, families[fam])

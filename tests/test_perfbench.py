@@ -173,6 +173,17 @@ def test_perfbench_tiny_end_to_end():
         "serve_tokens_per_sec",
         "serve_requests_per_sec",
         "serve_pool_peak_fraction",
+        # Fleet serving & failover arm (docs/SERVING.md).
+        "fleet_replicas",
+        "fleet_tokens_per_sec",
+        "fleet_ttft_p50_ms",
+        "fleet_ttft_p99_ms",
+        "router_overhead_ms",
+        "router_overhead_ms_min",
+        "router_overhead_ms_max",
+        "failover_recovery_ms",
+        "failover_recovery_ms_min",
+        "failover_recovery_ms_max",
         # Observability overhead arm (docs/OBSERVABILITY.md).
         "obs_overhead_pct",
         "obs_on_tokens_per_sec",
@@ -197,6 +208,10 @@ def test_perfbench_tiny_end_to_end():
     ):
         assert key in out, key
     assert 0.0 < out["serve_pool_peak_fraction"] <= 1.0
+    assert out["fleet_replicas"] == 4
+    assert out["fleet_tokens_per_sec"] > 0
+    assert out["failover_recovery_ms"] > 0
+    assert out["failover_requeued"] >= 1
     assert out["spec_phase_dominant"] in ("draft", "verify", "commit")
     assert out["spec_breakeven_batch"] >= 0.0
     for b in out["spec_phase_batches"]:

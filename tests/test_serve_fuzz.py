@@ -328,3 +328,167 @@ def test_injector_off_streams_bit_identical():
         retry_backoff_s=0.5,
     )
     assert plain == guarded
+
+
+# ---- fleet chaos arm -----------------------------------------------------
+#
+# Randomized replica crashes/hangs/slow-steps, health drains, live
+# drains/adds/removes and cancels/deadlines interleaved with open-loop
+# traffic across N=2..4 replicas (per-engine seam faults riding along),
+# asserting the fleet-scope lifecycle invariants: every accepted rid
+# reaches EXACTLY one terminal status fleet-wide, completed greedy
+# streams are bit-identical to the single-engine dense oracle THROUGH
+# cross-replica failover replays, interrupted streams are true
+# prefixes, and no surviving replica leaks a slot/page/commitment.
+# Deterministic seeds — failures reproduce.
+
+
+def _run_fleet_chaos(seed: int, params, adapters) -> None:
+    from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+    from tpu_device_plugin.device import HealthEvent
+    from workloads.errors import QueueFull
+    from workloads.faults import REPLICA_SEAMS, FaultInjector
+    from workloads.fleet import DEAD, Fleet
+
+    rng = np.random.default_rng(seed + 77000)
+    n = int(rng.integers(2, 5))
+    use_adapters = bool(rng.integers(2))
+    fleet_inj = FaultInjector.random(
+        seed=seed, rate=0.03, seams=REPLICA_SEAMS,
+        max_fires=int(rng.integers(1, n)),  # >= 1 replica always survives
+    )
+    engines = []
+    for i in range(n):
+        kw = dict(
+            slots=int(rng.integers(1, 3)),
+            page_size=int(rng.choice([4, 8])),
+            prefix_cache=bool(rng.integers(2)),
+            pipelined=bool(rng.integers(2)),
+        )
+        kw["prompt_bucket"] = int(kw["page_size"] * rng.choice([2, 3]))
+        if rng.integers(2):
+            kw["prefill_budget"] = int(
+                rng.choice([1, kw["prompt_bucket"]])
+            )
+        engines.append(ServeEngine(
+            params, CONFIG,
+            adapters=adapters if use_adapters else None,
+            fault_injector=(
+                FaultInjector.random(
+                    seed=seed * 13 + i, rate=0.02, max_fires=2
+                ) if rng.integers(2) else None
+            ),
+            max_retries=2, **kw,
+        ))
+    fleet = Fleet(
+        engines, chip_ids=[f"chip-{i}" for i in range(n)],
+        fault_injector=fleet_inj, max_failovers=2, slow_readback_s=0.0,
+        # Injected replica_hang gives deterministic hang coverage; the
+        # wall-clock watchdog would turn host-load-dependent XLA compile
+        # times into nondeterministic replica kills.
+        hang_timeout_s=None,
+        max_pending=int(rng.choice([4, 32])),
+    )
+    names = [None] + (sorted(adapters) if use_adapters else [])
+    expected = {}
+    pending_submits = []
+    for _ in range(int(rng.integers(5, 10))):
+        plen = int(rng.integers(1, 25))
+        prompt = [int(t) for t in rng.integers(0, CONFIG.vocab_size, plen)]
+        new = int(rng.integers(2, min(24, CONFIG.max_seq_len - plen) + 1))
+        adapter = names[int(rng.integers(len(names)))]
+        deadline = 0.05 if rng.integers(6) == 0 else None
+        pending_submits.append((prompt, new, adapter, deadline))
+    merged_cache: dict = {}
+
+    def model_for(adapter):
+        if adapter is None:
+            return params
+        if adapter not in merged_cache:
+            merged_cache[adapter] = merge_lora(
+                params, adapters[adapter], dtype=jnp.float32
+            )
+        return merged_cache[adapter]
+
+    terminal: dict[str, str] = {}
+    steps = 0
+    added = False
+    while pending_submits or not fleet.idle:
+        steps += 1
+        assert steps < 900, (seed, fleet.states(), "failed to converge")
+        # Open-loop-ish trickle: a couple of submissions per step.
+        for _ in range(min(len(pending_submits), int(rng.integers(1, 3)))):
+            prompt, new, adapter, deadline = pending_submits.pop()
+            sess = f"s{int(rng.integers(3))}" if rng.integers(2) else None
+            try:
+                rid = fleet.submit(
+                    prompt, new, adapter=adapter, deadline_s=deadline,
+                    session=sess,
+                )
+            except QueueFull:
+                continue
+            expected[rid] = (prompt, new, adapter)
+        live = [r for r in expected if r not in terminal]
+        if live and rng.integers(10) == 0:
+            fleet.cancel(str(rng.choice(live)))
+        if rng.integers(15) == 0:
+            alive = fleet.alive
+            if len(alive) > 1:
+                fleet.deliver_health([HealthEvent(
+                    chip_id=alive[int(rng.integers(len(alive)))].chip_id,
+                    health=UNHEALTHY,
+                )])
+        if rng.integers(15) == 0:
+            fleet.deliver_health([
+                HealthEvent(chip_id="", health=HEALTHY)
+            ])
+        if rng.integers(20) == 0:
+            drainable = [
+                r.index for r in fleet.replicas if r.state == "active"
+            ]
+            if len(drainable) > 1:
+                fleet.drain(int(rng.choice(drainable)))
+        if not added and rng.integers(25) == 0:
+            fleet.add_replica(ServeEngine(
+                params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+                adapters=adapters if use_adapters else None,
+            ), chip_id=f"chip-{n}")
+            added = True
+        for fr in fleet.step():
+            assert fr.rid not in terminal, (seed, fr.rid, "double terminal")
+            assert fr.status in TERMINAL, (seed, fr.rid, fr.status)
+            terminal[fr.rid] = fr.status
+    assert set(terminal) == set(expected), (
+        seed, set(expected) ^ set(terminal),
+    )
+    for rid, (prompt, new, adapter) in expected.items():
+        fr = fleet._reqs[rid]
+        ref = [int(t) for t in np.asarray(generate(
+            model_for(adapter), jnp.asarray([prompt], jnp.int32), CONFIG,
+            max_new_tokens=new,
+        )[0])]
+        if terminal[rid] == "ok":
+            # Bit-identical through cross-replica failover replays.
+            assert fr.tokens == ref, (seed, rid, fr.failovers, fr.segments)
+        else:
+            assert fr.tokens == ref[: len(fr.tokens)], (
+                seed, rid, terminal[rid],
+            )
+    for rep in fleet.replicas:
+        if rep.state == DEAD:
+            continue
+        e = rep.engine
+        assert not e._occupied.any(), (seed, rep.index)
+        assert e._committed_pages == 0, (seed, rep.index)
+        assert not e._groups, (seed, rep.index)
+        pinned = e.prefix.cached_pages if e.prefix is not None else 0
+        assert e.ctrl.used_pages == pinned, (seed, rep.index)
+        assert not rep.rids, (seed, rep.index)
+    fleet.close()
+
+
+def test_fleet_chaos_fuzz():
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    adapters = synthetic_adapters(CONFIG, 2, rank=4, scale=0.3, seed=3)
+    for seed in range(4):
+        _run_fleet_chaos(seed, params, adapters)

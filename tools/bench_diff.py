@@ -47,6 +47,7 @@ TRACKED_UP = [
     "spec_serve_tokens_per_sec",
     "spec_serve_lookahead_tokens_per_sec",
     "spec_engine_vs_plain_b1",
+    "fleet_tokens_per_sec",
     "aggregate_chip_busy_fraction",
     "aggregate_tokens_per_sec",
 ]
@@ -61,11 +62,19 @@ TRACKED_DOWN = [
     "serve_ttft_p99_ms",
     "serve_queue_wait_p99_ms",
     "interleave_ttft_p99_ratio",
+    # Fleet serving SLOs: the pooled client-visible TTFT tail under the
+    # open-loop generator, and the crash -> first-survivor-token window
+    # (the robustness number the fleet PR exists for).
+    "fleet_ttft_p99_ms",
+    "failover_recovery_ms",
 ]
 
 # The serving keys whose thresholds derive from the artifact's own
 # pooled ratio spreads (below) instead of the flat default.
-SPREAD_GUARDED = set(TRACKED_DOWN) | {"serve_tokens_per_sec"}
+SPREAD_GUARDED = set(TRACKED_DOWN) | {
+    "serve_tokens_per_sec",
+    "fleet_tokens_per_sec",
+}
 
 
 def spread_threshold(old: dict, floor: float) -> float:

@@ -29,7 +29,11 @@ class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[tuple[str, tuple[tuple[str, str], ...]], float] = defaultdict(float)
-        self._gauges: list[tuple[str, Callable[[], list[tuple[dict, float]]]]] = []
+        # (family name, optional collector key, collect): several keyed
+        # collectors may share one family (per-replica engine gauges).
+        self._gauges: list[
+            tuple[str, str | None, Callable[[], list[tuple[dict, float]]]]
+        ] = []
         self._help: dict[str, str] = {}
         self._buckets: dict[str, tuple[float, ...]] = {}
 
@@ -100,17 +104,39 @@ class Registry:
                 )
             ] += 1.0
 
-    def register_gauge(self, name: str, collect: Callable[[], list[tuple[dict, float]]]) -> None:
+    def register_gauge(
+        self,
+        name: str,
+        collect: Callable[[], list[tuple[dict, float]]],
+        key: str | None = None,
+    ) -> None:
         """collect() returns (labels, value) pairs evaluated at scrape time.
-        Re-registering a name replaces the previous collector (a restarted
-        daemon must not leave duplicate series or pin its predecessor)."""
+        Re-registering replaces the previous collector (a restarted
+        daemon must not leave duplicate series or pin its predecessor).
+        By default replacement is by NAME — one collector per family,
+        the single-daemon contract.  Pass ``key`` to register several
+        collectors under one family (a serving fleet's per-replica
+        engine gauges): replacement then happens per (name, key), and
+        the renderer emits one HELP/TYPE header per family regardless
+        of how many collectors feed it.  A keyed registration clears
+        any keyless collector of the same name (and vice versa), so
+        the two modes never double-report one family."""
         with self._lock:
-            self._gauges = [(n, c) for n, c in self._gauges if n != name]
-            self._gauges.append((name, collect))
+            self._gauges = [
+                (n, k, c) for n, k, c in self._gauges
+                if n != name or (key is not None and k is not None and k != key)
+            ]
+            self._gauges.append((name, key, collect))
 
-    def unregister_gauge(self, name: str) -> None:
+    def unregister_gauge(self, name: str, key: str | None = None) -> None:
+        """Remove collectors for ``name``: all of them by default, or —
+        with ``key`` — only that keyed registration (one fleet replica
+        retiring must not unregister its siblings)."""
         with self._lock:
-            self._gauges = [(n, c) for n, c in self._gauges if n != name]
+            self._gauges = [
+                (n, k, c) for n, k, c in self._gauges
+                if n != name or (key is not None and k != key)
+            ]
 
     def render(self) -> str:
         lines: list[str] = []
@@ -171,10 +197,16 @@ class Registry:
                 lines.append(f"# TYPE {full_family} {mtype}")
                 seen_help.add(full_family)
             lines.append(f"{PREFIX}_{name}{fmt_labels(labels)} {fmt_value(value)}")
-        for name, collect in gauges:
+        # Group keyed collectors by family: HELP/TYPE once per family
+        # name (duplicate headers are invalid exposition format), then
+        # every collector's samples — the order collectors registered.
+        gauge_names_seen: set[str] = set()
+        for name, _key, collect in gauges:
             full = f"{PREFIX}_{name}"
-            lines.append(f"# HELP {full} {help_texts.get(name, name)}")
-            lines.append(f"# TYPE {full} gauge")
+            if name not in gauge_names_seen:
+                gauge_names_seen.add(name)
+                lines.append(f"# HELP {full} {help_texts.get(name, name)}")
+                lines.append(f"# TYPE {full} gauge")
             try:
                 for labels, value in collect():
                     lines.append(

@@ -24,6 +24,20 @@ corresponding device interaction):
   * ``spec_dispatch``   / ``spec_readback``    — the speculative
     superstep and its (committed, n_accept) consume.
 
+Fleet-scope REPLICA seams (``REPLICA_SEAMS``; crossed once per replica
+step by ``workloads/fleet.py``, which treats a whole engine as one
+fault domain):
+
+  * ``replica_crash`` — the replica process/chip dies mid-step: the
+    fleet marks it dead and fails its in-flight requests over to
+    survivors (charged against their failover budgets).
+  * ``replica_hang``  — the step wedges past the fleet's
+    ``hang_timeout_s`` watchdog: same failover path, counted
+    separately (a hang and a crash are different production symptoms).
+  * ``replica_slow``  — a degraded link/readback: the step pays
+    injected latency instead of dying; consecutive slow steps drive
+    the router's auto-drain.
+
 Two scheduling modes, both deterministic:
 
   * Explicit: ``FaultInjector({"decode_dispatch": [3]})`` raises
@@ -48,7 +62,9 @@ from __future__ import annotations
 import random
 from dataclasses import dataclass
 
-SEAMS = (
+# Engine-internal dispatch/readback seams (ServeEngine's quarantine
+# machinery recovers from these inside one fault domain).
+ENGINE_SEAMS = (
     "prefill_dispatch",
     "prefill_readback",
     "decode_dispatch",
@@ -56,6 +72,16 @@ SEAMS = (
     "spec_dispatch",
     "spec_readback",
 )
+
+# Replica-level seams (the Fleet's failover machinery recovers from
+# these ACROSS fault domains; see module docstring).
+REPLICA_SEAMS = (
+    "replica_crash",
+    "replica_hang",
+    "replica_slow",
+)
+
+SEAMS = ENGINE_SEAMS + REPLICA_SEAMS
 
 
 def _validate_schedule(
@@ -218,6 +244,29 @@ def self_check(verbose: bool = True) -> int:
         (r.seam, r.crossing) for r in inj.fired
     ] == [("decode_dispatch", 2), ("decode_dispatch", 4), ("spec_readback", 1)]
 
+    # Replica seams are first-class: scheduled crossings fire, and a
+    # seams= restriction keeps Bernoulli draws off the engine seams (the
+    # fleet's chaos arm relies on both).
+    rinj = FaultInjector({"replica_crash": 2, "replica_slow": 1})
+    rinj.check("replica_crash")
+    try:
+        rinj.check("replica_slow")
+        raise AssertionError("scheduled replica_slow crossing did not fire")
+    except InjectedFault as e:
+        assert (e.seam, e.crossing) == ("replica_slow", 1)
+    try:
+        rinj.check("replica_crash")
+        raise AssertionError("scheduled replica_crash crossing did not fire")
+    except InjectedFault as e:
+        assert (e.seam, e.crossing) == ("replica_crash", 2)
+    scoped = FaultInjector.random(seed=5, rate=1.0, seams=REPLICA_SEAMS)
+    scoped.check("decode_dispatch")  # rate must not apply off-scope
+    try:
+        scoped.check("replica_hang")
+        raise AssertionError("rate=1.0 replica seam did not fire")
+    except InjectedFault:
+        pass
+
     # Seeded randomness replays bit-identically, and reset() replays it.
     def drive(injector, n=200):
         out = []
@@ -263,8 +312,8 @@ def self_check(verbose: bool = True) -> int:
             if isinstance(e, AssertionError):
                 raise
     if verbose:
-        print("faults selfcheck OK: schedule, seeded replay, reset, "
-              "max_fires, inert, validation")
+        print("faults selfcheck OK: schedule, replica seams, seeded "
+              "replay, reset, max_fires, inert, validation")
     return 0
 
 

@@ -1,0 +1,1369 @@
+"""A fault-domain serving fleet: N ``ServeEngine`` replicas behind a
+draining, failover-capable router.
+
+The paper's headline feature is time-sliced chip sharing — one physical
+chip advertised as N schedulable replicas — and this module is the
+serving side of that story: one ``ServeEngine`` per advertised replica,
+fronted by a ``Router`` that dispatches with least-loaded +
+session/prefix-affinity placement and a per-fleet admission bound.  The
+headline contract is ROBUSTNESS: each replica is an isolated fault
+domain, in the Llumnix lineage of instance-level schedulers over
+Orca/vLLM-style continuous-batching engines.
+
+  * **Failover by replay.** When a replica dies (a crash or hang at the
+    replica seams of ``workloads/faults.py``, or any exception that
+    escapes the engine's own step-level quarantine), the fleet harvests
+    its in-flight requests — prompt plus every token already streamed —
+    and requeues them at the router-queue front.  The next dispatch
+    re-prefills prompt + emitted tokens on a survivor (the PR-4 replay
+    path, lifted across engines), so a resumed greedy stream is
+    bit-identical to an uninterrupted one and an interrupted stream is
+    always a true prefix.  Every accepted rid reaches EXACTLY one
+    terminal status, fleet-wide.
+  * **Health drains are not faults.** A ``HealthFanout`` Unhealthy event
+    pauses the affected replica's engine (the PR-4 health bridge); the
+    fleet then withdraws that replica's requeued work and fails it over
+    to survivors WITHOUT charging failover budgets — a sick chip is not
+    the request's fault.  Mixed-attribution event streams drain exactly
+    the replicas whose chip the event names; an unattributed event
+    (``chip_id == ""``) applies to every replica, and an unattributed
+    all-clear lifts every mark, so no stream can strand the whole fleet
+    paused.  While EVERY replica is paused the fleet parks work in
+    place (there is nowhere to fail over to) and resumes on recovery.
+  * **Elastic membership.** ``drain()`` stops routing to a replica and
+    lets its in-flight work finish; ``remove()`` closes a drained or
+    dead replica; ``add_replica()`` joins a fresh engine live — the
+    router sees it on the next dispatch.
+
+The module also ships the workload that proves the fleet: an HTTP/SSE
+front end (``FleetServer``; ``python -m workloads.serve --fleet N
+--http-port P``) and a seeded OPEN-LOOP traffic generator
+(``TrafficGen``: bursty Markov-modulated arrivals, heavy-tailed prompt
+lengths) driven by ``drive_open_loop`` — the bench's ``measure_fleet``
+arm publishes ``fleet_tokens_per_sec`` / ``router_overhead_ms`` /
+``fleet_ttft_p99_ms`` / ``failover_recovery_ms`` from exactly this
+harness.
+
+The fleet is single-threaded and cooperative — ``step()`` advances every
+replica once, in index order, so tests are deterministic — and
+additionally takes an internal lock around its public surface so the
+HTTP front end can submit/poll from handler threads while one driver
+thread steps (``serve_forever``).
+
+Reference pendant: none — the reference plugin allocates the replicas
+but never serves on them; this joins the two halves.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from .errors import EngineClosed, InvalidRequest, QueueFull, RequestTooLarge
+from .faults import InjectedFault
+
+TERMINAL = ("ok", "cancelled", "expired", "failed")
+
+ACTIVE = "active"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+@dataclass
+class FleetRequest:
+    """One request through the fleet.  ``tokens`` is the STITCHED stream
+    across replica segments (each failover's survivor segment appends);
+    ``status`` follows the engine lifecycle — ``queued`` → ``running``
+    → exactly one terminal status — with the fleet, not any single
+    engine, owning the terminal transition.  ``failovers`` counts
+    replays charged for TRUE replica faults (crash/hang/escaped
+    exception); health drains and operator removals requeue uncharged."""
+
+    rid: str
+    prompt: list[int]
+    max_new_tokens: int
+    eos_token: int | None = None
+    adapter: str | None = None
+    session: str | None = None
+    deadline_s: float | None = None
+    t_deadline: float | None = None
+    tokens: list[int] = field(default_factory=list)
+    status: str = "queued"
+    error: str | None = None
+    replica: int | None = None
+    failovers: int = 0
+    segments: int = 0
+    t_submit: float | None = None
+    t_admit: float | None = None
+    t_first: float | None = None
+    t_done: float | None = None
+
+    @property
+    def done(self) -> bool:
+        return self.status in TERMINAL
+
+    @property
+    def ttft_secs(self) -> float | None:
+        if self.t_submit is None or self.t_first is None:
+            return None
+        return self.t_first - self.t_submit
+
+    @property
+    def e2e_secs(self) -> float | None:
+        if self.t_submit is None or self.t_done is None:
+            return None
+        return self.t_done - self.t_submit
+
+    @property
+    def queue_wait_secs(self) -> float | None:
+        """Submission -> FIRST admission into any replica's slots."""
+        if self.t_submit is None or self.t_admit is None:
+            return None
+        return self.t_admit - self.t_submit
+
+
+class Replica:
+    """One fault domain: a ``ServeEngine`` plus its fleet-side state.
+
+    ``chip_id`` ties the replica to the plugin-advertised chip whose
+    time-slice it serves on, so health events route to exactly the
+    replicas the sick chip backs."""
+
+    def __init__(self, index: int, engine, chip_id: str = ""):
+        import queue as _queue
+
+        self.index = index
+        self.engine = engine
+        self.chip_id = chip_id
+        self.state = ACTIVE
+        self.rids: dict[str, object] = {}  # fleet rid -> engine Request
+        self.slow_steps = 0
+        self.steps = 0
+        # The per-replica health inbox the fleet routes fanout events
+        # into; the engine polls it each step (raw-queue contract).  An
+        # engine already carrying its own health subscription keeps it.
+        if engine._health_events is None and engine._health_fanout is None:
+            self.health_q: "_queue.Queue" = _queue.Queue()
+            engine._health_events = self.health_q
+        else:
+            self.health_q = engine._health_events
+
+    @property
+    def paused(self) -> bool:
+        return bool(self.engine.paused)
+
+    @property
+    def dispatchable(self) -> bool:
+        """May the router hand this replica NEW work?"""
+        return self.state == ACTIVE and not self.engine.paused
+
+    def load(self) -> int:
+        """The router's least-loaded scalar: queued + mid-prefill +
+        occupied slots (every unit is one request the replica still owes
+        work to)."""
+        e = self.engine
+        return (
+            len(e.pending)
+            + len(e._inflight_prefill)
+            + int(e._occupied.sum())
+        )
+
+    @property
+    def idle(self) -> bool:
+        return self.engine.idle
+
+
+class Router:
+    """Dispatch policy: least-loaded with session/prefix affinity.
+
+    Affinity key: the request's explicit ``session`` when given, else
+    the first ``prefix_tokens`` prompt tokens — requests sharing a
+    system prompt land on the replica that already holds its KV pages
+    (the prefix cache is per-engine, so affinity is what makes it pay
+    fleet-wide).  Affinity yields to balance: a sticky replica more
+    than ``affinity_slack`` requests above the least-loaded one is
+    skipped (classic bounded-load consistent placement).  Deterministic
+    throughout — ties break on the lowest replica index."""
+
+    def __init__(self, *, affinity_slack: int = 2, prefix_tokens: int = 16):
+        if affinity_slack < 0:
+            raise ValueError(
+                f"affinity_slack must be >= 0, got {affinity_slack}"
+            )
+        if prefix_tokens < 1:
+            raise ValueError(
+                f"prefix_tokens must be >= 1, got {prefix_tokens}"
+            )
+        self.affinity_slack = affinity_slack
+        self.prefix_tokens = prefix_tokens
+        self._affinity: dict = {}
+        self.dispatches = 0
+        self.affinity_hits = 0
+
+    def _key(self, fr: FleetRequest):
+        if fr.session is not None:
+            return ("session", fr.session)
+        return ("prefix", tuple(fr.prompt[: self.prefix_tokens]))
+
+    def choose(
+        self, fr: FleetRequest, candidates: list[Replica],
+        loads: dict[int, int],
+    ) -> int:
+        """Pick a replica index from ``candidates`` (non-empty, all
+        dispatchable).  ``loads`` is the router's WORKING load view —
+        the caller bumps the chosen entry so one step's dispatches
+        spread instead of all chasing the same minimum."""
+        self.dispatches += 1
+        min_load = min(loads[r.index] for r in candidates)
+        key = self._key(fr)
+        sticky = self._affinity.get(key)
+        if sticky is not None:
+            for rep in candidates:
+                if rep.index == sticky:
+                    if loads[sticky] <= min_load + self.affinity_slack:
+                        self.affinity_hits += 1
+                        return sticky
+                    break
+        pick = min(
+            candidates, key=lambda r: (loads[r.index], r.index)
+        ).index
+        self._affinity[key] = pick
+        return pick
+
+    def forget(self, index: int) -> None:
+        """Drop affinity pins onto a replica that left the fleet."""
+        self._affinity = {
+            k: v for k, v in self._affinity.items() if v != index
+        }
+
+
+class Fleet:
+    """N ``ServeEngine`` replicas behind a draining, failover-capable
+    router.
+
+    Construct with a list of engines (homogeneous config; each becomes
+    one fault domain), or see ``make_fleet`` for the factory helper.
+    Engines should be built WITHOUT their own ``max_pending`` — the
+    fleet owns bounded admission (``max_pending=``, fleet-wide).
+
+    ``fault_injector`` consults the REPLICA-level seams of
+    ``workloads/faults.py`` once per replica step: ``replica_crash``
+    and ``replica_hang`` kill the replica (hang after the step watchdog
+    ``hang_timeout_s`` budget; ``None`` disables the wall-clock
+    watchdog — injected hangs still fire.  A replica's FIRST step is
+    always exempt: it is dominated by one-time XLA compilation, and a
+    compile is not a wedge), failing its work over to survivors
+    under ``max_failovers``; ``replica_slow`` injects
+    ``slow_readback_s`` of extra step latency, and
+    ``slow_drain_after`` consecutive slow steps auto-drain the replica
+    (graceful — in-flight work finishes there, nothing is charged).
+    Engine-internal seams stay the engines' own business (their
+    quarantine/replay machinery runs unchanged inside each domain)."""
+
+    def __init__(
+        self,
+        engines,
+        *,
+        router: Router | None = None,
+        chip_ids: list[str] | None = None,
+        max_pending: int | None = None,
+        max_failovers: int = 2,
+        fault_injector=None,
+        hang_timeout_s: float | None = 5.0,
+        slow_readback_s: float = 0.002,
+        slow_drain_after: int | None = 3,
+        observer=None,
+    ):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("a fleet needs at least one engine")
+        if max_pending is not None and max_pending < 1:
+            raise ValueError(
+                f"max_pending must be >= 1 or None (unbounded), got "
+                f"{max_pending}"
+            )
+        if max_failovers < 0:
+            raise ValueError(
+                f"max_failovers must be >= 0, got {max_failovers}"
+            )
+        if chip_ids is not None and len(chip_ids) != len(engines):
+            raise ValueError(
+                f"chip_ids ({len(chip_ids)}) must match engines "
+                f"({len(engines)})"
+            )
+        self.router = router if router is not None else Router()
+        self.replicas: list[Replica] = [
+            Replica(i, eng, (chip_ids or [""] * len(engines))[i])
+            for i, eng in enumerate(engines)
+        ]
+        self.max_pending = max_pending
+        self.max_failovers = max_failovers
+        self._faults = fault_injector
+        if hang_timeout_s is not None and hang_timeout_s <= 0:
+            raise ValueError(
+                f"hang_timeout_s must be > 0 or None (watchdog off), "
+                f"got {hang_timeout_s}"
+            )
+        self.hang_timeout_s = (
+            None if hang_timeout_s is None else float(hang_timeout_s)
+        )
+        self.slow_readback_s = float(slow_readback_s)
+        self.slow_drain_after = slow_drain_after
+        self.queue: deque[FleetRequest] = deque()
+        self._reqs: dict[str, FleetRequest] = {}
+        self.completed: list[FleetRequest] = []
+        # Terminal transitions made OUTSIDE step() (cancel of a
+        # router-queued request) surface through the next step()'s
+        # return, mirroring the engine's contract.
+        self._finished_buffer: list[FleetRequest] = []
+        self._ids = itertools.count()
+        self._closed = False
+        self._lock = threading.RLock()
+        self._health_fanout = None
+        self._health_sub = None
+        # Telemetry: the fleet-level mirror of the engines' lifecycle
+        # counters, plus the router/failover economics the bench reads.
+        self.requests_submitted = 0
+        self.queue_rejections = 0
+        self.requests_ok = 0
+        self.requests_cancelled = 0
+        self.requests_expired = 0
+        self.requests_failed = 0
+        self.failover_requeues = 0  # charged (true-fault) failovers
+        self.drain_requeues = 0  # uncharged (health/operator) failovers
+        self.replica_crashes = 0
+        self.replica_hangs = 0
+        self.replicas_added = 0
+        self.replicas_removed = 0
+        self.generated_tokens = 0
+        self.router_secs = 0.0  # dispatch + failover bookkeeping time
+        # Failover recovery: fault stamp -> first post-failover token on
+        # a survivor, the fleet-scope pendant of engine.fault_recovery_s
+        # (the bench's failover_recovery_ms).
+        self.failover_recovery_s: list[float] = []
+        self._t_fault: float | None = None
+        self._recovery_rids: set[str] = set()
+        self._obs = observer
+        if observer is not None:
+            observer._bind(self)
+
+    # ---- introspection ---------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self.replicas)
+
+    @property
+    def alive(self) -> list[Replica]:
+        return [r for r in self.replicas if r.state != DEAD]
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def idle(self) -> bool:
+        return (
+            not self.queue
+            and not self._finished_buffer
+            and all(r.idle for r in self.alive)
+        )
+
+    def states(self) -> dict[int, str]:
+        return {r.index: r.state for r in self.replicas}
+
+    def _config(self):
+        for rep in self.replicas:
+            if rep.state != DEAD:
+                return rep.engine.config
+        raise EngineClosed("every replica in the fleet is dead")
+
+    # ---- submission ------------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int | None = None,
+        *,
+        eos_token: int | None = None,
+        rid: str | None = None,
+        adapter: str | None = None,
+        deadline_s: float | None = None,
+        session: str | None = None,
+    ) -> str:
+        """Queue one request with the router; dispatch happens on the
+        next ``step()``.  Validation mirrors ``ServeEngine.submit`` so
+        a request the fleet accepts is one every (homogeneous) replica
+        can run; bounded admission raises a typed ``QueueFull`` against
+        the FLEET-wide queue."""
+        with self._lock:
+            if self._closed:
+                raise EngineClosed(
+                    "fleet is closed; submissions are refused"
+                )
+            config = self._config()
+            prompt = [int(t) for t in prompt]
+            limit = config.max_seq_len - 1
+            if not 1 <= len(prompt) <= limit:
+                raise RequestTooLarge(
+                    f"prompt length {len(prompt)} must be in [1, {limit}]"
+                )
+            if max_new_tokens is None:
+                max_new_tokens = config.max_seq_len - len(prompt)
+            if max_new_tokens < 1:
+                raise InvalidRequest(
+                    f"max_new_tokens must be >= 1, got {max_new_tokens}"
+                )
+            if len(prompt) + max_new_tokens > config.max_seq_len:
+                raise RequestTooLarge(
+                    f"prompt ({len(prompt)}) + max_new_tokens "
+                    f"({max_new_tokens}) exceeds max_seq_len "
+                    f"{config.max_seq_len}"
+                )
+            if deadline_s is not None and deadline_s <= 0:
+                raise InvalidRequest(
+                    f"deadline_s must be > 0 (or None), got {deadline_s}"
+                )
+            if (
+                self.max_pending is not None
+                and len(self.queue) >= self.max_pending
+            ):
+                self.queue_rejections += 1
+                raise QueueFull(
+                    f"fleet queue is full ({len(self.queue)} >= "
+                    f"max_pending {self.max_pending}); resubmit after "
+                    "completions drain it"
+                )
+            rid = rid if rid is not None else f"fleet-{next(self._ids)}"
+            if rid in self._reqs and not self._reqs[rid].done:
+                raise InvalidRequest(
+                    f"request id {rid!r} is already in flight"
+                )
+            t_submit = time.perf_counter()
+            fr = FleetRequest(
+                rid, prompt, max_new_tokens, eos_token, adapter=adapter,
+                session=session, deadline_s=deadline_s,
+                t_deadline=(
+                    t_submit + deadline_s if deadline_s is not None
+                    else None
+                ),
+                t_submit=t_submit,
+            )
+            self._reqs[rid] = fr
+            self.queue.append(fr)
+            self.requests_submitted += 1
+            return rid
+
+    def cancel(self, rid: str) -> bool:
+        """Cancel one request anywhere in the fleet: router-queued
+        requests finish terminally here; dispatched ones cancel inside
+        their replica's engine (surfacing on the next step).  Returns
+        True iff the rid was live."""
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("fleet is closed")
+            fr = self._reqs.get(rid)
+            if fr is None or fr.done:
+                return False
+            if any(q is fr for q in self.queue):
+                self.queue.remove(fr)
+                self._finished_buffer.append(
+                    self._finish_terminal(fr, "cancelled")
+                )
+                return True
+            rep = (
+                self.replicas[fr.replica] if fr.replica is not None
+                else None
+            )
+            if rep is not None and rid in rep.rids and rep.state != DEAD:
+                return bool(rep.engine.cancel(rid))
+            return False
+
+    # ---- terminal bookkeeping -------------------------------------------
+
+    def _finish_terminal(
+        self, fr: FleetRequest, status: str, error: str | None = None
+    ) -> FleetRequest:
+        if fr.done:  # one terminal status per rid — never overwrite
+            return fr
+        fr.status = status
+        fr.error = error
+        fr.t_done = time.perf_counter()
+        fr.replica = None
+        counter = {
+            "ok": "requests_ok",
+            "cancelled": "requests_cancelled",
+            "expired": "requests_expired",
+            "failed": "requests_failed",
+        }[status]
+        setattr(self, counter, getattr(self, counter) + 1)
+        self.completed.append(fr)
+        return fr
+
+    def drain_completed(self) -> list[FleetRequest]:
+        """Hand back (and clear) the finished-request ring — the same
+        between-measurement-windows contract as the engine's."""
+        with self._lock:
+            out = list(self.completed)
+            self.completed.clear()
+            return out
+
+    # ---- health routing --------------------------------------------------
+
+    def bind_health(self, fanout) -> None:
+        """Subscribe the FLEET (one subscription) to a plugin
+        ``HealthFanout`` and route each event to exactly the replicas
+        whose ``chip_id`` it names — ``chip_id == ""`` (unattributed)
+        reaches every replica, per the HealthEvent all-chips contract.
+        Each engine then applies its own pause/resume bridge."""
+        with self._lock:
+            if self._health_fanout is not None:
+                raise RuntimeError(
+                    "fleet is already bound to a health fanout"
+                )
+            self._health_fanout = fanout
+            self._health_sub = fanout.subscribe()
+
+    def unbind_health(self) -> None:
+        with self._lock:
+            if self._health_fanout is not None:
+                self._health_fanout.unsubscribe(self._health_sub)
+                self._health_fanout = None
+            self._health_sub = None
+
+    def deliver_health(self, events) -> None:
+        """Route health events to the affected replicas' inboxes (the
+        test/raw-queue entry point; ``bind_health`` feeds the same
+        path from a live fanout)."""
+        with self._lock:
+            for ev in events:
+                for rep in self.replicas:
+                    if rep.state == DEAD or rep.health_q is None:
+                        continue
+                    if not ev.chip_id or ev.chip_id == rep.chip_id:
+                        rep.health_q.put(ev)
+
+    def _pump_health(self) -> None:
+        q = self._health_sub
+        if q is None:
+            return
+        import queue as _queue
+
+        events = []
+        while True:
+            try:
+                events.append(q.get_nowait())
+            except _queue.Empty:
+                break
+        if events:
+            self.deliver_health(events)
+
+    # ---- membership ------------------------------------------------------
+
+    def add_replica(self, engine, chip_id: str = "") -> int:
+        """Join a fresh engine live; the router dispatches to it from
+        the next step.  Returns the new replica index."""
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("fleet is closed")
+            rep = Replica(len(self.replicas), engine, chip_id)
+            self.replicas.append(rep)
+            self.replicas_added += 1
+            return rep.index
+
+    def drain(self, index: int) -> None:
+        """Graceful drain: stop routing NEW work to the replica; its
+        queued and in-flight requests finish there (nothing is failed
+        over, nothing charged).  ``remove()`` closes it once idle."""
+        with self._lock:
+            rep = self.replicas[index]
+            if rep.state == ACTIVE:
+                rep.state = DRAINING
+                self.router.forget(index)
+
+    def resume(self, index: int) -> None:
+        """Undo a drain (not a death): the replica takes new work
+        again."""
+        with self._lock:
+            rep = self.replicas[index]
+            if rep.state == DRAINING:
+                rep.state = ACTIVE
+                rep.slow_steps = 0
+
+    def remove(self, index: int, *, force: bool = False) -> None:
+        """Remove a replica: dead replicas detach immediately; live
+        ones must be idle (drain first) unless ``force``, which fails
+        their in-flight work over to survivors UNCHARGED (an operator
+        removal is not the requests' fault) before closing."""
+        with self._lock:
+            rep = self.replicas[index]
+            if rep.state == DEAD:
+                self.replicas_removed += 1
+                return
+            if not rep.idle and not force:
+                raise RuntimeError(
+                    f"replica {index} still holds work "
+                    f"(load {rep.load()}); drain it first or pass "
+                    "force=True"
+                )
+            victims = self._harvest(rep)
+            rep.state = DEAD
+            self.router.forget(index)
+            try:
+                rep.engine.close()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
+            self._requeue_victims(victims, charge=False)
+            self.replicas_removed += 1
+
+    # ---- failover core ---------------------------------------------------
+
+    def _harvest(self, rep: Replica) -> list[FleetRequest]:
+        """Pull every live fleet request off a replica, stitching the
+        tokens its current segment already emitted (consumed host-side
+        — tokens still in flight on the device are gone with the
+        replica, and replay re-emits them bit-identically)."""
+        victims: list[FleetRequest] = []
+        for rid, ereq in list(rep.rids.items()):
+            fr = self._reqs.get(rid)
+            rep.rids.pop(rid, None)
+            if fr is None or fr.done:
+                continue
+            fr.tokens.extend(int(t) for t in ereq.tokens)
+            fr.replica = None
+            fr.segments += 1
+            victims.append(fr)
+        return victims
+
+    def _requeue_victims(
+        self, victims: list[FleetRequest], *, charge: bool,
+        error: str | None = None,
+    ) -> list[FleetRequest]:
+        """Route harvested requests: requeue at the router-queue FRONT
+        for failover replay, or — when a charged failover exhausts
+        ``max_failovers`` — fail terminally.  Returns the terminally
+        finished ones."""
+        finished: list[FleetRequest] = []
+        for fr in reversed(victims):  # appendleft keeps FIFO order
+            if len(fr.tokens) >= fr.max_new_tokens or (
+                fr.eos_token is not None
+                and fr.tokens
+                and fr.tokens[-1] == fr.eos_token
+            ):
+                # The harvested stream is already bit-complete (the
+                # replica died between emitting the last token and
+                # retiring the request): nothing to replay — a zero
+                # budget re-submit would InvalidRequest a stream the
+                # client received in full.
+                finished.append(self._finish_terminal(fr, "ok"))
+                continue
+            if charge:
+                fr.failovers += 1
+                self.failover_requeues += 1
+                if fr.failovers > self.max_failovers:
+                    finished.append(self._finish_terminal(
+                        fr, "failed",
+                        error=(error or "replica failure")
+                        + f" (after {self.max_failovers} failovers)",
+                    ))
+                    continue
+                if self._t_fault is not None:
+                    # Only victims of the open fault window may close
+                    # it — an engine-escalated failure with no window
+                    # must not donate a rid that later closes someone
+                    # else's crash at a meaningless near-zero reading.
+                    self._recovery_rids.add(fr.rid)
+            else:
+                self.drain_requeues += 1
+            fr.status = "queued"
+            self.queue.appendleft(fr)
+        return finished
+
+    def _fail_replica(
+        self, rep: Replica, exc: BaseException, kind: str
+    ) -> list[FleetRequest]:
+        """A replica died (crash, hang past the watchdog, or an escaped
+        exception): mark it DEAD, close what can be closed, and fail
+        its work over to survivors under the failover budget.  Opens
+        the failover-recovery window the bench measures."""
+        victims = self._harvest(rep)
+        rep.state = DEAD
+        self.router.forget(rep.index)
+        if kind == "hang":
+            self.replica_hangs += 1
+        else:
+            self.replica_crashes += 1
+        try:
+            rep.engine.close()
+        except Exception:  # noqa: BLE001 — a dead replica may not close
+            pass
+        self._t_fault = time.perf_counter()
+        return self._requeue_victims(
+            victims, charge=True,
+            error=f"replica {rep.index} {kind}: "
+                  f"{type(exc).__name__}: {exc}",
+        )
+
+    def _drain_paused(self, rep: Replica) -> None:
+        """A health-paused replica holds its (quarantine-requeued) work
+        in its own pending queue; when a dispatchable survivor exists,
+        withdraw and fail it over UNCHARGED.  With no survivor the work
+        parks in place — bouncing it between paused replicas would burn
+        time for nothing, and recovery resumes it where it sits."""
+        if not rep.rids:
+            return
+        if not any(
+            r.dispatchable for r in self.replicas if r.index != rep.index
+        ):
+            return
+        victims: list[FleetRequest] = []
+        for rid in list(rep.rids):
+            ereq = rep.engine.withdraw(rid)
+            if ereq is None:
+                continue  # still mid-teardown; next step retries
+            rep.rids.pop(rid, None)
+            fr = self._reqs.get(rid)
+            if fr is None or fr.done:
+                continue
+            fr.tokens.extend(int(t) for t in ereq.tokens)
+            fr.replica = None
+            fr.segments += 1
+            victims.append(fr)
+        self._requeue_victims(victims, charge=False)
+
+    # ---- dispatch --------------------------------------------------------
+
+    def _dispatch_queued(self) -> list[FleetRequest]:
+        """Hand router-queued requests to replicas: least-loaded +
+        affinity via the Router, against a WORKING load view bumped per
+        dispatch so one step spreads its admissions.  Failover replays
+        sit at the queue front and re-prefill prompt + stitched tokens.
+        Returns requests that finished terminally at dispatch (expired
+        in queue, or nothing left to serve them)."""
+        finished: list[FleetRequest] = []
+        if not self.queue:
+            return finished
+        t0 = time.perf_counter()
+        now = t0
+        candidates = [r for r in self.replicas if r.dispatchable]
+        loads = {r.index: r.load() for r in candidates}
+        still_queued: deque[FleetRequest] = deque()
+        while self.queue:
+            fr = self.queue.popleft()
+            if fr.done:
+                continue
+            if fr.t_deadline is not None and now >= fr.t_deadline:
+                finished.append(self._finish_terminal(fr, "expired"))
+                continue
+            if not candidates:
+                still_queued.append(fr)
+                continue
+            pick = self.router.choose(fr, candidates, loads)
+            try:
+                self._dispatch_to(fr, self.replicas[pick])
+            except (InvalidRequest, RequestTooLarge) as exc:
+                # A replica-level validation miss (heterogeneous fleet,
+                # or a replay that no longer fits): terminal, loudly.
+                finished.append(self._finish_terminal(
+                    fr, "failed", error=f"{type(exc).__name__}: {exc}"
+                ))
+                continue
+            except EngineClosed:
+                still_queued.append(fr)  # raced a death; redispatch next step
+                continue
+            loads[pick] += 1
+        self.queue = still_queued
+        self.router_secs += time.perf_counter() - t0
+        return finished
+
+    def _dispatch_to(self, fr: FleetRequest, rep: Replica) -> None:
+        """Submit one fleet request (or failover replay) into a
+        replica's engine: the engine-side prompt is prompt + stitched
+        tokens, the budget the remaining tokens — greedy continuation
+        of prompt+emitted is bit-identical to the uninterrupted
+        stream, so a failed-over stream resumes exactly where the
+        client's stopped."""
+        prompt = fr.prompt + fr.tokens
+        remaining = fr.max_new_tokens - len(fr.tokens)
+        deadline = None
+        if fr.t_deadline is not None:
+            deadline = max(fr.t_deadline - time.perf_counter(), 1e-6)
+        rep.engine.submit(
+            prompt, remaining, eos_token=fr.eos_token, rid=fr.rid,
+            adapter=fr.adapter, deadline_s=deadline,
+        )
+        ereq = rep.engine.pending[-1]  # submit() appends its Request
+        rep.rids[fr.rid] = ereq
+        fr.replica = rep.index
+        fr.status = "running"
+
+    # ---- stepping --------------------------------------------------------
+
+    def _consult_seams(self, rep: Replica) -> bool:
+        """Cross the replica-level fault seams for one replica step.
+        ``replica_crash`` / ``replica_hang`` raise (the caller fails
+        the replica over); a ``replica_slow`` hit returns True and the
+        step pays ``slow_readback_s`` of injected latency."""
+        inj = self._faults
+        if inj is None:
+            return False
+        inj.check("replica_crash")
+        inj.check("replica_hang")
+        try:
+            inj.check("replica_slow")
+        except InjectedFault:
+            return True
+        return False
+
+    def _step_replica(self, rep: Replica) -> list[FleetRequest]:
+        finished: list[FleetRequest] = []
+        slow = False
+        try:
+            slow = self._consult_seams(rep)
+            if slow:
+                time.sleep(self.slow_readback_s)
+            t0 = time.perf_counter()
+            engine_done = rep.engine.step()
+            step_secs = time.perf_counter() - t0
+        except InjectedFault as exc:
+            kind = "hang" if exc.seam == "replica_hang" else "crash"
+            return self._fail_replica(rep, exc, kind)
+        except EngineClosed:
+            # Closed under us (operator remove raced a step): harvest
+            # whatever tracking remains, uncharged.
+            victims = self._harvest(rep)
+            rep.state = DEAD
+            self._requeue_victims(victims, charge=False)
+            return finished
+        except Exception as exc:  # noqa: BLE001 — escaped the engine's
+            # own quarantine: the whole domain is suspect.
+            return self._fail_replica(rep, exc, "crash")
+        warmup = rep.steps == 0
+        rep.steps += 1
+        if slow:
+            rep.slow_steps += 1
+            if (
+                self.slow_drain_after is not None
+                and rep.state == ACTIVE
+                and rep.slow_steps >= self.slow_drain_after
+                # Never auto-drain the last dispatchable replica:
+                # degraded service beats a queue nothing can serve.
+                and any(
+                    r.dispatchable for r in self.replicas
+                    if r.index != rep.index
+                )
+            ):
+                self.drain(rep.index)
+        else:
+            rep.slow_steps = 0
+        if (
+            self.hang_timeout_s is not None
+            and not warmup  # first step = one-time XLA compiles, not a wedge
+            and step_secs > self.hang_timeout_s
+            and rep.state != DEAD
+        ):
+            # Watchdog after the fact: the cooperative loop cannot
+            # preempt a wedged step, but it can refuse to trust the
+            # replica that wedged it.
+            return finished + self._fail_replica(
+                rep,
+                RuntimeError(
+                    f"step took {step_secs:.3f}s > hang_timeout_s "
+                    f"{self.hang_timeout_s}"
+                ),
+                "hang",
+            )
+        for ereq in engine_done:
+            finished.extend(self._absorb_finished(rep, ereq))
+        self._observe_progress(rep)
+        return finished
+
+    def _absorb_finished(self, rep: Replica, ereq) -> list[FleetRequest]:
+        """Map one engine-terminal Request onto its fleet request:
+        stitch the segment's tokens and either finish the fleet
+        request, or — engine-terminal ``failed`` (its OWN retry budget
+        exhausted inside the domain) — escalate to a charged fleet
+        failover onto a survivor."""
+        fr = self._reqs.get(ereq.rid)
+        if fr is None or fr.done or ereq.rid not in rep.rids:
+            return []
+        rep.rids.pop(ereq.rid, None)
+        # A request that admits and retires within one engine step never
+        # reaches _observe_progress — stamp it (and close any open
+        # failover-recovery window) here, or the fleet's TTFT/queue-wait
+        # pools silently drop exactly the fastest requests.
+        if fr.t_admit is None and ereq.t_admit is not None:
+            fr.t_admit = ereq.t_admit
+        if fr.t_first is None and not fr.tokens and ereq.t_first is not None:
+            fr.t_first = ereq.t_first
+        if (
+            self._t_fault is not None
+            and ereq.rid in self._recovery_rids
+            and ereq.tokens
+        ):
+            self.failover_recovery_s.append(
+                time.perf_counter() - self._t_fault
+            )
+            self._t_fault = None
+            self._recovery_rids.clear()
+        fr.tokens.extend(int(t) for t in ereq.tokens)
+        fr.segments += 1
+        fr.replica = None
+        if ereq.status == "ok":
+            return [self._finish_terminal(fr, "ok")]
+        if ereq.status in ("cancelled", "expired"):
+            return [self._finish_terminal(fr, ereq.status, ereq.error)]
+        # "failed": the domain gave up; the fleet may still fail over.
+        return self._requeue_victims(
+            [fr], charge=True,
+            error=ereq.error or "engine retry budget exhausted",
+        )
+
+    def _observe_progress(self, rep: Replica) -> None:
+        """Per-step stamps off the replica's live requests: fleet-level
+        t_admit/t_first (first segment only — a failover's re-admission
+        is not the client's first token), and the failover-recovery
+        window closing on the first post-failover token."""
+        for rid, ereq in rep.rids.items():
+            fr = self._reqs.get(rid)
+            if fr is None:
+                continue
+            if fr.t_admit is None and ereq.t_admit is not None:
+                fr.t_admit = ereq.t_admit
+            if (
+                fr.t_first is None
+                and not fr.tokens
+                and ereq.t_first is not None
+            ):
+                fr.t_first = ereq.t_first
+            if (
+                self._t_fault is not None
+                and rid in self._recovery_rids
+                and ereq.tokens
+            ):
+                self.failover_recovery_s.append(
+                    time.perf_counter() - self._t_fault
+                )
+                self._t_fault = None
+                self._recovery_rids.clear()
+
+    def step(self) -> list[FleetRequest]:
+        """One fleet iteration: route health events and apply every
+        replica's pause/resume FIRST (so drain decisions see a
+        coherent fleet-wide picture — a fleet-wide Unhealthy must park
+        work in place, not bounce it through a replica that is about
+        to pause), then drain paused replicas onto true survivors,
+        dispatch the router queue, and advance every live replica one
+        engine step (index order — deterministic).  Returns the fleet
+        requests that reached a terminal status this step."""
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("fleet is closed; no further steps")
+            engines = [r.engine for r in self.alive]
+            tokens0 = sum(e.generated_tokens for e in engines)
+            finished = list(self._finished_buffer)
+            self._finished_buffer.clear()
+            self._pump_health()
+            for rep in self.replicas:
+                if rep.state == DEAD:
+                    continue
+                try:
+                    # Apply pause/resume now; anything it finishes
+                    # surfaces through the engine's own next step.
+                    rep.engine._finished_buffer.extend(
+                        rep.engine._poll_health()
+                    )
+                except Exception:  # noqa: BLE001 — a dying replica's
+                    pass  # poll failing is the step's problem below
+            for rep in self.replicas:
+                if rep.state != DEAD and rep.engine.paused:
+                    self._drain_paused(rep)
+            finished += self._dispatch_queued()
+            for rep in list(self.replicas):
+                if rep.state == DEAD:
+                    continue
+                finished.extend(self._step_replica(rep))
+            # A fleet with zero live replicas left cannot serve its
+            # queue — fail it loudly rather than spin forever.
+            if self.queue and not self.alive:
+                while self.queue:
+                    fr = self.queue.popleft()
+                    if not fr.done:
+                        finished.append(self._finish_terminal(
+                            fr, "failed",
+                            error="no live replicas remain",
+                        ))
+            self.generated_tokens += (
+                sum(e.generated_tokens for e in engines) - tokens0
+            )
+            if self._obs is not None:
+                self._obs._fleet_step_end(self, finished)
+            return finished
+
+    def run(self) -> dict[str, list[int]]:
+        """Drive ``step()`` until every submitted request is terminal;
+        returns {rid: stitched tokens}.  While no replica is
+        dispatchable (every live one health-paused or draining) the
+        loop polls instead of spinning — steps still advance, so
+        draining replicas finish their in-flight work."""
+        out: dict[str, list[int]] = {}
+        while True:
+            with self._lock:
+                if self.idle:
+                    break
+                for fr in self.step():
+                    out[fr.rid] = fr.tokens
+                parked = bool(self.alive) and not any(
+                    r.dispatchable for r in self.alive
+                )
+            if parked:
+                time.sleep(0.001)
+        return out
+
+    # ---- streaming / front-end support ----------------------------------
+
+    def poll(self, rid: str, cursor: int = 0):
+        """Snapshot one request's stream from ``cursor``: returns
+        (new_tokens, done, status).  Includes the live segment's
+        already-consumed tokens, so an SSE handler streams tokens as
+        the driver thread steps."""
+        with self._lock:
+            fr = self._reqs.get(rid)
+            if fr is None:
+                raise KeyError(rid)
+            tokens = list(fr.tokens)
+            if not fr.done and fr.replica is not None:
+                rep = self.replicas[fr.replica]
+                ereq = rep.rids.get(rid)
+                if ereq is not None:
+                    tokens += [int(t) for t in ereq.tokens]
+            return tokens[cursor:], fr.done, fr.status
+
+    def serve_forever(self, stop_event: threading.Event) -> None:
+        """The front-end driver loop: step while work exists, idle-poll
+        otherwise, until ``stop_event`` is set."""
+        while not stop_event.is_set():
+            parked = False
+            with self._lock:
+                busy = not self.idle and not self._closed
+                if busy:
+                    self.step()
+                    parked = bool(self.alive) and not any(
+                        r.dispatchable for r in self.alive
+                    )
+            if not busy:
+                time.sleep(0.002)
+            elif parked:
+                time.sleep(0.001)
+
+    # ---- shutdown --------------------------------------------------------
+
+    def close(self) -> None:
+        """Idempotent shutdown: every queued and in-flight request
+        fails terminally with the cause recorded, every live engine
+        closes, and the health subscription tears down."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            err = "EngineClosed: fleet closed with the request in flight"
+            for rep in self.replicas:
+                if rep.state == DEAD:
+                    continue
+                for rid, ereq in list(rep.rids.items()):
+                    fr = self._reqs.get(rid)
+                    if fr is not None and not fr.done:
+                        fr.tokens.extend(int(t) for t in ereq.tokens)
+                        self._finish_terminal(fr, "failed", error=err)
+                rep.rids.clear()
+                try:
+                    rep.engine.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                rep.state = DEAD
+            while self.queue:
+                fr = self.queue.popleft()
+                if not fr.done:
+                    self._finish_terminal(fr, "failed", error=err)
+            self._finished_buffer.clear()
+            self.unbind_health()
+
+    def __enter__(self) -> "Fleet":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False
+
+
+def make_fleet(
+    params,
+    config,
+    n: int,
+    *,
+    engine_kw: dict | None = None,
+    chip_ids: list[str] | None = None,
+    observers=None,
+    **fleet_kw,
+) -> Fleet:
+    """Build N homogeneous ``ServeEngine`` replicas over SHARED params
+    (the time-sliced chips serve one model; per-replica page pools are
+    each engine's own) and front them with a ``Fleet``.  ``observers``
+    is an optional list of per-replica EngineObservers (index-aligned;
+    give them distinct names/replica labels before binding a shared
+    registry)."""
+    from .serve import ServeEngine
+
+    if n < 1:
+        raise ValueError(f"a fleet needs n >= 1 replicas, got {n}")
+    engine_kw = dict(engine_kw or {})
+    engines = []
+    for i in range(n):
+        kw = dict(engine_kw)
+        if observers is not None:
+            kw["observer"] = observers[i]
+        engines.append(ServeEngine(params, config, **kw))
+    return Fleet(engines, chip_ids=chip_ids, **fleet_kw)
+
+
+# ---- open-loop traffic ---------------------------------------------------
+
+
+@dataclass
+class TrafficGen:
+    """Seeded OPEN-LOOP traffic: arrivals are scheduled in advance and
+    do not wait for completions — the load model "millions of users"
+    reduces to at fleet scale.  Arrivals ride a two-state
+    Markov-modulated Poisson process (calm rate ``rate_rps``, bursts at
+    ``burst_factor`` x for geometric dwells — bursty by construction),
+    and prompt lengths are heavy-tailed (Pareto with shape
+    ``tail_alpha``, clamped to ``[min_prompt, max_prompt]``), the mix
+    long-prompt head-of-line risk comes from.  Deterministic per seed."""
+
+    seed: int = 0
+    rate_rps: float = 50.0
+    burst_factor: float = 4.0
+    burst_dwell: float = 0.25  # P(stay in burst) per arrival
+    calm_dwell: float = 0.9  # P(stay calm) per arrival
+    min_prompt: int = 1
+    max_prompt: int = 24
+    tail_alpha: float = 1.5
+    min_new: int = 1
+    max_new: int = 16
+    vocab: int = 256
+
+    def schedule(self, n: int) -> list[tuple[float, list[int], int]]:
+        """n arrivals as (t_offset_s, prompt, max_new_tokens)."""
+        rng = random.Random(self.seed)
+        out = []
+        t = 0.0
+        burst = False
+        for _ in range(n):
+            rate = self.rate_rps * (self.burst_factor if burst else 1.0)
+            t += rng.expovariate(rate)
+            stay = self.burst_dwell if burst else self.calm_dwell
+            if rng.random() > stay:
+                burst = not burst
+            # Pareto excursion scaled to span/8: the BODY stays short
+            # (median a few tokens) while the tail still reaches the
+            # cap a few percent of the time — mostly-chat traffic with
+            # occasional document-sized head-of-line risks.
+            span = self.max_prompt - self.min_prompt
+            plen = self.min_prompt + min(
+                span,
+                int(span * (rng.paretovariate(self.tail_alpha) - 1.0) / 8),
+            )
+            prompt = [rng.randrange(self.vocab) for _ in range(plen)]
+            new = rng.randint(self.min_new, self.max_new)
+            out.append((t, prompt, new))
+        return out
+
+
+def drive_open_loop(
+    fleet: Fleet,
+    schedule: list[tuple[float, list[int], int]],
+    *,
+    time_scale: float = 1.0,
+    session_every: int | None = None,
+    on_reject=None,
+) -> dict[str, list[int]]:
+    """Run a TrafficGen schedule through a fleet OPEN-LOOP: submissions
+    land at their scheduled wall-clock offsets (scaled by
+    ``time_scale``) whether or not earlier work finished, the fleet
+    stepping continuously in between.  ``session_every`` tags every
+    k-th request with a recurring session id (affinity traffic).
+    Returns {rid: tokens} for every accepted request."""
+    out: dict[str, list[int]] = {}
+    idx = 0
+    t0 = time.perf_counter()
+    while idx < len(schedule) or not fleet.idle:
+        now = (time.perf_counter() - t0) / time_scale
+        while idx < len(schedule) and schedule[idx][0] <= now:
+            _, prompt, new = schedule[idx]
+            session = (
+                f"sess-{idx % session_every}"
+                if session_every else None
+            )
+            try:
+                rid = fleet.submit(prompt, new, session=session)
+                out[rid] = []
+            except QueueFull:
+                if on_reject is not None:
+                    on_reject(idx)
+            idx += 1
+        for fr in fleet.step():
+            if fr.rid in out:
+                out[fr.rid] = fr.tokens
+    return out
+
+
+# ---- HTTP/SSE front end --------------------------------------------------
+
+
+class FleetServer:
+    """A minimal HTTP/SSE front end over a Fleet (dependency-free, like
+    the plugin's MetricsServer).
+
+      * ``POST /v1/generate`` — JSON body ``{"prompt": [ints],
+        "max_new_tokens": n, "session": ..., "eos_token": ...,
+        "deadline_s": ...}`` → ``text/event-stream``: one
+        ``data: {"tokens": [...]}`` event per poll with fresh tokens,
+        then a final ``data: {"done": true, "status": ..., "rid": ...}``.
+        Backpressure maps to HTTP 429 (QueueFull), validation to 400.
+      * ``GET /healthz`` — fleet liveness + per-replica states JSON.
+
+    ``start()`` binds the port (0 = ephemeral; the bound port lands
+    back on ``.port``) and spins the fleet's driver thread; handlers
+    only submit/poll under the fleet lock."""
+
+    def __init__(self, fleet: Fleet, port: int = 0, poll_s: float = 0.002):
+        self.fleet = fleet
+        self.port = port
+        self.poll_s = poll_s
+        self._httpd = None
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def start(self) -> int:
+        import http.server
+
+        fleet, poll_s, stop = self.fleet, self.poll_s, self._stop
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _json(self, code: int, obj: dict) -> None:
+                body = (json.dumps(obj) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path != "/healthz":
+                    self.send_error(404)
+                    return
+                self._json(200, {
+                    "ok": not fleet.closed,
+                    "replicas": {
+                        str(r.index): {
+                            "state": r.state,
+                            "paused": r.paused,
+                            "load": r.load(),
+                        }
+                        for r in fleet.replicas
+                    },
+                    "queue_depth": fleet.queue_depth,
+                })
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/v1/generate":
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    rid = fleet.submit(
+                        body["prompt"],
+                        body.get("max_new_tokens"),
+                        eos_token=body.get("eos_token"),
+                        adapter=body.get("adapter"),
+                        deadline_s=body.get("deadline_s"),
+                        session=body.get("session"),
+                    )
+                except QueueFull as e:
+                    self._json(429, {"error": str(e)})
+                    return
+                except (
+                    KeyError, ValueError, TypeError, json.JSONDecodeError,
+                ) as e:
+                    self._json(400, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                except EngineClosed as e:
+                    self._json(503, {"error": str(e)})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                cursor = 0
+                while not stop.is_set():
+                    new, done, status = fleet.poll(rid, cursor)
+                    if new:
+                        cursor += len(new)
+                        self.wfile.write(
+                            b"data: "
+                            + json.dumps({"tokens": new}).encode()
+                            + b"\n\n"
+                        )
+                        self.wfile.flush()
+                    if done:
+                        self.wfile.write(
+                            b"data: "
+                            + json.dumps({
+                                "done": True, "status": status,
+                                "rid": rid, "n_tokens": cursor,
+                            }).encode()
+                            + b"\n\n"
+                        )
+                        self.wfile.flush()
+                        return
+                    time.sleep(poll_s)
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("", self.port), Handler
+        )
+        self.port = self._httpd.server_address[1]
+        for name, target in (
+            ("fleet-http", self._httpd.serve_forever),
+            ("fleet-driver",
+             lambda: self.fleet.serve_forever(self._stop)),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []

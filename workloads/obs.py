@@ -140,6 +140,12 @@ ENGINE_METRICS: tuple[MetricSpec, ...] = (
         "KV-cache pages currently held by live sequences (scrape-time)",
     ),
     MetricSpec(
+        "engine_paused", "gauge", ("engine",),
+        "1 while the health bridge holds admission paused on an "
+        "Unhealthy chip (scrape-time; fleet routers read this as the "
+        "replica's drain signal)",
+    ),
+    MetricSpec(
         "engine_ttft_seconds", "histogram", ("engine",),
         "submission -> first observed token (queue wait included)",
     ),
@@ -150,6 +156,74 @@ ENGINE_METRICS: tuple[MetricSpec, ...] = (
     MetricSpec(
         "engine_step_seconds", "histogram", ("engine",),
         "wall time of one engine step() (admit + dispatch + consume)",
+    ),
+)
+
+# Fleet-level metric families (workloads/fleet.py; FleetObserver below).
+# Same three-consumer contract as ENGINE_METRICS: bind_fleet metrics,
+# the lint test, and the rendered docs/OBSERVABILITY.md catalog all read
+# this spec.  Engine families additionally carry a ``replica`` label in
+# fleet mode (EngineObserver(replica=...)); single-engine output is
+# byte-compatible when the label is left at its empty default.
+FLEET_METRICS: tuple[MetricSpec, ...] = (
+    MetricSpec(
+        "fleet_requests_total", "counter", ("fleet",),
+        "requests accepted into the fleet router queue",
+    ),
+    MetricSpec(
+        "fleet_tokens_total", "counter", ("fleet",),
+        "tokens generated across every live replica",
+    ),
+    MetricSpec(
+        "fleet_failovers_total", "counter", ("fleet",),
+        "charged failover requeues after true replica faults "
+        "(crash/hang/escaped exception; replay re-prefills prompt + "
+        "emitted tokens on a survivor)",
+    ),
+    MetricSpec(
+        "fleet_drain_requeues_total", "counter", ("fleet",),
+        "uncharged requeues off health-paused or operator-removed "
+        "replicas (a sick chip is not the request's fault)",
+    ),
+    MetricSpec(
+        "fleet_queue_rejections_total", "counter", ("fleet",),
+        "submissions rejected by the fleet-wide admission bound "
+        "(max_pending)",
+    ),
+    MetricSpec(
+        "fleet_replica_failures_total", "counter", ("fleet", "kind"),
+        "replicas lost, by failure kind (crash vs hang)",
+    ),
+    MetricSpec(
+        "fleet_queue_depth", "gauge", ("fleet",),
+        "requests waiting in the fleet router queue (scrape-time)",
+    ),
+    MetricSpec(
+        "fleet_replicas", "gauge", ("fleet", "state"),
+        "replicas by state (active / draining / dead; scrape-time)",
+    ),
+    MetricSpec(
+        "fleet_replica_state", "gauge", ("fleet", "replica", "state"),
+        "1 for each live replica's current router state "
+        "(active/draining — the per-replica drain signal; scrape-time)",
+    ),
+    MetricSpec(
+        "fleet_replica_paused", "gauge", ("fleet", "replica"),
+        "1 while the replica's engine is health-paused (scrape-time)",
+    ),
+    MetricSpec(
+        "fleet_queue_wait_seconds", "histogram", ("fleet",),
+        "submission -> first admission into any replica's slots, "
+        "pooled across the fleet",
+    ),
+    MetricSpec(
+        "fleet_ttft_seconds", "histogram", ("fleet",),
+        "submission -> first streamed token, pooled across the fleet "
+        "(failover re-admissions do not reset it)",
+    ),
+    MetricSpec(
+        "fleet_e2e_seconds", "histogram", ("fleet",),
+        "submission -> terminal status, pooled across the fleet",
     ),
 )
 
@@ -267,6 +341,7 @@ class EngineObserver:
         step_limit: int = 2048,
         span_limit: int = 2048,
         name: str = "0",
+        replica: str = "",
     ):
         if step_limit < 1 or span_limit < 1:
             raise ValueError(
@@ -274,6 +349,13 @@ class EngineObserver:
                 f"{step_limit}/{span_limit}"
             )
         self.name = name
+        # Fleet mode: a non-empty ``replica`` adds a replica=<id> label
+        # to every series AND keys the gauge registrations, so N
+        # engines share one registry without colliding.  The empty
+        # default keeps single-engine scrape output BYTE-compatible
+        # (no replica label, name-keyed gauges) — pinned by
+        # tests/test_metrics_lint.py.
+        self.replica = replica
         self.steps: deque[StepRecord] = deque(maxlen=step_limit)
         self.spans: deque[RequestSpan] = deque(maxlen=span_limit)
         self.dropped_steps = 0
@@ -298,21 +380,28 @@ class EngineObserver:
         bucket ladder), register the scrape-time gauges, and start
         pushing counter/histogram updates from the step hooks.  All
         series carry an ``engine=<name>`` label so several engines can
-        share one registry (gauge registration replaces by name — give
-        concurrent engines distinct observer names and bind the LAST
-        one, or separate registries).  ``unbind_registry()`` detaches
-        when the engine retires."""
+        share one registry.  Without a ``replica`` id, gauge
+        registration replaces by name (give concurrent engines distinct
+        observer names and bind the LAST one, or separate registries —
+        the single-engine contract, unchanged); WITH one (fleet mode),
+        each observer's gauges register under its own key, every series
+        additionally carries ``replica=<id>``, and N replicas coexist
+        on one registry.  ``unbind_registry()`` detaches when the
+        engine retires."""
         self._registry = reg
         self._labels = dict(labels or {})
         self._labels.setdefault("engine", self.name)
+        if self.replica:
+            self._labels.setdefault("replica", self.replica)
         for m in ENGINE_METRICS:
             if m.type == "histogram":
                 reg.describe(m.name, m.help, buckets=SERVE_SECONDS_BUCKETS)
             else:
                 reg.describe(m.name, m.help)
+        key = f"replica:{self.replica}" if self.replica else None
         for name, reader in self._GAUGE_READERS.items():
             reg.register_gauge(
-                name, lambda reader=reader: self._gauge(reader)
+                name, lambda reader=reader: self._gauge(reader), key=key
             )
 
     # One engine reader per gauge family in ENGINE_METRICS — bind and
@@ -326,6 +415,9 @@ class EngineObserver:
         "engine_resident_pages": lambda e: e.ctrl.used_pages,
         "engine_prefill_inflight": (
             lambda e: len(getattr(e, "_inflight_prefill", ()))
+        ),
+        "engine_paused": (
+            lambda e: 1.0 if getattr(e, "paused", False) else 0.0
         ),
     }
 
@@ -350,12 +442,15 @@ class EngineObserver:
         no dead engine keeps scraping as live state.  Gauge
         registration replaces by name, so unbind the retiring observer
         BEFORE binding its successor — unbinding afterwards would
-        remove the successor's collectors."""
+        remove the successor's collectors.  (Fleet mode is immune:
+        replica-keyed registrations unbind only their own key, so one
+        replica retiring never touches its siblings'.)"""
         reg, self._registry = self._registry, None
         if reg is None:
             return
+        key = f"replica:{self.replica}" if self.replica else None
         for name in self._GAUGE_READERS:
-            reg.unregister_gauge(name)
+            reg.unregister_gauge(name, key=key)
         self._engine = None
 
     def _gauge(self, value_fn) -> list[tuple[dict, float]]:
@@ -534,6 +629,126 @@ class EngineObserver:
             json.dump(trace, f)
             f.write("\n")
         return len(trace["traceEvents"])
+
+
+class FleetObserver:
+    """Fleet-level Prometheus bridge (workloads/fleet.py): aggregate
+    counters, scrape-time gauges and pooled latency histograms NEXT TO
+    the per-replica engine series (give each replica's EngineObserver a
+    distinct ``replica=`` id and bind everything to one registry).
+
+    Same discipline as the engine bridge: inert (host counters only,
+    never scheduling state), jax-free, counters pushed as deltas
+    against the fleet's running totals at each ``Fleet.step()``."""
+
+    def __init__(self, *, name: str = "0"):
+        self.name = name
+        self._registry = None
+        self._labels: dict = {}
+        self._fleet = None
+        self._pushed: dict[str, float] = {}
+
+    # Scrape-time readers; ``e`` is the bound Fleet (the lint's
+    # reader-regex contract shared with the engine bridge).
+    _FLEET_GAUGE_READERS = {
+        "fleet_queue_depth": lambda e: [({}, float(len(e.queue)))],
+        "fleet_replicas": lambda e: [
+            ({"state": state}, float(
+                sum(1 for r in e.replicas if r.state == state)
+            ))
+            for state in ("active", "draining", "dead")
+        ],
+        "fleet_replica_state": lambda e: [
+            ({"replica": str(r.index), "state": r.state}, 1.0)
+            for r in e.replicas if r.state != "dead"
+        ],
+        "fleet_replica_paused": lambda e: [
+            ({"replica": str(r.index)}, 1.0 if r.paused else 0.0)
+            for r in e.replicas if r.state != "dead"
+        ],
+    }
+
+    # Counter family -> Fleet attribute carrying the running total.
+    _FLEET_COUNTERS = {
+        "fleet_requests_total": "requests_submitted",
+        "fleet_tokens_total": "generated_tokens",
+        "fleet_failovers_total": "failover_requeues",
+        "fleet_drain_requeues_total": "drain_requeues",
+        "fleet_queue_rejections_total": "queue_rejections",
+    }
+
+    def bind_registry(self, reg, labels: dict | None = None) -> None:
+        self._registry = reg
+        self._labels = dict(labels or {})
+        self._labels.setdefault("fleet", self.name)
+        for m in FLEET_METRICS:
+            if m.type == "histogram":
+                reg.describe(m.name, m.help, buckets=SERVE_SECONDS_BUCKETS)
+            else:
+                reg.describe(m.name, m.help)
+        for name, reader in self._FLEET_GAUGE_READERS.items():
+            reg.register_gauge(
+                name, lambda reader=reader: self._gauge(reader),
+                key=f"fleet:{self.name}",
+            )
+
+    def unbind_registry(self) -> None:
+        reg, self._registry = self._registry, None
+        if reg is None:
+            return
+        for name in self._FLEET_GAUGE_READERS:
+            reg.unregister_gauge(name, key=f"fleet:{self.name}")
+        self._fleet = None
+
+    def _gauge(self, value_fn) -> list[tuple[dict, float]]:
+        fleet = self._fleet
+        if fleet is None:
+            return []
+        try:
+            return [
+                ({**self._labels, **labels}, float(v))
+                for labels, v in value_fn(fleet)
+            ]
+        except Exception:
+            return []  # a gauge must never fail a scrape mid-teardown
+
+    # ---- fleet-facing hooks ---------------------------------------------
+
+    def _bind(self, fleet) -> None:
+        self._fleet = fleet
+
+    def _fleet_step_end(self, fleet, finished) -> None:
+        reg = self._registry
+        if reg is None:
+            return
+        labels = self._labels
+        for metric, attr in self._FLEET_COUNTERS.items():
+            total = float(getattr(fleet, attr, 0))
+            delta = total - self._pushed.get(metric, 0.0)
+            if delta:
+                reg.inc(metric, labels, delta)
+                self._pushed[metric] = total
+        for kind, attr in (
+            ("crash", "replica_crashes"), ("hang", "replica_hangs"),
+        ):
+            metric = f"fleet_replica_failures_total:{kind}"
+            total = float(getattr(fleet, attr, 0))
+            delta = total - self._pushed.get(metric, 0.0)
+            if delta:
+                reg.inc(
+                    "fleet_replica_failures_total",
+                    {**labels, "kind": kind}, delta,
+                )
+                self._pushed[metric] = total
+        for fr in finished:
+            if fr.queue_wait_secs is not None:
+                reg.observe_seconds(
+                    "fleet_queue_wait", fr.queue_wait_secs, labels
+                )
+            if fr.ttft_secs is not None:
+                reg.observe_seconds("fleet_ttft", fr.ttft_secs, labels)
+            if fr.e2e_secs is not None:
+                reg.observe_seconds("fleet_e2e", fr.e2e_secs, labels)
 
 
 def _us(t: float, t0: float) -> float:

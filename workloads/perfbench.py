@@ -1011,6 +1011,216 @@ def measure_fault_recovery(scale: BenchScale) -> dict:
     }
 
 
+def measure_fleet(scale: BenchScale) -> dict:
+    """Fleet serving economics (docs/SERVING.md "Fleet serving &
+    failover"), three questions measured on one composed engine shape
+    (int8 base, pipelined stepping, greedy so streams bit-compare):
+
+      1. **Aggregate throughput + tail** — 4 replicas behind the
+         router under the seeded open-loop generator (bursty arrivals,
+         heavy-tailed prompts): ``fleet_tokens_per_sec`` and the pooled
+         ``fleet_ttft_p50/p99_ms`` a client of the fleet would see.
+      2. **Router tax** — the same closed-loop stream through a BARE
+         engine vs a Fleet of ONE replica (interleaved repeats): the
+         per-request cost of the dispatch/affinity/bookkeeping layer,
+         published as ``router_overhead_ms`` (median per-pair with
+         spread; can read negative at the noise floor).
+      3. **Failover recovery** — one scheduled ``replica_crash``
+         mid-stream under the open-loop generator: the crash ->
+         first-token-on-a-survivor window, ``failover_recovery_ms``
+         (median over repeats with spread).  The crashed runs' token
+         streams are ASSERTED identical to a fault-free fleet run of
+         the same schedule (failover replay is bit-identical under
+         greedy), and every rid must reach exactly one terminal
+         status — a recovery number over a lossy stream would be a
+         lie."""
+    import statistics
+
+    from .faults import FaultInjector
+    from .fleet import Fleet, TrafficGen, drive_open_loop
+    from .quant import quantize_params
+    from .serve import ServeEngine
+
+    batch, ps = scale.batch, scale.page_size
+    chunk = ps
+    hi = scale.serve_chunks[1]
+    prompt_len = scale.decode_prompt
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
+        n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=prompt_len + 1 + hi * chunk,
+    )
+    params = quantize_params(
+        jax.tree.map(
+            lambda w: w.astype(config.dtype),
+            init_params(config, jax.random.PRNGKey(0)),
+        )
+    )
+    n_rep = 4
+    n_req = 4 * batch
+    gen = TrafficGen(
+        seed=9, rate_rps=100.0, min_prompt=1, max_prompt=prompt_len,
+        min_new=1 + chunk, max_new=1 + hi * chunk,
+        vocab=config.vocab_size,
+    )
+    sched = gen.schedule(n_req)
+
+    def build_fleet(n, injector=None):
+        engines = [
+            ServeEngine(
+                params, config, slots=batch, page_size=ps, chunk=chunk,
+                prompt_bucket=-(-prompt_len // ps) * ps, pipelined=True,
+            )
+            for _ in range(n)
+        ]
+        fleet = Fleet(
+            engines, chip_ids=[f"chip-{i}" for i in range(n)],
+            fault_injector=injector,
+            # Compiles past the exempt first step (decode programs land
+            # on step 2) must not read as hangs on a slow host/link.
+            hang_timeout_s=60.0,
+        )
+        for i in range(n):  # warm every replica's compiles, off the clock
+            fleet.submit([1 + i], 1 + chunk)
+        fleet.run()
+        fleet.drain_completed()
+        return fleet
+
+    def open_loop(injector=None):
+        """One open-loop run; returns (rate, streams, fleet)."""
+        fleet = build_fleet(n_rep, injector)
+        tokens0 = fleet.generated_tokens
+        t0 = time.perf_counter()
+        streams = drive_open_loop(fleet, sched, session_every=4)
+        secs = time.perf_counter() - t0
+        rate = (fleet.generated_tokens - tokens0) / secs
+        if len(streams) != n_req:
+            raise RuntimeError(
+                f"fleet bench served {len(streams)} of {n_req} requests"
+            )
+        done = fleet.drain_completed()
+        statuses = {fr.status for fr in done}
+        if statuses != {"ok"}:
+            raise RuntimeError(
+                f"fleet bench expected every request ok, saw {statuses}"
+            )
+        return rate, streams, fleet, done
+
+    rate, _, fleet4, done = open_loop()
+    ttfts = [
+        fr.ttft_secs * 1000 for fr in done if fr.ttft_secs is not None
+    ]
+    fleet4.close()
+
+    # Router tax: bare engine vs a one-replica fleet, same closed-loop
+    # stream (closed-loop so both arms measure the dispatch machinery,
+    # not the arrival process).
+    prompts = [(p, n) for _, p, n in sched]
+
+    def bare() -> float:
+        engine = ServeEngine(
+            params, config, slots=batch, page_size=ps, chunk=chunk,
+            prompt_bucket=-(-prompt_len // ps) * ps, pipelined=True,
+        )
+        engine.submit([1], 1 + chunk)
+        engine.run()
+        t0 = time.perf_counter()
+        for p, n in prompts:
+            engine.submit(p, n)
+        engine.run()
+        secs = time.perf_counter() - t0
+        engine.close()
+        return secs
+
+    def fleet1() -> float:
+        fleet = build_fleet(1)
+        t0 = time.perf_counter()
+        for p, n in prompts:
+            fleet.submit(p, n)
+        fleet.run()
+        secs = time.perf_counter() - t0
+        fleet.close()
+        return secs
+
+    bare_s, fleet1_s = _interleaved_repeats(bare, fleet1)
+    overhead_ms = [
+        (f - b) / n_req * 1000 for b, f in zip(bare_s, fleet1_s)
+    ]
+
+    # Failover: a fault-free reference run, then crashed repeats whose
+    # streams must match it bit-for-bit.  CLOSED-loop (the generator's
+    # prompts submitted up front) so the crash step provably finds
+    # in-flight work on the victim replica at any scale.
+    def closed_loop(injector=None, schedule=None):
+        """``schedule`` arms the injector only AFTER the warm run
+        inside build_fleet (reset + arm), so the scheduled crash lands
+        at a deterministic measured-stream step regardless of how many
+        replica-seam crossings warmup burned."""
+        fleet = build_fleet(n_rep, injector)
+        if injector is not None:
+            injector.reset()
+            if schedule:
+                injector.arm(schedule)
+        for i, (p, n) in enumerate(prompts):
+            fleet.submit(p, n, session=f"sess-{i % 4}")
+        streams = fleet.run()
+        done = fleet.drain_completed()
+        statuses = {fr.status for fr in done}
+        if len(done) != n_req or statuses != {"ok"}:
+            raise RuntimeError(
+                f"fleet failover bench: {len(done)} finished with "
+                f"statuses {statuses}, expected {n_req} ok"
+            )
+        return streams, fleet
+
+    ref_streams, ref_fleet = closed_loop()
+    ref_fleet.close()
+    recoveries: list[float] = []
+    requeued = 0
+    for _ in range(3):
+        # Crossing 2n+1 = fleet step 3, replica 0 — mid-stream, with
+        # every slot occupied by the up-front submissions.
+        streams, fleet = closed_loop(
+            FaultInjector(),
+            schedule={"replica_crash": 2 * n_rep + 1},
+        )
+        if streams != ref_streams:
+            raise RuntimeError(
+                "fleet failover bench: failed-over streams diverged "
+                "from the fault-free run — replay is supposed to be "
+                "bit-identical"
+            )
+        if fleet.replica_crashes != 1:
+            raise RuntimeError(
+                f"fleet failover bench expected exactly one crash, saw "
+                f"{fleet.replica_crashes}"
+            )
+        if len(fleet.failover_recovery_s) != 1:
+            raise RuntimeError(
+                f"fleet failover bench expected one recovery window, "
+                f"saw {len(fleet.failover_recovery_s)} (the crash "
+                "found no in-flight work)"
+            )
+        recoveries.extend(fleet.failover_recovery_s)
+        requeued += fleet.failover_requeues
+        fleet.close()
+    rec_ms = [r * 1000 for r in recoveries]
+    return {
+        "fleet_replicas": n_rep,
+        "fleet_requests": n_req,
+        "fleet_tokens_per_sec": round(rate, 1),
+        "fleet_ttft_p50_ms": round(_pctl(ttfts, 0.50), 2),
+        "fleet_ttft_p99_ms": round(_pctl(ttfts, 0.99), 2),
+        "router_overhead_ms": round(statistics.median(overhead_ms), 3),
+        "router_overhead_ms_min": round(min(overhead_ms), 3),
+        "router_overhead_ms_max": round(max(overhead_ms), 3),
+        "failover_recovery_ms": round(statistics.median(rec_ms), 2),
+        "failover_recovery_ms_min": round(min(rec_ms), 2),
+        "failover_recovery_ms_max": round(max(rec_ms), 2),
+        "failover_requeued": requeued,
+    }
+
+
 def measure_admission(scale: BenchScale) -> dict:
     """Admission throughput: serial (one batch-1 prefill dispatch + one
     first-token readback PER admitted request) vs BATCHED (one multi-row
@@ -1886,6 +2096,7 @@ def run(scale_name: str = "full", pool_with: dict | None = None) -> dict:
     out.update(measure_interleave(scale))
     out.update(measure_obs_overhead(scale))
     out.update(measure_fault_recovery(scale))
+    out.update(measure_fleet(scale))
     out.update(measure_admission(scale))
     out.update(measure_prefix_serve(scale))
     out.update(measure_spec_serve(scale))

@@ -1027,6 +1027,26 @@ class ServeEngine:
                 return True
         return False
 
+    def withdraw(self, rid: str) -> Request | None:
+        """Remove one QUEUED request from the pending queue WITHOUT a
+        terminal status — the router/failover seam: an external
+        scheduler (workloads/fleet.py) reclaims a request it will
+        re-dispatch on another engine, so the rid must stay free to
+        reach its one terminal status elsewhere.  Only pending requests
+        withdraw (a health pause has already requeued in-flight work
+        there); running or mid-prefill requests return None — cancel()
+        is the API that can reach those.  Fan-out membership is
+        abandoned exactly as a pre-admission cancel would."""
+        if self._closed:
+            raise EngineClosed("engine is closed")
+        for req in self.pending:
+            if req.rid == rid:
+                self.pending.remove(req)
+                self._group_abandon(req)
+                req.group = None
+                return req
+        return None
+
     def _drain_all_pending(self) -> list[Request]:
         """Consume any pipelined in-flight chunk AND superstep (host
         mirrors sync; the slot-reclaim precondition for cancel/expiry).
@@ -2688,6 +2708,167 @@ def serve_batch(
     return jnp.stack(out, axis=1), pools
 
 
+def _run_fleet_cli(
+    args, parser, params, config, page_size, bucket, adapters, names,
+    spec_kw, observer, metrics_server, schedule,
+) -> int:
+    """The ``--fleet N`` serve path: N replicas behind the router, a
+    seeded open-loop bursty traffic stream (optionally pushed through
+    the HTTP/SSE front end), replica fault injection, and a lifecycle
+    summary."""
+    from .faults import ENGINE_SEAMS, FaultInjector, REPLICA_SEAMS
+    from .fleet import Fleet, FleetServer, TrafficGen, drive_open_loop
+
+    fleet_schedule = {
+        s: n for s, n in schedule.items() if s in REPLICA_SEAMS
+    }
+    engine_schedule = {
+        s: n for s, n in schedule.items() if s in ENGINE_SEAMS
+    }
+    if set(schedule) - set(fleet_schedule) - set(engine_schedule):
+        parser.error(
+            f"unknown seams in --inject-fault: "
+            f"{sorted(set(schedule) - set(fleet_schedule) - set(engine_schedule))}"
+        )
+    observers = [None] * args.fleet
+    fleet_obs = None
+    if args.metrics_port is not None or args.trace_out:
+        from .obs import EngineObserver, FleetObserver
+
+        observers = [
+            EngineObserver(name=str(i), replica=str(i))
+            for i in range(args.fleet)
+        ]
+        fleet_obs = FleetObserver()
+        if args.metrics_port is not None:
+            from tpu_device_plugin.metrics import registry
+
+            for obs in observers:
+                obs.bind_registry(registry)
+            fleet_obs.bind_registry(registry)
+    engines = []
+    for i in range(args.fleet):
+        engines.append(ServeEngine(
+            params, config, slots=args.slots, page_size=page_size,
+            prompt_bucket=bucket, temperature=args.temperature,
+            top_k=args.top_k, top_p=args.top_p,
+            rng=jax.random.PRNGKey(42 + i), pipelined=args.pipelined,
+            prefill_budget=args.prefill_budget, adapters=adapters,
+            observer=observers[i],
+            fault_injector=(
+                FaultInjector(engine_schedule)
+                if i == 0 and engine_schedule else None
+            ),
+            max_retries=args.max_retries,
+            retry_backoff_s=args.retry_backoff_s, **spec_kw,
+        ))
+    fleet = Fleet(
+        engines,
+        chip_ids=[f"chip-{i}" for i in range(args.fleet)],
+        max_pending=args.max_pending, max_failovers=args.max_retries,
+        fault_injector=(
+            FaultInjector(fleet_schedule) if fleet_schedule else None
+        ),
+        # XLA compiles landing past each replica's exempt first step
+        # (decode programs compile on step 2) must not read as hangs.
+        hang_timeout_s=60.0,
+        observer=fleet_obs,
+    )
+    # Warm every replica's compile with one request each, off the clock.
+    for i in range(args.fleet):
+        fleet.submit([1 + i], 1, session=f"warm-{i}")
+    fleet.run()
+    traffic = TrafficGen(
+        seed=7, vocab=config.vocab_size, max_prompt=args.prompt_len,
+        max_new=args.max_new_tokens,
+        min_new=max(1, args.max_new_tokens // 3),
+    )
+    sched = traffic.schedule(args.requests)
+    tokens0 = fleet.generated_tokens
+    t0 = time.perf_counter()
+    if args.http_port is not None:
+        import json
+        import threading
+        import urllib.request
+
+        server = FleetServer(fleet, args.http_port)
+        port = server.start()
+        print(f"fleet SSE front end: http://127.0.0.1:{port}/v1/generate")
+        statuses: dict[str, int] = {}
+        statuses_lock = threading.Lock()
+
+        # One client thread per request: reading an SSE stream to
+        # completion inline would serialize the open-loop schedule into
+        # a closed loop of depth 1 and never exercise the router.
+        def sse_client(prompt, new):
+            body = json.dumps(
+                {"prompt": prompt, "max_new_tokens": new}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                for line in resp:
+                    if line.startswith(b"data: "):
+                        ev = json.loads(line[6:])
+                        if ev.get("done"):
+                            with statuses_lock:
+                                statuses[ev["status"]] = (
+                                    statuses.get(ev["status"], 0) + 1
+                                )
+
+        clients = []
+        t_start = time.perf_counter()
+        for offset, prompt, new in sched:
+            time.sleep(max(0.0, offset - (time.perf_counter() - t_start)))
+            t = threading.Thread(
+                target=sse_client, args=(prompt, new), daemon=True
+            )
+            t.start()
+            clients.append(t)
+        for t in clients:
+            t.join()
+        server.stop()
+        print(f"SSE streams closed: statuses={statuses}")
+    else:
+        drive_open_loop(fleet, sched)
+    elapsed = time.perf_counter() - t0
+    generated = fleet.generated_tokens - tokens0
+    rate = generated / elapsed if elapsed > 0 and generated else 0.0
+    print(
+        f"fleet done: {args.requests} requests over "
+        f"{args.fleet} replicas, {generated} tokens, "
+        f"≈ {rate:.0f} tok/s aggregate "
+        f"(states={fleet.states()}, router dispatches="
+        f"{fleet.router.dispatches}, affinity hits="
+        f"{fleet.router.affinity_hits}, queue rejections="
+        f"{fleet.queue_rejections})"
+    )
+    if (
+        fleet.replica_crashes or fleet.replica_hangs
+        or fleet.failover_requeues or fleet.drain_requeues
+    ):
+        from collections import Counter
+
+        statuses = Counter(r.status for r in fleet.completed)
+        print(
+            f"failover: crashes={fleet.replica_crashes} "
+            f"hangs={fleet.replica_hangs} "
+            f"charged_requeues={fleet.failover_requeues} "
+            f"drain_requeues={fleet.drain_requeues} "
+            f"statuses={dict(statuses)} recovery_ms="
+            f"{[round(s * 1000, 1) for s in fleet.failover_recovery_s]}"
+        )
+    if args.trace_out and observers[0] is not None:
+        n_events = observers[0].export_trace(args.trace_out)
+        print(f"trace (replica 0): {n_events} events -> {args.trace_out}")
+    fleet.close()
+    if metrics_server is not None:
+        metrics_server.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     """``python -m workloads.serve --requests 12 --slots 4`` — run a
     stream of synthetic mixed-length requests through the continuous-
@@ -2774,11 +2955,26 @@ def main(argv=None) -> int:
     parser.add_argument("--inject-fault", action="append", default=None,
                         metavar="SEAM:N",
                         help="deterministic fault injection: raise at the "
-                        "named seam's Nth crossing (repeatable; seams: "
-                        "prefill_dispatch, prefill_readback, "
+                        "named seam's Nth crossing (repeatable; engine "
+                        "seams: prefill_dispatch, prefill_readback, "
                         "decode_dispatch, decode_readback, spec_dispatch, "
-                        "spec_readback) — exercises quarantine + replay "
-                        "end-to-end")
+                        "spec_readback — exercises quarantine + replay; "
+                        "with --fleet, replica seams replica_crash / "
+                        "replica_hang / replica_slow drive router "
+                        "failover, and engine seams land on replica 0)")
+    parser.add_argument("--fleet", type=int, default=None, metavar="N",
+                        help="serve a FLEET of N engine replicas behind "
+                        "the least-loaded/affinity router "
+                        "(workloads/fleet.py): one engine per "
+                        "plugin-advertised time-slice replica, seeded "
+                        "open-loop bursty traffic, replica failover by "
+                        "replay (docs/SERVING.md 'Fleet serving & "
+                        "failover')")
+    parser.add_argument("--http-port", type=int, default=None,
+                        help="with --fleet: serve the HTTP/SSE front end "
+                        "on this port (0 = ephemeral) and push the "
+                        "synthetic request stream through it as real "
+                        "SSE clients instead of the in-process API")
     args = parser.parse_args(argv)
     if args.requests < 1 or args.slots < 1:
         parser.error("--requests and --slots must be >= 1")
@@ -2844,22 +3040,20 @@ def main(argv=None) -> int:
     # series this process carries).
     observer = None
     metrics_server = None
-    if args.metrics_port is not None or args.trace_out:
+    if args.fleet is None and (args.metrics_port is not None or args.trace_out):
         from .obs import EngineObserver
 
         observer = EngineObserver()
     if args.metrics_port is not None:
         from tpu_device_plugin.metrics import MetricsServer, registry
 
-        observer.bind_registry(registry)
+        if observer is not None:
+            observer.bind_registry(registry)
         metrics_server = MetricsServer(args.metrics_port)
         bound = metrics_server.start()
         print(f"metrics: http://127.0.0.1:{bound}/metrics")
-    injector = None
+    schedule: dict[str, list[int]] = {}
     if args.inject_fault:
-        from .faults import FaultInjector
-
-        schedule: dict[str, list[int]] = {}
         for spec_arg in args.inject_fault:
             seam, _, n = spec_arg.partition(":")
             if not n.isdigit() or int(n) < 1:
@@ -2868,6 +3062,32 @@ def main(argv=None) -> int:
                     f"{spec_arg!r}"
                 )
             schedule.setdefault(seam, []).append(int(n))
+    if args.fleet is not None:
+        if args.fleet < 1:
+            parser.error("--fleet must be >= 1 replicas")
+        return _run_fleet_cli(
+            args, parser, params, config, page_size, bucket, adapters,
+            names, spec_kw, observer, metrics_server, schedule,
+        )
+    if args.http_port is not None:
+        parser.error("--http-port needs --fleet (the SSE front end is "
+                     "the fleet's)")
+    injector = None
+    if schedule:
+        from .faults import ENGINE_SEAMS, REPLICA_SEAMS, FaultInjector
+
+        for seam in schedule:
+            if seam in REPLICA_SEAMS:
+                parser.error(
+                    f"seam {seam!r} is a fleet-level seam; it needs "
+                    "--fleet"
+                )
+            elif seam not in ENGINE_SEAMS:
+                parser.error(
+                    f"unknown seam {seam!r} (engine seams: "
+                    f"{', '.join(ENGINE_SEAMS)}; replica seams — with "
+                    f"--fleet: {', '.join(REPLICA_SEAMS)})"
+                )
         try:
             injector = FaultInjector(schedule)
         except ValueError as e:
