@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check fmt-check
 
 all: native
 
@@ -51,7 +51,17 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check fleet-check test
+check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check test
+
+# Self-healing tripwires (docs/SERVING.md "Self-healing & recovery"):
+# one seeded supervisor round — scripted crash ⇒ resurrection behind
+# the bit-identical half-open canary probe, scripted crash-loop ⇒
+# quarantine ⇒ manual clear ⇒ probed rejoin — asserting full-capacity
+# convergence, oracle-true streams and no slot/page leaks
+# (tests/test_supervisor.py).  The randomized supervised chaos fuzz
+# rides tests/test_serve_fuzz.py with the slow suite's multi-seed arms.
+selfheal-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_supervisor.py::test_selfheal_smoke" -q -o addopts=
 
 # Fleet-serving tripwires (docs/SERVING.md "Fleet serving & failover"):
 # one seeded router-chaos round — randomized replica crashes/hangs (the

@@ -555,6 +555,9 @@ COMPACT_KEYS = [
     "fault_recovery_ms", "fault_injector_off_overhead_pct",
     "fleet_tokens_per_sec", "fleet_ttft_p99_ms",
     "router_overhead_ms", "failover_recovery_ms",
+    "selfheal_restore_ms", "selfheal_capacity_recovered",
+    "selfheal_goodput_retained",
+    "replica_restore_cold_ms", "replica_restore_warm_ms",
     "admission_tokens_per_sec", "admission_speedup",
     "admission_dispatches_per_request",
     "prefix_serve_speedup", "prefix_prefill_speedup",

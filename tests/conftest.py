@@ -39,6 +39,10 @@ _FAST_DESPITE_JAX = {
     # Metrics-name lint + exposition-format parsing: imports
     # workloads.obs (deliberately jax-free) and scans source text.
     "test_metrics_lint",
+    # Daemon lifecycle against the fake kubelet: imports
+    # workloads.backoff (deliberately jax-free) for the restart-backoff
+    # pin; never traces a jax program.
+    "test_daemon",
 }
 _JAX_IMPORT_RE = re.compile(r"^\s*(?:import|from)\s+(?:jax|workloads)\b", re.MULTILINE)
 _slow_file_cache: dict[str, bool] = {}

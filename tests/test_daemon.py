@@ -9,6 +9,7 @@ import queue
 import signal
 import threading
 import time
+from types import SimpleNamespace
 
 import pytest
 
@@ -136,3 +137,54 @@ def test_make_backend_selects_fake_topology():
     backend = make_backend(Flags(backend="fake", fake_topology="8x4"))
     backend.init()
     assert len(backend.devices()) == 8
+
+
+def test_restart_backoff_escalates_caps_and_resets(tmp_path, monkeypatch):
+    """Repeated plugin-start failures must back off EXPONENTIALLY to the
+    cap — the flat RESTART_BACKOFF_SECS=5.0 retry hammered a broken
+    kubelet socket at a fixed cadence forever — and one successful
+    start resets the escalation (workloads/backoff.py policy)."""
+    from workloads.backoff import Backoff
+
+    from tpu_device_plugin import main as main_mod
+
+    daemon = make_daemon(tmp_path, SimpleNamespace(plugin_dir=str(tmp_path)))
+    daemon.restart_backoff = Backoff(
+        base_s=1.0, factor=2.0, max_s=4.0, jitter=0.0
+    )
+
+    starts = {"n": 0}
+
+    class FlakyPlugin:
+        resource_name = "google.com/tpu"
+
+        def start(self):
+            starts["n"] += 1
+            # Fail the first 4 starts (delays 1, 2, 4, 4 — capped),
+            # succeed once, then fail again (the reset pin).
+            if starts["n"] <= 4 or starts["n"] == 6:
+                raise RuntimeError(f"kubelet socket refused ({starts['n']})")
+
+        def stop(self):
+            pass
+
+    class FlakyStrategy:
+        def get_plugins(self):
+            return [FlakyPlugin()]
+
+    monkeypatch.setattr(
+        main_mod, "new_topology_strategy", lambda *a, **kw: FlakyStrategy()
+    )
+    delays = []
+
+    def record_sleep(secs):
+        delays.append(secs)
+        return len(delays) >= 5  # terminal signal after the reset probe
+
+    daemon._sleep_interruptible = record_sleep
+    # Success (start #5) drops into the event loop; a kubelet-socket
+    # recreation restarts the plugins, whose next start fails again.
+    daemon.events.put(SocketEvent(path="kubelet.sock"))
+    assert daemon._restart_loop(resource_config={}) == 0
+    assert delays == [1.0, 2.0, 4.0, 4.0, 1.0]  # escalate, cap, reset
+    assert starts["n"] == 6

@@ -49,12 +49,17 @@ def _emitted_names() -> set[str]:
 
 def _described_names() -> set[str]:
     from tpu_device_plugin import metrics
-    from workloads.obs import ENGINE_METRICS, FLEET_METRICS
+    from workloads.obs import (
+        ENGINE_METRICS,
+        FLEET_METRICS,
+        SUPERVISOR_METRICS,
+    )
 
     return (
         set(metrics.registry._help)
         | {m.name for m in ENGINE_METRICS}
         | {m.name for m in FLEET_METRICS}
+        | {m.name for m in SUPERVISOR_METRICS}
     )
 
 
@@ -114,6 +119,28 @@ def test_fleet_catalog_is_fully_described_on_bind():
     reg = Registry()
     FleetObserver().bind_registry(reg)
     missing = {m.name for m in FLEET_METRICS} - set(reg._help)
+    assert not missing, missing
+
+
+def test_supervisor_gauge_readers_match_the_catalog():
+    """Same drift pin for the supervisor bridge's gauge families."""
+    from workloads.obs import SUPERVISOR_METRICS, SupervisorObserver
+
+    catalog_gauges = {
+        m.name for m in SUPERVISOR_METRICS if m.type == "gauge"
+    }
+    assert catalog_gauges == set(
+        SupervisorObserver._SUPERVISOR_GAUGE_READERS
+    )
+
+
+def test_supervisor_catalog_is_fully_described_on_bind():
+    from tpu_device_plugin.metrics import Registry
+    from workloads.obs import SUPERVISOR_METRICS, SupervisorObserver
+
+    reg = Registry()
+    SupervisorObserver().bind_registry(reg)
+    missing = {m.name for m in SUPERVISOR_METRICS} - set(reg._help)
     assert not missing, missing
 
 
@@ -431,3 +458,56 @@ def test_fleet_bridge_render_is_valid_exposition():
         f"{PREFIX}_fleet_queue_wait_seconds",
     ):
         _assert_histogram_sound(fam, families[fam])
+
+
+def test_supervisor_bridge_render_is_valid_exposition():
+    """Drive the supervisor bridge against a fake supervisor (no jax):
+    counters land as running-total deltas (re-polling unchanged totals
+    pushes nothing), the slots-by-state gauge emits every state, and
+    the restore-time histogram obeys the exposition rules."""
+    from tpu_device_plugin.metrics import PREFIX, Registry
+    from workloads.obs import SupervisorObserver
+
+    reg = Registry()
+    obs = SupervisorObserver(name="sup0")
+    obs.bind_registry(reg)
+    sup = SimpleNamespace(
+        slots=[
+            SimpleNamespace(state="serving"),
+            SimpleNamespace(state="backoff"),
+            SimpleNamespace(state="quarantined"),
+        ],
+        restarts_total=2, restart_failures=3, crash_loops=1,
+        health_deferrals=0, restore_s=[0.08, 1.4],
+    )
+    obs._bind(sup)
+    obs._supervisor_poll_end(sup)
+    obs._supervisor_poll_end(sup)  # unchanged totals push no deltas
+    families = _parse_exposition(reg.render())
+    assert families[
+        f"{PREFIX}_supervisor_restarts_total"
+    ]["samples"][0][2] == 2.0
+    assert families[
+        f"{PREFIX}_supervisor_restart_failures_total"
+    ]["samples"][0][2] == 3.0
+    assert families[
+        f"{PREFIX}_supervisor_crash_loops_total"
+    ]["samples"][0][2] == 1.0
+    slots = families[f"{PREFIX}_supervisor_slots"]["samples"]
+    assert {
+        (labels["state"], v) for _, labels, v in slots
+    } == {
+        ("serving", 1.0), ("backoff", 1.0), ("probing", 0.0),
+        ("quarantined", 1.0), ("forgotten", 0.0),
+    }
+    restore = f"{PREFIX}_supervisor_restore_seconds"
+    _assert_histogram_sound(restore, families[restore])
+    count = [
+        v for name, _, v in families[restore]["samples"]
+        if name.endswith("_count")
+    ]
+    assert count == [2.0]  # both restores observed exactly once
+    obs.unbind_registry()
+    assert f"{PREFIX}_supervisor_slots" not in _parse_exposition(
+        reg.render()
+    )

@@ -184,6 +184,15 @@ def test_perfbench_tiny_end_to_end():
         "failover_recovery_ms",
         "failover_recovery_ms_min",
         "failover_recovery_ms_max",
+        # Self-healing supervision arm (docs/SERVING.md).
+        "selfheal_restore_ms",
+        "selfheal_restore_ms_min",
+        "selfheal_restore_ms_max",
+        "selfheal_capacity_recovered",
+        "selfheal_goodput_retained",
+        "selfheal_crash_loops",
+        "replica_restore_cold_ms",
+        "replica_restore_warm_ms",
         # Observability overhead arm (docs/OBSERVABILITY.md).
         "obs_overhead_pct",
         "obs_on_tokens_per_sec",
@@ -212,6 +221,15 @@ def test_perfbench_tiny_end_to_end():
     assert out["fleet_tokens_per_sec"] > 0
     assert out["failover_recovery_ms"] > 0
     assert out["failover_requeued"] >= 1
+    # Self-healing: full capacity back, nothing shed under closed-loop
+    # load, the scripted crash loop quarantined, cold beats nothing —
+    # the warm respawn just has to be a real positive measurement.
+    assert out["selfheal_restore_ms"] > 0
+    assert out["selfheal_capacity_recovered"] == 1.0
+    assert out["selfheal_goodput_retained"] == 1.0
+    assert out["selfheal_crash_loops"] == 1
+    assert out["replica_restore_warm_ms"] > 0
+    assert out["replica_restore_cold_ms"] > 0
     assert out["spec_phase_dominant"] in ("draft", "verify", "commit")
     assert out["spec_breakeven_batch"] >= 0.0
     for b in out["spec_phase_batches"]:

@@ -492,3 +492,182 @@ def test_fleet_chaos_fuzz():
     adapters = synthetic_adapters(CONFIG, 2, rank=4, scale=0.3, seed=3)
     for seed in range(4):
         _run_fleet_chaos(seed, params, adapters)
+
+
+# ---- supervised (self-healing) fleet chaos arm ---------------------------
+#
+# The fleet chaos arm with the FleetSupervisor armed: randomized replica
+# crashes/hangs (and, on some seeds, scripted repeat-crash-on-restart
+# respawn schedules) interleaved with cancels/deadlines/health events.
+# The added contracts: the fleet CONVERGES BACK to full capacity without
+# operator help (every non-quarantined slot serving; a scripted crash
+# loop must instead quarantine its slot), resurrected replicas pass the
+# bit-identical half-open probe before rejoining, and all the fleet
+# invariants still hold — exactly one terminal status per rid, ok
+# streams bit-identical to the dense oracle, interrupted streams true
+# prefixes, no slot/page/commitment leak on any live replica.
+
+
+def _run_supervised_chaos(seed: int, params, adapters) -> None:
+    from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+    from tpu_device_plugin.device import HealthEvent
+    from workloads.backoff import Backoff
+    from workloads.errors import QueueFull
+    from workloads.faults import FaultInjector, crash_loop_schedule
+    from workloads.fleet import DEAD, Fleet
+    from workloads.supervisor import (
+        QUARANTINED,
+        FleetSupervisor,
+        make_engine_factory,
+    )
+
+    rng = np.random.default_rng(seed + 91000)
+    n = int(rng.integers(2, 5))
+    use_adapters = bool(rng.integers(2))
+    engine_kw = dict(
+        slots=int(rng.integers(1, 3)),
+        page_size=int(rng.choice([4, 8])),
+        prefix_cache=bool(rng.integers(2)),
+        pipelined=bool(rng.integers(2)),
+        adapters=adapters if use_adapters else None,
+    )
+    engine_kw["prompt_bucket"] = int(
+        engine_kw["page_size"] * rng.choice([2, 3])
+    )
+    fleet_inj = FaultInjector.random(
+        seed=seed, rate=0.03,
+        seams=("replica_crash", "replica_hang"),
+        # The injector can kill at most n-1 replicas in total, but the
+        # supervisor keeps resurrecting — live capacity recovers anyway.
+        max_fires=int(rng.integers(1, n)),
+    )
+    engines = [
+        ServeEngine(params, CONFIG, **engine_kw) for _ in range(n)
+    ]
+    fleet = Fleet(
+        engines, chip_ids=[f"chip-{i}" for i in range(n)],
+        fault_injector=fleet_inj, max_failovers=2,
+        hang_timeout_s=None,
+        max_pending_per_replica=int(rng.choice([3, 16])),
+    )
+    factory, oracle = make_engine_factory(
+        params, CONFIG, engine_kw=engine_kw, probe=([1, 2, 3], 4)
+    )
+    crash_loop = bool(rng.integers(2))
+    sup = FleetSupervisor(
+        fleet, factory,
+        backoff=Backoff(base_s=1e-3, max_s=8e-3, jitter=0.0),
+        probe=([1, 2, 3], 4), probe_oracle=oracle,
+        crash_loop_k=3, crash_loop_window_s=60.0,
+        fault_injector=(
+            FaultInjector(crash_loop_schedule(2)) if crash_loop else None
+        ),
+    )
+    names = [None] + (sorted(adapters) if use_adapters else [])
+    merged_cache: dict = {}
+
+    def model_for(adapter):
+        if adapter is None:
+            return params
+        if adapter not in merged_cache:
+            merged_cache[adapter] = merge_lora(
+                params, adapters[adapter], dtype=jnp.float32
+            )
+        return merged_cache[adapter]
+
+    pending_submits = []
+    for _ in range(int(rng.integers(5, 10))):
+        plen = int(rng.integers(1, 25))
+        prompt = [int(t) for t in rng.integers(0, CONFIG.vocab_size, plen)]
+        new = int(rng.integers(2, min(24, CONFIG.max_seq_len - plen) + 1))
+        adapter = names[int(rng.integers(len(names)))]
+        deadline = 0.05 if rng.integers(6) == 0 else None
+        pending_submits.append((prompt, new, adapter, deadline))
+    expected = {}
+    terminal: dict[str, str] = {}
+    steps = 0
+    while pending_submits or not fleet.idle:
+        steps += 1
+        assert steps < 1500, (seed, fleet.states(), "failed to converge")
+        for _ in range(min(len(pending_submits), int(rng.integers(1, 3)))):
+            prompt, new, adapter, deadline = pending_submits.pop()
+            sess = f"s{int(rng.integers(3))}" if rng.integers(2) else None
+            try:
+                rid = fleet.submit(
+                    prompt, new, adapter=adapter, deadline_s=deadline,
+                    session=sess,
+                )
+            except QueueFull:
+                continue  # capacity-aware shedding did its job
+            expected[rid] = (prompt, new, adapter)
+        live = [r for r in expected if r not in terminal]
+        if live and rng.integers(10) == 0:
+            fleet.cancel(str(rng.choice(live)))
+        if rng.integers(15) == 0:
+            alive = fleet.alive
+            if len(alive) > 1:
+                ev = HealthEvent(
+                    chip_id=alive[int(rng.integers(len(alive)))].chip_id,
+                    health=UNHEALTHY,
+                )
+                fleet.deliver_health([ev])
+                sup.note_health([ev])  # the supervisor honors the mark
+        if rng.integers(15) == 0:
+            ev = HealthEvent(chip_id="", health=HEALTHY)
+            fleet.deliver_health([ev])
+            sup.note_health([ev])
+        for fr in sup.step():
+            assert fr.rid not in terminal, (seed, fr.rid, "double terminal")
+            assert fr.status in TERMINAL, (seed, fr.rid, fr.status)
+            terminal[fr.rid] = fr.status
+    # Lift any lingering health marks so deferred resurrections can
+    # proceed, then the fleet must converge BACK to full capacity.
+    ev = HealthEvent(chip_id="", health=HEALTHY)
+    fleet.deliver_health([ev])
+    sup.note_health([ev])
+    fleet.step()
+    assert sup.wait_healed(30.0), (seed, sup.states(), fleet.states())
+    serving = sum(1 for s in sup.slots if s.state == "serving")
+    active = sum(1 for r in fleet.replicas if r.state == "active")
+    assert active >= serving, (seed, sup.states(), fleet.states())
+    if crash_loop and sup.crash_loops:
+        # A scripted crash loop that actually tripped must have
+        # quarantined its slot — quarantine IS the converged state.
+        assert sup.quarantined, (seed, sup.states())
+        for chip in sup.quarantined:
+            slot = sup.slot_for(chip)
+            assert slot.state == QUARANTINED and slot.index is None
+    assert set(terminal) == set(expected), (
+        seed, set(expected) ^ set(terminal),
+    )
+    for rid, (prompt, new, adapter) in expected.items():
+        fr = fleet._reqs[rid]
+        ref = [int(t) for t in np.asarray(generate(
+            model_for(adapter), jnp.asarray([prompt], jnp.int32), CONFIG,
+            max_new_tokens=new,
+        )[0])]
+        if terminal[rid] == "ok":
+            # Bit-identical through failovers AND resurrections.
+            assert fr.tokens == ref, (seed, rid, fr.failovers, fr.segments)
+        else:
+            assert fr.tokens == ref[: len(fr.tokens)], (
+                seed, rid, terminal[rid],
+            )
+    for rep in fleet.replicas:
+        if rep.state == DEAD:
+            continue
+        e = rep.engine
+        assert not e._occupied.any(), (seed, rep.index)
+        assert e._committed_pages == 0, (seed, rep.index)
+        assert not e._groups, (seed, rep.index)
+        pinned = e.prefix.cached_pages if e.prefix is not None else 0
+        assert e.ctrl.used_pages == pinned, (seed, rep.index)
+        assert not rep.rids, (seed, rep.index)
+    fleet.close()
+
+
+def test_supervised_fleet_chaos_fuzz():
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    adapters = synthetic_adapters(CONFIG, 2, rank=4, scale=0.3, seed=3)
+    for seed in range(3):
+        _run_supervised_chaos(seed, params, adapters)

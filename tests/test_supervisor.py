@@ -1,0 +1,549 @@
+"""Self-healing fleet contracts (workloads/supervisor.py +
+workloads/backoff.py): a FleetSupervisor watches the fleet's replica
+states and resurrects failed replicas on their chip slot.
+
+The pinned contracts: a crashed replica respawns WITHOUT operator
+intervention and the fleet returns to its pre-fault alive count, with
+ok streams bit-identical to the dense oracle through the failover; the
+half-open canary probe gates rejoin on bit-identity (a diverging
+replacement never rejoins); restart scheduling is exponential, capped,
+deterministic per (seed, slot) and escalates per consecutive failure;
+K failures inside the sliding window quarantine the slot (the
+replica_respawn repeat-crash schedules) until a manual clear(), which
+rejoins via the probe; live HealthFanout marks defer resurrection
+uncharged; capacity-aware admission sheds (typed QueueFull) while
+degraded and restores with capacity; supervisor counters mirror to the
+Prometheus bridge."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+from tpu_device_plugin.device import HealthEvent
+from workloads.backoff import Backoff
+from workloads.errors import QueueFull
+from workloads.faults import FaultInjector, crash_loop_schedule
+from workloads.fleet import DEAD, Fleet
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+from workloads.supervisor import (
+    BACKOFF,
+    QUARANTINED,
+    SERVING,
+    FleetSupervisor,
+    make_engine_factory,
+)
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+PARAMS = init_params(CONFIG, jax.random.PRNGKey(0))
+ENGINE_KW = dict(slots=2, page_size=4, prompt_bucket=8)
+PROBE = ([1, 2, 3], 4)
+
+# Tiny, jitter-free backoff so tests converge in milliseconds while the
+# schedule stays exactly predictable.
+FAST = Backoff(base_s=1e-3, factor=2.0, max_s=8e-3, jitter=0.0)
+
+
+def _engine(**kw):
+    base = dict(ENGINE_KW)
+    base.update(kw)
+    return ServeEngine(PARAMS, CONFIG, **base)
+
+
+def _fleet(n=2, **fleet_kw):
+    fleet_kw.setdefault("chip_ids", [f"chip-{i}" for i in range(n)])
+    fleet_kw.setdefault("hang_timeout_s", None)
+    return Fleet([_engine() for _ in range(n)], **fleet_kw)
+
+
+def _supervised(n=2, *, fleet_kw=None, **sup_kw):
+    fleet = _fleet(n, **(fleet_kw or {}))
+    factory, oracle = make_engine_factory(
+        PARAMS, CONFIG, engine_kw=ENGINE_KW, probe=PROBE
+    )
+    sup_kw.setdefault("backoff", FAST)
+    sup_kw.setdefault("probe", PROBE)
+    sup_kw.setdefault("probe_oracle", oracle)
+    return FleetSupervisor(fleet, factory, **sup_kw), fleet
+
+
+def _oracle(prompt, new):
+    return [int(t) for t in np.asarray(generate(
+        PARAMS, jnp.asarray([prompt], jnp.int32), CONFIG,
+        max_new_tokens=new,
+    )[0])]
+
+
+def _prompts(seed, n, new_lo=4, new_hi=12):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        plen = int(rng.integers(1, 20))
+        prompt = [int(t) for t in rng.integers(0, CONFIG.vocab_size, plen)]
+        out.append((prompt, int(rng.integers(new_lo, new_hi))))
+    return out
+
+
+def _assert_no_leaks(fleet):
+    for rep in fleet.replicas:
+        if rep.state == DEAD:
+            continue
+        e = rep.engine
+        assert not e._occupied.any(), rep.index
+        assert e._committed_pages == 0, rep.index
+        pinned = e.prefix.cached_pages if e.prefix is not None else 0
+        assert e.ctrl.used_pages == pinned, rep.index
+        assert not rep.rids, rep.index
+
+
+# ---- backoff policy ------------------------------------------------------
+
+
+def test_backoff_escalates_caps_and_jitters_deterministically():
+    b = Backoff(base_s=0.5, factor=2.0, max_s=4.0, jitter=0.0)
+    assert [b.delay(k) for k in range(5)] == [0.5, 1.0, 2.0, 4.0, 4.0]
+    j = Backoff(base_s=0.5, factor=2.0, max_s=4.0, jitter=0.2, seed=3)
+    # Jitter is additive within [0, jitter*delay], pure per (seed,
+    # attempt): same inputs, same delay; a different seed decorrelates.
+    for k in range(6):
+        d = j.delay(k)
+        base = b.delay(k)
+        assert base <= d <= base * 1.2, (k, d)
+        assert d == j.delay(k)
+    other = Backoff(base_s=0.5, factor=2.0, max_s=4.0, jitter=0.2, seed=4)
+    assert [j.delay(k) for k in range(6)] != [
+        other.delay(k) for k in range(6)
+    ]
+    # Huge attempts stay at the cap instead of overflowing.
+    assert b.delay(10_000) == 4.0
+    # Interruptible: a pre-set event returns immediately, flagged.
+    import threading
+
+    ev = threading.Event()
+    ev.set()
+    slow = Backoff(base_s=30.0, max_s=30.0, jitter=0.0)
+    t0 = time.monotonic()
+    assert slow.sleep(0, interrupt=ev) is True
+    assert time.monotonic() - t0 < 1.0
+    for bad in (
+        lambda: Backoff(base_s=0.0),
+        lambda: Backoff(factor=0.5),
+        lambda: Backoff(base_s=2.0, max_s=1.0),
+        lambda: Backoff(jitter=1.5),
+        lambda: Backoff().delay(-1),
+    ):
+        with pytest.raises(ValueError):
+            bad()
+
+
+# ---- resurrection --------------------------------------------------------
+
+
+def test_crash_resurrects_capacity_and_streams_bit_identical():
+    """The headline acceptance contract: a mid-stream replica crash
+    with the supervisor armed — the fleet returns to its pre-fault
+    alive count without operator intervention, ok streams stay
+    bit-identical to the dense oracle, restore time is recorded, and
+    the resurrected replica really serves."""
+    n = 2
+    sup, fleet = _supervised(
+        n, fleet_kw=dict(
+            fault_injector=FaultInjector({"replica_crash": 3}),
+        ),
+    )
+    reqs = _prompts(0, 6, new_lo=6)
+    rids = [fleet.submit(p, nw) for p, nw in reqs]
+    sup.run()
+    terminal = {fr.rid: fr.status for fr in fleet.completed}
+    assert fleet.replica_crashes == 1
+    assert sup.wait_healed(20.0), sup.states()
+    alive = [r for r in fleet.replicas if r.state == "active"]
+    assert len(alive) == n  # pre-fault capacity, no operator involved
+    assert sup.restarts_total == 1
+    assert len(sup.restore_ms) == 1 and sup.restore_ms[0] > 0
+    assert sup.states() == {"chip-0": SERVING, "chip-1": SERVING}
+    for rid, (p, nw) in zip(rids, reqs):
+        fr = fleet._reqs[rid]
+        ref = _oracle(p, nw)
+        if terminal.get(rid) == "ok":
+            assert fr.tokens == ref, rid
+        else:
+            assert fr.tokens == ref[: len(fr.tokens)], rid
+    # The respawned replica takes real traffic.
+    new_idx = sup.slot_for("chip-0").index
+    admitted0 = fleet.replicas[new_idx].engine.requests_admitted
+    more = _prompts(1, 4, new_lo=2)
+    rids2 = [fleet.submit(p, nw, session="pin") for p, nw in more]
+    sup.run()
+    assert sum(
+        r.engine.requests_admitted for r in fleet.replicas
+        if r.state != DEAD
+    ) > admitted0
+    for rid, (p, nw) in zip(rids2, more):
+        assert fleet._reqs[rid].tokens == _oracle(p, nw)
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_restart_backoff_escalates_per_failure_and_is_deterministic():
+    """Failed restarts push the next attempt out exponentially (capped)
+    on a schedule that replays exactly for the same (policy seed, chip
+    slot) — pinned with a fake clock and an always-failing factory."""
+    t = [0.0]
+
+    def run_schedule():
+        fleet = _fleet(2, fault_injector=FaultInjector(
+            {"replica_crash": 3}
+        ))
+        boom_factory = lambda slot: (_ for _ in ()).throw(  # noqa: E731
+            RuntimeError("no chip")
+        )
+        sup = FleetSupervisor(
+            fleet, boom_factory,
+            backoff=Backoff(base_s=1.0, factor=2.0, max_s=8.0,
+                            jitter=0.1, seed=5),
+            probe=PROBE, probe_oracle=[0],
+            crash_loop_k=99, crash_loop_window_s=1e9,
+            clock=lambda: t[0],
+        )
+        for p, nw in _prompts(2, 4):
+            fleet.submit(p, nw)
+        t[0] = 0.0
+        delays = []
+        while len(delays) < 5:
+            if not fleet.idle:
+                fleet.step()
+            sup.poll(now=t[0])
+            slot = sup.slot_for("chip-0")
+            if slot.state == BACKOFF and (
+                not delays or slot.next_due - t[0] != delays[-1]
+            ):
+                if slot.next_due > t[0]:
+                    delays.append(slot.next_due - t[0])
+                    t[0] = slot.next_due  # jump to the attempt
+        fleet.close()
+        return delays
+
+    first = run_schedule()
+    # Escalates ~2x per consecutive failure (jitter <= 10% never breaks
+    # monotonicity at factor 2) and hits the cap band.
+    for a, b in zip(first, first[1:-1]):
+        assert b > a, first
+    assert first[0] <= 1.1 and first[-1] >= 8.0, first
+    assert run_schedule() == first  # deterministic replay
+
+
+def test_probe_divergence_keeps_the_replacement_out():
+    """Half-open means half-open: a respawned engine whose canary
+    stream diverges from the oracle is discarded (a failed restart),
+    and only a bit-identical probe rejoins."""
+    sup, fleet = _supervised(
+        2, fleet_kw=dict(
+            fault_injector=FaultInjector({"replica_crash": 3}),
+        ),
+    )
+    bad_params = init_params(CONFIG, jax.random.PRNGKey(9))
+    good_factory = sup.engine_factory
+    sup.engine_factory = lambda slot: ServeEngine(
+        bad_params, CONFIG, **ENGINE_KW
+    )
+    for p, nw in _prompts(3, 4):
+        fleet.submit(p, nw)
+    sup.run()
+    deadline = time.monotonic() + 20
+    while sup.restart_failures == 0 and time.monotonic() < deadline:
+        sup.step()
+        time.sleep(0.002)
+    assert sup.restart_failures >= 1
+    assert sup.slot_for("chip-0").state != SERVING
+    assert "probe" in (sup.slot_for("chip-0").reason or "")
+    assert sum(1 for r in fleet.replicas if r.state == "active") == 1
+    # The good factory heals it — probe passes bit-identically.
+    sup.engine_factory = good_factory
+    assert sup.wait_healed(20.0), sup.states()
+    assert sup.restarts_total == 1
+    fleet.close()
+
+
+def test_crash_loop_quarantines_until_manual_clear_then_rejoins():
+    """The make selfheal-check story, pinned step by step: a scripted
+    repeat-crash-on-restart (replica_respawn schedule) trips the
+    sliding-window detector -> the slot QUARANTINES (no rejoin, no
+    further attempts) -> an operator clear() forgives it -> the
+    half-open probe rejoins the replica."""
+    sup, fleet = _supervised(
+        2,
+        fleet_kw=dict(fault_injector=FaultInjector({"replica_crash": 3})),
+        crash_loop_k=3, crash_loop_window_s=60.0,
+        fault_injector=FaultInjector(crash_loop_schedule(2)),
+    )
+    reqs = _prompts(4, 5, new_lo=6)
+    rids = [fleet.submit(p, nw) for p, nw in reqs]
+    sup.run()
+    deadline = time.monotonic() + 20
+    while (
+        sup.slot_for("chip-0").state != QUARANTINED
+        and time.monotonic() < deadline
+    ):
+        sup.step()
+        time.sleep(0.002)
+    slot = sup.slot_for("chip-0")
+    # Death + 2 respawn crashes = 3 window failures = quarantine.
+    assert slot.state == QUARANTINED, sup.states()
+    assert sup.crash_loops == 1
+    assert sup.restart_failures == 2
+    assert "crash loop" in slot.reason
+    assert sup.quarantined == ["chip-0"]
+    # Quarantined means OUT: no rejoin however long we step.
+    for _ in range(10):
+        sup.step()
+    assert sum(1 for r in fleet.replicas if r.state == "active") == 1
+    assert sup.restarts_total == 0
+    # Every request still finished ok on the survivor, oracle-true.
+    for rid, (p, nw) in zip(rids, reqs):
+        fr = fleet._reqs[rid]
+        if fr.status == "ok":
+            assert fr.tokens == _oracle(p, nw), rid
+    # Manual clear -> half-open probe -> rejoin (the respawn schedule
+    # is exhausted, so the next attempt survives).
+    sup.clear("chip-0")
+    assert sup.wait_healed(20.0), sup.states()
+    assert sup.restarts_total == 1
+    assert sup.states() == {"chip-0": SERVING, "chip-1": SERVING}
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_max_restarts_budget_exhaustion_quarantines():
+    sup, fleet = _supervised(
+        2,
+        fleet_kw=dict(
+            fault_injector=FaultInjector({"replica_crash": 3}),
+        ),
+        max_restarts=1,
+    )
+    for p, nw in _prompts(5, 6, new_lo=8):
+        fleet.submit(p, nw)
+    sup.run()
+    assert sup.wait_healed(20.0)
+    assert sup.restarts_total == 1  # first death: within budget
+    # The REPLACEMENT dies too (an escaped exception is a crash): the
+    # per-slot budget is spent, so the slot quarantines instead of
+    # burning restarts forever.
+    idx = sup.slot_for("chip-0").index
+
+    def boom():
+        raise RuntimeError("chip fell off the bus")
+
+    fleet.replicas[idx].engine.step = boom
+    fleet.submit([1, 2], 2)
+    sup.run()
+    for _ in range(5):
+        sup.step()
+    slot = sup.slot_for("chip-0")
+    assert slot.state == QUARANTINED, sup.states()
+    assert "budget" in slot.reason
+    assert sup.restarts_total == 1  # no second resurrection
+    fleet.close()
+
+
+def test_single_replica_fleet_parks_queue_through_resurrection():
+    """The all-dead edge: when the fleet's ONLY replica crashes
+    mid-stream with a supervisor armed, the queue PARKS for the
+    replacement (the revival seam) instead of failing terminally with
+    'no live replicas remain' — and the replayed stream is
+    bit-identical.  Without supervision the loud failure stays."""
+    sup, fleet = _supervised(
+        1, fleet_kw=dict(
+            fault_injector=FaultInjector({"replica_crash": 2}),
+        ),
+    )
+    reqs = _prompts(20, 3, new_lo=8, new_hi=12)
+    rids = [fleet.submit(p, nw) for p, nw in reqs]
+    deadline = time.monotonic() + 40
+    while (
+        any(not fleet._reqs[r].done for r in rids)
+        and time.monotonic() < deadline
+    ):
+        sup.step()
+        if sup._parked():
+            time.sleep(0.001)
+    assert fleet.replica_crashes == 1
+    assert sup.restarts_total == 1
+    for rid, (p, nw) in zip(rids, reqs):
+        fr = fleet._reqs[rid]
+        assert fr.status == "ok", (rid, fr.status, fr.error)
+        assert fr.tokens == _oracle(p, nw), rid
+    # A fleet-wide wipeout with NO revival pending still fails loudly.
+    fleet.revival_hook = None
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+# ---- health marks --------------------------------------------------------
+
+
+def test_health_mark_defers_resurrection_until_cleared():
+    """A chip carrying a HealthFanout Unhealthy mark gets no new
+    engine: resurrection defers (counted, not escalated) until the
+    mark lifts — a sick chip is not a place to put a fresh replica."""
+    sup, fleet = _supervised(
+        2, fleet_kw=dict(
+            fault_injector=FaultInjector({"replica_crash": 3}),
+        ),
+    )
+    sup.note_health([HealthEvent(chip_id="chip-0", health=UNHEALTHY)])
+    for p, nw in _prompts(7, 4):
+        fleet.submit(p, nw)
+    sup.run()
+    for _ in range(5):
+        sup.step()
+        time.sleep(0.003)
+    assert fleet.replica_crashes == 1
+    assert sup.restarts_total == 0
+    assert sup.health_deferrals >= 1
+    assert sup.slot_for("chip-0").state == BACKOFF  # deferred, not failed
+    assert sup.restart_failures == 0
+    # The all-clear lifts the mark; resurrection proceeds.
+    sup.note_health([HealthEvent(chip_id="", health=HEALTHY)])
+    assert sup.wait_healed(20.0), sup.states()
+    assert sup.restarts_total == 1
+    fleet.close()
+
+
+# ---- capacity-aware load shedding ---------------------------------------
+
+
+def test_capacity_aware_bound_sheds_while_degraded_and_recovers():
+    """With max_pending_per_replica the fleet-wide admission bound
+    tracks the ACTIVE replica count: full fleet 2x2=4, degraded 1x2=2
+    (typed QueueFull sheds the overflow), healed back to 4."""
+    sup, fleet = _supervised(
+        2,
+        fleet_kw=dict(
+            fault_injector=FaultInjector({"replica_crash": 3}),
+            max_pending_per_replica=2,
+        ),
+        backoff=Backoff(base_s=5.0, max_s=5.0, jitter=0.0),  # stay down
+    )
+    assert fleet.admission_bound == 4
+    for p, nw in _prompts(8, 4, new_lo=6):
+        fleet.submit(p, nw)
+    sup.run()  # the crash fires mid-run; requests finish on survivors
+    assert fleet.replica_crashes == 1
+    assert fleet.admission_bound == 2  # scaled down with capacity
+    fleet.submit([1, 2], 4)
+    fleet.submit([3, 4], 4)
+    with pytest.raises(QueueFull) as exc:
+        fleet.submit([5, 6], 4)
+    assert "capacity-aware" in str(exc.value)
+    assert fleet.queue_rejections == 1
+    # Heal now (collapse the deliberate backoff) -> bound restored.
+    sup.slot_for("chip-0").next_due = 0.0
+    assert sup.wait_healed(20.0), sup.states()
+    assert fleet.admission_bound == 4
+    fleet.submit([5, 6], 4)  # fits again
+    sup.run()
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_static_max_pending_converts_to_capacity_aware_on_arming():
+    fleet = _fleet(2, max_pending=8)
+    factory, oracle = make_engine_factory(
+        PARAMS, CONFIG, engine_kw=ENGINE_KW, probe=PROBE
+    )
+    FleetSupervisor(
+        fleet, factory, backoff=FAST, probe=PROBE, probe_oracle=oracle
+    )
+    assert fleet.max_pending is None
+    assert fleet.max_pending_per_replica == 4
+    assert fleet.admission_bound == 8  # unchanged at full capacity
+    fleet.close()
+
+
+# ---- membership / operator surface --------------------------------------
+
+
+def test_adopt_forget_and_observer_counters():
+    from tpu_device_plugin.metrics import PREFIX, Registry
+    from workloads.obs import SupervisorObserver
+
+    reg = Registry()
+    obs = SupervisorObserver(name="t")
+    obs.bind_registry(reg)
+    sup, fleet = _supervised(
+        2, fleet_kw=dict(
+            # With 3 replicas stepping in index order, crossing 2 is
+            # step 1 replica 1 (chip-1) and crossing 4 is step 2
+            # replica 2 (chip-2): the forgotten chip and the adopted
+            # chip both die.
+            fault_injector=FaultInjector({"replica_crash": [2, 4]}),
+        ),
+        observer=obs,
+    )
+    # A third replica joins live; adopt() brings it under supervision,
+    # forget() stands down for chip-1 (its death then stays dead).
+    idx = fleet.add_replica(_engine(), chip_id="chip-2")
+    sup.adopt("chip-2", idx)
+    sup.forget("chip-1")
+    for p, nw in _prompts(9, 6, new_lo=6):
+        fleet.submit(p, nw)
+    sup.run()  # both scheduled crashes fire (chip-1 and chip-2 die)
+    assert sup.wait_healed(20.0), sup.states()
+    assert sup.slot_for("chip-0").state == SERVING  # never died
+    assert sup.slot_for("chip-1").state == "forgotten"  # stayed down
+    assert sup.slot_for("chip-2").state == SERVING  # adopted + healed
+    assert sup.restarts_total == 1
+    text = reg.render()
+    assert f"{PREFIX}_supervisor_restarts_total" in text
+    assert 'state="serving",supervisor="t"} 2' in text
+    assert f"{PREFIX}_supervisor_restore_seconds_count" in text
+    obs.unbind_registry()
+    fleet.close()
+
+
+# ---- the make selfheal-check smoke --------------------------------------
+
+
+def test_selfheal_smoke():
+    """ONE seeded supervisor chaos round — the `make selfheal-check`
+    tripwire: scripted crash -> resurrection; scripted crash-loop ->
+    quarantine -> manual clear -> probed rejoin; streams oracle-true
+    throughout, no leaks, full capacity at the end."""
+    sup, fleet = _supervised(
+        2,
+        fleet_kw=dict(fault_injector=FaultInjector({"replica_crash": 3})),
+        crash_loop_k=3, crash_loop_window_s=60.0,
+        fault_injector=FaultInjector(crash_loop_schedule(2)),
+    )
+    reqs = _prompts(11, 6, new_lo=6)
+    rids = [fleet.submit(p, nw) for p, nw in reqs]
+    sup.run()
+    deadline = time.monotonic() + 30
+    while (
+        sup.slot_for("chip-0").state != QUARANTINED
+        and time.monotonic() < deadline
+    ):
+        sup.step()
+        time.sleep(0.002)
+    assert sup.slot_for("chip-0").state == QUARANTINED
+    assert sup.crash_loops == 1
+    sup.clear("chip-0")
+    assert sup.wait_healed(30.0), sup.states()
+    assert sup.restarts_total == 1
+    assert sum(1 for r in fleet.replicas if r.state == "active") == 2
+    for rid, (p, nw) in zip(rids, reqs):
+        fr = fleet._reqs[rid]
+        ref = _oracle(p, nw)
+        if fr.status == "ok":
+            assert fr.tokens == ref, rid
+        else:
+            assert fr.tokens == ref[: len(fr.tokens)], rid
+    _assert_no_leaks(fleet)
+    fleet.close()

@@ -48,6 +48,10 @@ TRACKED_UP = [
     "spec_serve_lookahead_tokens_per_sec",
     "spec_engine_vs_plain_b1",
     "fleet_tokens_per_sec",
+    # Self-healing: the fraction of pre-fault alive capacity the
+    # supervisor restores without operator intervention (1.0 = every
+    # non-quarantined slot rejoined) — a drop means resurrection broke.
+    "selfheal_capacity_recovered",
     "aggregate_chip_busy_fraction",
     "aggregate_tokens_per_sec",
 ]
@@ -67,6 +71,9 @@ TRACKED_DOWN = [
     # (the robustness number the fleet PR exists for).
     "fleet_ttft_p99_ms",
     "failover_recovery_ms",
+    # Self-healing: replica death -> probed replacement rejoined the
+    # router (crash included; the supervisor PR's robustness number).
+    "selfheal_restore_ms",
 ]
 
 # The serving keys whose thresholds derive from the artifact's own
@@ -74,6 +81,7 @@ TRACKED_DOWN = [
 SPREAD_GUARDED = set(TRACKED_DOWN) | {
     "serve_tokens_per_sec",
     "fleet_tokens_per_sec",
+    "selfheal_capacity_recovered",
 }
 
 

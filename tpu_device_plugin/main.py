@@ -19,6 +19,8 @@ import threading
 import time
 from dataclasses import dataclass
 
+from workloads.backoff import Backoff
+
 from . import __version__, config as config_mod, sharing
 from .api import constants
 from .backend import BackendInitError, ChipManager
@@ -37,7 +39,25 @@ from .watchers import (
 log = logging.getLogger("tpu-device-plugin")
 
 TERMINAL_SIGNALS = {signal.SIGINT, signal.SIGTERM, signal.SIGQUIT}
-RESTART_BACKOFF_SECS = 5.0
+# Plugin-(re)start retry escalation.  The reference retries on a flat
+# 5 s timer (main.go:264-280); a permanently-broken kubelet socket then
+# gets hammered at a fixed cadence forever.  Consecutive start failures
+# now escalate exponentially to a 60 s cap and reset the moment every
+# plugin starts — the same shared policy the fleet supervisor uses for
+# replica resurrection.  The jitter seed is derived PER DAEMON INSTANCE
+# (hostname + pid, _instance_backoff below): after a cluster-wide
+# kubelet outage, every node retrying at bit-identical offsets would be
+# exactly the synchronized storm the jitter exists to prevent.
+RESTART_BACKOFF = Backoff(base_s=1.0, factor=2.0, max_s=60.0, jitter=0.1)
+
+
+def _instance_backoff(policy: Backoff = RESTART_BACKOFF) -> Backoff:
+    """The module policy re-seeded for THIS daemon instance, so
+    jittered retry schedules decorrelate across a fleet of nodes."""
+    import os
+    import socket
+
+    return policy.derive(f"{socket.gethostname()}:{os.getpid()}")
 
 
 @dataclass(frozen=True)
@@ -81,6 +101,9 @@ class Daemon:
         self.kubelet_socket = self.plugin_dir.rstrip("/") + "/kubelet.sock"
         self.plugins = []
         self.started = threading.Event()  # set once plugins serve
+        # Swappable (tests inject a jitter-free policy); instance-seeded
+        # so a fleet of daemons never retries in lockstep.
+        self.restart_backoff = _instance_backoff()
 
     def request_stop(self) -> None:
         self.events.put(SignalEvent(signum=signal.SIGTERM))
@@ -195,6 +218,7 @@ class Daemon:
     # ------------------------------------------------------------------ loops
 
     def _restart_loop(self, resource_config) -> int:
+        start_failures = 0  # consecutive; resets on a successful start
         while True:
             self._stop_plugins()
             strategy = new_topology_strategy(
@@ -216,20 +240,28 @@ class Daemon:
                 try:
                     plugin.start()
                 except Exception as e:
+                    delay = self.restart_backoff.delay(start_failures)
                     log.error(
-                        "failed to start plugin for %s: %s; retrying in %gs",
+                        "failed to start plugin for %s: %s; retrying in "
+                        "%.1fs (consecutive failure %d)",
                         plugin.resource_name,
                         e,
-                        RESTART_BACKOFF_SECS,
+                        delay,
+                        start_failures + 1,
                     )
                     ok = False
                     break
             if not ok:
                 # Retry everything, like the reference's plugin-start-error →
-                # restart path (main.go:264-280), with a small backoff.
-                if self._sleep_interruptible(RESTART_BACKOFF_SECS):
+                # restart path (main.go:264-280) — but with ESCALATING
+                # capped backoff instead of its flat timer, so a
+                # permanently-broken kubelet socket is probed ever more
+                # gently instead of hammered every 5 s forever.
+                if self._sleep_interruptible(delay):
                     return 0
+                start_failures += 1
                 continue
+            start_failures = 0
             if not self.plugins:
                 log.warning("no resources to serve on this node")
             self.started.set()

@@ -37,12 +37,22 @@ fault domain):
   * ``replica_slow``  — a degraded link/readback: the step pays
     injected latency instead of dying; consecutive slow steps drive
     the router's auto-drain.
+  * ``replica_respawn`` — crossed by the SUPERVISOR
+    (``workloads/supervisor.py``) once per resurrection attempt,
+    before the replacement engine is built: a fault here means the
+    respawn dies on arrival (a bad chip slot, a wedged runtime — no
+    engine is ever constructed for that attempt).
+    Scheduling consecutive crossings (``crash_loop_schedule`` below)
+    is the repeat-crash-on-restart scenario the crash-loop detector
+    quarantines.
 
 Two scheduling modes, both deterministic:
 
   * Explicit: ``FaultInjector({"decode_dispatch": [3]})`` raises
     ``InjectedFault`` on the 3rd crossing of that seam (1-based), and
-    never again.
+    never again.  A crossing spec may be any iterable of ints —
+    ``range(1, 6)`` schedules five consecutive crossings, the
+    repeat-crash shape ``crash_loop_schedule`` packages.
   * Seeded random: ``FaultInjector.random(seed=7, rate=0.05)`` draws an
     independent Bernoulli per crossing from ``random.Random(seed)`` —
     the same seed over the same crossing sequence fires identically,
@@ -74,14 +84,32 @@ ENGINE_SEAMS = (
 )
 
 # Replica-level seams (the Fleet's failover machinery recovers from
-# these ACROSS fault domains; see module docstring).
+# these ACROSS fault domains; ``replica_respawn`` is the supervisor's
+# resurrection seam — see module docstring).
 REPLICA_SEAMS = (
     "replica_crash",
     "replica_hang",
     "replica_slow",
+    "replica_respawn",
 )
 
 SEAMS = ENGINE_SEAMS + REPLICA_SEAMS
+
+
+def crash_loop_schedule(
+    k: int, *, seam: str = "replica_respawn", first: int = 1,
+) -> dict[str, list[int]]:
+    """The repeat-crash-on-restart schedule: ``k`` CONSECUTIVE crossings
+    of ``seam`` starting at crossing ``first`` (1-based) — every
+    resurrection attempt in the window dies on arrival, which is
+    exactly the pattern a supervisor's crash-loop detector exists to
+    quarantine.  Returns a plain schedule dict, mergeable via
+    ``FaultInjector.arm``."""
+    if k < 1:
+        raise ValueError(f"a crash loop needs k >= 1 crashes, got {k}")
+    if first < 1:
+        raise ValueError(f"crossings are 1-based, got first={first}")
+    return {seam: list(range(first, first + k))}
 
 
 def _validate_schedule(
@@ -267,6 +295,30 @@ def self_check(verbose: bool = True) -> int:
     except InjectedFault:
         pass
 
+    # The supervisor's repeat-crash-on-restart shape: k consecutive
+    # respawn crossings fire, the (k+1)th succeeds — the half-open
+    # probe after a quarantine clear rides exactly that crossing.
+    loop = FaultInjector(crash_loop_schedule(3))
+    fired = 0
+    for _ in range(5):
+        try:
+            loop.check("replica_respawn")
+        except InjectedFault as e:
+            assert e.seam == "replica_respawn"
+            fired += 1
+    assert fired == 3, fired
+    offset = crash_loop_schedule(2, first=4)
+    assert offset == {"replica_respawn": [4, 5]}, offset
+    for bad_loop in (
+        lambda: crash_loop_schedule(0),
+        lambda: crash_loop_schedule(1, first=0),
+    ):
+        try:
+            bad_loop()
+            raise AssertionError("bad crash_loop_schedule was accepted")
+        except ValueError:
+            pass
+
     # Seeded randomness replays bit-identically, and reset() replays it.
     def drive(injector, n=200):
         out = []
@@ -312,8 +364,9 @@ def self_check(verbose: bool = True) -> int:
             if isinstance(e, AssertionError):
                 raise
     if verbose:
-        print("faults selfcheck OK: schedule, replica seams, seeded "
-              "replay, reset, max_fires, inert, validation")
+        print("faults selfcheck OK: schedule, replica seams, crash-loop "
+              "schedules, seeded replay, reset, max_fires, inert, "
+              "validation")
     return 0
 
 

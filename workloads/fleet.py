@@ -272,6 +272,7 @@ class Fleet:
         router: Router | None = None,
         chip_ids: list[str] | None = None,
         max_pending: int | None = None,
+        max_pending_per_replica: int | None = None,
         max_failovers: int = 2,
         fault_injector=None,
         hang_timeout_s: float | None = 5.0,
@@ -286,6 +287,17 @@ class Fleet:
             raise ValueError(
                 f"max_pending must be >= 1 or None (unbounded), got "
                 f"{max_pending}"
+            )
+        if max_pending_per_replica is not None and max_pending_per_replica <= 0:
+            raise ValueError(
+                f"max_pending_per_replica must be > 0 or None (fractions "
+                f"allowed: the bound is ceil(per * active)), got "
+                f"{max_pending_per_replica}"
+            )
+        if max_pending is not None and max_pending_per_replica is not None:
+            raise ValueError(
+                "pass max_pending (static fleet-wide bound) OR "
+                "max_pending_per_replica (capacity-aware bound), not both"
             )
         if max_failovers < 0:
             raise ValueError(
@@ -302,6 +314,13 @@ class Fleet:
             for i, eng in enumerate(engines)
         ]
         self.max_pending = max_pending
+        # Capacity-aware load shedding: with ``max_pending_per_replica``
+        # the fleet-wide admission bound is per-replica budget x the
+        # CURRENT number of replicas the router can dispatch to, so a
+        # degraded fleet sheds (typed QueueFull) instead of queueing
+        # work its surviving capacity cannot absorb — and the bound
+        # grows back the moment the supervisor resurrects a replica.
+        self.max_pending_per_replica = max_pending_per_replica
         self.max_failovers = max_failovers
         self._faults = fault_injector
         if hang_timeout_s is not None and hang_timeout_s <= 0:
@@ -326,6 +345,14 @@ class Fleet:
         self._lock = threading.RLock()
         self._health_fanout = None
         self._health_sub = None
+        # Supervision seam (workloads/supervisor.py): when set, a
+        # zero-live-replica fleet consults it before failing its queue —
+        # True means a resurrection is pending and the queue PARKS for
+        # the replacement instead of failing terminally.  Validation
+        # needs a config even while every engine is down, so it is
+        # cached from the founding member.
+        self.revival_hook = None
+        self._config_cache = engines[0].config
         # Telemetry: the fleet-level mirror of the engines' lifecycle
         # counters, plus the router/failover economics the bench reads.
         self.requests_submitted = 0
@@ -381,10 +408,46 @@ class Fleet:
     def states(self) -> dict[int, str]:
         return {r.index: r.state for r in self.replicas}
 
+    @property
+    def admission_bound(self) -> int | None:
+        """The fleet queue's CURRENT admission bound: the static
+        ``max_pending`` when set, the capacity-scaled
+        ``max_pending_per_replica x max(1, active replicas)`` when that
+        knob is set (never zero — a fully-degraded fleet still queues
+        one replica's worth while recovery runs), else None
+        (unbounded)."""
+        if self.max_pending is not None:
+            return self.max_pending
+        if self.max_pending_per_replica is not None:
+            import math
+
+            active = sum(1 for r in self.replicas if r.state == ACTIVE)
+            # ceil of the exact product: a fractional per-replica
+            # budget (the supervisor's max_pending/n conversion) yields
+            # the operator's EXACT bound at full capacity instead of a
+            # rounded-up one.
+            return max(1, math.ceil(
+                self.max_pending_per_replica * max(1, active)
+            ))
+        return None
+
+    def _revival_pending(self) -> bool:
+        hook = self.revival_hook
+        if hook is None:
+            return False
+        try:
+            return bool(hook())
+        except Exception:  # noqa: BLE001 — a broken hook must not wedge
+            return False  # the fleet's own failure handling
+
     def _config(self):
         for rep in self.replicas:
             if rep.state != DEAD:
                 return rep.engine.config
+        if self._revival_pending():
+            # Every replica is down but a supervisor is bringing one
+            # back: keep accepting (bounded) work for the replacement.
+            return self._config_cache
         raise EngineClosed("every replica in the fleet is dead")
 
     # ---- submission ------------------------------------------------------
@@ -433,14 +496,16 @@ class Fleet:
                 raise InvalidRequest(
                     f"deadline_s must be > 0 (or None), got {deadline_s}"
                 )
-            if (
-                self.max_pending is not None
-                and len(self.queue) >= self.max_pending
-            ):
+            bound = self.admission_bound
+            if bound is not None and len(self.queue) >= bound:
                 self.queue_rejections += 1
+                scaled = (
+                    " (capacity-aware: scaled to the alive replica "
+                    "count)" if self.max_pending is None else ""
+                )
                 raise QueueFull(
                     f"fleet queue is full ({len(self.queue)} >= "
-                    f"max_pending {self.max_pending}); resubmit after "
+                    f"max_pending {bound}{scaled}); resubmit after "
                     "completions drain it"
                 )
             rid = rid if rid is not None else f"fleet-{next(self._ids)}"
@@ -993,8 +1058,11 @@ class Fleet:
                     continue
                 finished.extend(self._step_replica(rep))
             # A fleet with zero live replicas left cannot serve its
-            # queue — fail it loudly rather than spin forever.
-            if self.queue and not self.alive:
+            # queue — fail it loudly rather than spin forever, UNLESS a
+            # supervisor reports a resurrection in flight (the queue
+            # then parks for the replacement; deadlines/cancels still
+            # apply while it waits).
+            if self.queue and not self.alive and not self._revival_pending():
                 while self.queue:
                     fr = self.queue.popleft()
                     if not fr.done:
@@ -1245,10 +1313,17 @@ class FleetServer:
     back on ``.port``) and spins the fleet's driver thread; handlers
     only submit/poll under the fleet lock."""
 
-    def __init__(self, fleet: Fleet, port: int = 0, poll_s: float = 0.002):
+    def __init__(
+        self, fleet: Fleet, port: int = 0, poll_s: float = 0.002,
+        supervisor=None,
+    ):
         self.fleet = fleet
         self.port = port
         self.poll_s = poll_s
+        # Optional FleetSupervisor (workloads/supervisor.py): the driver
+        # thread then runs the SUPERVISED loop (heal pass per step) and
+        # /healthz reports per-chip-slot supervision states.
+        self.supervisor = supervisor
         self._httpd = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -1257,6 +1332,7 @@ class FleetServer:
         import http.server
 
         fleet, poll_s, stop = self.fleet, self.poll_s, self._stop
+        supervisor = self.supervisor
 
         class Handler(http.server.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -1273,7 +1349,7 @@ class FleetServer:
                 if self.path != "/healthz":
                     self.send_error(404)
                     return
-                self._json(200, {
+                health = {
                     "ok": not fleet.closed,
                     "replicas": {
                         str(r.index): {
@@ -1284,7 +1360,10 @@ class FleetServer:
                         for r in fleet.replicas
                     },
                     "queue_depth": fleet.queue_depth,
-                })
+                }
+                if supervisor is not None:
+                    health["supervisor"] = supervisor.states()
+                self._json(200, health)
 
             def do_POST(self):  # noqa: N802
                 if self.path != "/v1/generate":
@@ -1348,10 +1427,13 @@ class FleetServer:
             ("", self.port), Handler
         )
         self.port = self._httpd.server_address[1]
+        driver = (
+            self.supervisor.serve_forever if self.supervisor is not None
+            else self.fleet.serve_forever
+        )
         for name, target in (
             ("fleet-http", self._httpd.serve_forever),
-            ("fleet-driver",
-             lambda: self.fleet.serve_forever(self._stop)),
+            ("fleet-driver", lambda: driver(self._stop)),
         ):
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()

@@ -227,6 +227,45 @@ FLEET_METRICS: tuple[MetricSpec, ...] = (
     ),
 )
 
+# Supervisor-level metric families (workloads/supervisor.py;
+# SupervisorObserver below).  Same three-consumer contract as
+# ENGINE_METRICS / FLEET_METRICS: bind_registry, the lint test, and the
+# rendered docs/OBSERVABILITY.md catalog all read this spec.
+SUPERVISOR_METRICS: tuple[MetricSpec, ...] = (
+    MetricSpec(
+        "supervisor_restarts_total", "counter", ("supervisor",),
+        "replicas resurrected onto their chip slot (half-open canary "
+        "probe passed bit-identically, replica rejoined the router)",
+    ),
+    MetricSpec(
+        "supervisor_restart_failures_total", "counter", ("supervisor",),
+        "failed resurrection attempts (respawn seam fault, engine "
+        "factory error, or half-open probe divergence) — each feeds "
+        "the crash-loop window and escalates the slot's backoff",
+    ),
+    MetricSpec(
+        "supervisor_crash_loops_total", "counter", ("supervisor",),
+        "crash-loop verdicts: crash_loop_k failures inside the sliding "
+        "window (or a max_restarts budget exhausted) quarantined the "
+        "chip slot until an operator clear()",
+    ),
+    MetricSpec(
+        "supervisor_health_deferrals_total", "counter", ("supervisor",),
+        "resurrections deferred because the chip slot carried a live "
+        "HealthFanout Unhealthy mark (honored, not escalated)",
+    ),
+    MetricSpec(
+        "supervisor_slots", "gauge", ("supervisor", "state"),
+        "supervised chip slots by state (serving / backoff / probing / "
+        "quarantined / forgotten; scrape-time)",
+    ),
+    MetricSpec(
+        "supervisor_restore_seconds", "histogram", ("supervisor",),
+        "replica death detection -> probed replacement rejoined the "
+        "router (the bench's selfheal_restore_ms window)",
+    ),
+)
+
 
 @dataclass
 class RequestSpan:
@@ -749,6 +788,103 @@ class FleetObserver:
                 reg.observe_seconds("fleet_ttft", fr.ttft_secs, labels)
             if fr.e2e_secs is not None:
                 reg.observe_seconds("fleet_e2e", fr.e2e_secs, labels)
+
+
+class SupervisorObserver:
+    """Supervisor-level Prometheus bridge (workloads/supervisor.py):
+    restart / crash-loop / quarantine counters, a slots-by-state
+    scrape gauge and the restore-time histogram, NEXT TO the fleet and
+    per-replica engine series on one shared registry.
+
+    Same discipline as the other bridges: inert (host counters only,
+    never scheduling state), jax-free, counters pushed as deltas
+    against the supervisor's running totals at each ``poll()``."""
+
+    def __init__(self, *, name: str = "0"):
+        self.name = name
+        self._registry = None
+        self._labels: dict = {}
+        self._supervisor = None
+        self._pushed: dict[str, float] = {}
+        self._restores_pushed = 0
+
+    # Scrape-time readers; ``e`` is the bound FleetSupervisor (the
+    # lint's reader-regex contract shared with the other bridges).
+    _SUPERVISOR_GAUGE_READERS = {
+        "supervisor_slots": lambda e: [
+            ({"state": state}, float(
+                sum(1 for s in e.slots if s.state == state)
+            ))
+            for state in (
+                "serving", "backoff", "probing", "quarantined",
+                "forgotten",
+            )
+        ],
+    }
+
+    # Counter family -> FleetSupervisor attribute with the running total.
+    _SUPERVISOR_COUNTERS = {
+        "supervisor_restarts_total": "restarts_total",
+        "supervisor_restart_failures_total": "restart_failures",
+        "supervisor_crash_loops_total": "crash_loops",
+        "supervisor_health_deferrals_total": "health_deferrals",
+    }
+
+    def bind_registry(self, reg, labels: dict | None = None) -> None:
+        self._registry = reg
+        self._labels = dict(labels or {})
+        self._labels.setdefault("supervisor", self.name)
+        for m in SUPERVISOR_METRICS:
+            if m.type == "histogram":
+                reg.describe(m.name, m.help, buckets=SERVE_SECONDS_BUCKETS)
+            else:
+                reg.describe(m.name, m.help)
+        for name, reader in self._SUPERVISOR_GAUGE_READERS.items():
+            reg.register_gauge(
+                name, lambda reader=reader: self._gauge(reader),
+                key=f"supervisor:{self.name}",
+            )
+
+    def unbind_registry(self) -> None:
+        reg, self._registry = self._registry, None
+        if reg is None:
+            return
+        for name in self._SUPERVISOR_GAUGE_READERS:
+            reg.unregister_gauge(name, key=f"supervisor:{self.name}")
+        self._supervisor = None
+
+    def _gauge(self, value_fn) -> list[tuple[dict, float]]:
+        sup = self._supervisor
+        if sup is None:
+            return []
+        try:
+            return [
+                ({**self._labels, **labels}, float(v))
+                for labels, v in value_fn(sup)
+            ]
+        except Exception:
+            return []  # a gauge must never fail a scrape mid-teardown
+
+    # ---- supervisor-facing hooks ----------------------------------------
+
+    def _bind(self, supervisor) -> None:
+        self._supervisor = supervisor
+
+    def _supervisor_poll_end(self, supervisor) -> None:
+        reg = self._registry
+        if reg is None:
+            return
+        labels = self._labels
+        for metric, attr in self._SUPERVISOR_COUNTERS.items():
+            total = float(getattr(supervisor, attr, 0))
+            delta = total - self._pushed.get(metric, 0.0)
+            if delta:
+                reg.inc(metric, labels, delta)
+                self._pushed[metric] = total
+        fresh = supervisor.restore_s[self._restores_pushed:]
+        for secs in fresh:
+            reg.observe_seconds("supervisor_restore", secs, labels)
+        self._restores_pushed += len(fresh)
 
 
 def _us(t: float, t0: float) -> float:

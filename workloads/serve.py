@@ -2710,15 +2710,17 @@ def serve_batch(
 
 def _run_fleet_cli(
     args, parser, params, config, page_size, bucket, adapters, names,
-    spec_kw, observer, metrics_server, schedule,
+    spec_kw, observer, metrics_server, schedule, replica_schedules=None,
 ) -> int:
     """The ``--fleet N`` serve path: N replicas behind the router, a
     seeded open-loop bursty traffic stream (optionally pushed through
-    the HTTP/SSE front end), replica fault injection, and a lifecycle
-    summary."""
+    the HTTP/SSE front end), replica fault injection (per-replica
+    targeting via ``SEAM@REPLICA:N``), optional self-healing
+    supervision (``--supervise``), and a lifecycle summary."""
     from .faults import ENGINE_SEAMS, FaultInjector, REPLICA_SEAMS
     from .fleet import Fleet, FleetServer, TrafficGen, drive_open_loop
 
+    replica_schedules = dict(replica_schedules or {})
     fleet_schedule = {
         s: n for s, n in schedule.items() if s in REPLICA_SEAMS
     }
@@ -2730,8 +2732,42 @@ def _run_fleet_cli(
             f"unknown seams in --inject-fault: "
             f"{sorted(set(schedule) - set(fleet_schedule) - set(engine_schedule))}"
         )
+    # The supervisor's resurrection seam: consulted once per respawn
+    # attempt by FleetSupervisor, not by the fleet's step loop.
+    respawn_schedule = {
+        s: n for s, n in fleet_schedule.items() if s == "replica_respawn"
+    }
+    fleet_schedule = {
+        s: n for s, n in fleet_schedule.items() if s != "replica_respawn"
+    }
+    if respawn_schedule and not args.supervise:
+        parser.error(
+            "--inject-fault replica_respawn:N schedules supervised "
+            "resurrection crashes; it needs --supervise"
+        )
+    # SEAM@REPLICA:N targeting: engine seams only (replica seams are
+    # fleet-level, scheduled by crossing), and the target must exist.
+    for target, sched in replica_schedules.items():
+        if not 0 <= target < args.fleet:
+            parser.error(
+                f"--inject-fault targets replica {target}, but --fleet "
+                f"has replicas 0..{args.fleet - 1}"
+            )
+        for seam in sched:
+            if seam not in ENGINE_SEAMS:
+                parser.error(
+                    f"--inject-fault {seam}@{target}: only engine seams "
+                    f"({', '.join(ENGINE_SEAMS)}) can target a replica; "
+                    "replica seams are fleet-level crossings"
+                )
+    # Back-compat: untargeted engine seams land on replica 0.
+    if engine_schedule:
+        merged = replica_schedules.setdefault(0, {})
+        for seam, hits in engine_schedule.items():
+            merged.setdefault(seam, []).extend(hits)
     observers = [None] * args.fleet
     fleet_obs = None
+    sup_obs = None
     if args.metrics_port is not None or args.trace_out:
         from .obs import EngineObserver, FleetObserver
 
@@ -2746,6 +2782,11 @@ def _run_fleet_cli(
             for obs in observers:
                 obs.bind_registry(registry)
             fleet_obs.bind_registry(registry)
+            if args.supervise:
+                from .obs import SupervisorObserver
+
+                sup_obs = SupervisorObserver()
+                sup_obs.bind_registry(registry)
     engines = []
     for i in range(args.fleet):
         engines.append(ServeEngine(
@@ -2756,8 +2797,8 @@ def _run_fleet_cli(
             prefill_budget=args.prefill_budget, adapters=adapters,
             observer=observers[i],
             fault_injector=(
-                FaultInjector(engine_schedule)
-                if i == 0 and engine_schedule else None
+                FaultInjector(replica_schedules[i])
+                if replica_schedules.get(i) else None
             ),
             max_retries=args.max_retries,
             retry_backoff_s=args.retry_backoff_s, **spec_kw,
@@ -2778,6 +2819,50 @@ def _run_fleet_cli(
     for i in range(args.fleet):
         fleet.submit([1 + i], 1, session=f"warm-{i}")
     fleet.run()
+    supervisor = None
+    if args.supervise:
+        from .backoff import Backoff
+        from .supervisor import FleetSupervisor
+
+        def respawn_factory(slot):
+            # Respawns share the fleet's weights and in-process compile
+            # caches (warm restart) under a FIXED rng, so every
+            # respawn's canary stream is deterministic — the half-open
+            # probe's bit-identity check needs exactly that.
+            return ServeEngine(
+                params, config, slots=args.slots, page_size=page_size,
+                prompt_bucket=bucket, temperature=args.temperature,
+                top_k=args.top_k, top_p=args.top_p,
+                rng=jax.random.PRNGKey(4242), pipelined=args.pipelined,
+                prefill_budget=args.prefill_budget, adapters=adapters,
+                max_retries=args.max_retries,
+                retry_backoff_s=args.retry_backoff_s, **spec_kw,
+            )
+
+        supervisor = FleetSupervisor(
+            fleet, respawn_factory,
+            backoff=Backoff(
+                base_s=args.restart_backoff_s,
+                max_s=args.restart_backoff_max_s,
+                seed=7,
+            ),
+            max_restarts=args.max_restarts,
+            fault_injector=(
+                FaultInjector(respawn_schedule)
+                if respawn_schedule else None
+            ),
+            observer=sup_obs,
+        )
+        # Sampled engines have no dense greedy canary oracle: calibrate
+        # from a scratch respawn now, so the FIRST real resurrection is
+        # already held to bit-identity.
+        supervisor.calibrate_probe()
+        print(
+            f"supervisor armed: backoff {args.restart_backoff_s}s base "
+            f"/ {args.restart_backoff_max_s}s cap, max_restarts="
+            f"{args.max_restarts}, capacity-aware admission bound="
+            f"{fleet.admission_bound}"
+        )
     traffic = TrafficGen(
         seed=7, vocab=config.vocab_size, max_prompt=args.prompt_len,
         max_new=args.max_new_tokens,
@@ -2791,7 +2876,7 @@ def _run_fleet_cli(
         import threading
         import urllib.request
 
-        server = FleetServer(fleet, args.http_port)
+        server = FleetServer(fleet, args.http_port, supervisor=supervisor)
         port = server.start()
         print(f"fleet SSE front end: http://127.0.0.1:{port}/v1/generate")
         statuses: dict[str, int] = {}
@@ -2832,7 +2917,11 @@ def _run_fleet_cli(
         server.stop()
         print(f"SSE streams closed: statuses={statuses}")
     else:
-        drive_open_loop(fleet, sched)
+        drive_open_loop(
+            supervisor if supervisor is not None else fleet, sched
+        )
+    if supervisor is not None:
+        supervisor.wait_healed(timeout_s=30.0)
     elapsed = time.perf_counter() - t0
     generated = fleet.generated_tokens - tokens0
     rate = generated / elapsed if elapsed > 0 and generated else 0.0
@@ -2859,6 +2948,15 @@ def _run_fleet_cli(
             f"drain_requeues={fleet.drain_requeues} "
             f"statuses={dict(statuses)} recovery_ms="
             f"{[round(s * 1000, 1) for s in fleet.failover_recovery_s]}"
+        )
+    if supervisor is not None:
+        print(
+            f"selfheal: restarts={supervisor.restarts_total} "
+            f"restart_failures={supervisor.restart_failures} "
+            f"crash_loops={supervisor.crash_loops} "
+            f"quarantined={supervisor.quarantined} "
+            f"slots={supervisor.states()} "
+            f"restore_ms={supervisor.restore_ms}"
         )
     if args.trace_out and observers[0] is not None:
         n_events = observers[0].export_trace(args.trace_out)
@@ -2953,7 +3051,7 @@ def main(argv=None) -> int:
                         help="exponential host-side backoff between "
                         "consecutive quarantines (0 = none)")
     parser.add_argument("--inject-fault", action="append", default=None,
-                        metavar="SEAM:N",
+                        metavar="SEAM[@REPLICA]:N",
                         help="deterministic fault injection: raise at the "
                         "named seam's Nth crossing (repeatable; engine "
                         "seams: prefill_dispatch, prefill_readback, "
@@ -2961,7 +3059,12 @@ def main(argv=None) -> int:
                         "spec_readback — exercises quarantine + replay; "
                         "with --fleet, replica seams replica_crash / "
                         "replica_hang / replica_slow drive router "
-                        "failover, and engine seams land on replica 0)")
+                        "failover, replica_respawn kills supervised "
+                        "resurrections (--supervise), and engine seams "
+                        "land on replica 0 unless targeted: "
+                        "SEAM@REPLICA:N lands the Nth crossing on that "
+                        "replica's engine, so chaos runs can fault any "
+                        "member — e.g. decode_dispatch@2:3)")
     parser.add_argument("--fleet", type=int, default=None, metavar="N",
                         help="serve a FLEET of N engine replicas behind "
                         "the least-loaded/affinity router "
@@ -2975,6 +3078,27 @@ def main(argv=None) -> int:
                         "on this port (0 = ephemeral) and push the "
                         "synthetic request stream through it as real "
                         "SSE clients instead of the in-process API")
+    parser.add_argument("--supervise", action="store_true",
+                        help="with --fleet: arm the self-healing "
+                        "FleetSupervisor (workloads/supervisor.py) — "
+                        "dead replicas respawn on their chip slot under "
+                        "exponential backoff, rejoin only after a "
+                        "bit-identical half-open canary probe, crash "
+                        "loops quarantine the slot, and fleet admission "
+                        "scales with alive capacity (docs/SERVING.md "
+                        "'Self-healing & recovery')")
+    parser.add_argument("--max-restarts", type=int, default=None,
+                        metavar="N",
+                        help="with --supervise: lifetime resurrection "
+                        "budget per chip slot; exhaustion quarantines "
+                        "it (default: unbounded)")
+    parser.add_argument("--restart-backoff-s", type=float, default=0.5,
+                        help="with --supervise: base delay of the "
+                        "exponential restart backoff (doubles per "
+                        "consecutive failure, seeded jitter)")
+    parser.add_argument("--restart-backoff-max-s", type=float,
+                        default=30.0,
+                        help="with --supervise: the restart backoff cap")
     args = parser.parse_args(argv)
     if args.requests < 1 or args.slots < 1:
         parser.error("--requests and --slots must be >= 1")
@@ -2982,6 +3106,14 @@ def main(argv=None) -> int:
         parser.error("--metrics-port must be in [0, 65535] (0 = ephemeral)")
     if args.prefill_budget is not None and args.prefill_budget < 1:
         parser.error("--prefill-budget must be >= 1 token per step")
+    if args.restart_backoff_s <= 0:
+        parser.error("--restart-backoff-s must be > 0 seconds")
+    if args.restart_backoff_max_s < args.restart_backoff_s:
+        parser.error("--restart-backoff-max-s must be >= "
+                     "--restart-backoff-s (the cap cannot undercut the "
+                     "base)")
+    if args.max_restarts is not None and args.max_restarts < 0:
+        parser.error("--max-restarts must be >= 0 (omit for unbounded)")
 
     from . import lease
 
@@ -3053,25 +3185,47 @@ def main(argv=None) -> int:
         bound = metrics_server.start()
         print(f"metrics: http://127.0.0.1:{bound}/metrics")
     schedule: dict[str, list[int]] = {}
+    replica_schedules: dict[int, dict[str, list[int]]] = {}
     if args.inject_fault:
         for spec_arg in args.inject_fault:
             seam, _, n = spec_arg.partition(":")
+            target = None
+            if "@" in seam:
+                seam, _, rep_s = seam.partition("@")
+                if not rep_s.isdigit():
+                    parser.error(
+                        f"--inject-fault wants SEAM[@REPLICA]:N with an "
+                        f"integer replica index, got {spec_arg!r}"
+                    )
+                target = int(rep_s)
             if not n.isdigit() or int(n) < 1:
                 parser.error(
-                    f"--inject-fault wants SEAM:N with N >= 1, got "
-                    f"{spec_arg!r}"
+                    f"--inject-fault wants SEAM[@REPLICA]:N with N >= 1, "
+                    f"got {spec_arg!r}"
                 )
-            schedule.setdefault(seam, []).append(int(n))
+            if target is None:
+                schedule.setdefault(seam, []).append(int(n))
+            else:
+                replica_schedules.setdefault(target, {}).setdefault(
+                    seam, []
+                ).append(int(n))
     if args.fleet is not None:
         if args.fleet < 1:
             parser.error("--fleet must be >= 1 replicas")
         return _run_fleet_cli(
             args, parser, params, config, page_size, bucket, adapters,
             names, spec_kw, observer, metrics_server, schedule,
+            replica_schedules,
         )
     if args.http_port is not None:
         parser.error("--http-port needs --fleet (the SSE front end is "
                      "the fleet's)")
+    if args.supervise:
+        parser.error("--supervise needs --fleet (the supervisor heals "
+                     "fleet replicas)")
+    if replica_schedules:
+        parser.error("--inject-fault SEAM@REPLICA:N targets a fleet "
+                     "member; it needs --fleet")
     injector = None
     if schedule:
         from .faults import ENGINE_SEAMS, REPLICA_SEAMS, FaultInjector
