@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check fmt-check
 
 all: native
 
@@ -51,7 +51,17 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check test
+check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check test
+
+# Decode-superstep tripwires (docs/SERVING.md "Decode supersteps &
+# double-buffered scheduling"): the k-sweep parity smoke — greedy
+# streams bit-identical to the k=1 oracle for every swept k, over-decode
+# reconciled, no page leaks — plus the mid-superstep quarantine/replay
+# contract.  The full pinned suite and the superstep_k-randomized fuzz
+# arms ride the slow suite (tests/test_superstep.py,
+# tests/test_serve_fuzz.py).
+superstep-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_superstep.py::test_superstep_parity_smoke" "tests/test_superstep.py::test_superstep_quarantine_drops_and_replays_bit_identical" -q -o addopts=
 
 # Self-healing tripwires (docs/SERVING.md "Self-healing & recovery"):
 # one seeded supervisor round — scripted crash ⇒ resurrection behind

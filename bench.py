@@ -551,6 +551,9 @@ COMPACT_KEYS = [
     "serve_queue_wait_p50_ms", "serve_queue_wait_p99_ms",
     "interleave_ttft_p99_ratio", "interleave_decode_dip_pct",
     "interleave_prefill_budget",
+    "superstep_tokens_per_sec", "superstep_best_k",
+    "decode_host_sync_ms", "superstep_speedup",
+    "superstep_overdecode_pct",
     "obs_overhead_pct", "obs_on_tokens_per_sec",
     "fault_recovery_ms", "fault_injector_off_overhead_pct",
     "fleet_tokens_per_sec", "fleet_ttft_p99_ms",

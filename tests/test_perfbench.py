@@ -193,6 +193,18 @@ def test_perfbench_tiny_end_to_end():
         "selfheal_crash_loops",
         "replica_restore_cold_ms",
         "replica_restore_warm_ms",
+        # Decode-superstep arm (docs/SERVING.md "Decode supersteps &
+        # double-buffered scheduling").
+        "superstep_best_k",
+        "superstep_tokens_per_sec",
+        "superstep_tokens_per_sec_k1",
+        "superstep_tokens_per_sec_k8",
+        "superstep_speedup",
+        "superstep_overdecode_pct",
+        "decode_host_sync_ms",
+        "superstep_tokens_per_sec_samples",
+        "superstep_tokens_per_sec_min",
+        "superstep_tokens_per_sec_max",
         # Observability overhead arm (docs/OBSERVABILITY.md).
         "obs_overhead_pct",
         "obs_on_tokens_per_sec",
@@ -232,6 +244,10 @@ def test_perfbench_tiny_end_to_end():
     assert out["replica_restore_cold_ms"] > 0
     assert out["spec_phase_dominant"] in ("draft", "verify", "commit")
     assert out["spec_breakeven_batch"] >= 0.0
+    assert out["superstep_best_k"] in out["superstep_ks"]
+    assert out["superstep_tokens_per_sec"] > 0
+    assert out["decode_host_sync_ms"] >= 0
+    assert 0.0 <= out["superstep_overdecode_pct"] < 100.0
     for b in out["spec_phase_batches"]:
         assert f"spec_verify_ms_b{b}" in out
     # No spread pooling source passed -> within-run scope.

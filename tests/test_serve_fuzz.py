@@ -47,6 +47,11 @@ def _run_one(seed: int, params, draft, adapters) -> None:
             rng=jax.random.PRNGKey(seed),
         )
     kw["prompt_bucket"] = int(kw["page_size"] * rng.choice([2, 3]))
+    # Decode supersteps: k chained chunks per dispatch with device-side
+    # retirement masks must be emission-invariant for every k, across
+    # every other arm in this matrix (docs/SERVING.md "Decode
+    # supersteps & double-buffered scheduling").
+    kw["superstep_k"] = int(rng.choice([1, 1, 2, 4]))
     # Budgeted chunked-prefill interleaving: greedy streams must stay
     # pinned against the dense reference for ANY budget (including 1
     # token/step — every admission parks mid-prefill); sampled budgeted
@@ -187,6 +192,10 @@ def _run_chaos(seed: int, params, draft, adapters) -> None:
         pipelined=bool(rng.integers(2)),
     )
     kw["prompt_bucket"] = int(kw["page_size"] * rng.choice([2, 3]))
+    # Decode supersteps under chaos: a fault mid-superstep drops the
+    # whole in-flight superstep and replays bit-identically; cancels /
+    # deadlines / health pauses must reclaim it without leaks.
+    kw["superstep_k"] = int(rng.choice([1, 1, 2, 4]))
     # Budgeted chunked-prefill under chaos: mid-prefill cancels,
     # deadline expiries and seam faults must reclaim parked admissions
     # (the leak assertions below) and replays must stay bit-identical.

@@ -20,7 +20,7 @@ from workloads import (
     RequestTooLarge,
     ServeError,
 )
-from workloads.faults import SEAMS, FaultInjector, InjectedFault
+from workloads.faults import ENGINE_SEAMS, FaultInjector, InjectedFault
 from workloads.generate import generate
 from workloads.model import ModelConfig, init_params
 from workloads.serve import ServeEngine
@@ -208,14 +208,14 @@ def test_context_manager_closes_and_unbinds_gauges(params):
     reg = Registry()
     obs = EngineObserver()
     obs.bind_registry(reg)
-    assert any(n.startswith("engine_") for n, _ in reg._gauges)
+    assert any(n.startswith("engine_") for n, *_ in reg._gauges)
     with _engine(params, observer=obs) as engine:
         rid = engine.submit(PROMPT, 4)
         engine.run()
     assert engine.closed
     # close() released the gauge collectors (they would otherwise pin
     # the engine — and its params/pools — on the registry forever).
-    assert not any(n.startswith("engine_") for n, _ in reg._gauges)
+    assert not any(n.startswith("engine_") for n, *_ in reg._gauges)
     assert _statuses(engine)[rid] == "ok"
     # Lifecycle counters reached the registry through the bridge.
     assert "engine_requests_retired_total" in reg.render()
@@ -311,9 +311,10 @@ def test_retry_budget_exhaustion_fails_terminally(params):
 
 
 def test_injector_seams_are_exactly_the_engine_seams():
-    """Every seam the injector knows is one the engine actually crosses
-    (grep the source for the check call), and vice versa — a renamed
-    seam string would otherwise never fire."""
+    """Every ENGINE seam the injector knows is one the engine actually
+    crosses (grep the source for the check call), and vice versa — a
+    renamed seam string would otherwise never fire.  Replica seams
+    cross in workloads/fleet.py / the supervisor, not here."""
     import os
     import re
 
@@ -322,7 +323,7 @@ def test_injector_seams_are_exactly_the_engine_seams():
         "workloads", "serve.py",
     ), encoding="utf-8").read()
     crossed = set(re.findall(r'_maybe_fault\("([a-z_]+)"\)', src))
-    assert crossed == set(SEAMS)
+    assert crossed == set(ENGINE_SEAMS)
 
 
 def test_injected_fault_carries_seam_and_crossing():

@@ -1,0 +1,424 @@
+"""Decode supersteps + double-buffered scheduling
+(paged.paged_decode_superstep + ServeEngine(superstep_k=k)): k chained
+decode chunks per device dispatch with DEVICE-SIDE eos/max-token
+retirement masks, host bookkeeping overlapping the superstep's compute,
+and one fused readback per superstep.  Parity is the bar: greedy token
+streams must be EXACTLY the k=1 engine's (= the dense reference) for
+every k, across serial/batched admission, pipelining, budgeted chunked
+prefill and spec="auto" — with over-decode accounting, mid-superstep
+lifecycle reclaim (cancel/deadline/quarantine/close), page
+pre-commitment and fleet failover composed on top."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+DRAFT_CONFIG = ModelConfig(
+    max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+
+STREAMS = [([3, 1, 4, 1, 5], 17), ([2, 7], 9), ([9] * 11, 13)]
+
+
+@pytest.fixture(scope="module")
+def models():
+    return (
+        init_params(CONFIG, jax.random.PRNGKey(0)),
+        init_params(DRAFT_CONFIG, jax.random.PRNGKey(7)),
+    )
+
+
+def _engine(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prompt_bucket", 8)
+    return ServeEngine(params, CONFIG, **kw)
+
+
+def _ref(params, prompt, new):
+    return [int(t) for t in np.asarray(
+        generate(params, jnp.asarray([prompt], jnp.int32), CONFIG, new)[0]
+    )]
+
+
+@pytest.mark.parametrize("k", [2, 3, 5])
+def test_superstep_greedy_matches_dense_reference(models, k):
+    params, _ = models
+    engine = _engine(params, superstep_k=k)
+    rids = [engine.submit(p, n) for p, n in STREAMS]
+    served = engine.run()
+    for rid, (p, n) in zip(rids, STREAMS):
+        assert served[rid] == _ref(params, p, n), (k, rid)
+    assert engine.ctrl.used_pages == 0
+
+
+@pytest.mark.parametrize(
+    "mode_kw",
+    [
+        {"batched_admission": False},
+        {},
+        {"pipelined": True},
+        {"prefill_budget": 1},
+        {"pipelined": True, "prefill_budget": 8},
+    ],
+    ids=["serial", "batched", "pipelined", "budget1", "piped-budget"],
+)
+def test_superstep_bit_identical_across_modes(models, mode_kw):
+    """The tentpole parity pin: for every admission/overlap mode the
+    k>1 engine's greedy streams equal the k=1 engine's byte-for-byte
+    (WHEN decode work runs cannot change WHAT it computes)."""
+    params, _ = models
+    served = {}
+    for k in (1, 3):
+        engine = _engine(params, superstep_k=k, **mode_kw)
+        rids = [engine.submit(p, n) for p, n in STREAMS]
+        out = engine.run()
+        served[k] = [out[rid] for rid in rids]
+        assert engine.ctrl.used_pages == 0, (k, mode_kw)
+    assert served[3] == served[1], mode_kw
+
+
+def test_superstep_spec_auto_bit_identical(models):
+    """spec="auto" x superstep: whichever side of the break-even each
+    step lands on (always-plain, always-spec, switching), the emitted
+    tokens stay the per-regime oracle's for every k."""
+    params, draft = models
+    for breakeven in (0.0, 1.0, 2.0):
+        engine = _engine(
+            params, superstep_k=2, draft_params=draft,
+            draft_config=DRAFT_CONFIG, gamma=3, spec="auto",
+            spec_breakeven=breakeven,
+        )
+        rids = [engine.submit(p, n) for p, n in STREAMS]
+        served = engine.run()
+        for rid, (p, n) in zip(rids, STREAMS):
+            assert served[rid] == _ref(params, p, n), (breakeven, rid)
+        assert engine.ctrl.used_pages == 0, breakeven
+
+
+def test_superstep_fewer_steps_same_tokens(models):
+    """The superstep's point: one host round-trip per k chunks.  A k=4
+    engine must finish the same stream in fewer step() iterations (and
+    strictly fewer decode host syncs) than the k=1 engine."""
+    params, _ = models
+    ref = _ref(params, [5, 2, 9], 33)
+    steps = {}
+    for k in (1, 4):
+        engine = _engine(params, slots=1, superstep_k=k)
+        rid = engine.submit([5, 2, 9], 33)
+        n_steps, served = 0, {}
+        while not engine.idle:
+            for req in engine.step():
+                served[req.rid] = req.tokens
+            n_steps += 1
+        steps[k] = n_steps
+        assert served[rid] == ref, k
+    assert steps[4] < steps[1], steps
+
+
+def test_superstep_device_masks_stop_emission_at_eos(models):
+    """Unlike the k=1 chunk path (host-side eos at readback), the
+    device retirement mask freezes a row the step it emits its eos —
+    the emitted stream ends EXACTLY at the eos token, and the frozen
+    remainder is counted as over-decode."""
+    params, _ = models
+    prompt = [4, 4, 8]
+    full = _ref(params, prompt, 20)
+    eos = full[6]
+    engine = _engine(params, superstep_k=3)
+    rid = engine.submit(prompt, 20, eos_token=eos)
+    got = engine.run()[rid]
+    assert got == full[: full.index(eos) + 1]
+    assert engine.tokens_overdecoded > 0
+    assert engine.ctrl.used_pages == 0
+
+
+def test_superstep_overdecode_bounded_and_reconciled(models):
+    """Over-decode is bounded by ONE superstep per retiring row and the
+    fused readback reconciles it exactly: dead device steps = dispatched
+    decode capacity minus emitted tokens, never emission."""
+    params, _ = models
+    k, chunk = 3, 4
+    engine = _engine(params, page_size=chunk, superstep_k=k)
+    rids = [engine.submit(p, n) for p, n in STREAMS]
+    served = engine.run()
+    span = k * chunk
+    # Each retiring row wastes < one superstep; three requests retired.
+    assert 0 < engine.tokens_overdecoded <= len(STREAMS) * span
+    # Exact reconciliation: every dispatched decode slot-step is either
+    # an emitted token, dead over-decode, or an empty-slot lane (the
+    # [slots] dispatch always runs every lane).
+    emitted_decode = sum(len(served[r]) for r in rids) - len(rids)
+    occupied_lane_steps = emitted_decode + engine.tokens_overdecoded
+    assert occupied_lane_steps <= engine.supersteps_run * span * engine.slots
+    assert engine.ctrl.used_pages == 0
+
+
+def test_superstep_page_precommit_never_faults(models):
+    """Tables pre-extend k*chunk ahead capped at each row's retirement
+    ceiling, inside the admission-time worst-case commitment — so a
+    pool sized exactly to the commitment serves requests ending at
+    max_seq_len without the allocator ever raising mid-scan."""
+    params, _ = models
+    for pipelined in (False, True):
+        engine = _engine(
+            params, slots=1, superstep_k=4, pipelined=pipelined,
+        )
+        # One request spanning the full context window: prompt + new =
+        # max_seq_len, retirement far off any superstep boundary.
+        new = CONFIG.max_seq_len - 3
+        n_pages = engine._worst_case_pages(3, new)
+        tight = _engine(
+            params, slots=1, superstep_k=4, pipelined=pipelined,
+            n_pages=n_pages,
+        )
+        rid = tight.submit([5, 2, 9], new)
+        served = tight.run()
+        assert served[rid] == _ref(params, [5, 2, 9], new), pipelined
+        assert tight.ctrl.used_pages == 0
+
+
+def test_superstep_cancel_and_deadline_reclaim(models):
+    params, _ = models
+    engine = _engine(params, superstep_k=2, pipelined=True)
+    r1 = engine.submit([3, 1, 4], 30)
+    r2 = engine.submit([2, 7], 30)
+    engine.step()
+    engine.step()  # a superstep is now in flight
+    assert engine.cancel(r1)
+    served = engine.run()
+    statuses = {r.rid: r.status for r in engine.completed}
+    assert statuses[r1] == "cancelled" and statuses[r2] == "ok"
+    # The cancelled stream is a true prefix of the dense reference.
+    assert served[r1] == _ref(params, [3, 1, 4], 30)[: len(served[r1])]
+    assert served[r2] == _ref(params, [2, 7], 30)
+    assert engine.ctrl.used_pages == 0
+
+    engine = _engine(params, slots=1, superstep_k=2)
+    rd = engine.submit([1, 2, 3], 40, deadline_s=0.05)
+    engine.step()
+    time.sleep(0.08)
+    engine.run()
+    statuses = {r.rid: r.status for r in engine.completed}
+    assert statuses[rd] == "expired"
+    assert engine.ctrl.used_pages == 0
+
+
+def test_superstep_quarantine_drops_and_replays_bit_identical(models):
+    """A seam fault mid-superstep quarantines the WHOLE in-flight
+    superstep (PR-4 rules: state dropped, not drained) and the replays
+    resume bit-identically under the retry budget."""
+    from workloads.faults import FaultInjector
+
+    params, _ = models
+    for seam in ("decode_dispatch", "decode_readback"):
+        for pipelined in (False, True):
+            engine = _engine(
+                params, superstep_k=2, pipelined=pipelined,
+                fault_injector=FaultInjector({seam: [2]}), max_retries=2,
+            )
+            rids = [engine.submit(p, n) for p, n in STREAMS]
+            served = engine.run()
+            for rid, (p, n) in zip(rids, STREAMS):
+                assert served[rid] == _ref(params, p, n), (seam, pipelined)
+            assert engine.steps_quarantined >= 1
+            # No unconsumed superstep survives the stream (the chained
+            # device carry may — it is a dead placeholder, like the
+            # plain path's _chained_tok).
+            assert not engine._pending_super
+            assert engine.ctrl.used_pages == 0
+
+
+def test_superstep_close_reclaims_in_flight(models):
+    params, _ = models
+    engine = _engine(params, superstep_k=3, pipelined=True)
+    rid = engine.submit([5, 5], 40)
+    engine.step()
+    engine.step()
+    engine.close()
+    statuses = {r.rid: r.status for r in engine.completed}
+    assert statuses[rid] == "failed"
+    assert not engine._pending_super
+    assert engine.ctrl.used_pages == 0
+    assert engine.idle
+
+
+def test_superstep_host_sync_telemetry(models):
+    """StepRecord.host_sync_ms / tokens_overdecoded ride the observer,
+    and the registry families engine_host_sync_seconds /
+    engine_tokens_overdecoded_total accumulate — with streams untouched
+    (the observer-inert contract)."""
+    from tpu_device_plugin.metrics import Registry
+    from workloads.obs import EngineObserver
+
+    params, _ = models
+    prompt = [4, 4, 8]
+    full = _ref(params, prompt, 20)
+    bare = _engine(params, superstep_k=2)
+    rid = bare.submit(prompt, 20, eos_token=full[6])
+    want = bare.run()[rid]
+
+    obs = EngineObserver()
+    reg = Registry()
+    obs.bind_registry(reg)
+    engine = _engine(params, superstep_k=2, observer=obs)
+    rid = engine.submit(prompt, 20, eos_token=full[6])
+    assert engine.run()[rid] == want  # inert: bit-identical with obs on
+    steps = obs.drain_steps()
+    assert sum(r.host_sync_ms for r in steps) > 0
+    assert sum(r.tokens_overdecoded for r in steps) == engine.tokens_overdecoded
+    assert engine.tokens_overdecoded > 0
+    text = reg.render()
+    assert "engine_tokens_overdecoded_total" in text
+    assert "engine_host_sync_seconds_bucket" in text
+    obs.unbind_registry()
+
+
+def test_superstep_fanout_prefix_and_lora_compose(models):
+    from workloads.multi_lora import synthetic_adapters
+
+    params, _ = models
+    adapters = synthetic_adapters(CONFIG, 2, rank=4, scale=0.3, seed=3)
+    engine = _engine(
+        params, superstep_k=2, prefix_cache=True, adapters=adapters,
+    )
+    rids = [engine.submit(p, n) for p, n in STREAMS]
+    frids = engine.submit_fanout([6, 2, 6, 2, 6], 8, n_samples=2)
+    arid = engine.submit([1, 2, 3], 7, adapter=sorted(adapters)[0])
+    served = engine.run()
+    for rid, (p, n) in zip(rids, STREAMS):
+        assert served[rid] == _ref(params, p, n)
+    for rid in frids:
+        assert served[rid] == _ref(params, [6, 2, 6, 2, 6], 8)
+    from workloads.lora import merge_lora
+
+    merged = merge_lora(
+        params, adapters[sorted(adapters)[0]], dtype=jnp.float32
+    )
+    assert served[arid] == [int(t) for t in np.asarray(generate(
+        merged, jnp.asarray([[1, 2, 3]], jnp.int32), CONFIG, 7
+    )[0])]
+    assert engine.ctrl.used_pages == engine.prefix.cached_pages
+
+
+def test_superstep_sampling_structurally_sound(models):
+    params, _ = models
+    engine = _engine(
+        params, superstep_k=2, temperature=0.8, top_k=40,
+        rng=jax.random.PRNGKey(5),
+    )
+    rids = [engine.submit([1 + i, 2], 10) for i in range(4)]
+    served = engine.run()
+    for rid in rids:
+        toks = served[rid]
+        assert len(toks) == 10
+        assert all(0 <= t < CONFIG.vocab_size for t in toks)
+    assert engine.ctrl.used_pages == 0
+
+
+def test_superstep_fleet_failover_replays_through(models):
+    """A replica crash mid-stream fails superstep engines' in-flight
+    work over to a survivor by replay — greedy streams bit-identical,
+    one terminal status per rid, no leak (the PR-6 contract with k>1
+    domains)."""
+    from workloads.faults import FaultInjector
+    from workloads.fleet import Fleet
+
+    params, _ = models
+    def build():
+        return [
+            _engine(params, superstep_k=2, rng=jax.random.PRNGKey(42 + i))
+            for i in range(2)
+        ]
+
+    fleet = Fleet(build(), fault_injector=FaultInjector(
+        {"replica_crash": [3]}
+    ))
+    rids = [fleet.submit(p, n) for p, n in STREAMS for _ in range(2)]
+    served = fleet.run()
+    assert fleet.replica_crashes == 1
+    expected = [(p, n) for p, n in STREAMS for _ in range(2)]
+    for rid, (p, n) in zip(rids, expected):
+        assert served[rid] == _ref(params, p, n), rid
+    statuses = [r.status for r in fleet.completed]
+    assert statuses.count("ok") == len(rids)
+    for rep in fleet.replicas:
+        if rep.state != "dead":
+            assert rep.engine.ctrl.used_pages == 0
+    fleet.close()
+
+
+def test_superstep_drains_inflight_spec_after_last_retirement(models):
+    """Regression pin: a pipelined SPEC superstep whose consume retires
+    every slot leaves its successor in flight with zero occupancy — the
+    double-buffered step must still drain it (run() would otherwise
+    spin on idle forever)."""
+    params, draft = models
+    for spec_kw in (
+        {},
+        {"spec": "auto", "spec_breakeven": 1.0},
+    ):
+        engine = _engine(
+            params, superstep_k=2, pipelined=True, draft_params=draft,
+            draft_config=DRAFT_CONFIG, gamma=2, **spec_kw,
+        )
+        rids = [engine.submit(p, n) for p, n in STREAMS]
+        served = engine.run()  # must terminate
+        for rid, (p, n) in zip(rids, STREAMS):
+            assert served[rid] == _ref(params, p, n), spec_kw
+        assert engine._pending_spec is None
+        assert engine.ctrl.used_pages == 0
+
+
+def test_superstep_validation(models):
+    params, _ = models
+    with pytest.raises(ValueError, match="superstep_k"):
+        _engine(params, superstep_k=0)
+
+
+def test_superstep_tp_matches_greedy(models):
+    """The superstep under a ("data", "model") mesh: scan-of-shard_map
+    decode; tokens must equal the dense reference."""
+    from workloads.train import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    params, _ = models
+    mesh = make_mesh(2, model_parallel=2)
+    engine = ServeEngine(
+        params, CONFIG, slots=2, page_size=4, prompt_bucket=8,
+        mesh=mesh, superstep_k=2,
+    )
+    rids = [engine.submit(p, n) for p, n in STREAMS]
+    served = engine.run()
+    for rid, (p, n) in zip(rids, STREAMS):
+        assert served[rid] == _ref(params, p, n)
+    assert engine.ctrl.used_pages == 0
+
+
+def test_superstep_parity_smoke(models):
+    """The `make superstep-check` tripwire: a fast k-sweep whose greedy
+    streams must all equal the k=1 oracle, over-decode reconciled, no
+    leaks — one seeded round of the full-matrix fuzz rides the slow
+    suite."""
+    params, _ = models
+    oracle = None
+    for k in (1, 2, 4):
+        engine = _engine(params, superstep_k=k, pipelined=(k == 4))
+        rids = [engine.submit(p, n) for p, n in STREAMS]
+        served = engine.run()
+        out = [served[rid] for rid in rids]
+        if oracle is None:
+            oracle = out
+        else:
+            assert out == oracle, k
+        assert engine.ctrl.used_pages == 0, k

@@ -40,6 +40,10 @@ TRACKED_UP = [
     "paged_vs_contiguous_decode",
     "serve_tokens_per_sec",
     "serve_requests_per_sec",
+    # Decode supersteps: best-k chained-chunk decode throughput (the
+    # host-sync-amortization PR's headline) — a drop means either the
+    # superstep path or the link regressed.
+    "superstep_tokens_per_sec",
     "obs_on_tokens_per_sec",
     "admission_tokens_per_sec",
     "admission_speedup",
@@ -66,6 +70,10 @@ TRACKED_DOWN = [
     "serve_ttft_p99_ms",
     "serve_queue_wait_p99_ms",
     "interleave_ttft_p99_ratio",
+    # Decode supersteps: the per-decode-step host-sync stall the
+    # superstep exists to amortize — a rise means the scheduler started
+    # serializing host work behind the device again.
+    "decode_host_sync_ms",
     # Fleet serving SLOs: the pooled client-visible TTFT tail under the
     # open-loop generator, and the crash -> first-survivor-token window
     # (the robustness number the fleet PR exists for).
@@ -80,6 +88,7 @@ TRACKED_DOWN = [
 # pooled ratio spreads (below) instead of the flat default.
 SPREAD_GUARDED = set(TRACKED_DOWN) | {
     "serve_tokens_per_sec",
+    "superstep_tokens_per_sec",
     "fleet_tokens_per_sec",
     "selfheal_capacity_recovered",
 }

@@ -930,10 +930,18 @@ class Fleet:
                 self.drain(rep.index)
         else:
             rep.slow_steps = 0
+        # A decode-superstep engine legitimately runs superstep_k
+        # chunks' worth of device work per step; scale the watchdog
+        # budget with it so k can never read as a wedge.
+        hang_budget = (
+            None if self.hang_timeout_s is None
+            else self.hang_timeout_s
+            * max(1, getattr(rep.engine, "superstep_k", 1))
+        )
         if (
-            self.hang_timeout_s is not None
+            hang_budget is not None
             and not warmup  # first step = one-time XLA compiles, not a wedge
-            and step_secs > self.hang_timeout_s
+            and step_secs > hang_budget
             and rep.state != DEAD
         ):
             # Watchdog after the fact: the cooperative loop cannot
@@ -943,7 +951,7 @@ class Fleet:
                 rep,
                 RuntimeError(
                     f"step took {step_secs:.3f}s > hang_timeout_s "
-                    f"{self.hang_timeout_s}"
+                    f"{hang_budget}"
                 ),
                 "hang",
             )

@@ -146,8 +146,20 @@ ENGINE_METRICS: tuple[MetricSpec, ...] = (
         "replica's drain signal)",
     ),
     MetricSpec(
+        "engine_tokens_overdecoded_total", "counter", ("engine",),
+        "device decode steps computed past a row's retirement point "
+        "(dead decode-superstep compute, reconciled at each fused "
+        "readback)",
+    ),
+    MetricSpec(
         "engine_ttft_seconds", "histogram", ("engine",),
         "submission -> first observed token (queue wait included)",
+    ),
+    MetricSpec(
+        "engine_host_sync_seconds", "histogram", ("engine",),
+        "wall time one engine step spent BLOCKED in host syncs "
+        "(readbacks + fused consumes — the per-step tax decode "
+        "supersteps amortize)",
     ),
     MetricSpec(
         "engine_e2e_seconds", "histogram", ("engine",),
@@ -356,6 +368,13 @@ class StepRecord:
     # engines and older tooling identical).
     prefill_inflight: int = 0
     deferred_tokens: int = 0
+    # Decode supersteps (superstep_k): wall ms this step spent BLOCKED
+    # in host syncs (engine.host_sync_s delta — measured engine-side,
+    # observer on or off), and the device decode steps computed past
+    # rows' retirement points this step (the bounded over-decode the
+    # fused readback reconciled).
+    host_sync_ms: float = 0.0
+    tokens_overdecoded: int = 0
 
 
 class EngineObserver:
@@ -527,15 +546,27 @@ class EngineObserver:
             engine.spec_rounds,
             engine.mode_switches,
             getattr(engine, "prefill_deferred_tokens", 0),
+            getattr(engine, "host_sync_s", 0.0),
+            getattr(engine, "tokens_overdecoded", 0),
         )
 
     def _step_end(self, engine, snap: tuple, finished) -> StepRecord:
-        (t0, tokens0, adm0, ret0, pd0, sw0, ch0, sr0, ms0, dt0) = snap
+        (
+            t0, tokens0, adm0, ret0, pd0, sw0, ch0, sr0, ms0, dt0, hs0,
+            od0,
+        ) = snap
         dur = time.perf_counter() - t0
+        host_sync = getattr(engine, "host_sync_s", 0.0) - hs0
+        overdecoded = getattr(engine, "tokens_overdecoded", 0) - od0
         tokens = engine.generated_tokens - tokens0
         admitted = engine.requests_admitted - adm0
         retired = engine.requests_retired - ret0
-        chunk_d = engine.chunks_run - ch0
+        # chunks_run counts device decode CHUNKS; a superstep engine
+        # runs superstep_k of them per dispatch, so normalize both
+        # decode families to DISPATCH counts.
+        chunk_d = (engine.chunks_run - ch0) // max(
+            getattr(engine, "superstep_k", 1), 1
+        )
         spec_rounds_d = engine.spec_rounds - sr0
         spec_d = spec_rounds_d // max(engine.spec_lookahead, 1)
         # The mode the step actually DISPATCHED: the engine runs at most
@@ -560,6 +591,8 @@ class EngineObserver:
             deferred_tokens=(
                 getattr(engine, "prefill_deferred_tokens", 0) - dt0
             ),
+            host_sync_ms=round(host_sync * 1000, 3),
+            tokens_overdecoded=overdecoded,
         )
         self._step_index += 1
         if len(self.steps) == self.steps.maxlen:
@@ -588,6 +621,12 @@ class EngineObserver:
             switches = engine.mode_switches - ms0
             if switches:
                 reg.inc("engine_mode_switches_total", labels, switches)
+            if overdecoded:
+                reg.inc(
+                    "engine_tokens_overdecoded_total", labels, overdecoded
+                )
+            if host_sync > 0:
+                reg.observe_seconds("engine_host_sync", host_sync, labels)
             self._push_lifecycle(engine, reg, labels)
             if mode != "idle":
                 reg.inc(

@@ -565,6 +565,102 @@ def _chunk_core(
     return jnp.transpose(toks, (1, 0)), pools
 
 
+@partial(
+    jax.jit,
+    static_argnames=("config", "chunk", "k", "sampling"),
+    donate_argnums=(1,),
+)
+def paged_decode_superstep(
+    params: dict,
+    pools: tuple[jax.Array, jax.Array],
+    tables: jax.Array,
+    token: jax.Array,
+    positions: jax.Array,
+    live: jax.Array,
+    budget: jax.Array,
+    eos: jax.Array,
+    rngs: jax.Array,
+    temperature,
+    top_k,
+    top_p,
+    config: ModelConfig,
+    chunk: int,
+    k: int,
+    sampling: bool,
+    lora=None,
+):
+    """``k`` chained decode chunks in ONE dispatch with DEVICE-SIDE
+    retirement — the plain-decode counterpart of paged_spec_superstep.
+
+    A plain decode chunk still pays one full host round-trip per
+    dispatch, so on a high-RTT link the per-chunk readback tax bounds
+    ``serve_tokens_per_sec`` no matter how fast the chip is.  This
+    program runs ``k`` chunks' worth of decode steps in a single
+    lax.scan (each inner chunk splits its own rng exactly as
+    paged_decode_chunk does, so per-position draws match the k
+    dispatches it replaces) and keeps retirement ON DEVICE: per-row
+    ``eos`` ids (-1 = none) and remaining-token ``budget``s flip a
+    row's ``live`` mask the step it emits its terminal token, freezing
+    its position and token so retired rows stop contributing — the
+    over-decode a retiring row can waste is bounded by the remainder of
+    its own superstep, and the host reconciles it at the single fused
+    readback (ServeEngine._consume_superstep).
+
+    live: [batch] bool — False rows (empty slots, rows retired in an
+    earlier chained superstep) are frozen exactly like
+    paged_decode_chunk's parked occupancy=False rows.  budget/eos:
+    [batch] int32.  rngs: [k, 2] — one engine key per chunk, preserving
+    the k=1 path's key-draw schedule.  tables must already cover
+    positions + k*chunk for live rows (the engine pre-extends, capped
+    at each row's retirement ceiling — between those bounds the host is
+    out of the loop for k chunks at a time).
+
+    Returns (tokens [batch, k*chunk], new_token, new_positions,
+    new_live, new_budget, pools): the trailing per-row state is the
+    scan's carry AFTER chunk k, ON DEVICE, so a pipelined engine can
+    dispatch superstep N+1 chained on it while N's tokens are still in
+    flight to the host.  Pools are DONATED."""
+    return _decode_superstep_core(
+        params, pools, tables, token, positions, live, budget, eos, rngs,
+        temperature, top_k, top_p, config, chunk, k, sampling, lora=lora,
+    )
+
+
+def _decode_superstep_core(
+    params, pools, tables, token, positions, live, budget, eos, rngs,
+    temperature, top_k, top_p, config, chunk, k, sampling,
+    attention_fn=None, lora=None,
+):
+    """paged_decode_superstep's body, un-jitted so the tensor-parallel
+    path can re-jit it with explicit shardings and an injected attention
+    op (workloads/tp_serve.py make_tp_decode_superstep)."""
+    keys = jax.vmap(lambda r: jax.random.split(r, chunk))(rngs)
+    keys = keys.reshape(k * chunk, *keys.shape[2:])
+
+    def body(carry, key):
+        pools, tok, pos, live, budget = carry
+        logits, pools = _decode_core(
+            params, pools, tables, tok, pos, config, attention_fn, lora
+        )
+        nxt = sample_logits(
+            logits, key if sampling else None, temperature, top_k, top_p
+        )
+        pos = jnp.where(live, pos + 1, pos)
+        tok = jnp.where(live, nxt, tok)
+        budget = jnp.where(live, budget - 1, budget)
+        # Retire AFTER the emit: the terminal token (eos, or the one
+        # that exhausts the budget) is this step's output; every later
+        # step computes dead against the frozen position.
+        live = live & (nxt != eos) & (budget > 0)
+        return (pools, tok, pos, live, budget), nxt
+
+    positions = jnp.broadcast_to(jnp.asarray(positions, jnp.int32), token.shape)
+    (pools, tok, pos, live, budget), toks = jax.lax.scan(
+        body, (pools, token, positions, live, budget), keys
+    )
+    return jnp.transpose(toks, (1, 0)), tok, pos, live, budget, pools
+
+
 def _redirect_padding(
     tables_slice: jax.Array, covered_lengths: jax.Array, page_size: int,
     trash: int,

@@ -74,6 +74,7 @@ from .paged import (
     init_page_pools,
     paged_decode_chunk,
     paged_decode_step,
+    paged_decode_superstep,
     paged_prefill,
     paged_prefill_chunk,
     table_array,
@@ -187,6 +188,7 @@ class ServeEngine:
         spec: str = "on",
         spec_breakeven: float | None = None,
         pipelined: bool = False,
+        superstep_k: int = 1,
         prefix_cache: bool = False,
         adapters: dict[str, list] | None = None,
         lora_alpha: float = 1.0,
@@ -243,6 +245,10 @@ class ServeEngine:
         if spec_lookahead < 1:
             raise ValueError(
                 f"spec_lookahead must be >= 1, got {spec_lookahead}"
+            )
+        if superstep_k < 1:
+            raise ValueError(
+                f"superstep_k must be >= 1, got {superstep_k}"
             )
         if spec_lookahead > 1 and draft_params is None:
             raise ValueError(
@@ -310,8 +316,21 @@ class ServeEngine:
         self.mode_switches = 0
         self._last_mode: str | None = None
         self.decode_mode_trace: deque = deque(maxlen=mode_trace_limit)
+        # Decode supersteps (docs/SERVING.md "Decode supersteps &
+        # double-buffered scheduling"): with superstep_k > 1 every plain
+        # decode dispatch runs k chained chunks on device
+        # (paged_decode_superstep) with device-side eos/budget
+        # retirement masks, and the step loop turns dispatch-first — the
+        # step's host bookkeeping (admission planning and sweeps,
+        # lifecycle polls) overlaps the superstep's device compute, and
+        # one fused readback per superstep replaces k round-trips.
+        # Greedy streams are bit-identical for every k (pinned by
+        # tests/test_superstep.py); page pre-commitment below sizes the
+        # overshoot for k chunks so the allocator can never fault
+        # mid-scan.
+        self.superstep_k = superstep_k
         self._overshoot = max(
-            self.chunk * (2 if pipelined else 1),
+            self.chunk * superstep_k * (2 if pipelined else 1),
             ((gamma + 1) * spec_lookahead * (2 if pipelined else 1))
             if draft_params is not None else 0,
         )
@@ -407,6 +426,15 @@ class ServeEngine:
         self._inflight_prefill: list[dict] = []
         # Telemetry for benchmarking and tests.
         self.chunks_run = 0
+        self.supersteps_run = 0  # plain decode supersteps dispatched
+        # Device decode steps computed past a row's retirement point
+        # (dead superstep compute), reconciled at each fused readback.
+        self.tokens_overdecoded = 0
+        # Wall seconds the scheduler spent BLOCKED in host syncs
+        # (readbacks + fused consumes) — the tax supersteps amortize;
+        # surfaces as StepRecord.host_sync_ms and the
+        # engine_host_sync_seconds histogram (workloads/obs.py).
+        self.host_sync_s = 0.0
         self.generated_tokens = 0
         self.prefills_run = 0
         self.prefill_tokens = 0  # prompt tokens actually forwarded
@@ -477,6 +505,14 @@ class ServeEngine:
         self._chained_tok: jax.Array | None = None
         self._pending_spec = None
         self._spec_chained: tuple[jax.Array, jax.Array] | None = None
+        # Decode supersteps in flight (superstep_k > 1): dispatched but
+        # not yet consumed (tokens, slot->request snapshot) pairs —
+        # at most one under the double-buffered loop, plus one more
+        # while pipelined keeps the newest chained on device — and the
+        # device-side (tok, pos, live, budget) carry the next pipelined
+        # superstep chains on.
+        self._pending_super: deque = deque()
+        self._super_chained: tuple | None = None
         self._fresh_slots: set[int] = set()
 
         sampling = self.sampling
@@ -520,6 +556,12 @@ class ServeEngine:
                 paged_decode_chunk, config=self.config, chunk=self.chunk,
                 sampling=self.sampling,
             )
+            if superstep_k > 1:
+                self._superstep = partial(
+                    paged_decode_superstep, config=self.config,
+                    chunk=self.chunk, k=superstep_k,
+                    sampling=self.sampling,
+                )
         else:
             from .tp_serve import (
                 make_tp_serve_programs,
@@ -575,6 +617,15 @@ class ServeEngine:
             if draft_params is not None:
                 self._d_prefill_chunk = make_tp_prefill_chunk(
                     draft_config, mesh
+                )
+            if superstep_k > 1:
+                from .tp_serve import make_tp_decode_superstep
+
+                self._superstep = make_tp_decode_superstep(
+                    self.config, mesh, chunk=self.chunk, k=superstep_k,
+                    sampling=self.sampling,
+                    lora_stacked=self._stacked_adapters,
+                    lora_alpha=self.lora_alpha,
                 )
             self.params, self.pools = shard_serving_state(
                 self.params, self.pools, self.config, mesh
@@ -858,6 +909,21 @@ class ServeEngine:
         if self._faults is not None:
             self._faults.check(seam)
 
+    def _host_sync(self, fetch):
+        """Run one BLOCKING device->host fetch, timing the wall clock it
+        stalls the scheduler for — the per-step host-sync telemetry
+        (``host_sync_s`` -> StepRecord.host_sync_ms and the
+        ``engine_host_sync_seconds`` histogram) decode supersteps exist
+        to amortize.  Every readback site routes through here so the
+        accounting cannot drift from the syncs actually performed."""
+        t0 = time.perf_counter()
+        out = fetch()
+        dt = time.perf_counter() - t0
+        self.host_sync_s += dt
+        if self._obs is not None:
+            self._obs._note_readback(dt)
+        return out
+
     def _note_recovery(self) -> None:
         """Called after every SUCCESSFUL host readback: closes the
         recovery-latency window opened by the last quarantine and resets
@@ -912,6 +978,8 @@ class ServeEngine:
         self._chained_tok = None
         self._pending_spec = None
         self._spec_chained = None
+        self._pending_super.clear()
+        self._super_chained = None
         self._fresh_slots.clear()
         self._last_mode = None
         victims: list[Request] = []
@@ -1053,7 +1121,11 @@ class ServeEngine:
         A seam failure during the drain falls through to the step
         quarantine."""
         try:
-            return self._drain_pending_plain() + self._drain_pending_spec()
+            return (
+                self._drain_pending_plain()
+                + self._drain_pending_spec()
+                + self._drain_pending_super()
+            )
         except Exception as exc:  # noqa: BLE001 — recovery seam
             return self._quarantine_step(exc)
 
@@ -1202,6 +1274,8 @@ class ServeEngine:
         self._chained_tok = None
         self._pending_spec = None
         self._spec_chained = None
+        self._pending_super.clear()
+        self._super_chained = None
         self._fresh_slots.clear()
         err = "EngineClosed: engine closed with the request in flight"
         # step() refuses to run after close, so these can never surface
@@ -1562,20 +1636,20 @@ class ServeEngine:
                         table, prompt, start_page=start_page,
                         adapter_idx=aidx,
                     )
-                t_rb = time.perf_counter() if self._obs is not None else 0.0
                 self._maybe_fault("prefill_readback")
                 tok = int(
-                    self._first_token(
-                        logits, self._next_key(),
-                        jnp.float32(self.temperature), jnp.int32(self.top_k),
-                        jnp.float32(self.top_p),
-                    )[0]
+                    self._host_sync(
+                        lambda: self._first_token(
+                            logits, self._next_key(),
+                            jnp.float32(self.temperature),
+                            jnp.int32(self.top_k),
+                            jnp.float32(self.top_p),
+                        )[0]
+                    )
                 )
             except Exception as exc:  # noqa: BLE001 — recovery seam
                 plan = {"slot": slot, "req": req, "seq": seq, "need": 0}
                 return finished + self._quarantine_admissions([plan], exc)
-            if self._obs is not None:
-                self._obs._note_readback(time.perf_counter() - t_rb)
             self.admission_readbacks += 1
             self._note_recovery()
             req.tokens.append(tok)
@@ -1867,16 +1941,15 @@ class ServeEngine:
         keys = jnp.stack(
             [key_rows.get(s, zero_key) for s in range(self.slots)]
         )
-        t_rb = time.perf_counter() if self._obs is not None else 0.0
         self._maybe_fault("prefill_readback")
-        toks = np.asarray(
-            self._first_token_batch(
-                emitted, keys, jnp.float32(self.temperature),
-                jnp.int32(self.top_k), jnp.float32(self.top_p),
+        toks = self._host_sync(
+            lambda: np.asarray(
+                self._first_token_batch(
+                    emitted, keys, jnp.float32(self.temperature),
+                    jnp.int32(self.top_k), jnp.float32(self.top_p),
+                )
             )
         )  # the ONE first-token readback for the whole admission batch
-        if self._obs is not None:
-            self._obs._note_readback(time.perf_counter() - t_rb)
         self.admission_readbacks += 1
         self._note_recovery()
         finished, retry = [], False
@@ -1959,6 +2032,13 @@ class ServeEngine:
                     arrs, snapshot = self._pending_spec
                     self._pending_spec = None
                     finished += self._consume_spec(arrs, snapshot)
+                if len(self._pending_super) > 1:
+                    # The double-buffered loop calls _admit with the
+                    # newest superstep chained in flight; consume the
+                    # PREVIOUS one here so its (long-ready) readback
+                    # overlaps the sweep's prefill compute too.
+                    toks_dev, snapshot = self._pending_super.popleft()
+                    finished += self._consume_superstep(toks_dev, snapshot)
             done_slots = {
                 p["slot"] for p in self._inflight_prefill
                 if p["prefill"] and p["cursor"] > p["last_ci"]
@@ -2090,6 +2170,18 @@ class ServeEngine:
             self._group_cleanup(gid)
         return req
 
+    def _fresh_mask(self) -> jax.Array:
+        """[slots] bool device mask of slots admitted since the last
+        decode dispatch — the rows a pipelined chained dispatch must
+        take HOST state for (their device carry, if any, is a dead
+        placeholder).  Shared by all three chained paths (plain chunk,
+        spec superstep, decode superstep) so the chaining rule cannot
+        drift between them."""
+        fresh = np.zeros(self.slots, bool)
+        for s in self._fresh_slots:
+            fresh[s] = True
+        return jnp.asarray(fresh)
+
     def _dev(self, mirror: np.ndarray) -> jax.Array:
         """A host mirror crossing into a dispatch, COPIED first: on the
         CPU backend jnp.asarray may alias numpy memory zero-copy, so an
@@ -2138,6 +2230,18 @@ class ServeEngine:
             # Health hold: no admission, no dispatch — in-flight work was
             # requeued when the chip went Unhealthy; recovery resumes.
             return finished
+        if self.superstep_k > 1:
+            # Decode supersteps run the DOUBLE-BUFFERED loop: dispatch
+            # first, overlap the step's host bookkeeping (admission
+            # included) with the device compute, consume last.
+            self._decode_finished: list[Request] = []
+            try:
+                return finished + self._step_superstep()
+            except Exception as exc:  # noqa: BLE001 — recovery seam
+                return (
+                    finished + list(self._decode_finished)
+                    + self._quarantine_step(exc)
+                )
         finished += self._admit()
         # _step_decode accumulates into a member alias so retirements
         # that happened BEFORE a later seam faulted still surface in
@@ -2205,10 +2309,7 @@ class ServeEngine:
             # Continue from the previous chunk's last tokens ON DEVICE;
             # only freshly admitted slots take their host-side first
             # token.
-            fresh = np.zeros(self.slots, bool)
-            for s in self._fresh_slots:
-                fresh[s] = True
-            tok_in = jnp.where(jnp.asarray(fresh), tok_in, self._chained_tok)
+            tok_in = jnp.where(self._fresh_mask(), tok_in, self._chained_tok)
         self._fresh_slots.clear()
 
         chunk_kw = {}
@@ -2257,11 +2358,8 @@ class ServeEngine:
         """Read a chunk's tokens back (the host sync point: tokens stream
         out) and apply emission/eos/retirement for the slots as they were
         at dispatch."""
-        t_rb = time.perf_counter() if self._obs is not None else 0.0
         self._maybe_fault("decode_readback")
-        toks = np.asarray(toks_dev)
-        if self._obs is not None:
-            self._obs._note_readback(time.perf_counter() - t_rb)
+        toks = self._host_sync(lambda: np.asarray(toks_dev))
         self._note_recovery()
         finished = []
         for slot, req in snapshot.items():
@@ -2273,6 +2371,203 @@ class ServeEngine:
             self._tokens[slot] = toks[slot, -1]
             if req.done:
                 finished.append(self._retire(slot))
+        return finished
+
+    # ---- decode supersteps (superstep_k > 1) ----------------------------
+
+    def _step_superstep(self) -> list[Request]:
+        """One DOUBLE-BUFFERED engine iteration (``superstep_k > 1``).
+
+        The k=1 step serializes host work behind the device: admit,
+        dispatch, block on the readback.  Here the order inverts —
+        the decode superstep for the slots occupied NOW dispatches
+        FIRST (asynchronously), the step's host bookkeeping (admission
+        planning, budgeted prefill sweeps, a second health/deadline
+        poll) runs while the superstep computes on device, and the
+        single fused readback comes last.  Requests admitted in the overlap window
+        join the NEXT superstep — admission happens at superstep
+        boundaries, the same scheduling lag ``spec_lookahead`` already
+        documents — and greedy streams stay bit-identical for every k
+        (pinned by tests/test_superstep.py).  Under ``pipelined`` the
+        newest superstep additionally stays in flight, chained on
+        device, while the previous one is consumed here.
+
+        spec="auto" composes: the mode decision runs on the boundary
+        occupancy, a plain->spec switch drains the in-flight superstep
+        (mirror sync) exactly like the PR-2 chunk rules, and the spec
+        side keeps its own admit-before-dispatch order."""
+        finished = self._decode_finished
+        dispatched = False
+        if not self._occupied.any():
+            # Nothing to dispatch: consume whatever is still in flight
+            # (the k=1 step's idle-drain rule — a pipelined spec
+            # superstep whose consume retired every slot would
+            # otherwise hang here unread forever); _pending_super
+            # drains through the keep-loop below.
+            if self._pending_read is not None:
+                toks_dev, snapshot = self._pending_read
+                self._pending_read = None
+                finished += self._consume_chunk(toks_dev, snapshot)
+            if self._pending_spec is not None:
+                arrs, snapshot = self._pending_spec
+                self._pending_spec = None
+                finished += self._consume_spec(arrs, snapshot)
+        else:
+            use_spec = self._decide_spec()
+            if use_spec:
+                # Mode boundary: the spec superstep dispatches from the
+                # host mirrors, so the plain superstep path's in-flight
+                # state must consume (syncing them) first.
+                finished += self._drain_pending_super()
+            else:
+                finished += self._drain_pending_spec()
+                if self._occupied.any():
+                    use_spec = self._decide_spec()
+                    if use_spec:
+                        finished += self._drain_pending_super()
+            if self._occupied.any():
+                self._record_mode(use_spec)
+                if use_spec:
+                    finished += self._admit()
+                    if self._occupied.any():
+                        finished += self._step_spec()
+                    return finished
+                self._dispatch_superstep()
+                dispatched = True
+        # Overlap window: the next step's bookkeeping — admission
+        # planning and prefill sweeps (their device work queues behind
+        # the superstep; the host-side work runs during it), then a
+        # second lifecycle poll so health events and deadline expiries
+        # landing while the device computes are acted on NOW, not a
+        # full superstep later (both polls are idempotent; an expiry or
+        # pause here reclaims the in-flight superstep through the
+        # normal drain/quarantine seams, emptying the queue below).
+        finished += self._admit()
+        finished += self._poll_health()
+        finished += self._expire_deadlines()
+        # The single fused readback: consume everything due.  Pipelined
+        # keeps the newest superstep in flight (the next step chains on
+        # its device-side carry) for as long as it keeps dispatching.
+        keep = 1 if (self.pipelined and dispatched) else 0
+        while len(self._pending_super) > keep:
+            toks_dev, snapshot = self._pending_super.popleft()
+            finished += self._consume_superstep(toks_dev, snapshot)
+        return finished
+
+    def _dispatch_superstep(self) -> None:
+        """Dispatch ONE plain decode superstep — ``superstep_k`` chained
+        decode chunks with device-side retirement masks
+        (paged.paged_decode_superstep) — for the currently occupied
+        slots, asynchronously; _step_superstep overlaps host work with
+        it and consumes through the ``_pending_super`` queue.
+
+        Page pre-commitment: every live row's table extends UP FRONT to
+        cover the whole superstep's worst case (position + k*chunk),
+        capped at the row's own retirement ceiling — the last position
+        its budget mask can touch (+1 because dead writes land on the
+        frozen post-retirement slot) — so the allocator can never fault
+        mid-scan and the admission-time worst-case commitment is never
+        overrun."""
+        k, C = self.superstep_k, self.chunk
+        span = k * C
+        in_flight: set[int] = set()
+        for _, snap in self._pending_super:
+            in_flight.update(snap)
+        for slot, req in self._slot_req.items():
+            seq = self._seq_id(slot, req)
+            pos = int(self._positions[slot])
+            # pos and len(req.tokens) move in lockstep (both advance at
+            # consume), so this ceiling is exact even while a pipelined
+            # superstep is still in flight for the row.
+            ceiling = pos + (req.max_new_tokens - len(req.tokens)) + 1
+            bound = pos + span * (2 if slot in in_flight else 1)
+            table = self._extend_evicting(seq, min(bound, ceiling))
+            self._tables[slot, : len(table)] = table
+        eos = np.full(self.slots, -1, np.int32)
+        budget = np.zeros(self.slots, np.int32)
+        for slot, req in self._slot_req.items():
+            if req.eos_token is not None:
+                eos[slot] = req.eos_token
+            budget[slot] = req.max_new_tokens - len(req.tokens)
+        tok_in = self._dev(self._tokens)
+        pos_in = self._dev(self._positions)
+        live_in = self._dev(self._occupied)
+        budget_in = jnp.asarray(budget)
+        if self.pipelined and self._super_chained is not None:
+            # Chain on the previous superstep's device-side carry; only
+            # freshly admitted slots take their host-side state (a
+            # parked chained slot is a dead placeholder by contract).
+            fr = self._fresh_mask()
+            c_tok, c_pos, c_live, c_budget = self._super_chained
+            tok_in = jnp.where(fr, tok_in, c_tok)
+            pos_in = jnp.where(fr, pos_in, c_pos)
+            live_in = jnp.where(fr, live_in, c_live)
+            budget_in = jnp.where(fr, budget_in, c_budget)
+        self._fresh_slots.clear()
+        # One engine key per chunk, in the k=1 path's draw order.
+        rngs = jnp.stack([self._next_key() for _ in range(k)])
+        chunk_kw = {}
+        if self._stacked_adapters is not None:
+            chunk_kw["lora"] = (
+                self._stacked_adapters, self._dev(self._adapter_idx),
+                self.lora_alpha,
+            )
+        self._maybe_fault("decode_dispatch")
+        toks, n_tok, n_pos, n_live, n_budget, self.pools = self._superstep(
+            self.params, self.pools, self._dev(self._tables), tok_in,
+            pos_in, live_in, budget_in, jnp.asarray(eos), rngs,
+            jnp.float32(self.temperature), jnp.int32(self.top_k),
+            jnp.float32(self.top_p), **chunk_kw,
+        )
+        self.chunks_run += k
+        self.supersteps_run += 1
+        if self.pipelined:
+            self._super_chained = (n_tok, n_pos, n_live, n_budget)
+        self._pending_super.append((toks, dict(self._slot_req)))
+
+    def _consume_superstep(self, toks_dev, snapshot: dict) -> list[Request]:
+        """The single fused readback for one plain decode superstep:
+        read the [slots, k*chunk] tokens back, emit each row's live
+        prefix (``_emit``'s eos/max_new rule is byte-for-byte the
+        device's retirement mask, so the host mirrors advance by the
+        device's exact advance), retire finished rows, and reconcile
+        the over-decode accounting — the dead device steps each
+        retiring row sat frozen for."""
+        self._maybe_fault("decode_readback")
+        toks = self._host_sync(lambda: np.asarray(toks_dev))
+        self._note_recovery()
+        span = toks.shape[1]
+        finished = []
+        for slot, req in snapshot.items():
+            if req.done:
+                # Retired between dispatch and read (pipelined lag): the
+                # chained live mask parked the row, so the whole
+                # superstep was dead compute.
+                self.tokens_overdecoded += span
+                continue
+            before = len(req.tokens)
+            self._emit(req, toks[slot])
+            advance = len(req.tokens) - before
+            self._positions[slot] += advance
+            self._tokens[slot] = toks[slot, advance - 1]
+            if req.done:
+                self.tokens_overdecoded += span - advance
+                finished.append(self._retire(slot))
+        return finished
+
+    def _drain_pending_super(self) -> list[Request]:
+        """Mode-boundary / slot-reclaim handoff for the plain decode
+        superstep path: consume every in-flight superstep (syncing the
+        host position/token mirrors) and drop the device-chained carry
+        — after the drain the mirrors hold the same values, so the next
+        dispatch (a spec superstep, or a reclaim) proceeds from them."""
+        if not self._pending_super and self._super_chained is None:
+            return []
+        finished: list[Request] = []
+        while self._pending_super:
+            toks_dev, snapshot = self._pending_super.popleft()
+            finished += self._consume_superstep(toks_dev, snapshot)
+        self._super_chained = None
         return finished
 
     # ---- adaptive speculation (spec="auto") -----------------------------
@@ -2534,10 +2829,7 @@ class ServeEngine:
         cur = self._dev(self._tokens)
         pos = self._dev(self._positions)
         if self.pipelined and self._spec_chained is not None:
-            fresh = np.zeros(self.slots, bool)
-            for s in self._fresh_slots:
-                fresh[s] = True
-            fr = jnp.asarray(fresh)
+            fr = self._fresh_mask()
             c_cur, c_pos = self._spec_chained
             cur = jnp.where(fr, cur, c_cur)
             pos = jnp.where(fr, pos, c_pos)
@@ -2587,7 +2879,6 @@ class ServeEngine:
         mirrors advance by the DEVICE's total advance (emission stops at
         eos/max_new; rounds past a row's retirement point are the
         superstep's documented dead compute)."""
-        t_rb = time.perf_counter() if self._obs is not None else 0.0
         self._maybe_fault("spec_readback")
         # ONE host sync for the whole round's array tuple: serial
         # np.asarray calls would pay the link round-trip per array
@@ -2595,11 +2886,9 @@ class ServeEngine:
         # the bench tunnel — spec_round_readback_ms); device_get
         # transfers the tuple in a single fetch.  Values are identical,
         # only the sync count changes.
-        committed, n_acc = (
-            np.asarray(a) for a in jax.device_get(arrs)
+        committed, n_acc = self._host_sync(
+            lambda: tuple(np.asarray(a) for a in jax.device_get(arrs))
         )
-        if self._obs is not None:
-            self._obs._note_readback(time.perf_counter() - t_rb)
         self._note_recovery()
         if committed.ndim == 2:  # single round -> a 1-round superstep
             committed, n_acc = committed[None], n_acc[None]
@@ -2629,6 +2918,7 @@ class ServeEngine:
             and not self._inflight_prefill
             and self._pending_read is None
             and self._pending_spec is None
+            and not self._pending_super
             and not self._finished_buffer
         )
 
@@ -2794,6 +3084,7 @@ def _run_fleet_cli(
             prompt_bucket=bucket, temperature=args.temperature,
             top_k=args.top_k, top_p=args.top_p,
             rng=jax.random.PRNGKey(42 + i), pipelined=args.pipelined,
+            superstep_k=args.superstep_k,
             prefill_budget=args.prefill_budget, adapters=adapters,
             observer=observers[i],
             fault_injector=(
@@ -2834,6 +3125,7 @@ def _run_fleet_cli(
                 prompt_bucket=bucket, temperature=args.temperature,
                 top_k=args.top_k, top_p=args.top_p,
                 rng=jax.random.PRNGKey(4242), pipelined=args.pipelined,
+                superstep_k=args.superstep_k,
                 prefill_budget=args.prefill_budget, adapters=adapters,
                 max_retries=args.max_retries,
                 retry_backoff_s=args.retry_backoff_s, **spec_kw,
@@ -2998,6 +3290,18 @@ def main(argv=None) -> int:
     parser.add_argument("--pipelined", action="store_true",
                         help="overlap each chunk's readback with the next "
                         "chunk's compute (same tokens, higher throughput)")
+    parser.add_argument("--superstep-k", type=int, default=1, metavar="K",
+                        help="decode supersteps: run K chained decode "
+                        "chunks per device dispatch with device-side "
+                        "eos/max-token retirement masks and a "
+                        "double-buffered scheduler (admission planning "
+                        "and lifecycle polling overlap the superstep's "
+                        "device compute) — divides the per-chunk host "
+                        "round-trip tax by K on high-latency links at "
+                        "the cost of admission landing at superstep "
+                        "boundaries; greedy streams are bit-identical "
+                        "for every K (docs/SERVING.md 'Decode "
+                        "supersteps & double-buffered scheduling')")
     parser.add_argument("--spec-int8-draft", action="store_true",
                         help="speculative decoding with the int8-quantized "
                         "model drafting for its own bf16 self (quantized "
@@ -3106,6 +3410,8 @@ def main(argv=None) -> int:
         parser.error("--metrics-port must be in [0, 65535] (0 = ephemeral)")
     if args.prefill_budget is not None and args.prefill_budget < 1:
         parser.error("--prefill-budget must be >= 1 token per step")
+    if args.superstep_k < 1:
+        parser.error("--superstep-k must be >= 1 chained chunks")
     if args.restart_backoff_s <= 0:
         parser.error("--restart-backoff-s must be > 0 seconds")
     if args.restart_backoff_max_s < args.restart_backoff_s:
@@ -3251,6 +3557,7 @@ def main(argv=None) -> int:
         prompt_bucket=bucket,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         rng=jax.random.PRNGKey(42), pipelined=args.pipelined,
+        superstep_k=args.superstep_k,
         prefill_budget=args.prefill_budget,
         adapters=adapters, observer=observer,
         max_pending=args.max_pending, fault_injector=injector,
@@ -3297,12 +3604,14 @@ def main(argv=None) -> int:
         f"{engine.chunks_run} chunks, steady-state ≈ {rate:.0f} tok/s "
         f"(int8={args.int8}, kv_heads={config.kv_heads}, "
         f"adapters={args.lora_adapters}, "
+        f"superstep_k={engine.superstep_k}, "
         f"pool={engine.ctrl.n_pages} pages, "
         f"pages in use after drain: {engine.ctrl.used_pages})"
     )
     if (
         rejected or engine.steps_quarantined or engine.requests_expired
         or engine.requests_failed or engine.requests_cancelled
+        or engine.superstep_k > 1
     ):
         from collections import Counter
 
@@ -3311,6 +3620,9 @@ def main(argv=None) -> int:
             f"lifecycle: statuses={dict(statuses)} rejected={rejected} "
             f"quarantined_steps={engine.steps_quarantined} "
             f"replays={engine.requests_retried} "
+            f"supersteps={engine.supersteps_run} "
+            f"tokens_overdecoded={engine.tokens_overdecoded} "
+            f"host_sync_ms={round(engine.host_sync_s * 1000, 1)} "
             f"recoveries_ms={[round(s * 1000, 1) for s in engine.fault_recovery_s]}"
         )
     if args.trace_out:

@@ -44,6 +44,7 @@ from .model import ModelConfig, param_specs
 from .ops.paged_attention import paged_attention
 from .paged import (
     _chunk_core,
+    _decode_superstep_core,
     _prefill_chunk_core,
     _prefill_core,
     _spec_round_core,
@@ -225,6 +226,73 @@ def make_tp_serve_programs(
         )
 
     return tp_prefill, tp_chunk
+
+
+def make_tp_decode_superstep(
+    config: ModelConfig, mesh: Mesh, chunk: int, k: int, sampling: bool,
+    lora_stacked=None, lora_alpha: float = 1.0,
+):
+    """Tensor-parallel plain-decode SUPERSTEP: ``k`` chained decode
+    chunks with device-side retirement masks
+    (paged.paged_decode_superstep) under the model mesh — scan-of-
+    shard_map for the paged-attention kernel, everything else GSPMD.
+
+    Returns ``call(params, pools, tables, token, positions, live,
+    budget, eos, rngs, temperature, top_k, top_p, lora=None)`` with the
+    single-device program's keyword interface (config/chunk/k/sampling
+    baked in); the per-row state quintuple comes back exactly as the
+    module-level jit returns it, so ``ServeEngine`` drives both builds
+    through one call site."""
+    _check_tp(config, mesh)
+    param_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs(config)
+    )
+    pool_sh = NamedSharding(mesh, _POOL_SPEC)
+    rep = lambda *axes: NamedSharding(mesh, P(*axes))  # noqa: E731
+    attention_fn = _tp_paged_attention(config, mesh)
+    lora_sh = (
+        ()
+        if lora_stacked is None
+        else (jax.tree.map(lambda _: rep(), lora_stacked), rep(None))
+    )
+
+    @partial(
+        jax.jit,
+        donate_argnums=(1,),
+        in_shardings=(
+            param_sh, (pool_sh, pool_sh), rep(None, None), rep(None),
+            rep(None), rep(None), rep(None), rep(None), rep(None, None),
+            rep(), rep(), rep(), *lora_sh,
+        ),
+        out_shardings=(
+            rep(None, None), rep(None), rep(None), rep(None), rep(None),
+            (pool_sh, pool_sh),
+        ),
+    )
+    def tp_superstep(
+        params, pools, tables, token, positions, live, budget, eos, rngs,
+        temperature, top_k, top_p, *lora_args,
+    ):
+        lora = (
+            (lora_args[0], lora_args[1], lora_alpha) if lora_args else None
+        )
+        return _decode_superstep_core(
+            params, pools, tables, token, positions, live, budget, eos,
+            rngs, temperature, top_k, top_p, config, chunk, k, sampling,
+            attention_fn=attention_fn, lora=lora,
+        )
+
+    def call(
+        params, pools, tables, token, positions, live, budget, eos, rngs,
+        temperature, top_k, top_p, lora=None,
+    ):
+        lora_ops = () if lora is None else (lora[0], lora[1])
+        return tp_superstep(
+            params, pools, tables, token, positions, live, budget, eos,
+            rngs, temperature, top_k, top_p, *lora_ops,
+        )
+
+    return call
 
 
 def make_tp_prefill_chunk(
