@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check kvcache-check fmt-check
 
 all: native
 
@@ -51,7 +51,17 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check test
+check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check kvcache-check test
+
+# KV-cache-hierarchy tripwires (docs/SERVING.md "KV-cache hierarchy"):
+# radix-tree parity vs the flat chain cache on one repeated-prefix
+# stream plus one forced host-RAM offload/reload round trip, both
+# asserted bit-identical to the uncached oracle, with the pool and the
+# host tier fully reclaimed at close.  The full ≥15-contract suite and
+# the kv_offload-randomized fuzz arms ride the slow suite
+# (tests/test_kv_hierarchy.py, tests/test_serve_fuzz.py).
+kvcache-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_kv_hierarchy.py::test_kvcache_smoke" "tests/test_kv_hierarchy.py::test_radix_never_orphans_suffix_unlike_flat_lru" -q -o addopts=
 
 # Decode-superstep tripwires (docs/SERVING.md "Decode supersteps &
 # double-buffered scheduling"): the k-sweep parity smoke — greedy

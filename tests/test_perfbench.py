@@ -220,6 +220,17 @@ def test_perfbench_tiny_end_to_end():
         "spec_engine_vs_plain_b1",
         "spec_engine_vs_plain_b4",
         "spec_engine_best_k",
+        # KV-cache hierarchy arm (docs/SERVING.md "KV-cache
+        # hierarchy").
+        "kv_multiturn_speedup",
+        "kv_radix_vs_flat_hit_ratio",
+        "kv_flat_hit_pages",
+        "kv_radix_hit_pages",
+        "kv_oversub_pool_pages",
+        "kv_oversub_live_pages",
+        "kv_offload_spills",
+        "kv_offload_reloads",
+        "kv_resident_pages_saved",
         # Cross-run-poolable ratio spreads.
         "paged_vs_contiguous_decode_samples",
         "paged_vs_contiguous_decode_min",
@@ -229,6 +240,15 @@ def test_perfbench_tiny_end_to_end():
     ):
         assert key in out, key
     assert 0.0 < out["serve_pool_peak_fraction"] <= 1.0
+    # KV hierarchy: the tiny trace genuinely oversubscribes its pool,
+    # the offload tier is exercised both directions (streams asserted
+    # bit-identical inside the arm), and the tree never hits fewer
+    # pages than the flat index on the same trace.
+    assert out["kv_oversub_live_pages"] > out["kv_oversub_pool_pages"]
+    assert out["kv_offload_spills"] >= 1
+    assert out["kv_offload_reloads"] >= 1
+    assert out["kv_offload_reload_ms"] > 0
+    assert out["kv_radix_hit_pages"] >= out["kv_flat_hit_pages"]
     assert out["fleet_replicas"] == 4
     assert out["fleet_tokens_per_sec"] > 0
     assert out["failover_recovery_ms"] > 0

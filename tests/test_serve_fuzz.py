@@ -47,6 +47,14 @@ def _run_one(seed: int, params, draft, adapters) -> None:
             rng=jax.random.PRNGKey(seed),
         )
     kw["prompt_bucket"] = int(kw["page_size"] * rng.choice([2, 3]))
+    # KV-cache hierarchy: the host-RAM offload tier randomizes on top
+    # of the radix cache — spills/reloads are bit-exact byte
+    # round-trips, so every oracle below holds offload on or off, and
+    # the drain hygiene at the bottom proves reclaim.
+    if kw["prefix_cache"] and rng.integers(2):
+        kw["kv_offload"] = True
+        if rng.integers(2):
+            kw["kv_host_pages"] = int(rng.integers(1, 9))
     # Decode supersteps: k chained chunks per dispatch with device-side
     # retirement masks must be emission-invariant for every k, across
     # every other arm in this matrix (docs/SERVING.md "Decode
@@ -153,6 +161,20 @@ def _run_one(seed: int, params, draft, adapters) -> None:
     # Hygiene: everything drained; only prefix-cache pins may remain.
     pinned = engine.prefix.cached_pages if engine.prefix is not None else 0
     assert engine.ctrl.used_pages == pinned, (seed, kw)
+    _assert_kv_reclaimed(engine, seed, kw)
+
+
+def _assert_kv_reclaimed(engine, seed, kw) -> None:
+    """close() must reclaim EVERY page the KV hierarchy holds: resident
+    cache pins release to the pool and offloaded host pages drop with
+    the index that owns them — the no-leak contract for the offload
+    tier (cancel/deadline/quarantine paths exercise the same clear()
+    seam mid-run)."""
+    engine.close()
+    assert engine.ctrl.used_pages == 0, (seed, kw)
+    if engine.prefix is not None:
+        assert engine.prefix.cached_pages == 0, (seed, kw)
+        assert getattr(engine.prefix, "offloaded_pages", 0) == 0, (seed, kw)
 
 
 def test_engine_feature_matrix_fuzz():
@@ -192,6 +214,14 @@ def _run_chaos(seed: int, params, draft, adapters) -> None:
         pipelined=bool(rng.integers(2)),
     )
     kw["prompt_bucket"] = int(kw["page_size"] * rng.choice([2, 3]))
+    # KV-cache hierarchy under chaos: offloaded pages must survive (or
+    # be flushed by) quarantines, and replays through reloaded pages
+    # must stay bit-identical — randomized here, reclaim asserted at
+    # the bottom.
+    if kw["prefix_cache"] and rng.integers(2):
+        kw["kv_offload"] = True
+        if rng.integers(2):
+            kw["kv_host_pages"] = int(rng.integers(1, 9))
     # Decode supersteps under chaos: a fault mid-superstep drops the
     # whole in-flight superstep and replays bit-identically; cancels /
     # deadlines / health pauses must reclaim it without leaks.
@@ -291,6 +321,7 @@ def _run_chaos(seed: int, params, draft, adapters) -> None:
     assert not engine._groups, (seed, kw)
     pinned = engine.prefix.cached_pages if engine.prefix is not None else 0
     assert engine.ctrl.used_pages == pinned, (seed, kw)
+    _assert_kv_reclaimed(engine, seed, kw)
 
 
 def test_engine_fault_chaos_smoke():

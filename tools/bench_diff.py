@@ -48,6 +48,9 @@ TRACKED_UP = [
     "admission_tokens_per_sec",
     "admission_speedup",
     "prefix_serve_speedup",
+    # KV-cache hierarchy: radix-over-flat wall clock on the multi-turn
+    # trace — a drop means the tree (or its eviction policy) regressed.
+    "kv_multiturn_speedup",
     "spec_serve_tokens_per_sec",
     "spec_serve_lookahead_tokens_per_sec",
     "spec_engine_vs_plain_b1",
@@ -82,6 +85,9 @@ TRACKED_DOWN = [
     # Self-healing: replica death -> probed replacement rejoined the
     # router (crash included; the supervisor PR's robustness number).
     "selfheal_restore_ms",
+    # KV-cache hierarchy: per-page host-RAM reload cost — a rise means
+    # offloaded conversations started paying more to come back.
+    "kv_offload_reload_ms",
 ]
 
 # The serving keys whose thresholds derive from the artifact's own
@@ -91,6 +97,8 @@ SPREAD_GUARDED = set(TRACKED_DOWN) | {
     "superstep_tokens_per_sec",
     "fleet_tokens_per_sec",
     "selfheal_capacity_recovered",
+    "prefix_serve_speedup",
+    "kv_multiturn_speedup",
 }
 
 

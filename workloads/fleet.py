@@ -185,10 +185,18 @@ class Router:
     the first ``prefix_tokens`` prompt tokens — requests sharing a
     system prompt land on the replica that already holds its KV pages
     (the prefix cache is per-engine, so affinity is what makes it pay
-    fleet-wide).  Affinity yields to balance: a sticky replica more
-    than ``affinity_slack`` requests above the least-loaded one is
-    skipped (classic bounded-load consistent placement).  Deterministic
-    throughout — ties break on the lowest replica index."""
+    fleet-wide).  When replicas carry the RADIX prefix index
+    (``prefix_cache=True`` engines), a sticky miss falls through to
+    MEASURED affinity: each in-slack candidate is scored by its tree's
+    actual longest-prefix match depth for THIS prompt
+    (``RadixKV.match_depth`` — offloaded pages count; they reload on
+    hit), and the deepest match wins — so a replica that genuinely
+    holds a conversation's pages attracts its next turn even when the
+    opaque session/prefix key never saw it.  Affinity yields to
+    balance: a sticky replica more than ``affinity_slack`` requests
+    above the least-loaded one is skipped (classic bounded-load
+    consistent placement).  Deterministic throughout — ties break on
+    (load, lowest replica index)."""
 
     def __init__(self, *, affinity_slack: int = 2, prefix_tokens: int = 16):
         if affinity_slack < 0:
@@ -204,11 +212,29 @@ class Router:
         self._affinity: dict = {}
         self.dispatches = 0
         self.affinity_hits = 0
+        self.radix_hits = 0  # picks won by measured radix match depth
 
     def _key(self, fr: FleetRequest):
         if fr.session is not None:
             return ("session", fr.session)
         return ("prefix", tuple(fr.prompt[: self.prefix_tokens]))
+
+    @staticmethod
+    def _radix_depth(rep: Replica, fr: FleetRequest) -> int:
+        """Pages of this prompt the replica's radix index already holds
+        (0 when the engine runs no cache, a flat cache, or the probe
+        fails — measured affinity degrades to the key-based policy,
+        never breaks dispatch)."""
+        prefix = getattr(rep.engine, "prefix", None)
+        match = getattr(prefix, "match_depth", None)
+        if match is None:
+            return 0
+        try:
+            aidx = rep.engine._adapter_ids.get(fr.adapter, 0)
+            salt = f"lora:{aidx}" if aidx else ""
+            return int(match(fr.prompt, salt=salt))
+        except Exception:
+            return 0
 
     def choose(
         self, fr: FleetRequest, candidates: list[Replica],
@@ -229,9 +255,26 @@ class Router:
                         self.affinity_hits += 1
                         return sticky
                     break
-        pick = min(
-            candidates, key=lambda r: (loads[r.index], r.index)
-        ).index
+        # Measured affinity: among candidates within the load slack,
+        # the replica whose radix tree holds the DEEPEST actual prefix
+        # of this prompt wins (adapter-salted, offloaded pages count);
+        # depth 0 everywhere falls through to plain least-loaded.
+        in_slack = [
+            r for r in candidates
+            if loads[r.index] <= min_load + self.affinity_slack
+        ]
+        depths = {r.index: self._radix_depth(r, fr) for r in in_slack}
+        best = max(
+            in_slack,
+            key=lambda r: (depths[r.index], -loads[r.index], -r.index),
+        )
+        if depths[best.index] > 0:
+            self.radix_hits += 1
+            pick = best.index
+        else:
+            pick = min(
+                candidates, key=lambda r: (loads[r.index], r.index)
+            ).index
         self._affinity[key] = pick
         return pick
 

@@ -140,6 +140,21 @@ ENGINE_METRICS: tuple[MetricSpec, ...] = (
         "KV-cache pages currently held by live sequences (scrape-time)",
     ),
     MetricSpec(
+        "engine_prefix_hit_pages_total", "counter", ("engine",),
+        "prompt pages served from the prefix cache (radix or flat) "
+        "instead of re-prefilling — host-RAM reloads included",
+    ),
+    MetricSpec(
+        "engine_prefix_miss_total", "counter", ("engine",),
+        "prefix-cache lookups that matched nothing (the prompt "
+        "prefilled from scratch)",
+    ),
+    MetricSpec(
+        "engine_kv_offloaded_pages", "gauge", ("engine",),
+        "KV pages currently parked in the host-RAM offload tier "
+        "(kv_offload; scrape-time — state held without holding HBM)",
+    ),
+    MetricSpec(
         "engine_paused", "gauge", ("engine",),
         "1 while the health bridge holds admission paused on an "
         "Unhealthy chip (scrape-time; fleet routers read this as the "
@@ -477,6 +492,11 @@ class EngineObserver:
         "engine_paused": (
             lambda e: 1.0 if getattr(e, "paused", False) else 0.0
         ),
+        "engine_kv_offloaded_pages": (
+            lambda e: getattr(
+                getattr(e, "prefix", None), "offloaded_pages", 0
+            ) or 0
+        ),
     }
 
     # Lifecycle counter families -> the ServeEngine attribute carrying
@@ -535,6 +555,7 @@ class EngineObserver:
 
     def _step_begin(self, engine) -> tuple:
         self._readback_secs = 0.0
+        prefix = getattr(engine, "prefix", None)
         return (
             time.perf_counter(),
             engine.generated_tokens,
@@ -548,12 +569,14 @@ class EngineObserver:
             getattr(engine, "prefill_deferred_tokens", 0),
             getattr(engine, "host_sync_s", 0.0),
             getattr(engine, "tokens_overdecoded", 0),
+            getattr(prefix, "hits", 0),
+            getattr(prefix, "misses", 0),
         )
 
     def _step_end(self, engine, snap: tuple, finished) -> StepRecord:
         (
             t0, tokens0, adm0, ret0, pd0, sw0, ch0, sr0, ms0, dt0, hs0,
-            od0,
+            od0, ph0, pm0,
         ) = snap
         dur = time.perf_counter() - t0
         host_sync = getattr(engine, "host_sync_s", 0.0) - hs0
@@ -625,6 +648,15 @@ class EngineObserver:
                 reg.inc(
                     "engine_tokens_overdecoded_total", labels, overdecoded
                 )
+            prefix = getattr(engine, "prefix", None)
+            prefix_hits = getattr(prefix, "hits", 0) - ph0
+            prefix_misses = getattr(prefix, "misses", 0) - pm0
+            if prefix_hits:
+                reg.inc(
+                    "engine_prefix_hit_pages_total", labels, prefix_hits
+                )
+            if prefix_misses:
+                reg.inc("engine_prefix_miss_total", labels, prefix_misses)
             if host_sync > 0:
                 reg.observe_seconds("engine_host_sync", host_sync, labels)
             self._push_lifecycle(engine, reg, labels)

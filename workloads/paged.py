@@ -42,6 +42,8 @@ the JAX serving workloads (SURVEY.md §7 step 8).
 
 from __future__ import annotations
 
+import hashlib
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -152,6 +154,19 @@ class PagePool:
         for p in self.tables.pop(seq_id):
             self._unref(p)
 
+    def take_page(self) -> int:
+        """Claim ONE free physical page with no table attached (refcount
+        1, owned by the caller) — the KV-hierarchy reload path: a page
+        spilled to host RAM comes back into whichever free page is
+        handy, re-pinned by the cache index rather than a sequence.
+        Pair with release_page."""
+        if not self.free:
+            raise RuntimeError("page pool exhausted: no free page to take")
+        page = self.free.pop()
+        self.refcounts[page] = 1
+        self.peak_used = max(self.peak_used, self.used_pages)
+        return page
+
     def retain_page(self, page: int) -> None:
         """Pin one allocated physical page independently of any table —
         e.g. a fan-out group keeps the first member's partial tail page
@@ -193,9 +208,24 @@ class PagePool:
         return self.n_pages - len(self.free)
 
 
+def _chain_key(prev: bytes, block: list[int]) -> bytes:
+    """One chain-hash step: the digest committing to ``block`` AND every
+    block before it (``prev`` is the previous digest, or the salt for
+    block 0).  Shared by the flat PrefixCache and the RadixKV tree so
+    their key spaces cannot drift."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(prev)
+    h.update(b",".join(str(t).encode() for t in block))
+    return h.digest()
+
+
 class PrefixCache:
     """Cross-request prefix index over a PagePool: token blocks → the
-    physical pages already holding their k/v.
+    physical pages already holding their k/v.  The FLAT baseline of the
+    KV-cache hierarchy — ``RadixKV`` below supersedes it as the
+    engine's default (``prefix_cache=True``); this stays as
+    ``prefix_cache="flat"``, the comparison arm the bench's
+    ``kv_multiturn_speedup`` is measured against.
 
     Two independent requests with the same system prompt should not
     re-prefill it, nor store its k/v twice.  Keys are CHAIN hashes of
@@ -221,8 +251,6 @@ class PrefixCache:
         self.ctrl = ctrl
         self.page_size = ctrl.page_size
         # chain key -> page, in insertion/use order (LRU via move_to_end).
-        from collections import OrderedDict
-
         self._index: "OrderedDict[bytes, int]" = OrderedDict()
         self.hits = 0  # pages served from cache
         self.misses = 0  # lookups that found nothing
@@ -234,16 +262,10 @@ class PrefixCache:
         partitions the key space — the engine passes the adapter id, so
         cached pages (which hold ADAPTED k/v under multi-LoRA) are never
         shared across adapters."""
-        import hashlib
-
         ps = self.page_size
         keys, prev = [], salt.encode()
         for i in range(n_pages):
-            block = tokens[i * ps : (i + 1) * ps]
-            h = hashlib.blake2b(digest_size=16)
-            h.update(prev)
-            h.update(b",".join(str(t).encode() for t in block))
-            prev = h.digest()
+            prev = _chain_key(prev, tokens[i * ps : (i + 1) * ps])
             keys.append(prev)
         return keys
 
@@ -255,15 +277,17 @@ class PrefixCache:
         ``max_pages`` and floored to a multiple of ``granularity`` (the
         engine passes its bucket page count so partial prefill keeps its
         static shapes).  Touches only the RETURNED entries' LRU position,
-        and counts only them as hits."""
-        keys, pages = [], []
-        for key in self._keys(
-            tokens, min(max_pages, len(tokens) // self.page_size), salt
-        ):
-            page = self._index.get(key)
+        and counts only them as hits.  Chain keys hash INCREMENTALLY —
+        the walk stops at the first missing block, so a miss-heavy
+        stream never pays for hashing the whole prompt."""
+        ps = self.page_size
+        keys, pages, prev = [], [], salt.encode()
+        for i in range(min(max_pages, len(tokens) // ps)):
+            prev = _chain_key(prev, tokens[i * ps : (i + 1) * ps])
+            page = self._index.get(prev)
             if page is None:
                 break
-            keys.append(key)
+            keys.append(prev)
             pages.append(page)
         keep = len(pages) // granularity * granularity
         keys, pages = keys[:keep], pages[:keep]
@@ -312,6 +336,343 @@ class PrefixCache:
     @property
     def cached_pages(self) -> int:
         return len(self._index)
+
+
+class RadixNode:
+    """One page-sized token block in the RadixKV tree.  Exactly one of
+    ``page`` (resident: a pool page pinned through the pool refcounts)
+    or ``host`` (offloaded: the page's k/v bytes in host RAM, engine-
+    provided blob) is set for a real node; the per-salt root has
+    neither.  ``last_use`` is the tree's LRU clock at the node's last
+    hit/insert."""
+
+    __slots__ = ("block", "parent", "children", "page", "host", "last_use")
+
+    def __init__(self, block, parent):
+        self.block = block
+        self.parent = parent
+        self.children: dict = {}
+        self.page: int | None = None
+        self.host = None
+        self.last_use = 0
+
+
+class RadixKV:
+    """Radix-tree prefix index over a PagePool, with an optional
+    host-RAM offload tier — the KV-cache hierarchy (docs/SERVING.md
+    "KV-cache hierarchy").
+
+    Same contract as the flat ``PrefixCache`` where they overlap
+    (lookup/insert/evict/clear, adapter-salted key space, pages pinned
+    through the pool refcounts, promissory inserts safe because a
+    sequence's own table holds every inserted page at refcount >= 2
+    until retirement), plus what the tree structure buys:
+
+      * **longest-prefix match** walks page-sized token blocks from the
+        per-salt root, so two prompts sharing ONLY a system prompt
+        still share those pages — and ``match_depth`` exposes the walk
+        read-only, the fleet router's affinity score;
+      * **structural eviction**: LRU victims are chosen leaf-first and
+        eviction walks UP the tree (dropping a leaf exposes its
+        parent) — an interior node with children is never dropped, so
+        a reachable suffix can never be orphaned behind a missing
+        block, the flat index's silent-garbage mode;
+      * **offload tier**: with a host-page budget, a victim's page
+        SPILLS to pinned host memory (the caller's ``spill`` callback
+        copies the bytes out) instead of dropping, and a later lookup
+        RELOADS it through the ``reload`` callback — thousands of idle
+        conversations hold state without holding HBM.  Spill/reload
+        round-trips are bit-exact (device_get/device_put of the same
+        dtype), so streams are bit-identical offload on/off (pinned by
+        tests/test_kv_hierarchy.py).
+
+    Control-plane only: no jax imports run here; the engine owns the
+    device copies (read_page/write_page below).
+
+    Reference pendant: none — mechanism per the SGLang RadixAttention
+    design, rebuilt over this pool's refcounts.
+    """
+
+    def __init__(self, ctrl: PagePool, host_pages: int | None = 0):
+        self.ctrl = ctrl
+        self.page_size = ctrl.page_size
+        # host_pages: 0 disables the offload tier (evictions drop),
+        # None is an unbounded host budget, N caps offloaded pages.
+        if host_pages is not None and host_pages < 0:
+            raise ValueError(
+                f"host_pages must be >= 0 or None (unbounded), got "
+                f"{host_pages}"
+            )
+        self.host_pages = host_pages
+        self._roots: dict[str, RadixNode] = {}
+        self._clock = 0
+        # Pages matched by an IN-PROGRESS lookup: a reload mid-walk may
+        # recurse into evict (making room for the reloaded page), which
+        # must not victimize pages the walk already matched — they are
+        # pinned only by the index (refcount 1) until the caller adopts
+        # them.
+        self._locked: set[int] = set()
+        self.hits = 0  # pages served from the index (reloads included)
+        self.misses = 0  # lookups that matched nothing
+        self.reloads = 0  # pages brought back from the host tier
+        self.spills = 0  # pages pushed out to the host tier
+        self._resident = 0
+        self._offloaded = 0
+
+    # ---- tree walks -----------------------------------------------------
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def match_depth(self, tokens: list[int], salt: str = "") -> int:
+        """Pages of ``tokens`` this index knows — resident OR offloaded
+        (an offloaded page is still a prefill the owner saved) — the
+        fleet router's per-replica affinity score.  Read-only: no LRU
+        touch, no hit/miss accounting."""
+        node = self._roots.get(salt)
+        if node is None:
+            return 0
+        ps, depth = self.page_size, 0
+        for i in range(len(tokens) // ps):
+            node = node.children.get(tuple(tokens[i * ps : (i + 1) * ps]))
+            if node is None:
+                break
+            depth += 1
+        return depth
+
+    def lookup(
+        self, tokens: list[int], max_pages: int, granularity: int = 1,
+        salt: str = "", reload=None,
+    ) -> list[int]:
+        """Longest known prefix of ``tokens``, as RESIDENT pages, capped
+        at ``max_pages`` and floored to a ``granularity`` multiple (the
+        engine's bucket page count — partial prefill keeps its static
+        shapes).  An OFFLOADED node on the path reloads through
+        ``reload(host_blob) -> page | None`` (the engine restores the
+        bytes into a freshly taken pool page); without a reload
+        callback, or when it cannot make room, the match stops there —
+        a shorter hit, never a failure.  The walk is stepwise against
+        the live tree, so an evict fired by a mid-walk reload can never
+        hand back a freed page.
+
+        The walk is bounded UP FRONT by the granularity-floored known
+        depth (match_depth, offloaded nodes included): reloading a page
+        the floor would then drop pays a full HBM <-> host round trip
+        for zero shared pages — and thrashes, because the unused
+        reloaded page is the next eviction's coldest victim.  A reload
+        also refreshes the node's LRU tick (bringing a page back IS a
+        use), so a reload that a mid-walk failure strands beyond the
+        floor cannot be immediately re-spilled."""
+        ps = self.page_size
+        node = self._roots.get(salt)
+        matched: list[RadixNode] = []
+        if node is not None:
+            bound = min(max_pages, len(tokens) // ps)
+            usable = (
+                min(self.match_depth(tokens, salt), bound)
+                // granularity * granularity
+            )
+            try:
+                for i in range(usable):
+                    child = node.children.get(
+                        tuple(tokens[i * ps : (i + 1) * ps])
+                    )
+                    if child is None:
+                        break
+                    if child.page is None:
+                        if reload is None:
+                            break
+                        page = reload(child.host)
+                        if page is None:
+                            break
+                        child.page = page
+                        child.host = None
+                        self._offloaded -= 1
+                        self._resident += 1
+                        self.reloads += 1
+                        child.last_use = self._tick()
+                    matched.append(child)
+                    self._locked.add(child.page)
+                    node = child
+            finally:
+                self._locked.clear()
+        keep = len(matched) // granularity * granularity
+        pages = []
+        for n in matched[:keep]:
+            n.last_use = self._tick()
+            pages.append(n.page)
+        if pages:
+            self.hits += len(pages)
+        else:
+            self.misses += 1
+        return pages
+
+    def insert(
+        self, tokens: list[int], table: list[int], salt: str = ""
+    ) -> None:
+        """Register a just-prefilled sequence's full prompt pages (the
+        first len(tokens)//page_size entries of its table).  New nodes
+        pin their page; known resident nodes just refresh LRU; an
+        OFFLOADED node whose blocks this prefill re-wrote re-anchors to
+        the freshly written page (same bytes by construction) and drops
+        its host copy."""
+        ps = self.page_size
+        node = self._roots.setdefault(salt, RadixNode(None, None))
+        for i in range(len(tokens) // ps):
+            block = tuple(tokens[i * ps : (i + 1) * ps])
+            child = node.children.get(block)
+            if child is None:
+                child = RadixNode(block, node)
+                node.children[block] = child
+            if child.page is None:
+                if child.host is not None:
+                    child.host = None
+                    self._offloaded -= 1
+                self.ctrl.retain_page(table[i])
+                child.page = table[i]
+                self._resident += 1
+            child.last_use = self._tick()
+            node = child
+
+    # ---- eviction / offload ---------------------------------------------
+
+    def _nodes(self):
+        for root in self._roots.values():
+            stack = list(root.children.values())
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                yield n
+
+    def _droppable(self, node: RadixNode) -> bool:
+        """May this node leave the tree outright?  Leaves only: an
+        interior node anchors the chain its descendants are reachable
+        through (offloaded descendants included)."""
+        return not node.children
+
+    def _drop(self, node: RadixNode) -> None:
+        if node.page is not None:
+            self.ctrl.release_page(node.page)
+            self._resident -= 1
+        elif node.host is not None:
+            node.host = None
+            self._offloaded -= 1
+        del node.parent.children[node.block]
+
+    def _host_budget_left(self) -> bool:
+        return self.host_pages is None or self._offloaded < self.host_pages
+
+    def evict(self, n_pages: int, spill=None) -> int:
+        """Free up to ``n_pages`` POOL pages, coldest (LRU) first, from
+        nodes whose page only the index holds (pool refcount 1 — live
+        readers are never victims).  With a ``spill(page) -> blob``
+        callback and host budget left, a victim OFFLOADS (page freed,
+        node survives in the host tier); otherwise only LEAF nodes drop
+        outright, and dropping a leaf exposes its parent to the next
+        pass — eviction walks up the tree.  Returns pages freed."""
+        freed = 0
+        while freed < n_pages:
+            victims = sorted(
+                (
+                    n for n in self._nodes()
+                    if n.page is not None
+                    and n.page not in self._locked
+                    and self.ctrl.refcounts.get(n.page) == 1
+                ),
+                key=lambda n: n.last_use,
+            )
+            progress = False
+            for node in victims:
+                if freed >= n_pages:
+                    break
+                if spill is not None and self._host_budget_left():
+                    blob = spill(node.page)
+                    if blob is not None:
+                        self.ctrl.release_page(node.page)
+                        node.page = None
+                        node.host = blob
+                        self._resident -= 1
+                        self._offloaded += 1
+                        self.spills += 1
+                        freed += 1
+                        progress = True
+                        continue
+                if self._droppable(node):
+                    self._drop(node)
+                    freed += 1
+                    progress = True
+            if not progress:
+                break
+        return freed
+
+    def clear(self) -> None:
+        """Drop the whole index: resident pages release back to the
+        pool, host blobs free — the close/quarantine-flush path (an
+        offloaded page must not outlive the cache that owns it)."""
+        for root in self._roots.values():
+            stack = list(root.children.values())
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                if n.page is not None:
+                    self.ctrl.release_page(n.page)
+        self._roots.clear()
+        self._resident = 0
+        self._offloaded = 0
+
+    # ---- accounting -----------------------------------------------------
+
+    @property
+    def cached_pages(self) -> int:
+        """POOL pages currently pinned by the index (the fuzz arms'
+        drain accounting) — offloaded entries hold none."""
+        return self._resident
+
+    @property
+    def offloaded_pages(self) -> int:
+        return self._offloaded
+
+    @property
+    def node_count(self) -> int:
+        return sum(1 for _ in self._nodes())
+
+
+@jax.jit
+def read_page(pools: tuple[jax.Array, jax.Array], src):
+    """Slice ONE physical page (all layers, k and v) out of the pools —
+    the KV-hierarchy SPILL primitive: the engine device_gets the
+    returned pair into pinned host memory.  ``src`` is a traced scalar,
+    so every spill shares one compile; returns
+    (k [L, Hkv, ps, hd], v [L, Hkv, ps, hd])."""
+    k_pages, v_pages = pools
+    src = jnp.asarray(src, jnp.int32)
+
+    def one(pool):
+        return jax.lax.dynamic_slice_in_dim(pool, src, 1, axis=1)[:, 0]
+
+    return one(k_pages), one(v_pages)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def write_page(
+    pools: tuple[jax.Array, jax.Array], k_page, v_page, dst
+) -> tuple[jax.Array, jax.Array]:
+    """Write one page's k/v bytes into the pools at physical page
+    ``dst`` — the KV-hierarchy RELOAD primitive (host blob back into a
+    freshly taken pool page).  dst is a traced scalar so every reload
+    shares one compile; pools are DONATED (in-place dynamic update).
+    device_get -> write_page round-trips are bit-exact for same-dtype
+    arrays, which is what keeps streams identical offload on/off."""
+    k_pages, v_pages = pools
+    dst = jnp.asarray(dst, jnp.int32)
+
+    def one(pool, page):
+        return jax.lax.dynamic_update_slice_in_dim(
+            pool, page[:, None].astype(pool.dtype), dst, axis=1
+        )
+
+    return one(k_pages, k_page), one(v_pages, v_page)
 
 
 def init_page_pools(

@@ -2405,6 +2405,174 @@ def measure_prefix_serve(scale: BenchScale) -> dict:
     }
 
 
+def measure_kv_hierarchy(scale: BenchScale) -> dict:
+    """The KV-cache hierarchy (docs/SERVING.md "KV-cache hierarchy"),
+    measured on the traffic it exists for: a MULTI-TURN trace —
+    conversations sharing a few-shot system template, every turn's
+    prompt = the whole history — on a pool too small to keep every
+    conversation resident.
+
+    Two questions, answered separately (the arms are distinct engines,
+    so neither mechanism's number can credit the other):
+
+      * **radix vs flat under pressure** (same tight pool, NO offload,
+        interleaved repeats): the flat chain index evicts LRU-first,
+        which orphans chains behind a dropped middle block, while the
+        radix tree evicts leaf-first so surviving pages are always a
+        usable prefix — published as the hit-page counts of each arm
+        and the wall-clock ratio ``kv_multiturn_speedup``, a property
+        of the TREE alone.
+
+      * **the offload tier under oversubscription** (same trace, same
+        tight pool, ``kv_offload=True``): live conversation state
+        exceeds the pool, cold pages park in host RAM and reload on
+        hit; every greedy stream is ASSERTED bit-identical to a
+        roomy-pool engine's, and the published costs are the per-page
+        ``kv_offload_reload_ms`` / spill ms plus
+        ``kv_resident_pages_saved`` (peak pages held without holding
+        HBM)."""
+    import statistics
+
+    from .serve import ServeEngine
+
+    ps = scale.page_size
+    prefix_len = 4 * ps  # the shared system/few-shot template
+    tail, turns, new = ps, 3, 1  # max_new=1: the window IS prefill
+    n_conv = max(3, scale.batch // 2)
+    longest = prefix_len + turns * (tail + new)
+    chunk = ps
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model,
+        n_heads=scale.n_heads, n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=longest + 2 * chunk,
+    )
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype),
+        init_params(config, jax.random.PRNGKey(0)),
+    )
+    system = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(5), (prefix_len,), 0, config.vocab_size,
+        jnp.int32,
+    )]
+
+    def serve(cache, n_pages=None, kv_offload=False):
+        """Run the full multi-turn trace; returns (engine, streams,
+        secs, peak_offloaded).  The trace is deterministic — turn
+        tails derive from (conversation, turn) — so every arm serves
+        byte-identical traffic."""
+        engine = ServeEngine(
+            params, config, slots=min(2, n_conv), page_size=ps,
+            chunk=chunk, prompt_bucket=2 * ps, n_pages=n_pages,
+            prefix_cache=cache, kv_offload=kv_offload,
+        )
+        engine.submit(system + [1] * tail, new)  # warm compile, uncounted
+        engine.run()
+        history = [
+            system + [100 + ci] * tail for ci in range(n_conv)
+        ]
+        outs, peak_offloaded = [], 0
+        t0 = time.perf_counter()
+        for turn in range(turns):
+            for ci in range(n_conv):
+                rid = engine.submit(history[ci], new)
+                toks = engine.run()[rid]
+                outs.append(list(toks))
+                history[ci] = (
+                    history[ci] + list(toks)
+                    + [200 + ci * turns + turn] * tail
+                )
+                if kv_offload:
+                    peak_offloaded = max(
+                        peak_offloaded, engine.prefix.offloaded_pages
+                    )
+        secs = time.perf_counter() - t0
+        return engine, outs, secs, peak_offloaded
+
+    # A pool that holds ONE conversation's worst case but nowhere near
+    # every conversation's cached history — the pressure regime.
+    probe = ServeEngine(
+        params, config, slots=2, page_size=ps, chunk=chunk,
+        prompt_bucket=2 * ps,
+    )
+    tight = probe._worst_case_pages(longest, new) + 2
+    probe.close()
+    live_pages = n_conv * (longest // ps)
+
+    oracle_e, oracle, _, _ = serve(False)  # roomy, uncached: the oracle
+    oracle_e.close()
+
+    flat_hits = radix_hits = 0
+    reload_ms_samples, spill_ms_samples = [], []
+    saved = 0
+    reloads = spills = 0
+
+    def flat_arm():
+        nonlocal flat_hits
+        e, outs, secs, _ = serve("flat", n_pages=tight)
+        assert outs == oracle, "flat-cache streams diverged"
+        flat_hits = max(flat_hits, e.prefix.hits)
+        e.close()
+        return secs
+
+    def radix_arm():
+        # PURE radix — no offload, so the headline speedup and the
+        # hit-page comparison credit the tree's structure alone.
+        nonlocal radix_hits
+        e, outs, secs, _ = serve(True, n_pages=tight)
+        assert outs == oracle, "radix-cache streams diverged"
+        radix_hits = max(radix_hits, e.prefix.hits)
+        e.close()
+        return secs
+
+    flat_s, radix_s = _interleaved_repeats(flat_arm, radix_arm)
+    ratios = [f / max(r, 1e-9) for f, r in zip(flat_s, radix_s)]
+
+    # The offload tier, measured on its own engines (same trace, same
+    # tight pool): parity asserted per repeat, per-page costs pooled.
+    for _ in range(3):
+        e, outs, _, peak = serve(True, n_pages=tight, kv_offload=True)
+        assert outs == oracle, "offload streams diverged"
+        saved = max(saved, peak)
+        reloads, spills = e.prefix.reloads, e.prefix.spills
+        if e.prefix.reloads:
+            reload_ms_samples.append(
+                round(e.kv_reload_s / e.prefix.reloads * 1000, 3)
+            )
+        if e.prefix.spills:
+            spill_ms_samples.append(
+                round(e.kv_spill_s / e.prefix.spills * 1000, 3)
+            )
+        e.close()
+    out = {
+        "kv_multiturn_conversations": n_conv,
+        "kv_multiturn_turns": turns,
+        "kv_prefix_tokens": prefix_len,
+        "kv_oversub_pool_pages": tight,
+        "kv_oversub_live_pages": live_pages,
+        "kv_flat_hit_pages": flat_hits,
+        "kv_radix_hit_pages": radix_hits,
+        "kv_radix_vs_flat_hit_ratio": round(
+            radix_hits / max(flat_hits, 1), 3
+        ),
+        "kv_multiturn_speedup": round(statistics.median(ratios), 3),
+        "kv_multiturn_speedup_min": round(min(ratios), 3),
+        "kv_multiturn_speedup_max": round(max(ratios), 3),
+        "kv_offload_spills": spills,
+        "kv_offload_reloads": reloads,
+        "kv_resident_pages_saved": saved,
+    }
+    if reload_ms_samples:
+        out["kv_offload_reload_ms"] = round(
+            statistics.median(reload_ms_samples), 3
+        )
+        out["kv_offload_reload_ms_samples"] = reload_ms_samples
+    if spill_ms_samples:
+        out["kv_offload_spill_ms"] = round(
+            statistics.median(spill_ms_samples), 3
+        )
+    return out
+
+
 def _publish_ratio_spread(
     out: dict, key: str, samples: list[float], prior: dict | None
 ) -> None:
@@ -2471,6 +2639,13 @@ def run(scale_name: str = "full", pool_with: dict | None = None) -> dict:
     out.update(measure_selfheal(scale))
     out.update(measure_admission(scale))
     out.update(measure_prefix_serve(scale))
+    kvh = measure_kv_hierarchy(scale)
+    out.update(kvh)
+    if "kv_offload_reload_ms_samples" in kvh:
+        _publish_ratio_spread(
+            out, "kv_offload_reload_ms",
+            kvh["kv_offload_reload_ms_samples"], pool_with,
+        )
     out.update(measure_spec_serve(scale))
     out.update(measure_spec_economics(scale))
     phases = measure_spec_phases(scale)

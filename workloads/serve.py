@@ -22,8 +22,12 @@ The flagship serving features compose here end-to-end: grouped-query
 attention (smaller pages), int8 weight-only bases (halved weight
 stream), paged memory with on-demand allocation, temperature/top-k/top-p
 sampling (traced knobs), fan-out sampling (shared prompt pages AND
-prefill), cross-request prefix caching (``prefix_cache=True``,
-adapter-salted), batched speculative decoding (``draft_params=``, with
+prefill), cross-request prefix caching (``prefix_cache=True`` — a
+radix tree with longest-prefix match, adapter-salted; ``"flat"`` keeps
+the chain-hash baseline) with an optional host-RAM KV offload tier
+(``kv_offload=True``: cold cached pages spill to pinned host buffers
+under pool pressure and reload on hit — docs/SERVING.md "KV-cache
+hierarchy"), batched speculative decoding (``draft_params=``, with
 optionally PIPELINED rounds chained on device, and ``spec="auto"``
 letting the engine pick speculative vs plain decode per step from live
 slot occupancy against a measured break-even threshold), multi-tenant
@@ -70,6 +74,7 @@ from .model import ModelConfig, init_params
 from .paged import (
     PagePool,
     PrefixCache,
+    RadixKV,
     copy_page,
     init_page_pools,
     paged_decode_chunk,
@@ -77,7 +82,9 @@ from .paged import (
     paged_decode_superstep,
     paged_prefill,
     paged_prefill_chunk,
+    read_page,
     table_array,
+    write_page,
 )
 
 
@@ -189,7 +196,9 @@ class ServeEngine:
         spec_breakeven: float | None = None,
         pipelined: bool = False,
         superstep_k: int = 1,
-        prefix_cache: bool = False,
+        prefix_cache: bool | str = False,
+        kv_offload: bool = False,
+        kv_host_pages: int | None = None,
         adapters: dict[str, list] | None = None,
         lora_alpha: float = 1.0,
         batched_admission: bool = True,
@@ -349,7 +358,59 @@ class ServeEngine:
         # few-shot preambles) reuse their k/v pages AND skip their prefill
         # compute.  Opt-in: with it on, drained engines intentionally keep
         # pages pinned in the index (evicted on demand, or clear()ed).
-        self.prefix = PrefixCache(self.ctrl) if prefix_cache else None
+        # True selects the RadixKV TREE (longest-prefix match across
+        # partial overlaps, leaf-first LRU eviction, the optional
+        # host-RAM offload tier); "flat" keeps the chain-hash PrefixCache
+        # as the comparison baseline (docs/SERVING.md "KV-cache
+        # hierarchy").  Greedy streams are bit-identical across off /
+        # flat / radix (cached pages hold the bytes prefill would have
+        # written; pinned by tests/test_kv_hierarchy.py).
+        if prefix_cache not in (False, True, "radix", "flat"):
+            raise ValueError(
+                f'prefix_cache must be False, True/"radix", or "flat", '
+                f"got {prefix_cache!r}"
+            )
+        if kv_offload and not prefix_cache:
+            raise ValueError(
+                "kv_offload is the prefix cache's host-RAM eviction tier; "
+                "it needs prefix_cache=True"
+            )
+        if kv_offload and prefix_cache == "flat":
+            raise ValueError(
+                'the host-RAM offload tier lives on the radix tree; use '
+                'prefix_cache=True (radix), not "flat", with kv_offload'
+            )
+        if kv_offload and mesh is not None:
+            raise ValueError(
+                "kv_offload is not supported under tensor parallelism "
+                "yet (page spills/reloads would round-trip sharded pools)"
+            )
+        if kv_host_pages is not None and not kv_offload:
+            raise ValueError(
+                "kv_host_pages bounds the kv_offload host tier; it has "
+                "no effect without kv_offload=True"
+            )
+        if kv_host_pages is not None and kv_host_pages < 1:
+            raise ValueError(
+                f"kv_host_pages must be >= 1 or None (unbounded), got "
+                f"{kv_host_pages}"
+            )
+        self._kv_offload = bool(kv_offload)
+        if prefix_cache == "flat":
+            self.prefix = PrefixCache(self.ctrl)
+        elif prefix_cache:
+            self.prefix = RadixKV(
+                self.ctrl,
+                host_pages=(kv_host_pages if kv_offload else 0),
+            )
+        else:
+            self.prefix = None
+        # Wall seconds spent moving KV pages across the HBM <-> host-RAM
+        # boundary (spills pay one device_get each; reloads dispatch
+        # async and ride the admission sweep) — the bench's
+        # kv_offload_reload_ms source.
+        self.kv_spill_s = 0.0
+        self.kv_reload_s = 0.0
         # Speculative serving: the draft model gets its OWN physical
         # pools but SHARES the control plane — same page indices, same
         # tables — so one allocator serves both caches.
@@ -818,9 +879,66 @@ class ServeEngine:
     def _ensure_free(self, need: int) -> None:
         """Evict index-only prefix-cache pages when the free list is short
         of ``need`` — the cache may pin every idle page at zero cost, but
-        never at the cost of an allocation the budget promised."""
+        never at the cost of an allocation the budget promised.  With the
+        offload tier armed, cold pages SPILL to pinned host buffers
+        instead of dropping, so the evicted state stays reloadable."""
         if self.prefix is not None and len(self.ctrl.free) < need:
-            self.prefix.evict(need - len(self.ctrl.free))
+            self._prefix_evict(need - len(self.ctrl.free))
+
+    def _prefix_evict(self, n: int) -> int:
+        """The one eviction call site: radix evictions spill when the
+        offload tier is on; the flat cache (and an offload-less radix
+        tree) drops — a single seam so the spill policy cannot drift
+        between the allocate/extend/reload paths."""
+        if self._kv_offload:
+            return self.prefix.evict(n, spill=self._spill_page)
+        return self.prefix.evict(n)
+
+    # ---- KV-cache hierarchy: host-RAM offload tier ----------------------
+
+    def _spill_page(self, page: int):
+        """Copy one cache-owned physical page (target pools, and draft
+        pools when speculation is loaded — cached pages hold BOTH models'
+        k/v under one index) into host RAM; returns the blob the radix
+        node keeps while offloaded.  The device_get is the spill's one
+        host sync (the page's arrays fetch as a single tuple)."""
+        t0 = time.perf_counter()
+        main = read_page(self.pools, page)
+        draft = (
+            read_page(self.d_pools, page)
+            if self.d_pools is not None else None
+        )
+        blob = jax.device_get((main, draft))
+        self.kv_spill_s += time.perf_counter() - t0
+        return blob
+
+    def _reload_page(self, blob):
+        """Bring one offloaded page's bytes back into a freshly taken
+        pool page (evicting/spilling colder index pages if the free list
+        is empty); returns the page index, or None when no page can be
+        made free — the lookup then treats the rest of the match as a
+        miss.  Pure dispatch (device_put + donated update): reloads ride
+        the admission sweep without an extra host sync.  The timer
+        starts AFTER the room-making eviction — a spill fired there
+        already bills its device_get to ``kv_spill_s``, and counting it
+        again here would inflate the published kv_offload_reload_ms."""
+        if not self.ctrl.free:
+            self._prefix_evict(1)
+        if not self.ctrl.free:
+            return None
+        t0 = time.perf_counter()
+        page = self.ctrl.take_page()
+        main, draft = blob
+        self.pools = write_page(
+            self.pools, jnp.asarray(main[0]), jnp.asarray(main[1]), page
+        )
+        if self.d_pools is not None and draft is not None:
+            self.d_pools = write_page(
+                self.d_pools, jnp.asarray(draft[0]), jnp.asarray(draft[1]),
+                page,
+            )
+        self.kv_reload_s += time.perf_counter() - t0
+        return page
 
     def _allocate_evicting(self, seq, n_tokens: int) -> list:
         self._ensure_free(self.ctrl.pages_needed(n_tokens))
@@ -1377,11 +1495,17 @@ class ServeEngine:
             # Cap hits to (a) leave >= 1 prompt token computed (the
             # last position's logits feed the first sample) and (b)
             # a bucket-aligned page count, so the partial prefill
-            # reuses the chunked programs' static shapes.
+            # reuses the chunked programs' static shapes.  With the
+            # offload tier on, hit pages parked in host RAM reload
+            # inside the lookup (their device_puts queue ahead of this
+            # admission's sweep, which then reads them).
             bp = self.prompt_bucket // self.page_size
             cap = (n - 1) // self.page_size // bp * bp
+            lookup_kw = (
+                {"reload": self._reload_page} if self._kv_offload else {}
+            )
             shared_pages = self.prefix.lookup(
-                tokens, cap, granularity=bp, salt=salt
+                tokens, cap, granularity=bp, salt=salt, **lookup_kw
             )
         if shared_pages:
             self.ctrl.adopt(seq, shared_pages)
@@ -3085,7 +3209,9 @@ def _run_fleet_cli(
             top_k=args.top_k, top_p=args.top_p,
             rng=jax.random.PRNGKey(42 + i), pipelined=args.pipelined,
             superstep_k=args.superstep_k,
-            prefill_budget=args.prefill_budget, adapters=adapters,
+            prefill_budget=args.prefill_budget,
+            prefix_cache=args.prefix_cache, kv_offload=args.kv_offload,
+            kv_host_pages=args.kv_host_pages, adapters=adapters,
             observer=observers[i],
             fault_injector=(
                 FaultInjector(replica_schedules[i])
@@ -3126,7 +3252,10 @@ def _run_fleet_cli(
                 top_k=args.top_k, top_p=args.top_p,
                 rng=jax.random.PRNGKey(4242), pipelined=args.pipelined,
                 superstep_k=args.superstep_k,
-                prefill_budget=args.prefill_budget, adapters=adapters,
+                prefill_budget=args.prefill_budget,
+                prefix_cache=args.prefix_cache,
+                kv_offload=args.kv_offload,
+                kv_host_pages=args.kv_host_pages, adapters=adapters,
                 max_retries=args.max_retries,
                 retry_backoff_s=args.retry_backoff_s, **spec_kw,
             )
@@ -3302,6 +3431,25 @@ def main(argv=None) -> int:
                         "boundaries; greedy streams are bit-identical "
                         "for every K (docs/SERVING.md 'Decode "
                         "supersteps & double-buffered scheduling')")
+    parser.add_argument("--prefix-cache", action="store_true",
+                        help="cross-request radix-tree prefix caching: "
+                        "prompts sharing any page-aligned prefix "
+                        "(system prompts, few-shot templates, "
+                        "multi-turn history) reuse its k/v pages and "
+                        "skip its prefill compute (docs/SERVING.md "
+                        "'KV-cache hierarchy')")
+    parser.add_argument("--kv-offload", action="store_true",
+                        help="KV-cache host-RAM offload tier (implies "
+                        "--prefix-cache): under pool pressure, cold "
+                        "cached pages spill to pinned host buffers "
+                        "instead of dropping and reload on a future "
+                        "hit — idle conversations hold state without "
+                        "holding HBM; greedy streams bit-identical "
+                        "offload on/off")
+    parser.add_argument("--kv-host-pages", type=int, default=None,
+                        metavar="N",
+                        help="with --kv-offload: cap the host tier at N "
+                        "offloaded pages (default: unbounded)")
     parser.add_argument("--spec-int8-draft", action="store_true",
                         help="speculative decoding with the int8-quantized "
                         "model drafting for its own bf16 self (quantized "
@@ -3412,6 +3560,12 @@ def main(argv=None) -> int:
         parser.error("--prefill-budget must be >= 1 token per step")
     if args.superstep_k < 1:
         parser.error("--superstep-k must be >= 1 chained chunks")
+    if args.kv_offload:
+        args.prefix_cache = True  # the offload tier lives on the cache
+    if args.kv_host_pages is not None and not args.kv_offload:
+        parser.error("--kv-host-pages bounds the --kv-offload host tier")
+    if args.kv_host_pages is not None and args.kv_host_pages < 1:
+        parser.error("--kv-host-pages must be >= 1 pages")
     if args.restart_backoff_s <= 0:
         parser.error("--restart-backoff-s must be > 0 seconds")
     if args.restart_backoff_max_s < args.restart_backoff_s:
@@ -3559,6 +3713,8 @@ def main(argv=None) -> int:
         rng=jax.random.PRNGKey(42), pipelined=args.pipelined,
         superstep_k=args.superstep_k,
         prefill_budget=args.prefill_budget,
+        prefix_cache=args.prefix_cache, kv_offload=args.kv_offload,
+        kv_host_pages=args.kv_host_pages,
         adapters=adapters, observer=observer,
         max_pending=args.max_pending, fault_injector=injector,
         max_retries=args.max_retries,
@@ -3611,17 +3767,25 @@ def main(argv=None) -> int:
     if (
         rejected or engine.steps_quarantined or engine.requests_expired
         or engine.requests_failed or engine.requests_cancelled
-        or engine.superstep_k > 1
+        or engine.superstep_k > 1 or args.kv_offload
     ):
         from collections import Counter
 
         statuses = Counter(r.status for r in engine.completed)
+        kv = ""
+        if args.kv_offload:
+            kv = (
+                f"kv_offloads={engine.prefix.spills} "
+                f"kv_reloads={engine.prefix.reloads} "
+                f"kv_host_pages_now={engine.prefix.offloaded_pages} "
+            )
         print(
             f"lifecycle: statuses={dict(statuses)} rejected={rejected} "
             f"quarantined_steps={engine.steps_quarantined} "
             f"replays={engine.requests_retried} "
             f"supersteps={engine.supersteps_run} "
             f"tokens_overdecoded={engine.tokens_overdecoded} "
+            f"{kv}"
             f"host_sync_ms={round(engine.host_sync_s * 1000, 1)} "
             f"recoveries_ms={[round(s * 1000, 1) for s in engine.fault_recovery_s]}"
         )
