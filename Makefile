@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check kvcache-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check kvcache-check slo-check fmt-check
 
 all: native
 
@@ -51,7 +51,19 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check kvcache-check test
+check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check kvcache-check slo-check test
+
+# Fleet-tracing + SLO tripwires (docs/OBSERVABILITY.md "Distributed
+# tracing & SLO attainment"): a seeded two-replica crash under the full
+# observability treatment — the merged multi-process chrome trace
+# (router + per-replica + supervisor lanes, failover attempts linked)
+# round-trips tools/trace_export.py --validate, per-class attainment
+# counters land on the registry, and streams stay oracle-true through
+# the failover.  The full suite (span stitching, first-segment TTFT
+# attribution, inert-parity across engine modes, burn-rate math) rides
+# tests/test_fleet_trace.py with the slow suite.
+slo-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_fleet_trace.py::test_slo_check_smoke" -q -o addopts=
 
 # KV-cache-hierarchy tripwires (docs/SERVING.md "KV-cache hierarchy"):
 # radix-tree parity vs the flat chain cache on one repeated-prefix

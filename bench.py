@@ -558,6 +558,11 @@ COMPACT_KEYS = [
     "fault_recovery_ms", "fault_injector_off_overhead_pct",
     "fleet_tokens_per_sec", "fleet_ttft_p99_ms",
     "router_overhead_ms", "failover_recovery_ms",
+    # Fleet-scope tracing + SLO classes: per-class attainment, the
+    # class-bound tails and the merged-trace observability tax.
+    "fleet_slo_attainment_interactive", "fleet_slo_attainment_bulk",
+    "fleet_interactive_ttft_p99_ms", "fleet_bulk_tpot_p99_ms",
+    "fleet_trace_overhead_pct", "fleet_trace_on_tokens_per_sec",
     "selfheal_restore_ms", "selfheal_capacity_recovered",
     "selfheal_goodput_retained",
     "replica_restore_cold_ms", "replica_restore_warm_ms",

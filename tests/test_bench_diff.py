@@ -111,6 +111,39 @@ def test_diff_warns_on_drop_and_notes_gains():
     assert not any("serve_tokens_per_sec" in line for line in lines)
 
 
+def test_diff_says_no_baseline_instead_of_skipping_silently():
+    """A TRACKED metric present in the fresh run but absent from the
+    baseline artifact must print an explicit NO BASELINE line — the
+    committed artifact predates PRs 6-9, so the fleet_*/selfheal_*
+    guardrails were dead AND invisible until this note existed."""
+    old = {"mfu": 0.5}
+    new = {"mfu": 0.5, "fleet_tokens_per_sec": 900.0,
+           "fleet_slo_attainment_interactive": 0.98,
+           "selfheal_restore_ms": 120.0}
+    lines = bench_diff.diff(new, old, threshold=0.02)
+    for key in (
+        "fleet_tokens_per_sec", "fleet_slo_attainment_interactive",
+        "selfheal_restore_ms",
+    ):
+        assert any(
+            line.startswith("NOTE") and "NO BASELINE" in line
+            and key in line for line in lines
+        ), (key, lines)
+    # A metric absent from BOTH sides stays silent (nothing to note).
+    assert not any("superstep_tokens_per_sec" in line for line in lines)
+
+
+def test_every_tracked_metric_rides_the_compact_headline():
+    """bench_diff's guardrails read the compact headline the driver
+    captures; a tracked key missing from bench.COMPACT_KEYS would make
+    its tripwire silently dead on every driver run."""
+    import bench as bench_mod
+
+    tracked = set(bench_diff.TRACKED_UP) | set(bench_diff.TRACKED_DOWN)
+    missing = tracked - set(bench_mod.COMPACT_KEYS)
+    assert not missing, missing
+
+
 def test_diff_skips_busy_across_platform_change_and_flags_fallback():
     old = {"busy_platform": "axon", "aggregate_chip_busy_fraction": 0.99}
     new = {"busy_platform": "cpu", "aggregate_chip_busy_fraction": 0.5,

@@ -1,0 +1,385 @@
+"""Fleet-scope distributed tracing + SLO-class attainment
+(workloads/obs.py FleetSpan / fleet_trace_events, workloads/fleet.py
+SLOClass): every fleet request gets ONE span on the fleet's clock —
+router enqueue -> each per-replica attempt -> exactly one terminal
+status — with failover replays linked as retry children carrying the
+replica id and fault kind, and supervisor transitions as instant events
+on the same merged chrome trace.
+
+The pinned contracts: span stitching through a seeded mid-stream crash
+(charged crash attempt on the victim, linked ok retry child on a
+survivor, first-segment queue-wait/TTFT attribution never reset by the
+replay); the merged multi-process trace round-trips
+tools/trace_export.py --validate; the whole layer is INERT (greedy
+streams bit-identical with fleet tracing + SLO classes on vs off across
+serial/pipelined/spec="auto"/superstep_k); per-class attainment
+counters, class-labeled histograms and the windowed burn-rate gauge
+land on the registry."""
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.errors import InvalidRequest
+from workloads.faults import FaultInjector
+from workloads.fleet import (
+    DEFAULT_SLO_CLASSES,
+    Fleet,
+    SLOClass,
+    TrafficGen,
+)
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.obs import (
+    EngineObserver,
+    FleetObserver,
+    export_fleet_trace,
+    fleet_trace_events,
+)
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+PARAMS = init_params(CONFIG, jax.random.PRNGKey(0))
+DRAFT_CONFIG = ModelConfig(
+    max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+DRAFT_PARAMS = init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
+
+PROMPTS = [([3, 1, 4, 1, 5], 12), ([2, 7], 9), ([9] * 11, 13), ([5, 5], 8)]
+CLASSES = ["interactive", "bulk", "interactive", "bulk"]
+
+
+def _engine(observer=None, **kw):
+    base = dict(slots=2, page_size=4, prompt_bucket=8)
+    base.update(kw)
+    return ServeEngine(PARAMS, CONFIG, observer=observer, **base)
+
+
+def _observed_fleet(n=2, *, engine_kw=None, registry=None, **fleet_kw):
+    observers = [
+        EngineObserver(name=str(i), replica=str(i)) for i in range(n)
+    ]
+    fleet_obs = FleetObserver()
+    if registry is not None:
+        for o in observers:
+            o.bind_registry(registry)
+        fleet_obs.bind_registry(registry)
+    fleet_kw.setdefault("chip_ids", [f"chip-{i}" for i in range(n)])
+    fleet_kw.setdefault("hang_timeout_s", None)
+    fleet = Fleet(
+        [_engine(observers[i], **(engine_kw or {})) for i in range(n)],
+        observer=fleet_obs, **fleet_kw,
+    )
+    return fleet, observers, fleet_obs
+
+
+def _bare_fleet(n=2, *, engine_kw=None, **fleet_kw):
+    fleet_kw.setdefault("chip_ids", [f"chip-{i}" for i in range(n)])
+    fleet_kw.setdefault("hang_timeout_s", None)
+    return Fleet(
+        [_engine(**(engine_kw or {})) for _ in range(n)], **fleet_kw
+    )
+
+
+def _oracle(prompt, new):
+    return [int(t) for t in np.asarray(generate(
+        PARAMS, jnp.asarray([prompt], jnp.int32), CONFIG,
+        max_new_tokens=new,
+    )[0])]
+
+
+def _validate(trace: dict) -> list:
+    sys.path.insert(0, "tools")
+    from trace_export import validate_trace
+
+    return validate_trace(trace)
+
+
+# ---- span stitching through a seeded crash -------------------------------
+
+
+def _crashed_run():
+    """Two replicas, replica_crash at crossing 3 (= replica 0's second
+    step, mid-stream with work in flight), closed-loop classed
+    submissions; returns (streams, spans, fleet) after convergence."""
+    fleet, observers, fleet_obs = _observed_fleet(
+        2, fault_injector=FaultInjector({"replica_crash": [3]}),
+    )
+    rids = [
+        fleet.submit(p, n, slo_class=c)
+        for (p, n), c in zip(PROMPTS, CLASSES)
+    ]
+    streams = fleet.run()
+    assert fleet.replica_crashes == 1
+    spans = {s.rid: s for s in fleet_obs.spans}
+    assert set(spans) == set(rids)
+    return streams, spans, fleet, observers, fleet_obs
+
+
+def test_crash_spans_link_attempts_with_fault_kind_and_one_terminal():
+    streams, spans, fleet, _, fleet_obs = _crashed_run()
+    # Streams bit-identical to the dense oracle through the failover
+    # (rids are fleet-0..3 in submission order).
+    for i, (p, n) in enumerate(PROMPTS):
+        assert streams[f"fleet-{i}"] == _oracle(p, n), i
+    failed_over = [s for s in spans.values() if len(s.attempts) > 1]
+    assert failed_over, "the scheduled crash failed nothing over"
+    for span in failed_over:
+        first, last = span.attempts[0], span.attempts[-1]
+        assert first.outcome == "crash" and first.charged
+        assert last.outcome == "ok" and not last.charged
+        assert first.replica != last.replica
+        assert span.failovers >= 1
+        assert span.status == "ok"
+        # Attempts tile the span: dispatch/end stamps are ordered and
+        # the retry child starts after its parent ended.
+        assert first.t_end is not None and last.t_end is not None
+        assert first.t_dispatch <= first.t_end <= last.t_dispatch
+    # Exactly one terminal per rid, and every span carries its class.
+    assert [s.status for s in spans.values()].count("ok") == len(spans)
+    assert {s.slo_class for s in spans.values()} == {"interactive", "bulk"}
+    fleet.close()
+
+
+def test_crash_keeps_first_segment_queue_wait_and_ttft_attribution():
+    """A failover's re-admission must not reset queue-wait/TTFT: the
+    span's t_admit/t_first are the FIRST attempt's stamps, not the
+    survivor's."""
+    _, spans, fleet, _, _ = _crashed_run()
+    for span in spans.values():
+        if len(span.attempts) < 2:
+            continue
+        first = span.attempts[0]
+        assert span.t_admit == first.t_admit
+        if first.t_first is not None:
+            # The client saw its first token from the FIRST segment;
+            # the replay on the survivor happened strictly later.
+            assert span.t_first == first.t_first
+            assert span.t_first < span.attempts[1].t_dispatch
+        assert span.queue_wait_secs is not None
+        assert span.queue_wait_secs <= span.ttft_secs
+    fleet.close()
+
+
+def test_merged_trace_validates_with_all_lanes_and_flow_links(tmp_path):
+    _, spans, fleet, observers, fleet_obs = _crashed_run()
+    path = str(tmp_path / "fleet-trace.json")
+    n_events, n_replicas = export_fleet_trace(path, fleet_obs, observers)
+    assert n_replicas == 2
+    sys.path.insert(0, "tools")
+    from trace_export import validate_file
+
+    assert validate_file(path) == []
+    trace = json.load(open(path))["traceEvents"]
+    assert len(trace) == n_events
+    procs = {
+        ev["args"]["name"] for ev in trace
+        if ev["ph"] == "M" and ev["name"] == "process_name"
+    }
+    assert "fleet router" in procs and "supervisor" in procs
+    assert {"requests (engine 0)", "requests (engine 1)"} <= procs
+    # Failover flow links survive the round trip, s/f paired by id.
+    s_ids = [ev["id"] for ev in trace if ev["ph"] == "s"]
+    f_ids = [ev["id"] for ev in trace if ev["ph"] == "f"]
+    assert s_ids and sorted(s_ids) == sorted(f_ids)
+    # Exactly one terminal instant per request span.
+    terminals = [
+        ev for ev in trace
+        if ev["ph"] == "i" and ev["name"].startswith("terminal:")
+    ]
+    assert len(terminals) == len(spans)
+    assert {ev["name"] for ev in terminals} == {"terminal:ok"}
+    fleet.close()
+
+
+# ---- supervisor events on the same timeline ------------------------------
+
+
+def test_supervisor_events_land_on_the_merged_trace():
+    from workloads.backoff import Backoff
+    from workloads.supervisor import FleetSupervisor, make_engine_factory
+
+    fleet, observers, fleet_obs = _observed_fleet(
+        2, fault_injector=FaultInjector({"replica_crash": [3]}),
+    )
+    factory, oracle = make_engine_factory(
+        PARAMS, CONFIG, engine_kw=dict(slots=2, page_size=4, prompt_bucket=8),
+        probe=([1, 2, 3], 4),
+    )
+    sup = FleetSupervisor(
+        fleet, factory,
+        backoff=Backoff(base_s=1e-3, factor=2.0, max_s=8e-3, jitter=0.0),
+        probe=([1, 2, 3], 4), probe_oracle=oracle,
+    )
+    for (p, n), c in zip(PROMPTS, CLASSES):
+        sup.submit(p, n, slo_class=c)
+    sup.run()
+    assert sup.wait_healed(timeout_s=30.0)
+    kinds = [ev.kind for ev in sup.events]
+    for expected in ("death", "backoff", "probe", "rejoin"):
+        assert expected in kinds, (expected, kinds)
+    trace = fleet_trace_events(fleet_obs, observers, sup.events)
+    assert _validate(trace) == []
+    instants = [
+        ev["name"] for ev in trace["traceEvents"]
+        if ev["ph"] == "i" and ev.get("cat") == "supervisor"
+    ]
+    assert set(instants) >= {"death", "backoff", "probe", "rejoin"}
+    # drain_events hands the ring back and clears it.
+    drained = sup.drain_events()
+    assert [ev.kind for ev in drained] == kinds and not sup.events
+    fleet.close()
+
+
+# ---- inert parity --------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine_kw", [
+    {},
+    {"pipelined": True},
+    {"superstep_k": 2},
+    {
+        "draft_params": DRAFT_PARAMS, "draft_config": DRAFT_CONFIG,
+        "gamma": 3, "spec": "auto", "spec_breakeven": 1.0,
+    },
+], ids=["serial", "pipelined", "superstep", "spec-auto"])
+def test_tracing_and_slo_classes_are_inert(engine_kw):
+    """Greedy streams must be bit-identical with the FULL fleet
+    observability treatment (per-replica observers + fleet observer +
+    registry + SLO class tags) on vs off, per engine mode."""
+    from tpu_device_plugin.metrics import Registry
+
+    bare = _bare_fleet(2, engine_kw=engine_kw)
+    rids = [bare.submit(p, n) for p, n in PROMPTS]
+    ref = bare.run()
+    bare.close()
+
+    fleet, observers, fleet_obs = _observed_fleet(
+        2, engine_kw=engine_kw, registry=Registry(),
+    )
+    rids2 = [
+        fleet.submit(p, n, slo_class=c)
+        for (p, n), c in zip(PROMPTS, CLASSES)
+    ]
+    assert rids2 == rids
+    out = fleet.run()
+    assert out == ref, "fleet tracing + SLO classes moved a token"
+    assert len(fleet_obs.spans) == len(PROMPTS)
+    fleet.close()
+
+
+# ---- SLO classes, attainment, burn rate ----------------------------------
+
+
+def test_unknown_slo_class_is_a_typed_invalid_request():
+    fleet = _bare_fleet(1)
+    with pytest.raises(InvalidRequest, match="unknown slo_class"):
+        fleet.submit([1, 2], 4, slo_class="platinum")
+    fleet.close()
+
+
+def test_slo_class_validation():
+    with pytest.raises(ValueError, match="at least one"):
+        SLOClass("empty")
+    with pytest.raises(ValueError, match="objective"):
+        SLOClass("bad", ttft_target_s=1.0, objective=1.5)
+    with pytest.raises(ValueError, match="ttft_target_s"):
+        SLOClass("bad", ttft_target_s=-1.0)
+    cls = SLOClass("t", ttft_target_s=1.0, tpot_target_s=0.1)
+    assert cls.met(0.5, 0.05)
+    assert not cls.met(2.0, 0.05)  # ttft blown
+    assert not cls.met(0.5, 0.2)  # tpot blown
+    assert not cls.met(None, None)  # no first token against a ttft bound
+    assert cls.met(0.5, None)  # one-token stream has no tpot to miss
+
+
+def test_attainment_and_burn_rate_score_against_class_targets():
+    """An impossible target misses every request (attainment 0, burn =
+    1/error-budget); a generous one attains everything (burn 0)."""
+    fleet = _bare_fleet(2, slo_classes=(
+        SLOClass("strict", ttft_target_s=1e-9, objective=0.99),
+        SLOClass("loose", ttft_target_s=1e9, objective=0.99),
+    ))
+    for i, (p, n) in enumerate(PROMPTS):
+        fleet.submit(p, n, slo_class="strict" if i % 2 else "loose")
+    fleet.run()
+    att = fleet.slo_attainment()
+    assert att["strict"] == 0.0 and att["loose"] == 1.0
+    burn = fleet.slo_burn_rates()
+    assert burn["strict"] == pytest.approx(100.0)  # 100% miss / 1% budget
+    assert burn["loose"] == 0.0
+    # The sliding window forgets: far enough in the future the strict
+    # class's misses age out and burn reads 0 (no fresh evidence).
+    import time as _time
+
+    future = _time.perf_counter() + fleet.slo_window_s + 1.0
+    assert fleet.slo_burn_rates(now=future)["strict"] == 0.0
+    fleet.close()
+
+
+def test_cancelled_requests_are_excluded_from_attainment():
+    fleet = _bare_fleet(1)
+    rid = fleet.submit([1, 2, 3], 8, slo_class="interactive")
+    assert fleet.cancel(rid)
+    fleet.step()
+    assert fleet.slo_request_counts["interactive"] == 0
+    done = fleet.drain_completed()
+    assert [fr.status for fr in done] == ["cancelled"]
+    assert done[0].slo_attained is None
+    fleet.close()
+
+
+def test_classed_schedule_is_bit_identical_to_unclassed():
+    gen = TrafficGen(seed=11, class_mix=(("interactive", 3), ("bulk", 1)))
+    plain = gen.schedule(32)
+    classed = gen.schedule_classed(32)
+    assert [e[:3] for e in classed] == plain  # tagging moves nothing
+    assert {e[3] for e in classed} <= {"interactive", "bulk"}
+    assert classed == gen.schedule_classed(32)  # deterministic per seed
+    names = {c.name for c in DEFAULT_SLO_CLASSES}
+    assert {e[3] for e in classed} <= names
+
+
+# ---- the make slo-check smoke --------------------------------------------
+
+
+def test_slo_check_smoke(tmp_path):
+    """The CI tripwire (make slo-check): a seeded two-replica crash
+    under the full observability treatment — merged trace round-trips
+    the validator with every lane present, per-class attainment
+    counters land on the registry, streams stay oracle-true."""
+    from tpu_device_plugin.metrics import PREFIX, Registry
+
+    reg = Registry()
+    fleet, observers, fleet_obs = _observed_fleet(
+        2, registry=reg,
+        fault_injector=FaultInjector({"replica_crash": [3]}),
+    )
+    for (p, n), c in zip(PROMPTS, CLASSES):
+        fleet.submit(p, n, slo_class=c)
+    streams = fleet.run()
+    for i, (p, n) in enumerate(PROMPTS):
+        assert streams[f"fleet-{i}"] == _oracle(p, n), i
+    assert fleet.replica_crashes == 1
+    path = str(tmp_path / "slo-check-trace.json")
+    n_events, n_replicas = export_fleet_trace(path, fleet_obs, observers)
+    assert n_replicas == 2 and n_events > 0
+    sys.path.insert(0, "tools")
+    from trace_export import validate_file
+
+    assert validate_file(path) == []
+    render = reg.render()
+    for cls, count in fleet.slo_request_counts.items():
+        assert count > 0
+        line = (
+            f'{PREFIX}_fleet_slo_requests_total{{fleet="0",'
+            f'slo_class="{cls}"}} {count}'
+        )
+        assert line in render, (line, render)
+    assert f"{PREFIX}_fleet_slo_burn_rate" in render
+    fleet.close()

@@ -406,12 +406,26 @@ def test_multi_replica_engines_share_one_registry():
     assert {labels["replica"] for _, labels, _ in depth} == {"0", "2"}
 
 
+def _fake_fleet_request(
+    rid="fr-0", *, status="ok", slo_class=None, slo_attained=None,
+    n_tokens=5, t_first=1.05,
+):
+    """A terminal FleetRequest stand-in carrying the stamp/class fields
+    FleetSpan.from_fleet_request flattens (no jax, no fleet)."""
+    return SimpleNamespace(
+        rid=rid, t_submit=1.0, t_admit=1.01, t_first=t_first,
+        t_done=1.3, status=status, tokens=[7] * n_tokens,
+        slo_class=slo_class, slo_attained=slo_attained, failovers=0,
+        attempts=[],
+    )
+
+
 def test_fleet_bridge_render_is_valid_exposition():
     """Drive the fleet bridge against a fake fleet (no jax) next to a
     replica-labeled engine bridge and parse the render: fleet families
     obey the exposition rules, per-replica state/paused gauges emit one
-    sample per live replica, and counters land as running-total
-    deltas."""
+    sample per live replica, counters land as running-total deltas, and
+    the SLO-class families carry the class label."""
     from tpu_device_plugin.metrics import PREFIX, Registry
     from workloads.obs import FleetObserver
 
@@ -427,11 +441,25 @@ def test_fleet_bridge_render_is_valid_exposition():
         queue=[1, 2], replicas=replicas, requests_submitted=5,
         generated_tokens=40, failover_requeues=2, drain_requeues=1,
         queue_rejections=3, replica_crashes=1, replica_hangs=0,
+        slo_burn_rates=lambda: {"interactive": 1.5, "bulk": 0.0},
     )
     obs._bind(fleet)
-    finished = [SimpleNamespace(
-        queue_wait_secs=0.01, ttft_secs=0.05, e2e_secs=0.3,
-    )]
+    finished = [
+        _fake_fleet_request(
+            "fr-0", slo_class="interactive", slo_attained=True,
+        ),
+        _fake_fleet_request(
+            "fr-1", status="failed", slo_class="interactive",
+            slo_attained=False,
+        ),
+        _fake_fleet_request("fr-2", slo_class="bulk", slo_attained=True),
+        # Cancelled before the verdict: excluded from attainment but
+        # its stamps still pool into the unclassed histograms.
+        _fake_fleet_request(
+            "fr-3", status="cancelled", slo_class="bulk",
+        ),
+        _fake_fleet_request("fr-4"),  # untagged
+    ]
     obs._fleet_step_end(fleet, finished)
     obs._fleet_step_end(fleet, [])  # unchanged totals push no deltas
     families = _parse_exposition(reg.render())
@@ -456,8 +484,50 @@ def test_fleet_bridge_render_is_valid_exposition():
         f"{PREFIX}_fleet_ttft_seconds",
         f"{PREFIX}_fleet_e2e_seconds",
         f"{PREFIX}_fleet_queue_wait_seconds",
+        f"{PREFIX}_fleet_class_ttft_seconds",
+        f"{PREFIX}_fleet_class_tpot_seconds",
     ):
         _assert_histogram_sound(fam, families[fam])
+    # Per-class attainment counters: every series carries the class
+    # label; the cancelled request is excluded, the untagged one never
+    # lands in a classed family.
+    slo_req = families[f"{PREFIX}_fleet_slo_requests_total"]["samples"]
+    assert {
+        (labels["slo_class"], v) for _, labels, v in slo_req
+    } == {("interactive", 2.0), ("bulk", 1.0)}
+    slo_att = families[f"{PREFIX}_fleet_slo_attained_total"]["samples"]
+    assert {
+        (labels["slo_class"], v) for _, labels, v in slo_att
+    } == {("interactive", 1.0), ("bulk", 1.0)}
+    burn = families[f"{PREFIX}_fleet_slo_burn_rate"]["samples"]
+    assert {
+        (labels["slo_class"], v) for _, labels, v in burn
+    } == {("interactive", 1.5), ("bulk", 0.0)}
+    class_ttft = families[f"{PREFIX}_fleet_class_ttft_seconds"]["samples"]
+    assert {
+        labels["slo_class"] for _, labels, _ in class_ttft
+    } == {"interactive", "bulk"}
+    # The span ring filled alongside the registry pushes.
+    assert [s.rid for s in obs.spans] == [
+        "fr-0", "fr-1", "fr-2", "fr-3", "fr-4",
+    ]
+    assert obs.drain_spans() and not obs.spans
+
+
+def test_fleet_spans_record_without_a_registry():
+    """--trace-out without --metrics-port: the span ring must fill with
+    no registry bound (the merged trace's raw material), bounded with
+    counted drops."""
+    from workloads.obs import FleetObserver
+
+    obs = FleetObserver(name="f1", span_limit=2)
+    fleet = SimpleNamespace(replicas=[], queue=[])
+    obs._bind(fleet)
+    obs._fleet_step_end(
+        fleet, [_fake_fleet_request(f"fr-{i}") for i in range(3)]
+    )
+    assert [s.rid for s in obs.spans] == ["fr-1", "fr-2"]
+    assert obs.dropped_spans == 1
 
 
 def test_supervisor_bridge_render_is_valid_exposition():

@@ -55,6 +55,14 @@ TRACKED_UP = [
     "spec_serve_lookahead_tokens_per_sec",
     "spec_engine_vs_plain_b1",
     "fleet_tokens_per_sec",
+    # Per-class SLO attainment (the fleet-tracing PR's scheduler
+    # inputs): a drop means a class started missing its targets.
+    "fleet_slo_attainment_interactive",
+    "fleet_slo_attainment_bulk",
+    # Throughput under the full fleet observability treatment — a drop
+    # with fleet_tokens_per_sec flat means the tracing layer itself
+    # got more expensive.
+    "fleet_trace_on_tokens_per_sec",
     # Self-healing: the fraction of pre-fault alive capacity the
     # supervisor restores without operator intervention (1.0 = every
     # non-quarantined slot rejoined) — a drop means resurrection broke.
@@ -82,6 +90,11 @@ TRACKED_DOWN = [
     # (the robustness number the fleet PR exists for).
     "fleet_ttft_p99_ms",
     "failover_recovery_ms",
+    # Per-class SLO tails: the interactive class's TTFT bound and the
+    # bulk class's per-token decode bound under the classed open-loop
+    # mix.
+    "fleet_interactive_ttft_p99_ms",
+    "fleet_bulk_tpot_p99_ms",
     # Self-healing: replica death -> probed replacement rejoined the
     # router (crash included; the supervisor PR's robustness number).
     "selfheal_restore_ms",
@@ -241,6 +254,18 @@ def diff(new: dict, old: dict, threshold: float) -> list[str]:
         if key.startswith("aggregate") and not busy_comparable:
             continue
         a, b = old.get(key), new.get(key)
+        if not isinstance(a, (int, float)) and isinstance(b, (int, float)):
+            # The guardrail exists but cannot fire: the committed
+            # artifact predates this metric.  Say so — a silently dead
+            # tripwire reads exactly like a healthy one (the PR 6-9
+            # fleet_*/selfheal_*/superstep_*/kv_* families were
+            # invisible for a full re-anchor cycle this way).
+            lines.append(
+                f"NOTE bench_diff: {key}: NO BASELINE (absent from the "
+                f"baseline artifact; new value {b} is untracked until a "
+                f"full bench run commits one)"
+            )
+            continue
         if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
             continue
         if a <= 0:

@@ -39,6 +39,11 @@ _REQUIRED = {
     "B": ("name", "ts"),
     "E": ("ts",),
     "i": ("name", "ts"),
+    # Flow events: the merged fleet trace links a failover replay to
+    # the attempt it retries with an "s"(tart) -> "f"(inish) pair,
+    # matched by cat+name+id.
+    "s": ("name", "ts", "id"),
+    "f": ("name", "ts", "id"),
 }
 
 
@@ -126,13 +131,75 @@ def _synthetic_observer():
     return obs
 
 
+def _synthetic_fleet():
+    """A fabricated fleet-scope timeline exercising every merged-trace
+    event shape: a clean span, a failed-over span (crash attempt ->
+    linked retry child -> ok, SLO-classed), two replica engine
+    observers, and a supervision event sequence (no engine, no jax)."""
+    from workloads.obs import (
+        AttemptSpan,
+        FleetObserver,
+        FleetSpan,
+        SupervisorEvent,
+    )
+
+    fleet_obs = FleetObserver(name="selfcheck")
+    t = 2000.0
+    fleet_obs.spans.extend([
+        FleetSpan(
+            rid="fr-0", t_submit=t, t_done=t + 0.30, status="ok",
+            n_tokens=8, slo_class="interactive", slo_attained=True,
+            t_admit=t + 0.01, t_first=t + 0.05,
+            attempts=[AttemptSpan(
+                replica=0, t_dispatch=t + 0.01, t_admit=t + 0.01,
+                t_first=t + 0.05, t_end=t + 0.30, tokens=8,
+                outcome="ok",
+            )],
+        ),
+        FleetSpan(
+            rid="fr-1", t_submit=t + 0.02, t_done=t + 0.55,
+            status="ok", n_tokens=12, slo_class="bulk",
+            slo_attained=False, t_admit=t + 0.03, t_first=t + 0.08,
+            failovers=1,
+            attempts=[
+                AttemptSpan(
+                    replica=0, t_dispatch=t + 0.03, t_admit=t + 0.03,
+                    t_first=t + 0.08, t_end=t + 0.20, tokens=5,
+                    outcome="crash", charged=True,
+                ),
+                AttemptSpan(
+                    replica=1, t_dispatch=t + 0.22, t_admit=t + 0.23,
+                    t_end=t + 0.55, tokens=7, outcome="ok",
+                ),
+            ],
+        ),
+    ])
+    engine_observers = [_synthetic_observer(), _synthetic_observer()]
+    supervisor_events = [
+        SupervisorEvent(t + 0.20, "death", "chip-0", "replica died"),
+        SupervisorEvent(t + 0.20, "backoff", "chip-0", "retry in 0.1s"),
+        SupervisorEvent(t + 0.31, "probe", "chip-0", "half-open canary"),
+        SupervisorEvent(t + 0.40, "rejoin", "chip-0", "restored"),
+    ]
+    return fleet_obs, engine_observers, supervisor_events
+
+
 def selfcheck() -> int:
+    from workloads.obs import export_fleet_trace
+
     obs = _synthetic_observer()
+    fleet_obs, engine_observers, supervisor_events = _synthetic_fleet()
     fd, path = tempfile.mkstemp(prefix="trace-selfcheck-", suffix=".json")
     os.close(fd)
     try:
         n = obs.export_trace(path)
         errors = validate_file(path)
+        n_fleet, n_replicas = export_fleet_trace(
+            path, fleet_obs, engine_observers, supervisor_events
+        )
+        errors += validate_file(path)
+        with open(path) as f:
+            merged = json.load(f)["traceEvents"]
     finally:
         os.unlink(path)
     if errors:
@@ -146,7 +213,28 @@ def selfcheck() -> int:
             file=sys.stderr,
         )
         return 1
-    print(f"trace_export selfcheck OK ({n} events round-tripped)")
+    # The merged fleet trace must cover every lane it claims to merge:
+    # router + supervisor + two pids per replica, and the failover
+    # flow link ("s"/"f" pair) must have survived the round trip.
+    pids = {ev["pid"] for ev in merged}
+    phases = {ev["ph"] for ev in merged}
+    if n_replicas != 2 or len(pids) < 2 + 2 * n_replicas:
+        print(
+            f"trace_export selfcheck: merged trace covers pids {sorted(pids)} "
+            f"for {n_replicas} replicas — lanes are missing",
+            file=sys.stderr,
+        )
+        return 1
+    if not {"s", "f"} <= phases:
+        print(
+            "trace_export selfcheck: merged trace lost its failover "
+            f"flow links (phases {sorted(phases)})", file=sys.stderr,
+        )
+        return 1
+    print(
+        f"trace_export selfcheck OK ({n} engine + {n_fleet} merged "
+        f"fleet events round-tripped, {n_replicas} replica lanes)"
+    )
     return 0
 
 

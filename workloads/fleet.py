@@ -66,12 +66,71 @@ from dataclasses import dataclass, field
 
 from .errors import EngineClosed, InvalidRequest, QueueFull, RequestTooLarge
 from .faults import InjectedFault
+from .obs import AttemptSpan
 
 TERMINAL = ("ok", "cancelled", "expired", "failed")
 
 ACTIVE = "active"
 DRAINING = "draining"
 DEAD = "dead"
+
+
+@dataclass(frozen=True)
+class SLOClass:
+    """One service-level class a request can be submitted under
+    (``Fleet.submit(slo_class=...)``).  A class binds whichever targets
+    matter to its tenants — TTFT for interactive chat, TPOT (per-token
+    decode time) for bulk generation — and an attainment ``objective``
+    whose complement is the error budget the windowed burn-rate gauge
+    divides by (SRE-workbook convention: burn rate 1.0 = spending the
+    budget exactly as fast as the objective allows)."""
+
+    name: str
+    ttft_target_s: float | None = None
+    tpot_target_s: float | None = None
+    objective: float = 0.99
+
+    def __post_init__(self):
+        if self.ttft_target_s is None and self.tpot_target_s is None:
+            raise ValueError(
+                f"SLO class {self.name!r} needs at least one of "
+                "ttft_target_s / tpot_target_s"
+            )
+        for field_name in ("ttft_target_s", "tpot_target_s"):
+            v = getattr(self, field_name)
+            if v is not None and v <= 0:
+                raise ValueError(
+                    f"{field_name} must be > 0, got {v}"
+                )
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(
+                f"objective must be in (0, 1), got {self.objective}"
+            )
+
+    def met(self, ttft_secs, tpot_secs) -> bool:
+        """Did a finished-ok request hit every target this class sets?
+        A missing measurement against a set target is a miss (a request
+        that never produced a first token cannot have attained a TTFT
+        bound); an unset target constrains nothing, and a one-token
+        request has no TPOT to miss."""
+        if self.ttft_target_s is not None and (
+            ttft_secs is None or ttft_secs > self.ttft_target_s
+        ):
+            return False
+        if self.tpot_target_s is not None and (
+            tpot_secs is not None and tpot_secs > self.tpot_target_s
+        ):
+            return False
+        return True
+
+
+# The stock class pair the ROADMAP's SLO scheduler names: TTFT-bound
+# interactive tenants vs TPOT-bound bulk tenants.  Pass your own dict
+# to Fleet(slo_classes=) to retune.
+DEFAULT_SLO_CLASSES = (
+    SLOClass("interactive", ttft_target_s=1.0, objective=0.95),
+    SLOClass("bulk", tpot_target_s=0.25, objective=0.95),
+)
 
 
 @dataclass
@@ -102,6 +161,13 @@ class FleetRequest:
     t_admit: float | None = None
     t_first: float | None = None
     t_done: float | None = None
+    # Fleet-scope tracing + SLO classes: one AttemptSpan per replica
+    # dispatch (failover replays append retry children), the request's
+    # SLO class tag, and the fleet's terminal attainment verdict (None
+    # = untagged or excluded, e.g. cancelled).
+    slo_class: str | None = None
+    slo_attained: bool | None = None
+    attempts: list = field(default_factory=list)
 
     @property
     def done(self) -> bool:
@@ -125,6 +191,18 @@ class FleetRequest:
         if self.t_submit is None or self.t_admit is None:
             return None
         return self.t_admit - self.t_submit
+
+    @property
+    def tpot_secs(self) -> float | None:
+        """Per-token decode time: first token -> done over the n-1
+        decoded tokens (the bulk SLO class's bound).  None until
+        terminal, and for streams that never decoded past their first
+        token."""
+        if self.t_first is None or self.t_done is None:
+            return None
+        if len(self.tokens) < 2:
+            return None
+        return (self.t_done - self.t_first) / (len(self.tokens) - 1)
 
 
 class Replica:
@@ -322,6 +400,8 @@ class Fleet:
         slow_readback_s: float = 0.002,
         slow_drain_after: int | None = 3,
         observer=None,
+        slo_classes=None,
+        slo_window_s: float = 60.0,
     ):
         engines = list(engines)
         if not engines:
@@ -418,6 +498,28 @@ class Fleet:
         self.failover_recovery_s: list[float] = []
         self._t_fault: float | None = None
         self._recovery_rids: set[str] = set()
+        # SLO classes: requests submitted with slo_class= are scored
+        # against their class targets at the terminal transition, and
+        # the per-class attainment counters + sliding miss window feed
+        # the burn-rate gauge (the SLO scheduler/autoscaler inputs).
+        if slo_window_s <= 0:
+            raise ValueError(
+                f"slo_window_s must be > 0, got {slo_window_s}"
+            )
+        classes = (
+            DEFAULT_SLO_CLASSES if slo_classes is None else slo_classes
+        )
+        if isinstance(classes, dict):
+            classes = tuple(classes.values())
+        self.slo_classes: dict[str, SLOClass] = {
+            c.name: c for c in classes
+        }
+        self.slo_window_s = float(slo_window_s)
+        self.slo_request_counts = {c: 0 for c in self.slo_classes}
+        self.slo_attained_counts = {c: 0 for c in self.slo_classes}
+        self._slo_window: dict[str, deque] = {
+            c: deque() for c in self.slo_classes
+        }
         self._obs = observer
         if observer is not None:
             observer._bind(self)
@@ -505,12 +607,16 @@ class Fleet:
         adapter: str | None = None,
         deadline_s: float | None = None,
         session: str | None = None,
+        slo_class: str | None = None,
     ) -> str:
         """Queue one request with the router; dispatch happens on the
         next ``step()``.  Validation mirrors ``ServeEngine.submit`` so
         a request the fleet accepts is one every (homogeneous) replica
         can run; bounded admission raises a typed ``QueueFull`` against
-        the FLEET-wide queue."""
+        the FLEET-wide queue.  ``slo_class`` tags the request with one
+        of the fleet's service-level classes (``slo_classes=``; default
+        ``interactive``/``bulk``) — scored at the terminal transition,
+        never consulted by dispatch, so tagging cannot move tokens."""
         with self._lock:
             if self._closed:
                 raise EngineClosed(
@@ -539,6 +645,11 @@ class Fleet:
                 raise InvalidRequest(
                     f"deadline_s must be > 0 (or None), got {deadline_s}"
                 )
+            if slo_class is not None and slo_class not in self.slo_classes:
+                raise InvalidRequest(
+                    f"unknown slo_class {slo_class!r}: fleet serves "
+                    f"{sorted(self.slo_classes) or '(none)'}"
+                )
             bound = self.admission_bound
             if bound is not None and len(self.queue) >= bound:
                 self.queue_rejections += 1
@@ -564,7 +675,7 @@ class Fleet:
                     t_submit + deadline_s if deadline_s is not None
                     else None
                 ),
-                t_submit=t_submit,
+                t_submit=t_submit, slo_class=slo_class,
             )
             self._reqs[rid] = fr
             self.queue.append(fr)
@@ -606,6 +717,7 @@ class Fleet:
         fr.status = status
         fr.error = error
         fr.t_done = time.perf_counter()
+        self._close_attempt(fr, None, status)
         fr.replica = None
         counter = {
             "ok": "requests_ok",
@@ -614,8 +726,63 @@ class Fleet:
             "failed": "requests_failed",
         }[status]
         setattr(self, counter, getattr(self, counter) + 1)
+        self._score_slo(fr)
         self.completed.append(fr)
         return fr
+
+    def _score_slo(self, fr: FleetRequest) -> None:
+        """The terminal SLO verdict for a classed request: ok within
+        every class target = attained; failed/expired (or ok outside a
+        target) = a miss.  Cancelled requests are EXCLUDED — a client
+        abort is not an SLO verdict — leaving ``slo_attained`` None."""
+        cls = self.slo_classes.get(fr.slo_class or "")
+        if cls is None or fr.status == "cancelled":
+            return
+        fr.slo_attained = fr.status == "ok" and cls.met(
+            fr.ttft_secs, fr.tpot_secs
+        )
+        self.slo_request_counts[cls.name] += 1
+        if fr.slo_attained:
+            self.slo_attained_counts[cls.name] += 1
+        win = self._slo_window[cls.name]
+        win.append((fr.t_done, fr.slo_attained))
+        self._trim_slo_window(win, fr.t_done)
+
+    def _trim_slo_window(self, win: deque, now: float) -> None:
+        while win and now - win[0][0] > self.slo_window_s:
+            win.popleft()
+
+    def slo_attainment(self) -> dict[str, float | None]:
+        """Lifetime per-class attainment ratio (attained / scored), or
+        None for a class no scored request has reached yet."""
+        with self._lock:
+            return {
+                name: (
+                    self.slo_attained_counts[name] / n if n else None
+                )
+                for name, n in self.slo_request_counts.items()
+            }
+
+    def slo_burn_rates(self, now: float | None = None) -> dict[str, float]:
+        """Windowed error-budget burn rate per class: the miss fraction
+        over the sliding ``slo_window_s`` divided by the class's error
+        budget (1 - objective).  1.0 = burning the budget exactly as
+        fast as the objective allows; an empty window reads 0.0 (no
+        evidence of burning).  The SRE-workbook multi-window alert is
+        this gauge sampled at two cadences."""
+        with self._lock:
+            now = time.perf_counter() if now is None else now
+            out: dict[str, float] = {}
+            for name, cls in self.slo_classes.items():
+                win = self._slo_window[name]
+                self._trim_slo_window(win, now)
+                if not win:
+                    out[name] = 0.0
+                    continue
+                misses = sum(1 for _, attained in win if not attained)
+                budget = max(1.0 - cls.objective, 1e-9)
+                out[name] = (misses / len(win)) / budget
+            return out
 
     def drain_completed(self) -> list[FleetRequest]:
         """Hand back (and clear) the finished-request ring — the same
@@ -723,7 +890,7 @@ class Fleet:
                     f"(load {rep.load()}); drain it first or pass "
                     "force=True"
                 )
-            victims = self._harvest(rep)
+            victims = self._harvest(rep, outcome="removed")
             rep.state = DEAD
             self.router.forget(index)
             try:
@@ -735,17 +902,45 @@ class Fleet:
 
     # ---- failover core ---------------------------------------------------
 
-    def _harvest(self, rep: Replica) -> list[FleetRequest]:
+    def _close_attempt(
+        self, fr: FleetRequest, ereq, outcome: str, *,
+        charged: bool = False,
+    ) -> None:
+        """Close the request's open per-replica attempt span with the
+        reason its segment ended (the fault kind for failovers, the
+        engine status for finishes) and, when the engine-side Request
+        is still in hand, its admission/first-token stamps and segment
+        token count.  Idempotent — the terminal transition's sweep only
+        catches attempts nothing else closed."""
+        for att in reversed(fr.attempts):
+            if att.t_end is not None:
+                return
+            att.t_end = time.perf_counter()
+            att.outcome = outcome
+            att.charged = charged
+            if ereq is not None:
+                att.t_admit = ereq.t_admit
+                att.t_first = ereq.t_first
+                att.tokens = len(ereq.tokens)
+            return
+
+    def _harvest(
+        self, rep: Replica, *, outcome: str = "crash",
+        charged: bool = False,
+    ) -> list[FleetRequest]:
         """Pull every live fleet request off a replica, stitching the
         tokens its current segment already emitted (consumed host-side
         — tokens still in flight on the device are gone with the
-        replica, and replay re-emits them bit-identically)."""
+        replica, and replay re-emits them bit-identically).  Each
+        victim's open attempt span closes with ``outcome`` (the fault
+        kind, or the uncharged drain/removal reason)."""
         victims: list[FleetRequest] = []
         for rid, ereq in list(rep.rids.items()):
             fr = self._reqs.get(rid)
             rep.rids.pop(rid, None)
             if fr is None or fr.done:
                 continue
+            self._close_attempt(fr, ereq, outcome, charged=charged)
             fr.tokens.extend(int(t) for t in ereq.tokens)
             fr.replica = None
             fr.segments += 1
@@ -803,7 +998,7 @@ class Fleet:
         exception): mark it DEAD, close what can be closed, and fail
         its work over to survivors under the failover budget.  Opens
         the failover-recovery window the bench measures."""
-        victims = self._harvest(rep)
+        victims = self._harvest(rep, outcome=kind, charged=True)
         rep.state = DEAD
         self.router.forget(rep.index)
         if kind == "hang":
@@ -842,6 +1037,7 @@ class Fleet:
             fr = self._reqs.get(rid)
             if fr is None or fr.done:
                 continue
+            self._close_attempt(fr, ereq, "drain")
             fr.tokens.extend(int(t) for t in ereq.tokens)
             fr.replica = None
             fr.segments += 1
@@ -913,6 +1109,11 @@ class Fleet:
         rep.rids[fr.rid] = ereq
         fr.replica = rep.index
         fr.status = "running"
+        # Open this segment's attempt span — a failover replay appends
+        # a retry child next to the attempt the fault closed.
+        fr.attempts.append(AttemptSpan(
+            replica=rep.index, t_dispatch=time.perf_counter(),
+        ))
 
     # ---- stepping --------------------------------------------------------
 
@@ -948,7 +1149,7 @@ class Fleet:
         except EngineClosed:
             # Closed under us (operator remove raced a step): harvest
             # whatever tracking remains, uncharged.
-            victims = self._harvest(rep)
+            victims = self._harvest(rep, outcome="closed")
             rep.state = DEAD
             self._requeue_victims(victims, charge=False)
             return finished
@@ -1013,6 +1214,9 @@ class Fleet:
         if fr is None or fr.done or ereq.rid not in rep.rids:
             return []
         rep.rids.pop(ereq.rid, None)
+        self._close_attempt(
+            fr, ereq, ereq.status, charged=ereq.status == "failed",
+        )
         # A request that admits and retires within one engine step never
         # reaches _observe_progress — stamp it (and close any open
         # failover-recovery window) here, or the fleet's TTFT/queue-wait
@@ -1201,6 +1405,7 @@ class Fleet:
                 for rid, ereq in list(rep.rids.items()):
                     fr = self._reqs.get(rid)
                     if fr is not None and not fr.done:
+                        self._close_attempt(fr, ereq, "closed")
                         fr.tokens.extend(int(t) for t in ereq.tokens)
                         self._finish_terminal(fr, "failed", error=err)
                 rep.rids.clear()
@@ -1279,6 +1484,11 @@ class TrafficGen:
     min_new: int = 1
     max_new: int = 16
     vocab: int = 256
+    # Per-SLO-class arrival mix for schedule_classed: (class, weight)
+    # pairs — the default mirrors a chat-dominated tenant mix with a
+    # bulk-generation minority (the ROADMAP's interactive-vs-bulk
+    # split).
+    class_mix: tuple = (("interactive", 3.0), ("bulk", 1.0))
 
     def schedule(self, n: int) -> list[tuple[float, list[int], int]]:
         """n arrivals as (t_offset_s, prompt, max_new_tokens)."""
@@ -1306,6 +1516,25 @@ class TrafficGen:
             out.append((t, prompt, new))
         return out
 
+    def schedule_classed(
+        self, n: int,
+    ) -> list[tuple[float, list[int], int, str]]:
+        """``schedule(n)`` with a per-arrival SLO class drawn from
+        ``class_mix`` — the per-class arrival streams the attainment
+        bench and the SLO scheduler consume.  The class draw uses its
+        OWN seeded rng, so the arrival process, prompts and budgets
+        stay bit-identical to the unclassed schedule (tagging cannot
+        move tokens, starting with the generator)."""
+        if not self.class_mix:
+            raise ValueError("schedule_classed needs a non-empty class_mix")
+        names = [name for name, _ in self.class_mix]
+        weights = [float(w) for _, w in self.class_mix]
+        rng = random.Random((self.seed << 8) ^ 0x510C1A55)
+        return [
+            (t, prompt, new, rng.choices(names, weights)[0])
+            for t, prompt, new in self.schedule(n)
+        ]
+
 
 def drive_open_loop(
     fleet: Fleet,
@@ -1320,20 +1549,26 @@ def drive_open_loop(
     ``time_scale``) whether or not earlier work finished, the fleet
     stepping continuously in between.  ``session_every`` tags every
     k-th request with a recurring session id (affinity traffic).
-    Returns {rid: tokens} for every accepted request."""
+    Entries may be ``(t, prompt, new)`` or — ``schedule_classed`` —
+    ``(t, prompt, new, slo_class)``.  Returns {rid: tokens} for every
+    accepted request."""
     out: dict[str, list[int]] = {}
     idx = 0
     t0 = time.perf_counter()
     while idx < len(schedule) or not fleet.idle:
         now = (time.perf_counter() - t0) / time_scale
         while idx < len(schedule) and schedule[idx][0] <= now:
-            _, prompt, new = schedule[idx]
+            entry = schedule[idx]
+            _, prompt, new = entry[:3]
+            slo_class = entry[3] if len(entry) > 3 else None
             session = (
                 f"sess-{idx % session_every}"
                 if session_every else None
             )
             try:
-                rid = fleet.submit(prompt, new, session=session)
+                rid = fleet.submit(
+                    prompt, new, session=session, slo_class=slo_class,
+                )
                 out[rid] = []
             except QueueFull:
                 if on_reject is not None:
@@ -1430,6 +1665,7 @@ class FleetServer:
                         adapter=body.get("adapter"),
                         deadline_s=body.get("deadline_s"),
                         session=body.get("session"),
+                        slo_class=body.get("slo_class"),
                     )
                 except QueueFull as e:
                     self._json(429, {"error": str(e)})

@@ -252,6 +252,38 @@ FLEET_METRICS: tuple[MetricSpec, ...] = (
         "fleet_e2e_seconds", "histogram", ("fleet",),
         "submission -> terminal status, pooled across the fleet",
     ),
+    # Per-SLO-class attainment (Fleet.submit(slo_class=...)): the exact
+    # inputs the ROADMAP's SLO-class scheduler and autoscaler consume —
+    # attainment per class, not just global percentiles.
+    MetricSpec(
+        "fleet_slo_requests_total", "counter", ("fleet", "slo_class"),
+        "SLO-classed requests that reached a terminal status "
+        "(cancelled requests are excluded — a client abort is not an "
+        "SLO verdict)",
+    ),
+    MetricSpec(
+        "fleet_slo_attained_total", "counter", ("fleet", "slo_class"),
+        "SLO-classed requests that finished ok WITHIN their class "
+        "targets (TTFT-bound interactive, TPOT-bound bulk); "
+        "attained/requests is the per-class attainment ratio",
+    ),
+    MetricSpec(
+        "fleet_slo_burn_rate", "gauge", ("fleet", "slo_class"),
+        "windowed error-budget burn rate per class (miss fraction over "
+        "the sliding slo_window_s divided by the class's error budget "
+        "1-objective; 1.0 = burning exactly the budget, >1 = an SRE "
+        "multi-window alert would fire; scrape-time)",
+    ),
+    MetricSpec(
+        "fleet_class_ttft_seconds", "histogram", ("fleet", "slo_class"),
+        "submission -> first streamed token, by SLO class (the "
+        "interactive class's bound)",
+    ),
+    MetricSpec(
+        "fleet_class_tpot_seconds", "histogram", ("fleet", "slo_class"),
+        "per-token decode time (first token -> done over tokens-1), by "
+        "SLO class (the bulk class's bound)",
+    ),
 )
 
 # Supervisor-level metric families (workloads/supervisor.py;
@@ -390,6 +422,102 @@ class StepRecord:
     # fused readback reconciled).
     host_sync_ms: float = 0.0
     tokens_overdecoded: int = 0
+
+
+@dataclass
+class AttemptSpan:
+    """One per-replica serving attempt of a fleet request — the unit
+    the fleet-scope trace stitches.  A request that fails over carries
+    several attempts: each later one is a RETRY CHILD of the previous
+    (rendered as a chrome flow link), with ``outcome`` recording why
+    the parent ended ("crash"/"hang" for charged faults, "drain" /
+    "removed" / "closed" for uncharged operator or health moves,
+    "failed" when the engine's own retry budget gave up, or the
+    terminal engine status for the final attempt).  Stamps are on the
+    fleet's clock (``time.perf_counter`` — the one clock every lane of
+    the merged trace shares)."""
+
+    replica: int
+    t_dispatch: float
+    t_admit: float | None = None
+    t_first: float | None = None
+    t_end: float | None = None
+    tokens: int = 0
+    outcome: str = "running"
+    charged: bool = False
+
+
+@dataclass
+class FleetSpan:
+    """One fleet request's whole lifecycle on the fleet's clock:
+    router enqueue -> each per-replica attempt -> exactly one terminal
+    status.  ``t_admit``/``t_first`` are FIRST-segment stamps (a
+    failover's re-admission never resets them), so queue-wait and TTFT
+    attribution stay correct across failovers; ``attempts`` carries
+    the per-replica segments with their fault kinds."""
+
+    rid: str
+    t_submit: float
+    t_done: float
+    status: str
+    n_tokens: int
+    slo_class: str | None = None
+    slo_attained: bool | None = None
+    t_admit: float | None = None
+    t_first: float | None = None
+    failovers: int = 0
+    attempts: list = field(default_factory=list)
+
+    @property
+    def queue_wait_secs(self) -> float | None:
+        if self.t_admit is None:
+            return None
+        return self.t_admit - self.t_submit
+
+    @property
+    def ttft_secs(self) -> float | None:
+        if self.t_first is None:
+            return None
+        return self.t_first - self.t_submit
+
+    @property
+    def e2e_secs(self) -> float:
+        return self.t_done - self.t_submit
+
+    @property
+    def tpot_secs(self) -> float | None:
+        """Per-token decode time (first token -> done over the n-1
+        decoded tokens) — the bulk class's bound.  None for spans that
+        never decoded past their first token."""
+        if self.t_first is None or self.n_tokens < 2:
+            return None
+        return (self.t_done - self.t_first) / (self.n_tokens - 1)
+
+    @classmethod
+    def from_fleet_request(cls, fr) -> "FleetSpan":
+        return cls(
+            rid=fr.rid, t_submit=fr.t_submit, t_done=fr.t_done,
+            status=fr.status, n_tokens=len(fr.tokens),
+            slo_class=getattr(fr, "slo_class", None),
+            slo_attained=getattr(fr, "slo_attained", None),
+            t_admit=fr.t_admit, t_first=fr.t_first,
+            failovers=getattr(fr, "failovers", 0),
+            attempts=list(getattr(fr, "attempts", ())),
+        )
+
+
+@dataclass
+class SupervisorEvent:
+    """One instant on the supervision timeline (death, backoff wait,
+    canary probe, quarantine, rejoin, ...) — rendered as an instant
+    event on the merged fleet trace's supervisor lane.  Lives here
+    (jax-free, next to the other span types) so the trace tooling
+    never needs the supervisor module."""
+
+    t: float
+    kind: str
+    chip_id: str = ""
+    detail: str = ""
 
 
 class EngineObserver:
@@ -749,10 +877,23 @@ class FleetObserver:
 
     Same discipline as the engine bridge: inert (host counters only,
     never scheduling state), jax-free, counters pushed as deltas
-    against the fleet's running totals at each ``Fleet.step()``."""
+    against the fleet's running totals at each ``Fleet.step()``.
 
-    def __init__(self, *, name: str = "0"):
+    Beyond the bridge, the observer keeps the FLEET-SCOPE request
+    timeline: one ``FleetSpan`` per terminal request (router enqueue ->
+    every per-replica attempt -> exactly one terminal status) in a
+    bounded ring with the engine observer's drain/dropped contract —
+    the raw material ``fleet_trace_events`` merges into one chrome
+    trace.  Spans record with or without a bound registry."""
+
+    def __init__(self, *, name: str = "0", span_limit: int = 2048):
+        if span_limit < 1:
+            raise ValueError(
+                f"span_limit must be >= 1, got {span_limit}"
+            )
         self.name = name
+        self.spans: deque[FleetSpan] = deque(maxlen=span_limit)
+        self.dropped_spans = 0
         self._registry = None
         self._labels: dict = {}
         self._fleet = None
@@ -775,6 +916,10 @@ class FleetObserver:
         "fleet_replica_paused": lambda e: [
             ({"replica": str(r.index)}, 1.0 if r.paused else 0.0)
             for r in e.replicas if r.state != "dead"
+        ],
+        "fleet_slo_burn_rate": lambda e: [
+            ({"slo_class": name}, float(rate))
+            for name, rate in sorted(e.slo_burn_rates().items())
         ],
     }
 
@@ -827,7 +972,24 @@ class FleetObserver:
     def _bind(self, fleet) -> None:
         self._fleet = fleet
 
+    def drain_spans(self) -> list[FleetSpan]:
+        """Hand back (and clear) the fleet-span ring — the same
+        between-measurement-windows contract as the engine observer's."""
+        out = list(self.spans)
+        self.spans.clear()
+        return out
+
     def _fleet_step_end(self, fleet, finished) -> None:
+        # The span ring fills whether or not a registry is bound — a
+        # --trace-out run without --metrics-port still gets its merged
+        # timeline.
+        new_spans = []
+        for fr in finished:
+            span = FleetSpan.from_fleet_request(fr)
+            if len(self.spans) == self.spans.maxlen:
+                self.dropped_spans += 1
+            self.spans.append(span)
+            new_spans.append(span)
         reg = self._registry
         if reg is None:
             return
@@ -850,15 +1012,33 @@ class FleetObserver:
                     {**labels, "kind": kind}, delta,
                 )
                 self._pushed[metric] = total
-        for fr in finished:
-            if fr.queue_wait_secs is not None:
+        for span in new_spans:
+            if span.queue_wait_secs is not None:
                 reg.observe_seconds(
-                    "fleet_queue_wait", fr.queue_wait_secs, labels
+                    "fleet_queue_wait", span.queue_wait_secs, labels
                 )
-            if fr.ttft_secs is not None:
-                reg.observe_seconds("fleet_ttft", fr.ttft_secs, labels)
-            if fr.e2e_secs is not None:
-                reg.observe_seconds("fleet_e2e", fr.e2e_secs, labels)
+            if span.ttft_secs is not None:
+                reg.observe_seconds("fleet_ttft", span.ttft_secs, labels)
+            if span.e2e_secs is not None:
+                reg.observe_seconds("fleet_e2e", span.e2e_secs, labels)
+            if span.slo_class is None:
+                continue
+            cls_labels = {**labels, "slo_class": span.slo_class}
+            # The fleet's accounting decision travels on the request:
+            # slo_attained is None for spans the fleet excluded
+            # (cancelled — a client abort is not an SLO verdict).
+            if span.slo_attained is not None:
+                reg.inc("fleet_slo_requests_total", cls_labels)
+                if span.slo_attained:
+                    reg.inc("fleet_slo_attained_total", cls_labels)
+            if span.ttft_secs is not None:
+                reg.observe_seconds(
+                    "fleet_class_ttft", span.ttft_secs, cls_labels
+                )
+            if span.tpot_secs is not None:
+                reg.observe_seconds(
+                    "fleet_class_tpot", span.tpot_secs, cls_labels
+                )
 
 
 class SupervisorObserver:
@@ -962,17 +1142,21 @@ def _us(t: float, t0: float) -> float:
     return round((t - t0) * 1e6, 3)
 
 
-def trace_events(observer: EngineObserver) -> dict:
+def trace_events(observer: EngineObserver, t0: float | None = None) -> dict:
     """Render an observer's rings (NON-destructively — drains are the
     caller's business) as a Chrome trace_event object: request lifecycle
     spans as complete ("X") events on a per-request lane under the
     "requests" process, step records as "X" events plus occupancy /
     queue-depth counter ("C") tracks under the "engine" process.  Load
-    the written file in chrome://tracing or https://ui.perfetto.dev."""
+    the written file in chrome://tracing or https://ui.perfetto.dev.
+    ``t0`` pins the timeline origin to an EXTERNAL clock zero — the
+    merged fleet trace passes the fleet-wide minimum so every lane
+    shares one clock; standalone export derives it from the rings."""
     steps = list(observer.steps)
     spans = list(observer.spans)
-    stamps = [s.t_start for s in steps] + [sp.t_submit for sp in spans]
-    t0 = min(stamps) if stamps else 0.0
+    if t0 is None:
+        stamps = [s.t_start for s in steps] + [sp.t_submit for sp in spans]
+        t0 = min(stamps) if stamps else 0.0
     events: list[dict] = [
         {"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
          "args": {"name": f"requests (engine {observer.name})"}},
@@ -1026,3 +1210,158 @@ def trace_events(observer: EngineObserver) -> dict:
                 "ts": _us(rec.t_start, t0), "args": {counter: value},
             })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# Merged fleet trace pid layout: the router process, the supervisor
+# process, then two pids per replica (its requests + its engine steps,
+# the same split the single-engine export uses).
+_ROUTER_PID = 1
+_SUPERVISOR_PID = 2
+_REPLICA_PID_BASE = 10
+
+
+def fleet_trace_events(
+    fleet_observer,
+    engine_observers=(),
+    supervisor_events=(),
+) -> dict:
+    """Merge the whole fleet's timelines into ONE chrome trace_event
+    object, all lanes on the fleet's clock:
+
+      * **Router process** (pid 1): one lane per terminal fleet request
+        — its queued segment, then one complete event per per-replica
+        attempt (replica id, outcome/fault kind, charged flag, SLO
+        class in ``args``), failover replays linked to the attempt they
+        retry by chrome flow events ("s"/"f"), and an instant event at
+        the exactly-one terminal status.
+      * **Supervisor process** (pid 2): instant events for every
+        supervision transition (death, backoff wait, canary probe,
+        quarantine, rejoin, ... — ``SupervisorEvent``).
+      * **Per-replica processes** (pids 10+): each replica's full
+        engine timeline exactly as its own ``trace_events`` renders it
+        (request lanes + step/counter tracks), re-based onto the shared
+        clock zero.
+
+    Load the written file in chrome://tracing or perfetto;
+    ``tools/trace_export.py --validate`` schema-checks it."""
+    spans = list(fleet_observer.spans) if fleet_observer is not None else []
+    engine_observers = [o for o in engine_observers if o is not None]
+    supervisor_events = list(supervisor_events)
+    stamps = [s.t_submit for s in spans]
+    stamps += [ev.t for ev in supervisor_events]
+    for obs in engine_observers:
+        stamps += [r.t_start for r in obs.steps]
+        stamps += [sp.t_submit for sp in obs.spans]
+    t0 = min(stamps) if stamps else 0.0
+    events: list[dict] = [
+        {"ph": "M", "pid": _ROUTER_PID, "tid": 0, "name": "process_name",
+         "args": {"name": "fleet router"}},
+        {"ph": "M", "pid": _SUPERVISOR_PID, "tid": 0,
+         "name": "process_name", "args": {"name": "supervisor"}},
+        {"ph": "M", "pid": _SUPERVISOR_PID, "tid": 1, "name": "thread_name",
+         "args": {"name": "events"}},
+    ]
+    flow_id = 0
+    for lane, span in enumerate(spans, start=1):
+        events.append(
+            {"ph": "M", "pid": _ROUTER_PID, "tid": lane,
+             "name": "thread_name", "args": {"name": span.rid}}
+        )
+        first_dispatch = (
+            span.attempts[0].t_dispatch if span.attempts else span.t_done
+        )
+        events.append({
+            "ph": "X", "pid": _ROUTER_PID, "tid": lane, "cat": "request",
+            "name": "queued", "ts": _us(span.t_submit, t0),
+            "dur": max(
+                _us(first_dispatch, t0) - _us(span.t_submit, t0), 0.0
+            ),
+            "args": {
+                "rid": span.rid, "slo_class": span.slo_class,
+                "status": span.status,
+            },
+        })
+        prev_end = None
+        for i, att in enumerate(span.attempts):
+            end = att.t_end if att.t_end is not None else span.t_done
+            events.append({
+                "ph": "X", "pid": _ROUTER_PID, "tid": lane,
+                "cat": "attempt", "name": f"attempt r{att.replica}",
+                "ts": _us(att.t_dispatch, t0),
+                "dur": max(
+                    _us(end, t0) - _us(att.t_dispatch, t0), 0.0
+                ),
+                "args": {
+                    "rid": span.rid, "replica": att.replica,
+                    "attempt": i, "outcome": att.outcome,
+                    "charged": att.charged, "tokens": att.tokens,
+                    "retry_of": i - 1 if i else None,
+                    "slo_class": span.slo_class,
+                },
+            })
+            if i:
+                # Chrome flow link: the replay attempt is a retry CHILD
+                # of the attempt the fault ended ("s" at the parent's
+                # end, "f" at the child's dispatch; matched by
+                # cat+name+id).
+                flow_id += 1
+                events.append({
+                    "ph": "s", "pid": _ROUTER_PID, "tid": lane,
+                    "cat": "failover", "name": "failover",
+                    "id": flow_id,
+                    "ts": _us(prev_end if prev_end is not None
+                              else att.t_dispatch, t0),
+                })
+                events.append({
+                    "ph": "f", "pid": _ROUTER_PID, "tid": lane,
+                    "cat": "failover", "name": "failover",
+                    "id": flow_id, "bp": "e",
+                    "ts": _us(att.t_dispatch, t0),
+                })
+            prev_end = end
+        events.append({
+            "ph": "i", "pid": _ROUTER_PID, "tid": lane, "cat": "request",
+            "name": f"terminal:{span.status}", "ts": _us(span.t_done, t0),
+            "s": "t",
+            "args": {
+                "rid": span.rid, "status": span.status,
+                "failovers": span.failovers,
+                "slo_class": span.slo_class,
+                "slo_attained": span.slo_attained,
+                "tokens": span.n_tokens,
+            },
+        })
+    for ev in supervisor_events:
+        events.append({
+            "ph": "i", "pid": _SUPERVISOR_PID, "tid": 1,
+            "cat": "supervisor", "name": ev.kind, "ts": _us(ev.t, t0),
+            "s": "t",
+            "args": {"chip_id": ev.chip_id, "detail": ev.detail},
+        })
+    for idx, obs in enumerate(engine_observers):
+        base = _REPLICA_PID_BASE + 2 * idx
+        for ev in trace_events(obs, t0=t0)["traceEvents"]:
+            ev = dict(ev)
+            ev["pid"] = base + (ev["pid"] - 1)
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_fleet_trace(
+    path: str,
+    fleet_observer,
+    engine_observers=(),
+    supervisor_events=(),
+) -> tuple[int, int]:
+    """Write the merged fleet timeline (``fleet_trace_events``) as
+    chrome://tracing-loadable JSON.  Returns ``(n_events,
+    n_replicas)`` — how much of the fleet the file actually covers, so
+    the CLI can say so instead of silently exporting one replica."""
+    engine_observers = [o for o in engine_observers if o is not None]
+    trace = fleet_trace_events(
+        fleet_observer, engine_observers, supervisor_events
+    )
+    with open(path, "w") as f:
+        json.dump(trace, f)
+        f.write("\n")
+    return len(trace["traceEvents"]), len(engine_observers)

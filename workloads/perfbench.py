@@ -1165,11 +1165,28 @@ def measure_fleet(scale: BenchScale) -> dict:
          the same schedule (failover replay is bit-identical under
          greedy), and every rid must reach exactly one terminal
          status — a recovery number over a lossy stream would be a
-         lie."""
+         lie.
+      4. **Per-class SLO attainment** — the same open-loop generator
+         with class-tagged arrivals (the default interactive/bulk
+         mix): per-class attainment ratios, class TTFT/TPOT tails and
+         end-of-run burn rates — the inputs the ROADMAP's SLO
+         scheduler and autoscaler consume
+         (``fleet_slo_attainment_interactive`` /
+         ``fleet_interactive_ttft_p99_ms`` / ...).
+      5. **Fleet-trace overhead** — the same closed-loop stream with
+         the FULL fleet observability treatment (per-replica engine
+         observers + fleet observer + SLO class tags, all pushing into
+         a live Registry) vs bare, interleaved repeats; published as
+         ``fleet_trace_overhead_pct``, with every on/off pair's
+         streams ASSERTED bit-identical (tracing and class tags must
+         never move a token)."""
     import statistics
+
+    from tpu_device_plugin.metrics import Registry
 
     from .faults import FaultInjector
     from .fleet import Fleet, TrafficGen, drive_open_loop
+    from .obs import EngineObserver, FleetObserver
     from .quant import quantize_params
     from .serve import ServeEngine
 
@@ -1197,17 +1214,34 @@ def measure_fleet(scale: BenchScale) -> dict:
     )
     sched = gen.schedule(n_req)
 
-    def build_fleet(n, injector=None):
+    def build_fleet(n, injector=None, observed=False):
+        observers = [None] * n
+        fleet_obs = None
+        if observed:
+            # The FULL fleet observability treatment a production
+            # scrape-plus-trace deployment pays: per-replica engine
+            # observers and the fleet observer, every bridge pushing
+            # into a live Registry.
+            reg = Registry()
+            observers = [
+                EngineObserver(name=str(i), replica=str(i))
+                for i in range(n)
+            ]
+            for o in observers:
+                o.bind_registry(reg)
+            fleet_obs = FleetObserver()
+            fleet_obs.bind_registry(reg)
         engines = [
             ServeEngine(
                 params, config, slots=batch, page_size=ps, chunk=chunk,
                 prompt_bucket=-(-prompt_len // ps) * ps, pipelined=True,
+                observer=observers[i],
             )
-            for _ in range(n)
+            for i in range(n)
         ]
         fleet = Fleet(
             engines, chip_ids=[f"chip-{i}" for i in range(n)],
-            fault_injector=injector,
+            fault_injector=injector, observer=fleet_obs,
             # Compiles past the exempt first step (decode programs land
             # on step 2) must not read as hangs on a slow host/link.
             hang_timeout_s=60.0,
@@ -1337,9 +1371,114 @@ def measure_fleet(scale: BenchScale) -> dict:
         requeued += fleet.failover_requeues
         fleet.close()
     rec_ms = [r * 1000 for r in recoveries]
+
+    # Per-class SLO attainment: the same open-loop generator with
+    # class-tagged arrivals (schedule_classed keeps arrivals, prompts
+    # and budgets bit-identical to the unclassed stream — tagging
+    # cannot move tokens, and the class draw is its own seeded rng).
+    classed = gen.schedule_classed(n_req)
+    fleet_slo = build_fleet(n_rep)
+    streams = drive_open_loop(fleet_slo, classed, session_every=4)
+    if len(streams) != n_req:
+        raise RuntimeError(
+            f"fleet SLO bench served {len(streams)} of {n_req} requests"
+        )
+    done = fleet_slo.drain_completed()
+    attainment = fleet_slo.slo_attainment()
+    burn = fleet_slo.slo_burn_rates()
+    by_class: dict[str, list] = {}
+    for fr in done:
+        if fr.slo_class is not None:
+            by_class.setdefault(fr.slo_class, []).append(fr)
+    slo_fields: dict = {}
+    for name in ("interactive", "bulk"):
+        spans = by_class.get(name, [])
+        ratio = attainment.get(name)
+        if ratio is not None:
+            slo_fields[f"fleet_slo_attainment_{name}"] = round(ratio, 3)
+            slo_fields[f"fleet_slo_burn_rate_{name}"] = round(
+                burn.get(name, 0.0), 3
+            )
+            # Scored requests only (cancelled are excluded from the
+            # attainment denominator — keep the artifact's arithmetic
+            # consistent with the ratio it sits next to).
+            slo_fields[f"fleet_slo_requests_{name}"] = sum(
+                1 for fr in spans if fr.slo_attained is not None
+            )
+        ttfts_c = [
+            fr.ttft_secs * 1000 for fr in spans
+            if fr.ttft_secs is not None
+        ]
+        tpots_c = [
+            fr.tpot_secs * 1000 for fr in spans
+            if fr.tpot_secs is not None
+        ]
+        if ttfts_c:
+            slo_fields[f"fleet_{name}_ttft_p99_ms"] = round(
+                _pctl(ttfts_c, 0.99), 2
+            )
+        if tpots_c:
+            slo_fields[f"fleet_{name}_tpot_p99_ms"] = round(
+                _pctl(tpots_c, 0.99), 2
+            )
+    fleet_slo.close()
+
+    # Fleet-trace overhead: the closed-loop stream bare vs under the
+    # full observability treatment + SLO tags, interleaved repeats with
+    # every pair's streams asserted bit-identical (the inertness
+    # contract, priced).
+    trace_streams: dict[bool, list] = {False: [], True: []}
+
+    def traced_run(observed: bool) -> float:
+        fleet = build_fleet(n_rep, observed=observed)
+        tokens0 = fleet.generated_tokens
+        t0 = time.perf_counter()
+        for i, (p, n) in enumerate(prompts):
+            fleet.submit(
+                p, n, session=f"sess-{i % 4}",
+                slo_class=(
+                    ("interactive" if i % 4 else "bulk") if observed
+                    else None
+                ),
+            )
+        streams = fleet.run()
+        secs = time.perf_counter() - t0
+        rate = (fleet.generated_tokens - tokens0) / secs
+        trace_streams[observed].append(streams)
+        fleet.close()
+        return rate
+
+    trace_off, trace_on = _interleaved_repeats(
+        lambda: traced_run(False), lambda: traced_run(True)
+    )
+    for off_streams, on_streams in zip(
+        trace_streams[False], trace_streams[True]
+    ):
+        if off_streams != on_streams:
+            raise RuntimeError(
+                "fleet-trace bench: streams diverged observer on vs "
+                "off — fleet tracing + SLO classes are supposed to be "
+                "inert"
+            )
+    trace_overheads = [
+        (off - on) / max(off, 1e-9) * 100.0
+        for off, on in zip(trace_off, trace_on)
+    ]
     return {
         "fleet_replicas": n_rep,
         "fleet_requests": n_req,
+        **slo_fields,
+        "fleet_trace_overhead_pct": round(
+            statistics.median(trace_overheads), 2
+        ),
+        "fleet_trace_overhead_pct_min": round(min(trace_overheads), 2),
+        "fleet_trace_overhead_pct_max": round(max(trace_overheads), 2),
+        "fleet_trace_on_tokens_per_sec": round(
+            statistics.median(trace_on), 1
+        ),
+        "fleet_trace_off_tokens_per_sec": round(
+            statistics.median(trace_off), 1
+        ),
         "fleet_tokens_per_sec": round(rate, 1),
         "fleet_ttft_p50_ms": round(_pctl(ttfts, 0.50), 2),
         "fleet_ttft_p99_ms": round(_pctl(ttfts, 0.99), 2),

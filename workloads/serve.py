@@ -3183,6 +3183,10 @@ def _run_fleet_cli(
     fleet_obs = None
     sup_obs = None
     if args.metrics_port is not None or args.trace_out:
+        # Any active sink (a metrics scrape OR a trace file) gets the
+        # FULL observer set — a --trace-out --supervise run without
+        # --metrics-port must still see supervisor events on the very
+        # trace it asked for; only registry BINDING is port-gated.
         from .obs import EngineObserver, FleetObserver
 
         observers = [
@@ -3190,16 +3194,17 @@ def _run_fleet_cli(
             for i in range(args.fleet)
         ]
         fleet_obs = FleetObserver()
+        if args.supervise:
+            from .obs import SupervisorObserver
+
+            sup_obs = SupervisorObserver()
         if args.metrics_port is not None:
             from tpu_device_plugin.metrics import registry
 
             for obs in observers:
                 obs.bind_registry(registry)
             fleet_obs.bind_registry(registry)
-            if args.supervise:
-                from .obs import SupervisorObserver
-
-                sup_obs = SupervisorObserver()
+            if sup_obs is not None:
                 sup_obs.bind_registry(registry)
     engines = []
     for i in range(args.fleet):
@@ -3237,6 +3242,7 @@ def _run_fleet_cli(
         fleet.submit([1 + i], 1, session=f"warm-{i}")
     fleet.run()
     supervisor = None
+    respawn_observers: list = []
     if args.supervise:
         from .backoff import Backoff
         from .supervisor import FleetSupervisor
@@ -3246,6 +3252,24 @@ def _run_fleet_cli(
             # caches (warm restart) under a FIXED rng, so every
             # respawn's canary stream is deterministic — the half-open
             # probe's bit-identity check needs exactly that.
+            obs = None
+            if fleet_obs is not None and slot is not None:
+                # A resurrected replica keeps reporting: its engine gets
+                # its own observer (chip-slot-keyed replica label) so
+                # the merged trace covers the post-revival timeline too.
+                # Probe-calibration scratch engines (slot None) stay
+                # unobserved.
+                from .obs import EngineObserver
+
+                obs = EngineObserver(
+                    name=f"respawn-{slot.chip_id}-{slot.restarts}",
+                    replica=f"respawn-{slot.chip_id}",
+                )
+                if args.metrics_port is not None:
+                    from tpu_device_plugin.metrics import registry
+
+                    obs.bind_registry(registry)
+                respawn_observers.append(obs)
             return ServeEngine(
                 params, config, slots=args.slots, page_size=page_size,
                 prompt_bucket=bucket, temperature=args.temperature,
@@ -3256,7 +3280,7 @@ def _run_fleet_cli(
                 prefix_cache=args.prefix_cache,
                 kv_offload=args.kv_offload,
                 kv_host_pages=args.kv_host_pages, adapters=adapters,
-                max_retries=args.max_retries,
+                max_retries=args.max_retries, observer=obs,
                 retry_backoff_s=args.retry_backoff_s, **spec_kw,
             )
 
@@ -3284,12 +3308,42 @@ def _run_fleet_cli(
             f"{args.max_restarts}, capacity-aware admission bound="
             f"{fleet.admission_bound}"
         )
+    # SLO-classed traffic: --slo-mix tags every arrival with a class
+    # drawn from the weighted mix; attainment is scored by the fleet's
+    # default interactive/bulk targets and summarized at exit.
+    class_mix = None
+    if args.slo_mix:
+        from .fleet import DEFAULT_SLO_CLASSES
+
+        import math
+
+        known = {c.name for c in DEFAULT_SLO_CLASSES}
+        class_mix = []
+        for part in args.slo_mix.split(","):
+            name, _, weight = part.partition(":")
+            name = name.strip()
+            try:
+                w = float(weight) if weight else 1.0
+            except ValueError:
+                parser.error(
+                    f"--slo-mix wants CLASS[:WEIGHT] pairs, got {part!r}"
+                )
+            if name not in known or not math.isfinite(w) or w <= 0:
+                parser.error(
+                    f"--slo-mix class must be one of {sorted(known)} "
+                    f"with a positive weight, got {part!r}"
+                )
+            class_mix.append((name, w))
     traffic = TrafficGen(
         seed=7, vocab=config.vocab_size, max_prompt=args.prompt_len,
         max_new=args.max_new_tokens,
         min_new=max(1, args.max_new_tokens // 3),
+        **({"class_mix": tuple(class_mix)} if class_mix else {}),
     )
-    sched = traffic.schedule(args.requests)
+    sched = (
+        traffic.schedule_classed(args.requests) if class_mix
+        else traffic.schedule(args.requests)
+    )
     tokens0 = fleet.generated_tokens
     t0 = time.perf_counter()
     if args.http_port is not None:
@@ -3306,10 +3360,11 @@ def _run_fleet_cli(
         # One client thread per request: reading an SSE stream to
         # completion inline would serialize the open-loop schedule into
         # a closed loop of depth 1 and never exercise the router.
-        def sse_client(prompt, new):
-            body = json.dumps(
-                {"prompt": prompt, "max_new_tokens": new}
-            ).encode()
+        def sse_client(prompt, new, slo_class=None):
+            payload = {"prompt": prompt, "max_new_tokens": new}
+            if slo_class is not None:
+                payload["slo_class"] = slo_class
+            body = json.dumps(payload).encode()
             req = urllib.request.Request(
                 f"http://127.0.0.1:{port}/v1/generate", data=body,
                 headers={"Content-Type": "application/json"},
@@ -3326,10 +3381,12 @@ def _run_fleet_cli(
 
         clients = []
         t_start = time.perf_counter()
-        for offset, prompt, new in sched:
+        for offset, prompt, new, *rest in sched:
             time.sleep(max(0.0, offset - (time.perf_counter() - t_start)))
             t = threading.Thread(
-                target=sse_client, args=(prompt, new), daemon=True
+                target=sse_client,
+                args=(prompt, new, rest[0] if rest else None),
+                daemon=True,
             )
             t.start()
             clients.append(t)
@@ -3379,9 +3436,32 @@ def _run_fleet_cli(
             f"slots={supervisor.states()} "
             f"restore_ms={supervisor.restore_ms}"
         )
-    if args.trace_out and observers[0] is not None:
-        n_events = observers[0].export_trace(args.trace_out)
-        print(f"trace (replica 0): {n_events} events -> {args.trace_out}")
+    attainment = fleet.slo_attainment()
+    if any(v is not None for v in attainment.values()):
+        burn = fleet.slo_burn_rates()
+        print("slo: " + " ".join(
+            f"{name}={fleet.slo_attained_counts[name]}"
+            f"/{fleet.slo_request_counts[name]} attained "
+            f"({ratio * 100:.1f}%, burn_rate={burn[name]:.2f})"
+            for name, ratio in sorted(attainment.items())
+            if ratio is not None
+        ))
+    if args.trace_out and fleet_obs is not None:
+        from .obs import export_fleet_trace
+
+        n_events, n_replicas = export_fleet_trace(
+            args.trace_out, fleet_obs, list(observers) + respawn_observers,
+            supervisor_events=(
+                supervisor.events if supervisor is not None else ()
+            ),
+        )
+        print(
+            f"fleet trace: {n_events} events covering {n_replicas} "
+            f"replica lanes + router + supervisor "
+            f"({len(fleet_obs.spans)} request spans, "
+            f"{len(supervisor.events) if supervisor is not None else 0} "
+            f"supervisor events) -> {args.trace_out}"
+        )
     fleet.close()
     if metrics_server is not None:
         metrics_server.stop()
@@ -3530,6 +3610,15 @@ def main(argv=None) -> int:
                         "on this port (0 = ephemeral) and push the "
                         "synthetic request stream through it as real "
                         "SSE clients instead of the in-process API")
+    parser.add_argument("--slo-mix", default=None,
+                        metavar="CLASS[:WEIGHT],...",
+                        help="with --fleet: tag the traffic stream with "
+                        "SLO classes drawn from this weighted mix (e.g. "
+                        "'interactive:3,bulk:1' — TTFT-bound interactive "
+                        "vs TPOT-bound bulk); per-class attainment and "
+                        "burn rates print at exit and land on the "
+                        "registry/trace (docs/OBSERVABILITY.md "
+                        "'Distributed tracing & SLO attainment')")
     parser.add_argument("--supervise", action="store_true",
                         help="with --fleet: arm the self-healing "
                         "FleetSupervisor (workloads/supervisor.py) — "
@@ -3574,6 +3663,8 @@ def main(argv=None) -> int:
                      "base)")
     if args.max_restarts is not None and args.max_restarts < 0:
         parser.error("--max-restarts must be >= 0 (omit for unbounded)")
+    if args.slo_mix and args.fleet is None:
+        parser.error("--slo-mix tags fleet traffic; it needs --fleet N")
 
     from . import lease
 

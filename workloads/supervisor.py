@@ -64,6 +64,7 @@ from dataclasses import dataclass, field
 from .backoff import Backoff
 from .errors import EngineClosed
 from .faults import InjectedFault
+from .obs import SupervisorEvent
 
 # Slot states.
 SERVING = "serving"  # its replica is alive in the fleet
@@ -212,9 +213,34 @@ class FleetSupervisor:
         self.crash_loops = 0
         self.health_deferrals = 0
         self.restore_s: list[float] = []
+        # The supervision timeline: one SupervisorEvent per transition
+        # (death, backoff wait, canary probe, quarantine, rejoin, ...)
+        # in a bounded ring — the merged fleet trace's supervisor lane
+        # (workloads.obs.fleet_trace_events).  Evictions are counted,
+        # never silent.
+        self.events: deque = deque(maxlen=4096)
+        self.dropped_events = 0
         self._obs = observer
         if observer is not None:
             observer._bind(self)
+
+    def _event(
+        self, kind: str, chip_id: str, detail: str = "",
+        t: float | None = None,
+    ) -> None:
+        if len(self.events) == self.events.maxlen:
+            self.dropped_events += 1
+        self.events.append(SupervisorEvent(
+            t=self._clock() if t is None else t, kind=kind,
+            chip_id=chip_id, detail=detail,
+        ))
+
+    def drain_events(self) -> list:
+        """Hand back (and clear) the supervision-event ring (the
+        observer rings' drain contract)."""
+        out = list(self.events)
+        self.events.clear()
+        return out
 
     # ---- introspection ---------------------------------------------------
 
@@ -284,6 +310,7 @@ class FleetSupervisor:
         if slot.state != QUARANTINED:
             slot.state = QUARANTINED
             slot.reason = reason
+            self._event("quarantine", chip_id, reason)
 
     def clear(self, chip_id: str) -> None:
         """Lift a quarantine: the slot's crash history is forgiven and
@@ -295,6 +322,7 @@ class FleetSupervisor:
         slot.failures.clear()
         slot.attempt = 0
         slot.reason = None
+        self._event("clear", chip_id, "operator lifted quarantine")
         if slot.index is not None and (
             slot.index < len(self.fleet.replicas)
             and self.fleet.replicas[slot.index].state != "dead"
@@ -393,6 +421,7 @@ class FleetSupervisor:
         slot.index = None
         slot.t_down = now
         slot.attempt = 0
+        self._event("death", slot.chip_id, "replica died", t=now)
         self._record_failure(slot, now, "replica died")
         if slot.state == QUARANTINED:
             return
@@ -406,9 +435,14 @@ class FleetSupervisor:
                 f"max_restarts {self.max_restarts})"
             )
             self.crash_loops += 1  # budget exhaustion is a loop verdict
+            self._event("quarantine", slot.chip_id, slot.reason, t=now)
             return
         slot.state = BACKOFF
-        slot.next_due = now + self._delay(slot)
+        delay = self._delay(slot)
+        slot.next_due = now + delay
+        self._event(
+            "backoff", slot.chip_id, f"retry in {delay:.3f}s", t=now
+        )
 
     def _delay(self, slot: ReplicaSlot) -> float:
         # Per-slot decorrelation: distinct chips jitter differently
@@ -437,6 +471,7 @@ class FleetSupervisor:
                 f"{self.crash_loop_window_s}s (last: {reason})"
             )
             self.crash_loops += 1
+            self._event("quarantine", slot.chip_id, slot.reason, t=now)
 
     def _restart_failed(
         self, slot: ReplicaSlot, now: float, reason: str
@@ -444,10 +479,15 @@ class FleetSupervisor:
         self.restart_failures += 1
         slot.attempt += 1
         slot.state = BACKOFF
+        self._event("restart_failed", slot.chip_id, reason, t=now)
         self._record_failure(slot, now, reason)
         if slot.state == QUARANTINED:
             return
-        slot.next_due = now + self._delay(slot)
+        delay = self._delay(slot)
+        slot.next_due = now + delay
+        self._event(
+            "backoff", slot.chip_id, f"retry in {delay:.3f}s", t=now
+        )
 
     def _resurrect(self, slot: ReplicaSlot, now: float) -> None:
         """One resurrection attempt: respawn seam -> engine factory ->
@@ -458,8 +498,13 @@ class FleetSupervisor:
             # re-check after the current delay without escalating.
             self.health_deferrals += 1
             slot.next_due = now + self._delay(slot)
+            self._event(
+                "health_deferral", slot.chip_id,
+                "chip carries a live Unhealthy mark", t=now,
+            )
             return
         slot.state = PROBING
+        self._event("probe", slot.chip_id, "half-open canary", t=now)
         try:
             if self._faults is not None:
                 self._faults.check("replica_respawn")
@@ -501,6 +546,14 @@ class FleetSupervisor:
         done = self._clock()
         if slot.t_down is not None:
             self.restore_s.append(done - slot.t_down)
+        self._event(
+            "rejoin", slot.chip_id,
+            (
+                f"restored in {(done - slot.t_down) * 1000:.1f}ms"
+                if slot.t_down is not None else "rejoined"
+            ),
+            t=done,
+        )
         slot.t_down = None
         slot.next_due = None
         slot.reason = None
