@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check kvcache-check slo-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check spec-superstep-check kvcache-check slo-check fmt-check
 
 all: native
 
@@ -51,7 +51,20 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check kvcache-check slo-check test
+check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check spec-superstep-check kvcache-check slo-check test
+
+# Speculative-superstep tripwires (docs/SERVING.md "Speculative
+# supersteps"): one seeded spec="auto" stream at spec_superstep_k=4 —
+# greedy streams bit-identical to the k=1 spec oracle, and the
+# observer's step records prove ONE fused readback per superstep (one
+# normalized dispatch per spec step, k rounds per dispatch, over-decode
+# reconciled, no leaks).  The full pinned suite (sampled parity,
+# acceptance-mask exact-stop, tight-pool pre-commit, lifecycle reclaim,
+# fleet failover, TP) and the spec_superstep_k-randomized fuzz arms
+# ride the slow suite (tests/test_spec_superstep.py,
+# tests/test_serve_fuzz.py).
+spec-superstep-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_spec_superstep.py::test_spec_superstep_check_smoke" -q -o addopts=
 
 # Fleet-tracing + SLO tripwires (docs/OBSERVABILITY.md "Distributed
 # tracing & SLO attainment"): a seeded two-replica crash under the full

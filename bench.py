@@ -577,6 +577,10 @@ COMPACT_KEYS = [
     # headline so the link-tax-bound absolute number cannot be misread
     # as the design's ceiling (VERDICT r5 weak #3).
     "spec_serve_tokens_per_sec", "spec_round_readback_ms",
+    # Speculative supersteps: best-k chained throughput + the sweep's
+    # verdict (the readback-amortization PR's spec-path headline).
+    "spec_superstep_tokens_per_sec", "spec_superstep_best_k",
+    "spec_superstep_speedup", "spec_superstep_overdecode_pct",
     "spec_lookahead_speedup",
     "spec_serve_lookahead_tokens_per_sec", "spec_vs_plain_decode_b1",
     "spec_vs_plain_decode_b4", "spec_acceptance_rate",

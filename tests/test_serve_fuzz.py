@@ -71,10 +71,16 @@ def _run_one(seed: int, params, draft, adapters) -> None:
         )
     if spec:
         kw.update(draft_params=draft, draft_config=DRAFT_CONFIG,
-                  gamma=int(rng.integers(2, 5)),
-                  # Lookahead supersteps (k rounds per dispatch) must be
-                  # emission-invariant for every k.
-                  spec_lookahead=int(rng.choice([1, 1, 2, 3])))
+                  gamma=int(rng.integers(2, 5)))
+        if rng.integers(2):
+            # Chained-retirement spec supersteps (device-side acceptance
+            # masks, one readback per k rounds) must be
+            # emission-invariant for every k across this whole matrix.
+            kw["spec_superstep_k"] = int(rng.choice([1, 2, 4]))
+        else:
+            # Lookahead supersteps (k rounds per dispatch) must be
+            # emission-invariant for every k.
+            kw["spec_lookahead"] = int(rng.choice([1, 1, 2, 3]))
         if rng.integers(2):
             # Adaptive arm: injected thresholds force always-plain
             # (0.0), always-spec (slots) and mid-stream switching —
@@ -235,8 +241,14 @@ def _run_chaos(seed: int, params, draft, adapters) -> None:
         )
     if spec:
         kw.update(draft_params=draft, draft_config=DRAFT_CONFIG,
-                  gamma=int(rng.integers(2, 5)),
-                  spec_lookahead=int(rng.choice([1, 2])))
+                  gamma=int(rng.integers(2, 5)))
+        if rng.integers(2):
+            # Chained spec supersteps under chaos: a fault mid-scan
+            # drops the whole in-flight superstep and replays
+            # bit-identically; reclaim asserted at the bottom.
+            kw["spec_superstep_k"] = int(rng.choice([1, 2, 4]))
+        else:
+            kw["spec_lookahead"] = int(rng.choice([1, 2]))
         if rng.integers(2):
             kw.update(spec="auto", spec_breakeven=float(
                 rng.choice([0.0, 1.0, kw["slots"]])

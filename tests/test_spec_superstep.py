@@ -1,0 +1,386 @@
+"""Speculative supersteps (paged.paged_spec_superstep_chained +
+ServeEngine(spec_superstep_k=k)): k chained draft→verify→commit rounds
+per device dispatch with DEVICE-SIDE acceptance masks and eos/budget
+retirement, host bookkeeping overlapping the scan's compute, and ONE
+fused readback per k rounds.  Parity is the bar: greedy AND sampled
+token streams must be EXACTLY the k=1 spec engine's (= the dense
+reference, greedy) for every k, across serial/batched admission,
+pipelining, budgeted chunked prefill, the KV offload tier and
+spec="auto" — with the acceptance mask's exact-stop rule, over-decode
+reconciliation, tight-pool page pre-commitment, mid-superstep lifecycle
+reclaim (cancel/deadline/quarantine/close), fleet failover and TP
+composed on top."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+DRAFT_CONFIG = ModelConfig(
+    max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+
+STREAMS = [([3, 1, 4, 1, 5], 17), ([2, 7], 9), ([9] * 11, 13)]
+
+
+@pytest.fixture(scope="module")
+def models():
+    return (
+        init_params(CONFIG, jax.random.PRNGKey(0)),
+        init_params(DRAFT_CONFIG, jax.random.PRNGKey(7)),
+    )
+
+
+def _engine(models, **kw):
+    params, draft = models
+    kw.setdefault("slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prompt_bucket", 8)
+    kw.setdefault("draft_params", draft)
+    kw.setdefault("draft_config", DRAFT_CONFIG)
+    kw.setdefault("gamma", 3)
+    return ServeEngine(params, CONFIG, **kw)
+
+
+def _ref(models, prompt, new):
+    params, _ = models
+    return [int(t) for t in np.asarray(
+        generate(params, jnp.asarray([prompt], jnp.int32), CONFIG, new)[0]
+    )]
+
+
+def _serve(models, streams=STREAMS, **kw):
+    engine = _engine(models, **kw)
+    rids = [engine.submit(p, n) for p, n in streams]
+    served = engine.run()
+    return [served[rid] for rid in rids], engine
+
+
+@pytest.mark.parametrize("k", [2, 3, 5])
+def test_spec_superstep_greedy_matches_dense_reference(models, k):
+    got, engine = _serve(models, spec_superstep_k=k)
+    for row, (p, n) in zip(got, STREAMS):
+        assert row == _ref(models, p, n), (k, p)
+    assert engine.ctrl.used_pages == 0
+    assert engine.spec_rounds == engine.spec_supersteps_run * k
+
+
+@pytest.mark.parametrize(
+    "mode_kw",
+    [
+        {"batched_admission": False},
+        {},
+        {"pipelined": True},
+        {"prefill_budget": 1},
+        {"pipelined": True, "prefill_budget": 8},
+        {"prefix_cache": True, "kv_offload": True, "kv_host_pages": 4},
+    ],
+    ids=["serial", "batched", "pipelined", "budget1", "piped-budget",
+         "kv-offload"],
+)
+def test_spec_superstep_bit_identical_across_modes(models, mode_kw):
+    """The tentpole parity pin: for every admission/overlap mode the
+    k>1 engine's greedy streams equal the k=1 spec engine's
+    byte-for-byte (WHEN the host reads tokens back cannot change WHAT
+    the rounds commit)."""
+    served = {}
+    for k in (1, 4):
+        served[k], engine = _serve(models, spec_superstep_k=k, **mode_kw)
+        pinned = (
+            engine.prefix.cached_pages if engine.prefix is not None else 0
+        )
+        assert engine.ctrl.used_pages == pinned, (k, mode_kw)
+    assert served[4] == served[1], mode_kw
+
+
+def test_spec_superstep_spec_auto_bit_identical(models):
+    """spec="auto" composes: the mode decision runs on boundary
+    occupancy, drains hand the mirrors across, and the mixed stream
+    stays the per-regime oracle's for every k and threshold."""
+    streams = STREAMS + [([5, 5, 5], 7)]
+    for breakeven in (0.0, 1.0, 2.0):
+        served = {}
+        for k, kw in ((1, {}), (4, {}), (4, {"pipelined": True})):
+            served[(k, *kw)] , engine = _serve(
+                models, streams=streams, spec="auto",
+                spec_breakeven=breakeven, spec_superstep_k=k, **kw,
+            )
+            assert engine.ctrl.used_pages == 0, (breakeven, k, kw)
+        first = next(iter(served.values()))
+        assert all(v == first for v in served.values()), breakeven
+
+
+def test_spec_superstep_sampled_bit_identical_to_k1(models):
+    """Per-round rng keys preserve the k=1 key schedule (each round
+    splits ONE engine key exactly as a k=1 dispatch does), so sampled
+    speculative streams — not just greedy — are bit-identical for every
+    k on a turnover-free stream (slot turnover legitimately shifts the
+    engine key schedule across k, as for every other engine mode)."""
+    streams = [([3, 1, 4, 1, 5], 12), ([2, 7], 9)]
+    served = {}
+    for k in (1, 2, 4):
+        served[k], engine = _serve(
+            models, streams=streams, spec_superstep_k=k, temperature=0.8,
+            top_k=40, rng=jax.random.PRNGKey(5),
+        )
+        assert engine.ctrl.used_pages == 0, k
+    assert served[2] == served[1]
+    assert served[4] == served[1]
+
+
+def test_spec_superstep_acceptance_mask_exact_stop(models):
+    """The device acceptance/retirement mask applies _emit's rule as
+    data: the emitted stream ends EXACTLY where the k=1 engine's does
+    (eos mid-round included), and the frozen remainder reconciles into
+    tokens_overdecoded at the fused readback."""
+    prompt, new = [3, 1, 4, 1, 5], 16
+    full = _ref(models, prompt, new)
+    eos = full[new // 2]
+    want = full[: full.index(eos) + 1]
+    for k in (1, 4):
+        engine = _engine(models, spec_superstep_k=k)
+        rid = engine.submit(prompt, new, eos_token=eos)
+        assert engine.run()[rid] == want, k
+        assert engine.ctrl.used_pages == 0, k
+
+
+def test_spec_superstep_overdecode_bounded_and_reconciled(models):
+    """A row freezes the round its terminal token lands, so over-decode
+    is bounded by the remainder of its own superstep — and the consume
+    reconciles it exactly (dead full-block rounds + the retiring
+    round's unemitted tail)."""
+    k = 4
+    engine = _engine(models, spec_superstep_k=k)
+    gp1 = engine.gamma + 1
+    rids = [engine.submit(p, n) for p, n in STREAMS]
+    served = engine.run()
+    for rid, (p, n) in zip(rids, STREAMS):
+        assert served[rid] == _ref(models, p, n)
+    # Each retiring row wastes < one superstep's committed capacity.
+    assert engine.tokens_overdecoded <= len(STREAMS) * k * gp1
+    assert engine.ctrl.used_pages == 0
+
+
+def test_spec_superstep_tight_pool_precommit_never_faults(models):
+    """Page pre-commitment is capped at each row's retirement ceiling
+    inside the admission-time worst-case commitment — a pool sized
+    exactly to the commitment serves a request ending at max_seq_len
+    without the allocator ever raising mid-scan."""
+    for pipelined in (False, True):
+        sizer = _engine(models, slots=1, spec_superstep_k=4,
+                        pipelined=pipelined)
+        new = CONFIG.max_seq_len - 3
+        n_pages = sizer._worst_case_pages(3, new)
+        tight = _engine(
+            models, slots=1, spec_superstep_k=4, pipelined=pipelined,
+            n_pages=n_pages,
+        )
+        rid = tight.submit([5, 2, 9], new)
+        served = tight.run()
+        assert served[rid] == _ref(models, [5, 2, 9], new), pipelined
+        assert tight.ctrl.used_pages == 0
+
+
+def test_spec_superstep_cancel_and_deadline_reclaim(models):
+    engine = _engine(models, spec_superstep_k=2, pipelined=True)
+    r1 = engine.submit([3, 1, 4], 30)
+    r2 = engine.submit([2, 7], 30)
+    engine.step()
+    engine.step()  # a chained spec superstep is now in flight
+    assert engine.cancel(r1)
+    served = engine.run()
+    statuses = {r.rid: r.status for r in engine.completed}
+    assert statuses[r1] == "cancelled" and statuses[r2] == "ok"
+    # The cancelled stream is a true prefix of the dense reference.
+    assert served[r1] == _ref(models, [3, 1, 4], 30)[: len(served[r1])]
+    assert served[r2] == _ref(models, [2, 7], 30)
+    assert engine.ctrl.used_pages == 0
+
+    engine = _engine(models, slots=1, spec_superstep_k=2)
+    rd = engine.submit([1, 2, 3], 40, deadline_s=0.05)
+    engine.step()
+    time.sleep(0.08)
+    engine.run()
+    statuses = {r.rid: r.status for r in engine.completed}
+    assert statuses[rd] == "expired"
+    assert engine.ctrl.used_pages == 0
+
+
+def test_spec_superstep_quarantine_drops_and_replays_bit_identical(models):
+    """A seam fault mid-superstep quarantines the WHOLE in-flight
+    chained superstep (PR-4 rules: state dropped, not drained) and the
+    replays resume bit-identically under the retry budget."""
+    from workloads.faults import FaultInjector
+
+    for seam in ("spec_dispatch", "spec_readback"):
+        for pipelined in (False, True):
+            engine = _engine(
+                models, spec_superstep_k=2, pipelined=pipelined,
+                fault_injector=FaultInjector({seam: [2]}), max_retries=2,
+            )
+            rids = [engine.submit(p, n) for p, n in STREAMS]
+            served = engine.run()
+            for rid, (p, n) in zip(rids, STREAMS):
+                assert served[rid] == _ref(models, p, n), (seam, pipelined)
+            assert engine.steps_quarantined >= 1
+            assert engine._pending_spec is None
+            assert engine.ctrl.used_pages == 0
+
+
+def test_spec_superstep_close_reclaims_in_flight(models):
+    engine = _engine(models, spec_superstep_k=3, pipelined=True)
+    rid = engine.submit([5, 5], 40)
+    engine.step()
+    engine.step()
+    engine.close()
+    statuses = {r.rid: r.status for r in engine.completed}
+    assert statuses[rid] == "failed"
+    assert engine._pending_spec is None
+    assert engine.ctrl.used_pages == 0
+    assert engine.idle
+
+
+def test_spec_superstep_one_readback_per_k_rounds(models):
+    """The acceptance criterion, observer-verified: every spec-mode
+    step dispatches exactly ONE chained superstep (k rounds) and pays
+    exactly one fused spec readback — spec_round_readback_ms amortizes
+    by k.  StepRecords carry the dispatch counts; engine counters carry
+    the round/superstep ratio."""
+    from workloads.obs import EngineObserver
+
+    k = 4
+    obs = EngineObserver()
+    engine = _engine(models, spec_superstep_k=k, observer=obs)
+    rids = [engine.submit(p, n) for p, n in STREAMS]
+    served = engine.run()
+    for rid, (p, n) in zip(rids, STREAMS):
+        assert served[rid] == _ref(models, p, n)  # observer inert
+    steps = obs.drain_steps()
+    spec_steps = [r for r in steps if r.mode == "spec"]
+    assert spec_steps, "no spec dispatch recorded"
+    # One normalized decode dispatch per spec step — k rounds ride it.
+    assert all(r.decode_dispatches == 1 for r in spec_steps)
+    assert engine.spec_rounds == engine.spec_supersteps_run * k
+    assert len(spec_steps) == engine.spec_supersteps_run
+    # Each spec step's one fused consume is its one host sync beyond
+    # admission (readback_secs sums the step's syncs; a spec step with
+    # no admission performed exactly one).
+    pure_decode = [r for r in spec_steps if not r.admitted]
+    assert pure_decode and all(r.readback_secs > 0 for r in pure_decode)
+
+
+def test_spec_superstep_fanout_prefix_and_lora_compose(models):
+    from workloads.lora import merge_lora
+    from workloads.multi_lora import synthetic_adapters
+
+    params, _ = models
+    adapters = synthetic_adapters(CONFIG, 2, rank=4, scale=0.3, seed=3)
+    engine = _engine(
+        models, spec_superstep_k=2, prefix_cache=True, adapters=adapters,
+    )
+    rids = [engine.submit(p, n) for p, n in STREAMS]
+    frids = engine.submit_fanout([6, 2, 6, 2, 6], 8, n_samples=2)
+    arid = engine.submit([1, 2, 3], 7, adapter=sorted(adapters)[0])
+    served = engine.run()
+    for rid, (p, n) in zip(rids, STREAMS):
+        assert served[rid] == _ref(models, p, n)
+    for rid in frids:
+        assert served[rid] == _ref(models, [6, 2, 6, 2, 6], 8)
+    merged = merge_lora(
+        params, adapters[sorted(adapters)[0]], dtype=jnp.float32
+    )
+    assert served[arid] == [int(t) for t in np.asarray(generate(
+        merged, jnp.asarray([[1, 2, 3]], jnp.int32), CONFIG, 7
+    )[0])]
+    assert engine.ctrl.used_pages == engine.prefix.cached_pages
+
+
+def test_spec_superstep_fleet_failover_replays_through(models):
+    """A replica crash mid-stream fails chained-spec engines' in-flight
+    work over to a survivor by replay — greedy streams bit-identical,
+    one terminal status per rid, no leak (the PR-6 contract with the
+    spec superstep's k-round fault domain)."""
+    from workloads.faults import FaultInjector
+    from workloads.fleet import Fleet
+
+    def build():
+        return [
+            _engine(models, spec_superstep_k=2,
+                    rng=jax.random.PRNGKey(42 + i))
+            for i in range(2)
+        ]
+
+    fleet = Fleet(build(), fault_injector=FaultInjector(
+        {"replica_crash": [3]}
+    ))
+    rids = [fleet.submit(p, n) for p, n in STREAMS for _ in range(2)]
+    served = fleet.run()
+    assert fleet.replica_crashes == 1
+    expected = [(p, n) for p, n in STREAMS for _ in range(2)]
+    for rid, (p, n) in zip(rids, expected):
+        assert served[rid] == _ref(models, p, n), rid
+    statuses = [r.status for r in fleet.completed]
+    assert statuses.count("ok") == len(rids)
+    for rep in fleet.replicas:
+        if rep.state != "dead":
+            assert rep.engine.ctrl.used_pages == 0
+    fleet.close()
+
+
+def test_spec_superstep_tp_matches_greedy(models):
+    """The chained-retirement superstep under a ("data", "model") mesh:
+    make_tp_spec_superstep(retire=True) re-jits the un-jitted core with
+    explicit shardings; tokens must equal the dense reference."""
+    from workloads.train import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    mesh = make_mesh(2, model_parallel=2)
+    got, engine = _serve(models, spec_superstep_k=3, mesh=mesh)
+    for row, (p, n) in zip(got, STREAMS):
+        assert row == _ref(models, p, n)
+    assert engine.ctrl.used_pages == 0
+
+
+def test_spec_superstep_validation(models):
+    params, draft = models
+    with pytest.raises(ValueError, match="spec_superstep_k"):
+        _engine(models, spec_superstep_k=0)
+    with pytest.raises(ValueError, match="spec_superstep_k"):
+        ServeEngine(params, CONFIG, spec_superstep_k=2)
+    with pytest.raises(ValueError, match="supersedes"):
+        _engine(models, spec_superstep_k=2, spec_lookahead=2)
+
+
+def test_spec_superstep_check_smoke(models):
+    """The `make spec-superstep-check` tripwire: one seeded spec="auto"
+    stream at k=4, greedy streams oracle-true, and the observer's step
+    records prove ONE readback per superstep (one normalized dispatch
+    per spec step, k rounds per dispatch, over-decode reconciled, no
+    leaks)."""
+    from workloads.obs import EngineObserver
+
+    streams = STREAMS + [([5, 5, 5], 7)]
+    oracle, engine = _serve(
+        models, streams=streams, spec="auto", spec_breakeven=2.0,
+    )
+    obs = EngineObserver()
+    got, engine = _serve(
+        models, streams=streams, spec="auto", spec_breakeven=2.0,
+        spec_superstep_k=4, observer=obs,
+    )
+    assert got == oracle
+    spec_steps = [r for r in obs.drain_steps() if r.mode == "spec"]
+    assert spec_steps
+    assert all(r.decode_dispatches == 1 for r in spec_steps)
+    assert engine.spec_rounds == engine.spec_supersteps_run * 4
+    assert len(spec_steps) == engine.spec_supersteps_run
+    assert engine.ctrl.used_pages == 0

@@ -311,3 +311,43 @@ def test_flash_crossover_consults_device_kind(jax_cpu, monkeypatch):
     assert model_mod.flash_min_seq() == model_mod._FLASH_MIN_SEQ_DEFAULT
     fake_devices("cpu")
     assert model_mod.flash_min_seq() == model_mod._FLASH_MIN_SEQ_DEFAULT
+
+
+def test_kernel_select_per_bucket_dispatch(jax_cpu, monkeypatch):
+    """The per-(seq-bucket) kernel dispatch table
+    (workloads/ops/kernel_select.py): a measured override wins, the
+    per-device-kind defaults cover known chips (flash 0.80x dense at
+    1024 on the bench chip -> xla there, flash from 2048), sequences
+    past the largest bucket take flash's asymptotic regime, and
+    unknown hardware falls back to the legacy single crossover so CPU
+    hosts behave exactly as before the table existed."""
+    from workloads.ops import kernel_select as ks
+
+    try:
+        # Unknown kind (CPU): no table -> threshold fallback.
+        assert ks.kernel_table() is None
+        assert ks.kernel_for_seq(1024, default_min_seq=2048) == "xla"
+        assert ks.kernel_for_seq(2048, default_min_seq=2048) == "flash"
+        # Known kind: measured per-bucket picks.
+        class _Dev:
+            device_kind = "TPU v5 lite"
+
+        monkeypatch.setattr(jax_cpu, "devices", lambda: [_Dev()])
+        assert ks.kernel_for_seq(1024) == "xla"  # measured 0.80x
+        assert ks.kernel_for_seq(2048) == "flash"
+        assert ks.kernel_for_seq(1 << 20) == "flash"  # past the table
+        # Injected measurement overrides everything.
+        ks.set_kernel_table(
+            ks.table_from_measurements({1024: 1.3, 2048: 0.9})
+        )
+        assert ks.kernel_for_seq(512) == "flash"
+        assert ks.kernel_for_seq(2000) == "xla"
+        # Artifact round trip: the bench's kernel_pick_seq* fields
+        # rebuild the same table.
+        art = {"kernel_pick_seq1024": "flash", "kernel_pick_seq2048": "xla",
+               "unrelated": 1}
+        assert ks.table_from_artifact(art) == {1024: "flash", 2048: "xla"}
+        with pytest.raises(ValueError):
+            ks.set_kernel_table({128: "fast"})
+    finally:
+        ks.set_kernel_table(None)

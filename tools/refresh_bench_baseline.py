@@ -1,0 +1,145 @@
+"""Fill the committed bench artifact's NO-BASELINE holes.
+
+``tools/bench_diff.py`` prints "NOTE ... NO BASELINE" for every tracked
+metric the committed ``docs/bench-builder-latest.json`` predates — the
+PR 6–10 ``fleet_*``/``selfheal_*``/``superstep_*``/``kv_*`` families
+were dead-invisible tripwires for a full re-anchor cycle this way.  The
+honest fix on a chip host is ``make bench`` (a full-fidelity run
+rewrites the artifact and the docs atomically); this tool is the fix
+for hosts WITHOUT the chip: it runs the perf harness at a small scale
+on whatever platform is present and merges ONLY the keys the committed
+artifact lacks, so
+
+  * every chip-measured number in the artifact is preserved verbatim —
+    a CPU value can never overwrite a chip one;
+  * every previously-invisible guardrail gains a baseline measured by
+    the SAME code path it will be diffed by, explicitly stamped
+    (``baseline_addendum``: platform, scale, and the exact keys added)
+    so nobody mistakes harness baselines for chip performance;
+  * ``kernel_pick_seq*`` (the per-bucket attention kernel table,
+    workloads/ops/kernel_select.py) is derived from the artifact's OWN
+    chip-measured ``flash_vs_xla_detail`` sweep when present — chip
+    data wins over anything this host could measure;
+  * the docs re-render from the merged artifact in the same code path
+    as ``make bench`` (tools/render_bench_docs.py), with the renderers'
+    provenance note keyed off the addendum stamp.
+
+Usage:
+    python tools/refresh_bench_baseline.py [--scale tiny] [--dry-run]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ARTIFACT = os.path.join(REPO, "docs", "bench-builder-latest.json")
+
+
+def kernel_picks_from_artifact(artifact: dict) -> dict[str, str]:
+    """Per-bucket kernel winners from the artifact's own (chip-measured)
+    flash-vs-XLA sweep — the authoritative source when present."""
+    detail = artifact.get("flash_vs_xla_detail") or {}
+    from workloads.ops.kernel_select import table_from_measurements
+
+    speedups = {}
+    for seq, row in detail.items():
+        if isinstance(row, dict) and isinstance(
+            row.get("speedup"), (int, float)
+        ):
+            speedups[int(seq)] = float(row["speedup"])
+    return {
+        f"kernel_pick_seq{seq}": impl
+        for seq, impl in sorted(
+            table_from_measurements(speedups).items()
+        )
+    }
+
+
+def merge(committed: dict, fresh: dict, platform: str, scale: str) -> dict:
+    """Adopt every key the committed artifact lacks; never overwrite an
+    existing one.  Samples/min/max companions follow their base key's
+    verdict so a spread can never mix platforms."""
+    added = []
+    out = dict(committed)
+    for key in sorted(fresh):
+        base = key
+        for suffix in ("_samples", "_min", "_max"):
+            if key.endswith(suffix):
+                base = key[: -len(suffix)]
+                break
+        if base in committed or key in committed:
+            continue
+        out[key] = fresh[key]
+        added.append(key)
+    # A re-run must EXTEND the provenance record, never erase it: the
+    # prior addendum's keys are still harness-measured values in the
+    # merged artifact, and dropping them from the stamp would silently
+    # re-label them as chip measurements (the renderers' provenance
+    # note keys off this list).
+    prior = committed.get("baseline_addendum") or {}
+    carried = [k for k in prior.get("keys", []) if k in out]
+    out["baseline_addendum"] = {
+        "platform": platform,
+        "perf_scale": scale,
+        "keys": sorted(set(added) | set(carried)),
+        "note": (
+            "guardrail baselines measured by the perf harness on this "
+            "platform to replace NO-BASELINE blindness; chip-measured "
+            "keys above are untouched — a full-fidelity `make bench` "
+            "on the chip supersedes this addendum"
+        ),
+    }
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--scale", default="tiny", choices=["full", "tiny"])
+    parser.add_argument("--dry-run", action="store_true",
+                        help="print what would be added; write nothing")
+    args = parser.parse_args(argv)
+
+    with open(ARTIFACT) as f:
+        committed = json.load(f)
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    from workloads import perfbench
+
+    fresh = perfbench.run(args.scale, pool_with=None)
+    fresh.pop("train_step_flops", None)
+    # The kernel table ships from chip data when the artifact has any;
+    # the fresh run's picks only fill hosts with no sweep at all.
+    fresh.update(kernel_picks_from_artifact(committed) or {})
+
+    merged = merge(committed, fresh, platform, args.scale)
+    added = merged["baseline_addendum"]["keys"]
+    print(
+        f"refresh_bench_baseline: {len(added)} keys added "
+        f"(platform={platform}, scale={args.scale}):", file=sys.stderr,
+    )
+    for key in added:
+        print(f"  + {key} = {merged[key]!r}"[:120], file=sys.stderr)
+    if args.dry_run:
+        return 0
+
+    with open(ARTIFACT, "w") as f:
+        json.dump(merged, f, indent=2, sort_keys=True)
+        f.write("\n")
+    import tools.render_bench_docs as render_bench_docs
+
+    render_bench_docs.main(["--artifact", ARTIFACT])
+    print("refresh_bench_baseline: artifact + docs re-rendered",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1174,13 +1174,18 @@ class Fleet:
                 self.drain(rep.index)
         else:
             rep.slow_steps = 0
-        # A decode-superstep engine legitimately runs superstep_k
-        # chunks' worth of device work per step; scale the watchdog
-        # budget with it so k can never read as a wedge.
+        # A superstep engine (plain decode OR chained speculative)
+        # legitimately runs k chunks'/rounds' worth of device work per
+        # step; scale the watchdog budget with the larger k so neither
+        # can read as a wedge.
         hang_budget = (
             None if self.hang_timeout_s is None
             else self.hang_timeout_s
-            * max(1, getattr(rep.engine, "superstep_k", 1))
+            * max(
+                1,
+                getattr(rep.engine, "superstep_k", 1),
+                getattr(rep.engine, "spec_superstep_k", 1),
+            )
         )
         if (
             hang_budget is not None

@@ -719,7 +719,9 @@ class EngineObserver:
             getattr(engine, "superstep_k", 1), 1
         )
         spec_rounds_d = engine.spec_rounds - sr0
-        spec_d = spec_rounds_d // max(engine.spec_lookahead, 1)
+        spec_d = spec_rounds_d // max(
+            engine.spec_lookahead, getattr(engine, "spec_superstep_k", 1), 1
+        )
         # The mode the step actually DISPATCHED: the engine runs at most
         # one decode program per step (drains only consume in-flight
         # work; they never dispatch).

@@ -1,0 +1,134 @@
+"""Per-sequence-bucket attention kernel selection.
+
+The flash/dense routing used to be a single crossover threshold
+(model.flash_min_seq), but the committed bench artifact shows the
+decision is not monotone enough for one number to be honest everywhere:
+flash was 0.80x the dense XLA core at seq 1024 on the bench chip while
+winning at 2048+ — so prefill at a mid-length bucket was paying a
+measured 20% kernel tax for no reason.  This module is the fix: a tiny
+per-(sequence-bucket) dispatch TABLE of measured winners, consulted at
+trace time by model._attention, with three layers of precedence:
+
+  1. an injected override (``set_kernel_table`` — the "measured once"
+     hook: feed it ``table_from_measurements`` over a fresh
+     ``measure_flash_vs_xla`` sweep, or the committed artifact's
+     ``kernel_pick_seq*`` fields via ``table_from_artifact``);
+  2. the per-device-kind measured defaults below (from the committed
+     BENCH artifacts; kinds not yet measured skip this layer rather
+     than guess);
+  3. the legacy single-crossover fallback (the caller passes
+     ``model.flash_min_seq()``'s value), so unknown hardware — CPU test
+     hosts included — behaves exactly as before this table existed.
+
+A lookup takes the SMALLEST table bucket >= seq (buckets are ceilings);
+sequences beyond the largest bucket pick "flash" — the kernel's
+asymptotic regime, where the dense core's [seq, seq] score matrix is
+HBM-hostile regardless of what any mid-length measurement said.
+
+The table is trace-time routing, not data: changing it recompiles, it
+never changes numerics (both cores are parity-pinned against each
+other in tests/test_flash_attention.py).
+
+The perf bench publishes each sweep length's winner as
+``kernel_pick_seq{N}`` in the bench artifact (workloads/perfbench.py),
+so the committed measurement and the routing that should follow it are
+reviewable side by side.
+"""
+
+from __future__ import annotations
+
+IMPLS = ("flash", "xla")
+
+# Measured per-device-kind winners, from the committed bench artifacts'
+# flash-vs-XLA sweep (fwd+bwd slope ratio > 1 => flash wins).  On the
+# r05 chip flash is 0.80x at 1024 and >1x from 2048 up (BENCH_r05 /
+# docs/bench-builder-latest.json flash_vs_xla family).  Add a row by
+# re-running `python -m workloads.perfbench` on the new generation and
+# reading its kernel_pick_seq* fields.
+_MEASURED_PICKS: tuple[tuple[str, tuple[tuple[int, str], ...]], ...] = (
+    ("v5 lite", ((1024, "xla"), (2048, "flash"), (4096, "flash"))),
+    ("v5e", ((1024, "xla"), (2048, "flash"), (4096, "flash"))),
+)
+
+_override: tuple[tuple[int, str], ...] | None = None
+
+
+def _validate(picks) -> tuple[tuple[int, str], ...]:
+    table = []
+    for bucket, impl in sorted(dict(picks).items()):
+        if int(bucket) < 1:
+            raise ValueError(f"bucket ceilings must be >= 1, got {bucket}")
+        if impl not in IMPLS:
+            raise ValueError(
+                f"kernel impl must be one of {IMPLS}, got {impl!r}"
+            )
+        table.append((int(bucket), impl))
+    return tuple(table)
+
+
+def set_kernel_table(picks: dict[int, str] | None) -> None:
+    """Install a measured {bucket_ceiling: "flash"|"xla"} override (or
+    None to fall back to the per-device-kind defaults).  Trace-time
+    only: programs compiled before the call keep their routing."""
+    global _override
+    _override = None if picks is None else _validate(picks)
+
+
+def kernel_table() -> tuple[tuple[int, str], ...] | None:
+    """The effective dispatch table: the injected override, else this
+    device kind's measured defaults, else None (threshold fallback)."""
+    if _override is not None:
+        return _override
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # no backend — routing still needs an answer
+        return None
+    for marker, picks in _MEASURED_PICKS:
+        if marker in kind:
+            return picks
+    return None
+
+
+def kernel_for_seq(seq: int, default_min_seq: int | None = None) -> str:
+    """The measured winner for a (static) sequence length: the smallest
+    table bucket >= seq decides; past the largest bucket flash's
+    asymptotic win decides.  Without any table (unknown kind, nothing
+    injected) the legacy single-crossover rule applies against
+    ``default_min_seq``."""
+    table = kernel_table()
+    if table is None:
+        if default_min_seq is None:
+            from workloads.model import flash_min_seq
+
+            default_min_seq = flash_min_seq()
+        return "flash" if seq >= default_min_seq else "xla"
+    for bucket, impl in table:
+        if seq <= bucket:
+            return impl
+    return "flash"
+
+
+def table_from_measurements(speedups: dict[int, float]) -> dict[int, str]:
+    """{seq: flash_over_xla_speedup} -> a dispatch table: each measured
+    length becomes a bucket picking the side that won there (ties to
+    flash — at parity the kernel's O(seq*d) HBM footprint wins)."""
+    return {
+        int(seq): ("flash" if ratio >= 1.0 else "xla")
+        for seq, ratio in speedups.items()
+    }
+
+
+def table_from_artifact(artifact: dict) -> dict[int, str] | None:
+    """Rebuild the dispatch table from a committed bench artifact's
+    ``kernel_pick_seq{N}`` fields (None when the artifact predates
+    them) — the 'measured once' injection path for serving hosts."""
+    picks = {}
+    for key, val in artifact.items():
+        if key.startswith("kernel_pick_seq") and val in IMPLS:
+            try:
+                picks[int(key[len("kernel_pick_seq"):])] = val
+            except ValueError:
+                continue
+    return picks or None

@@ -1419,11 +1419,152 @@ def _spec_superstep_core(
     return committed, n, new_cur, new_pos, t_pools, d_pools
 
 
+@partial(
+    jax.jit,
+    static_argnames=("t_config", "d_config", "gamma", "k", "cover_pages",
+                     "sampling"),
+    donate_argnums=(2, 3),
+)
+def paged_spec_superstep_chained(
+    t_params: dict,
+    d_params: dict,
+    t_pools: tuple[jax.Array, jax.Array],
+    d_pools: tuple[jax.Array, jax.Array],
+    tables: jax.Array,
+    cur: jax.Array,
+    positions: jax.Array,
+    occupancy: jax.Array,
+    live: jax.Array,
+    budget: jax.Array,
+    eos: jax.Array,
+    rngs: jax.Array,
+    t_config: ModelConfig,
+    d_config: ModelConfig,
+    gamma: int,
+    k: int,
+    cover_pages: int | None = None,
+    t_lora=None,
+    sampling: bool = False,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
+):
+    """``k`` chained draft→verify→commit rounds in ONE dispatch with
+    DEVICE-SIDE acceptance masks and retirement — paged_spec_superstep
+    upgraded with the decode superstep's retirement rule
+    (paged_decode_superstep), so the host leaves the speculative loop
+    for k rounds at a time without paying unbounded over-decode.
+
+    Per round, every live row drafts gamma tokens, verifies them in one
+    target forward, and commits its own accepted prefix + correction —
+    then the device applies the ENGINE's emission rule to the committed
+    block: per-row ``eos`` ids (-1 = none) and remaining-token
+    ``budget``s flip the row's ``live`` mask the round its terminal
+    token lands, freezing its token AND position (dead rounds for a
+    frozen row read/write only its own already-overwritable slots or
+    trash — never position 0, where prefix-cache/fan-out SHARED pages
+    live).  Over-decode is therefore bounded to the remainder of the
+    retiring row's own superstep and reconciled at the single fused
+    readback (ServeEngine._consume_spec).
+
+    ``occupancy``: [batch] bool — the engine's static slot-occupancy
+    mirror at dispatch.  Truly EMPTY slots (occupancy False: all-trash
+    tables) are pinned to position 0 once at entry, exactly like
+    paged_spec_round_chained's parked rows; ``live`` is the DYNAMIC
+    retirement mask the scan carries (entry value: occupancy, or the
+    previous superstep's chained carry under pipelining) and is forced
+    under occupancy.  budget/eos: [batch] int32.  rngs: [k, 2] — one
+    ENGINE key per round, each consumed exactly as the k=1 superstep
+    consumes its single key (split once), so greedy AND sampled streams
+    are bit-identical to k successive k=1 dispatches; greedy callers
+    pass zeros (ignored).
+
+    Tables must cover ``min(positions + k*(gamma+1), positions +
+    budget + gamma + 1)`` for live rows — the retirement ceiling caps
+    the pre-commitment, and the trailing trash columns of the engine's
+    table mirror swallow any dead writes beyond it, so the allocator
+    can never fault mid-scan.
+
+    Returns (committed [k, batch, gamma+1], n_accept [k, batch],
+    round_live [k, batch] — the mask AT EACH ROUND'S ENTRY, the host's
+    per-round emission gate — plus new_cur, new_pos, new_live,
+    new_budget (the device-side carry superstep N+1 chains on under
+    pipelining), t_pools, d_pools).  Both pool pairs are DONATED."""
+    return _spec_superstep_chained_core(
+        t_params, d_params, t_pools, d_pools, tables, cur, positions,
+        occupancy, live, budget, eos, rngs, t_config=t_config,
+        d_config=d_config, gamma=gamma, k=k, cover_pages=cover_pages,
+        t_lora=t_lora, sampling=sampling, temperature=temperature,
+        top_k=top_k, top_p=top_p,
+    )
+
+
+def _spec_superstep_chained_core(
+    t_params, d_params, t_pools, d_pools, tables, cur, positions,
+    occupancy, live, budget, eos, rngs, t_config, d_config, gamma, k,
+    cover_pages, d_attention_fn=None, t_lora=None, sampling=False,
+    temperature=0.0, top_k=0, top_p=1.0,
+):
+    """paged_spec_superstep_chained's body, un-jitted so the tensor-
+    parallel path can re-jit it with explicit shardings and an injected
+    draft attention op (workloads/tp_serve.py make_tp_spec_superstep
+    with retire=True — scan-of-shard_map, same as the non-retiring
+    superstep)."""
+    # Empty slots (all-trash tables) pin to 0 ONCE; rows that freeze
+    # MID-SCAN keep their real frozen position instead — see
+    # _spec_round_core's pin_parked note for why 0 would be unsafe for
+    # them.  Entry positions of occupied rows are in-cover by the
+    # engine's pre-extension, and frozen positions never grow.
+    positions = jnp.where(occupancy, positions, 0)
+    live = live & occupancy
+    gp1 = gamma + 1
+    idx = jnp.arange(gp1)[None, :]
+
+    def one_round(carry, key):
+        t_pools, d_pools, cur, pos, live, budget = carry
+        committed, n, new_cur, new_pos, t_pools, d_pools = _spec_round_core(
+            t_params, d_params, t_pools, d_pools, tables, cur, pos,
+            t_config=t_config, d_config=d_config, gamma=gamma,
+            cover_pages=cover_pages, d_attention_fn=d_attention_fn,
+            occupancy=live, t_lora=t_lora, sampling=sampling,
+            # One split per round mirrors the k=1 superstep's
+            # jax.random.split(rng, 1) of its single engine key — the
+            # key-schedule identity sampled parity rests on.
+            rng=jax.random.split(key, 1)[0] if sampling else None,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            pin_parked=False,
+        )
+        # The ENGINE's emission rule (_emit), as data: the host appends
+        # committed[0..n] one by one, stopping at the first eos or when
+        # the remaining budget runs out — so a token is "seen" iff it
+        # sits at index <= n AND inside the budget, and the row retires
+        # iff a seen token is the eos or the round exhausted the budget.
+        adv = n + 1
+        seen = (idx <= n[:, None]) & (idx < budget[:, None])
+        hit_eos = jnp.any(seen & (committed == eos[:, None]), axis=1)
+        new_budget = jnp.where(live, budget - adv, budget)
+        new_live = live & ~hit_eos & (new_budget > 0)
+        return (
+            (t_pools, d_pools, new_cur, new_pos, new_live, new_budget),
+            (committed, n, live),
+        )
+
+    carry0 = (t_pools, d_pools, cur, positions, live, budget)
+    (t_pools, d_pools, new_cur, new_pos, new_live, new_budget), ys = (
+        jax.lax.scan(one_round, carry0, rngs)
+    )
+    committed, n, round_live = ys
+    return (
+        committed, n, round_live, new_cur, new_pos, new_live, new_budget,
+        t_pools, d_pools,
+    )
+
+
 def _spec_round_core(
     t_params, d_params, t_pools, d_pools, tables, cur, positions,
     t_config, d_config, gamma, cover_pages, d_attention_fn=None,
     occupancy=None, t_lora=None, sampling=False, rng=None,
-    temperature=0.0, top_k=0, top_p=1.0,
+    temperature=0.0, top_k=0, top_p=1.0, pin_parked=True,
 ):
     """paged_spec_round's body, un-jitted so the tensor-parallel path can
     re-jit it with explicit shardings and an injected draft attention op
@@ -1432,13 +1573,22 @@ def _spec_round_core(
     With ``occupancy`` it also emits the chained next-round state (see
     paged_spec_round_chained).  With ``sampling`` (static) the greedy
     agreement rule is replaced by lossless rejection sampling
-    (_spec_accept) under the traced temperature/top_k/top_p knobs."""
+    (_spec_accept) under the traced temperature/top_k/top_p knobs.
+
+    ``pin_parked=False`` keeps parked rows' positions FROZEN instead of
+    pinned to 0 — the chained-retirement superstep's rule: a row frozen
+    mid-scan still holds a REAL table, and position 0 would aim its dead
+    writes at the row's first pages, which the prefix cache or a fan-out
+    group may SHARE with live rows.  Callers passing pin_parked=False
+    must guarantee every parked position sits inside the (cover-sliced)
+    table width (_spec_superstep_chained_core pins truly-empty slots
+    once at entry and bounds the rest by construction)."""
     if sampling and rng is None:
         raise ValueError("sampling speculative round requires an rng key")
     batch = cur.shape[0]
     if cover_pages is not None:
         tables = tables[:, :cover_pages]
-    if occupancy is not None:
+    if occupancy is not None and pin_parked:
         # Parked rows compute a dead round on their all-trash tables;
         # pinning their position to 0 keeps every index they touch inside
         # the (possibly cover-sliced) table width regardless of how deep

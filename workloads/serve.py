@@ -28,7 +28,10 @@ the chain-hash baseline) with an optional host-RAM KV offload tier
 (``kv_offload=True``: cold cached pages spill to pinned host buffers
 under pool pressure and reload on hit — docs/SERVING.md "KV-cache
 hierarchy"), batched speculative decoding (``draft_params=``, with
-optionally PIPELINED rounds chained on device, and ``spec="auto"``
+optionally PIPELINED rounds chained on device, ``spec_superstep_k=k``
+chaining k full draft→verify→commit rounds per dispatch with
+DEVICE-SIDE acceptance/retirement masks and ONE fused readback per k
+rounds — docs/SERVING.md "Speculative supersteps" — and ``spec="auto"``
 letting the engine pick speculative vs plain decode per step from live
 slot occupancy against a measured break-even threshold), multi-tenant
 LoRA serving (``adapters=``: per-row activation deltas over one base),
@@ -192,6 +195,7 @@ class ServeEngine:
         draft_config: ModelConfig | None = None,
         gamma: int = 4,
         spec_lookahead: int = 1,
+        spec_superstep_k: int = 1,
         spec: str = "on",
         spec_breakeven: float | None = None,
         pipelined: bool = False,
@@ -259,10 +263,25 @@ class ServeEngine:
             raise ValueError(
                 f"superstep_k must be >= 1, got {superstep_k}"
             )
+        if spec_superstep_k < 1:
+            raise ValueError(
+                f"spec_superstep_k must be >= 1, got {spec_superstep_k}"
+            )
         if spec_lookahead > 1 and draft_params is None:
             raise ValueError(
                 "spec_lookahead > 1 is a speculative-serving mode; pass "
                 "draft_params/draft_config"
+            )
+        if spec_superstep_k > 1 and draft_params is None:
+            raise ValueError(
+                "spec_superstep_k > 1 is a speculative-serving mode; pass "
+                "draft_params/draft_config"
+            )
+        if spec_superstep_k > 1 and spec_lookahead > 1:
+            raise ValueError(
+                "spec_superstep_k and spec_lookahead both chain rounds "
+                "per dispatch; spec_superstep_k (device-side retirement) "
+                "supersedes spec_lookahead — use one, not both"
             )
         if spec not in ("on", "auto"):
             raise ValueError(f'spec must be "on" or "auto", got {spec!r}')
@@ -338,9 +357,26 @@ class ServeEngine:
         # overshoot for k chunks so the allocator can never fault
         # mid-scan.
         self.superstep_k = superstep_k
+        # Speculative supersteps (docs/SERVING.md "Speculative
+        # supersteps"): with spec_superstep_k > 1 every speculative
+        # dispatch runs k chained draft→verify→commit rounds on device
+        # (paged.paged_spec_superstep_chained) with DEVICE-SIDE
+        # acceptance masks and eos/budget retirement — rows freeze the
+        # round their terminal token lands, page pre-commitment is
+        # capped at each row's retirement ceiling, and ONE fused
+        # readback per k rounds replaces the per-round link tax
+        # (spec_round_readback_ms).  The spec step loop turns
+        # dispatch-first like the plain superstep's: admission planning
+        # and lifecycle polls run in the overlap window while the
+        # device computes.  Greedy AND sampled streams are
+        # bit-identical to the k=1 spec engine for every k (per-round
+        # rng keys preserve the k=1 key schedule; pinned by
+        # tests/test_spec_superstep.py).
+        self.spec_superstep_k = spec_superstep_k
         self._overshoot = max(
             self.chunk * superstep_k * (2 if pipelined else 1),
-            ((gamma + 1) * spec_lookahead * (2 if pipelined else 1))
+            ((gamma + 1) * max(spec_lookahead, spec_superstep_k)
+             * (2 if pipelined else 1))
             if draft_params is not None else 0,
         )
         bucket_pages = self.prompt_bucket // page_size
@@ -504,6 +540,7 @@ class ServeEngine:
         self.prefill_deferred_tokens = 0  # prompt tokens the budget parked
         self.admission_readbacks = 0  # first-token host syncs
         self.spec_rounds = 0
+        self.spec_supersteps_run = 0  # chained spec supersteps dispatched
         self.requests_admitted = 0  # popped off pending (instant-finish too)
         self.requests_retired = 0  # finished, at admission or mid-stream
         # Request-lifecycle fault tolerance (docs/SERVING.md "Fault
@@ -617,7 +654,11 @@ class ServeEngine:
                 paged_decode_chunk, config=self.config, chunk=self.chunk,
                 sampling=self.sampling,
             )
-            if superstep_k > 1:
+            if superstep_k > 1 or spec_superstep_k > 1:
+                # spec_superstep_k's double-buffered loop dispatches the
+                # PLAIN side as supersteps too (k may be 1 — a 1-chunk
+                # superstep emits the chunk path's exact tokens), so one
+                # inverted step loop serves both modes.
                 self._superstep = partial(
                     paged_decode_superstep, config=self.config,
                     chunk=self.chunk, k=superstep_k,
@@ -679,7 +720,7 @@ class ServeEngine:
                 self._d_prefill_chunk = make_tp_prefill_chunk(
                     draft_config, mesh
                 )
-            if superstep_k > 1:
+            if superstep_k > 1 or spec_superstep_k > 1:
                 from .tp_serve import make_tp_decode_superstep
 
                 self._superstep = make_tp_decode_superstep(
@@ -698,15 +739,18 @@ class ServeEngine:
                 # shards like the target's.
                 # ONE TP spec program for every k (the engine's spec
                 # dispatch is always a superstep; k=1 is the classic
-                # per-round engine).
+                # per-round engine).  spec_superstep_k > 1 re-jits the
+                # CHAINED-RETIREMENT core instead (retire=True).
                 from .tp_serve import make_tp_spec_superstep
 
                 self._tp_spec = make_tp_spec_superstep(
                     self.config, draft_config, mesh, gamma,
-                    k=spec_lookahead,
+                    k=(spec_superstep_k if spec_superstep_k > 1
+                       else spec_lookahead),
                     lora_stacked=self._stacked_adapters,
                     lora_alpha=self.lora_alpha,
                     sampling=self.sampling,
+                    retire=spec_superstep_k > 1,
                 )
                 self.draft_params, self.d_pools = shard_serving_state(
                     self.draft_params, self.d_pools, draft_config, mesh
@@ -2152,7 +2196,16 @@ class ServeEngine:
                     toks_dev, snapshot = self._pending_read
                     self._pending_read = None
                     finished += self._consume_chunk(toks_dev, snapshot)
-                if self._pending_spec is not None:
+                if (
+                    self._pending_spec is not None
+                    and self.spec_superstep_k == 1
+                ):
+                    # spec_superstep_k > 1 runs dispatch-first: by the
+                    # time this sweep overlaps, _pending_spec holds the
+                    # superstep dispatched THIS step (its prev was
+                    # consumed at dispatch) — syncing it here would
+                    # serialize the host behind the scan it just
+                    # launched, the exact stall the chained path kills.
                     arrs, snapshot = self._pending_spec
                     self._pending_spec = None
                     finished += self._consume_spec(arrs, snapshot)
@@ -2354,10 +2407,11 @@ class ServeEngine:
             # Health hold: no admission, no dispatch — in-flight work was
             # requeued when the chip went Unhealthy; recovery resumes.
             return finished
-        if self.superstep_k > 1:
-            # Decode supersteps run the DOUBLE-BUFFERED loop: dispatch
-            # first, overlap the step's host bookkeeping (admission
-            # included) with the device compute, consume last.
+        if self.superstep_k > 1 or self.spec_superstep_k > 1:
+            # Decode supersteps (plain OR speculative) run the
+            # DOUBLE-BUFFERED loop: dispatch first, overlap the step's
+            # host bookkeeping (admission included) with the device
+            # compute, consume last.
             self._decode_finished: list[Request] = []
             try:
                 return finished + self._step_superstep()
@@ -2519,9 +2573,14 @@ class ServeEngine:
         spec="auto" composes: the mode decision runs on the boundary
         occupancy, a plain->spec switch drains the in-flight superstep
         (mirror sync) exactly like the PR-2 chunk rules, and the spec
-        side keeps its own admit-before-dispatch order."""
+        side keeps its own admit-before-dispatch order — UNLESS
+        ``spec_superstep_k > 1``, where the spec side runs
+        dispatch-first too (_dispatch_spec_superstep: the chained
+        draft→verify→commit scan goes out, the shared overlap window
+        below runs while it computes, and the fused spec consume at
+        the bottom is the one readback per k rounds)."""
         finished = self._decode_finished
-        dispatched = False
+        dispatched: str | bool = False
         if not self._occupied.any():
             # Nothing to dispatch: consume whatever is still in flight
             # (the k=1 step's idle-drain rule — a pipelined spec
@@ -2552,12 +2611,24 @@ class ServeEngine:
             if self._occupied.any():
                 self._record_mode(use_spec)
                 if use_spec:
-                    finished += self._admit()
-                    if self._occupied.any():
-                        finished += self._step_spec()
-                    return finished
-                self._dispatch_superstep()
-                dispatched = True
+                    if self.spec_superstep_k > 1:
+                        # Speculative supersteps run dispatch-first too:
+                        # the chained draft→verify→commit scan goes out
+                        # NOW and the shared overlap window below runs
+                        # while it computes; the fused consume at the
+                        # bottom (or the pipelined consume-prev inside
+                        # the dispatch) is the one readback per k
+                        # rounds.
+                        finished += self._dispatch_spec_superstep()
+                        dispatched = "spec"
+                    else:
+                        finished += self._admit()
+                        if self._occupied.any():
+                            finished += self._step_spec()
+                        return finished
+                else:
+                    self._dispatch_superstep()
+                    dispatched = "plain"
         # Overlap window: the next step's bookkeeping — admission
         # planning and prefill sweeps (their device work queues behind
         # the superstep; the host-side work runs during it), then a
@@ -2572,10 +2643,19 @@ class ServeEngine:
         # The single fused readback: consume everything due.  Pipelined
         # keeps the newest superstep in flight (the next step chains on
         # its device-side carry) for as long as it keeps dispatching.
-        keep = 1 if (self.pipelined and dispatched) else 0
+        keep = 1 if (self.pipelined and dispatched == "plain") else 0
         while len(self._pending_super) > keep:
             toks_dev, snapshot = self._pending_super.popleft()
             finished += self._consume_superstep(toks_dev, snapshot)
+        # The spec superstep's fused readback: under pipelining the
+        # newest stays chained in flight (its prev was consumed inside
+        # the dispatch, overlapping the new scan); a lifecycle poll
+        # above may already have drained it (deadline/health reclaim).
+        if not (self.pipelined and dispatched == "spec"):
+            if self._pending_spec is not None:
+                arrs, snapshot = self._pending_spec
+                self._pending_spec = None
+                finished += self._consume_spec(arrs, snapshot)
         return finished
 
     def _dispatch_superstep(self) -> None:
@@ -2789,7 +2869,7 @@ class ServeEngine:
         conservative middle of the measured int8-self-draft range).
         Uses a private RNG key so the served sampling stream's key
         schedule is untouched (parity with injected-threshold engines)."""
-        k = self.spec_lookahead
+        k = max(self.spec_lookahead, self.spec_superstep_k)
         u = (self.gamma + 1) * k
         # The superstep's verify gather is O(cover), and production's
         # cover grows with row positions (from ~prompt pages toward
@@ -2830,8 +2910,43 @@ class ServeEngine:
             return toks[:, -1]
 
         def spec_once(cur):
-            from .paged import paged_spec_superstep
+            from .paged import (
+                paged_spec_superstep,
+                paged_spec_superstep_chained,
+            )
 
+            if self.spec_superstep_k > 1:
+                # Probe the CHAINED-RETIREMENT program the engine will
+                # actually dispatch (the non-retiring superstep would
+                # pay a whole extra compile just to calibrate).
+                rngs = jnp.stack([key] * k)
+                if self._mesh is None:
+                    out = paged_spec_superstep_chained(
+                        self.params, self.draft_params, self.pools,
+                        self.d_pools, tables, cur, zeros, occ, occ,
+                        zeros + 1, zeros - 1, rngs,
+                        t_config=self.config, d_config=self.draft_config,
+                        gamma=self.gamma, k=k, cover_pages=cover,
+                        t_lora=t_lora, sampling=self.sampling,
+                        temperature=jnp.float32(self.temperature),
+                        top_k=jnp.int32(self.top_k),
+                        top_p=jnp.float32(self.top_p),
+                    )
+                else:
+                    csamp = (
+                        (jnp.float32(self.temperature),
+                         jnp.int32(self.top_k),
+                         jnp.float32(self.top_p))
+                        if self.sampling else ()
+                    )
+                    out = self._tp_spec(
+                        self.params, self.draft_params, self.pools,
+                        self.d_pools, tables, cur, zeros, occ, occ,
+                        zeros + 1, zeros - 1, rngs, *lora_ops, *csamp,
+                        cover,
+                    )
+                _, _, _, new_cur, _, _, _, self.pools, self.d_pools = out
+                return new_cur
             if self._mesh is None:
                 out = paged_spec_superstep(
                     self.params, self.draft_params, self.pools,
@@ -2993,16 +3108,146 @@ class ServeEngine:
         return []
 
 
+    def _dispatch_spec_superstep(self) -> list[Request]:
+        """Dispatch ONE chained speculative superstep —
+        ``spec_superstep_k`` draft→verify→commit rounds with device-side
+        acceptance masks and eos/budget retirement
+        (paged.paged_spec_superstep_chained) — for the currently
+        occupied slots, asynchronously; _step_superstep overlaps the
+        step's host bookkeeping with it and consumes ``_pending_spec``
+        last (pipelined: the previous superstep consumes HERE, its
+        readback overlapping the scan just dispatched, and the newest
+        stays chained on the device carry).
+
+        Page pre-commitment: every live row's table extends UP FRONT to
+        cover k rounds' worst case (position + k*(gamma+1), doubled for
+        rows an in-flight superstep is still advancing), CAPPED at the
+        row's own retirement ceiling — position + remaining budget +
+        gamma + 1, the last slot the device's frozen-row rule can write
+        a REAL token into (a retiring round commits its full block, so
+        the cap carries one extra round's width; dead writes past it
+        land on the table mirror's trailing trash columns) — so the
+        allocator can never fault mid-scan and the admission-time
+        worst-case commitment is never overrun."""
+        from .paged import paged_spec_superstep_chained
+
+        k = self.spec_superstep_k
+        u = (self.gamma + 1) * k
+        in_flight = (
+            set(self._pending_spec[1]) if self._pending_spec else set()
+        )
+        targets = {}
+        for slot, req in self._slot_req.items():
+            pos = int(self._positions[slot])
+            # pos and len(req.tokens) move in lockstep for LIVE rows
+            # (retiring rows' divergence never matters: they free at
+            # consume), so the ceiling is exact even while a pipelined
+            # superstep is still in flight for the row.
+            ceiling = (
+                pos + (req.max_new_tokens - len(req.tokens))
+                + self.gamma + 1
+            )
+            bound = pos + u * (2 if slot in in_flight else 1)
+            targets[slot] = min(bound, ceiling)
+        for slot, req in self._slot_req.items():
+            seq = self._seq_id(slot, req)
+            table = self._extend_evicting(seq, targets[slot])
+            self._tables[slot, : len(table)] = table
+        need = -(-max(targets.values()) // self.page_size)
+        cover = min(self.max_pages, -(-need // 4) * 4)
+        eos = np.full(self.slots, -1, np.int32)
+        budget = np.zeros(self.slots, np.int32)
+        for slot, req in self._slot_req.items():
+            if req.eos_token is not None:
+                eos[slot] = req.eos_token
+            budget[slot] = req.max_new_tokens - len(req.tokens)
+        t_lora = None
+        if self._stacked_adapters is not None:
+            t_lora = (
+                self._stacked_adapters, self._dev(self._adapter_idx),
+                self.lora_alpha,
+            )
+        lora_ops = () if t_lora is None else (t_lora[0], t_lora[1])
+        # One engine key per round, in the k=1 spec path's draw order
+        # (a k=1 spec step consumes a key only when sampling).
+        rngs = (
+            jnp.stack([self._next_key() for _ in range(k)])
+            if self.sampling else jnp.zeros((k, 2), jnp.uint32)
+        )
+        samp_ops = (
+            (jnp.float32(self.temperature), jnp.int32(self.top_k),
+             jnp.float32(self.top_p))
+            if self.sampling else ()
+        )
+        self._maybe_fault("spec_dispatch")
+        cur = self._dev(self._tokens)
+        pos = self._dev(self._positions)
+        occ = self._dev(self._occupied)
+        live_in = occ
+        budget_in = jnp.asarray(budget)
+        if self.pipelined and self._spec_chained is not None:
+            # Chain on the previous superstep's device-side carry; only
+            # freshly admitted slots take their host-side state (a
+            # parked chained slot is a dead placeholder by contract).
+            fr = self._fresh_mask()
+            c_cur, c_pos, c_live, c_budget = self._spec_chained
+            cur = jnp.where(fr, cur, c_cur)
+            pos = jnp.where(fr, pos, c_pos)
+            live_in = jnp.where(fr, live_in, c_live)
+            budget_in = jnp.where(fr, budget_in, c_budget)
+        self._fresh_slots.clear()
+        if self._mesh is None:
+            out = paged_spec_superstep_chained(
+                self.params, self.draft_params, self.pools, self.d_pools,
+                self._dev(self._tables), cur, pos, occ, live_in,
+                budget_in, jnp.asarray(eos), rngs,
+                t_config=self.config, d_config=self.draft_config,
+                gamma=self.gamma, k=k, cover_pages=cover, t_lora=t_lora,
+                sampling=self.sampling,
+                temperature=jnp.float32(self.temperature),
+                top_k=jnp.int32(self.top_k),
+                top_p=jnp.float32(self.top_p),
+            )
+        else:
+            out = self._tp_spec(
+                self.params, self.draft_params, self.pools, self.d_pools,
+                self._dev(self._tables), cur, pos, occ, live_in,
+                budget_in, jnp.asarray(eos), rngs, *lora_ops, *samp_ops,
+                cover,
+            )
+        (
+            committed, n_acc, round_live, new_cur, new_pos, new_live,
+            new_budget, self.pools, self.d_pools,
+        ) = out
+        self.spec_rounds += k
+        self.spec_supersteps_run += 1
+        snapshot = dict(self._slot_req)
+        prev, self._pending_spec = self._pending_spec, (
+            (committed, n_acc, round_live), snapshot,
+        )
+        if not self.pipelined:
+            # Non-pipelined never leaves a superstep in flight across
+            # steps; _step_superstep consumes the one just dispatched
+            # after the overlap window.
+            return []
+        self._spec_chained = (new_cur, new_pos, new_live, new_budget)
+        if prev is not None:
+            return self._consume_spec(*prev)
+        return []
+
     def _consume_spec(self, arrs, snapshot: dict) -> list[Request]:
         """Read a speculative round's — or superstep's — (committed,
         n_accept) back (the host sync point) and apply per-row
         emission/retirement for the slots as they were at dispatch.
 
         A single round's arrays are [batch, gamma+1]/[batch]; a
-        superstep stacks a leading per-round axis.  Either way the host
-        mirrors advance by the DEVICE's total advance (emission stops at
-        eos/max_new; rounds past a row's retirement point are the
-        superstep's documented dead compute)."""
+        superstep stacks a leading per-round axis; a CHAINED-RETIREMENT
+        superstep (spec_superstep_k) additionally carries the per-round
+        live mask, the host's emission gate — rounds a row sat frozen
+        for are the bounded dead compute the device's retirement rule
+        already priced, reconciled here into ``tokens_overdecoded``.
+        Either way the host mirrors advance by the DEVICE's total
+        advance (emission stops at eos/max_new)."""
         self._maybe_fault("spec_readback")
         # ONE host sync for the whole round's array tuple: serial
         # np.asarray calls would pay the link round-trip per array
@@ -3010,10 +3255,13 @@ class ServeEngine:
         # the bench tunnel — spec_round_readback_ms); device_get
         # transfers the tuple in a single fetch.  Values are identical,
         # only the sync count changes.
-        committed, n_acc = self._host_sync(
+        fetched = self._host_sync(
             lambda: tuple(np.asarray(a) for a in jax.device_get(arrs))
         )
         self._note_recovery()
+        if len(fetched) == 3:
+            return self._apply_spec_super(fetched, snapshot)
+        committed, n_acc = fetched
         if committed.ndim == 2:  # single round -> a 1-round superstep
             committed, n_acc = committed[None], n_acc[None]
         finished = []
@@ -3031,6 +3279,51 @@ class ServeEngine:
             self._positions[slot] += advance
             self._tokens[slot] = committed[-1, slot, int(n_acc[-1, slot])]
             if req.done:
+                finished.append(self._retire(slot))
+        return finished
+
+    def _apply_spec_super(self, fetched, snapshot: dict) -> list[Request]:
+        """Emission/retirement for one CHAINED-RETIREMENT speculative
+        superstep's fused readback: per slot, emit each LIVE round's
+        committed prefix (``round_live`` is the device's round-entry
+        mask — byte-for-byte ``_emit``'s eos/max_new rule, so the host
+        mirrors advance by the device's exact advance) and reconcile
+        the over-decode: the full-block width of every frozen round
+        plus the retiring round's unemitted tail."""
+        committed, n_acc, round_live = fetched
+        gp1 = committed.shape[2]
+        finished = []
+        for slot, req in snapshot.items():
+            if req.done:
+                # Retired between dispatch and read (pipelined lag): the
+                # chained live mask parked the row, so the whole
+                # superstep was dead compute.
+                self.tokens_overdecoded += committed.shape[0] * gp1
+                continue
+            advance = 0
+            emitted_before = len(req.tokens)
+            last_live = None
+            for j in range(committed.shape[0]):
+                if not round_live[j, slot]:
+                    self.tokens_overdecoded += gp1
+                    continue
+                k = int(n_acc[j, slot]) + 1
+                self._emit(req, committed[j, slot, :k])
+                advance += k
+                last_live = j
+            if last_live is None:
+                # Defensive: a snapshot row with no live round and
+                # req not done cannot arise (the device mask mirrors
+                # _emit exactly) — leave the mirrors untouched.
+                continue
+            self._positions[slot] += advance
+            self._tokens[slot] = committed[
+                last_live, slot, int(n_acc[last_live, slot])
+            ]
+            if req.done:
+                self.tokens_overdecoded += advance - (
+                    len(req.tokens) - emitted_before
+                )
                 finished.append(self._retire(slot))
         return finished
 
@@ -3546,6 +3839,19 @@ def main(argv=None) -> int:
                         "divides the per-round host round-trip tax by k on "
                         "high-latency links at the cost of up to k rounds "
                         "of emission lag")
+    parser.add_argument("--spec-superstep-k", type=int, default=1,
+                        metavar="K",
+                        help="speculative SUPERSTEPS with device-side "
+                        "retirement: run K chained draft->verify->commit "
+                        "rounds per dispatch with on-device acceptance "
+                        "masks and eos/max-token retirement (rows freeze "
+                        "the round they retire, page pre-commitment "
+                        "capped at each row's retirement ceiling) and ONE "
+                        "fused readback per K rounds — the spec-path "
+                        "counterpart of --superstep-k; greedy and sampled "
+                        "streams are bit-identical to K=1 "
+                        "(docs/SERVING.md 'Speculative supersteps'; "
+                        "supersedes --spec-lookahead, use one)")
     parser.add_argument("--spec-auto", action="store_true",
                         help="adaptive speculation: keep both decode "
                         "programs resident and pick speculative vs plain "
@@ -3649,6 +3955,14 @@ def main(argv=None) -> int:
         parser.error("--prefill-budget must be >= 1 token per step")
     if args.superstep_k < 1:
         parser.error("--superstep-k must be >= 1 chained chunks")
+    if args.spec_superstep_k < 1:
+        parser.error("--spec-superstep-k must be >= 1 chained rounds")
+    if args.spec_superstep_k > 1 and not args.spec_int8_draft:
+        parser.error("--spec-superstep-k chains speculative rounds; it "
+                     "needs --spec-int8-draft (a draft model)")
+    if args.spec_superstep_k > 1 and args.spec_lookahead > 1:
+        parser.error("--spec-superstep-k supersedes --spec-lookahead; "
+                     "use one round-chaining knob, not both")
     if args.kv_offload:
         args.prefix_cache = True  # the offload tier lives on the cache
     if args.kv_host_pages is not None and not args.kv_offload:
@@ -3712,6 +4026,7 @@ def main(argv=None) -> int:
             draft_params=params if args.int8 else quantize_params(params),
             draft_config=config, gamma=args.gamma,
             spec_lookahead=args.spec_lookahead,
+            spec_superstep_k=args.spec_superstep_k,
         )
         if args.spec_auto:
             spec_kw.update(spec="auto", spec_breakeven=args.spec_breakeven)
@@ -3858,7 +4173,8 @@ def main(argv=None) -> int:
     if (
         rejected or engine.steps_quarantined or engine.requests_expired
         or engine.requests_failed or engine.requests_cancelled
-        or engine.superstep_k > 1 or args.kv_offload
+        or engine.superstep_k > 1 or engine.spec_superstep_k > 1
+        or args.kv_offload
     ):
         from collections import Counter
 
@@ -3875,6 +4191,8 @@ def main(argv=None) -> int:
             f"quarantined_steps={engine.steps_quarantined} "
             f"replays={engine.requests_retried} "
             f"supersteps={engine.supersteps_run} "
+            f"spec_superstep_k={engine.spec_superstep_k} "
+            f"spec_supersteps={engine.spec_supersteps_run} "
             f"tokens_overdecoded={engine.tokens_overdecoded} "
             f"{kv}"
             f"host_sync_ms={round(engine.host_sync_s * 1000, 1)} "

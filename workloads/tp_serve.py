@@ -478,7 +478,7 @@ def make_tp_spec_program(
 def make_tp_spec_superstep(
     t_config: ModelConfig, d_config: ModelConfig, mesh: Mesh, gamma: int,
     k: int, lora_stacked=None, lora_alpha: float = 1.0,
-    sampling: bool = False,
+    sampling: bool = False, retire: bool = False,
 ):
     """Tensor-parallel speculative SUPERSTEP: ``k`` chained rounds in one
     dispatch under the model mesh (a lax.scan of the chained round's
@@ -493,8 +493,17 @@ def make_tp_spec_superstep(
     (occupancy always present, then optional lora pair, then optional
     sampling quad, then the static cover_pages last); returns
     (committed [k, b, gamma+1], n [k, b], new_cur, new_pos, t_pools,
-    d_pools)."""
-    from .paged import _spec_superstep_core
+    d_pools).
+
+    ``retire=True`` re-jits the CHAINED-RETIREMENT core instead
+    (paged._spec_superstep_chained_core — the spec_superstep_k engine
+    path): three extra [b] operands (live, budget, eos) follow
+    occupancy, an [k, 2] rngs operand replaces the sampling quad's
+    single rng (one engine key per round; greedy passes zeros and it
+    rides the replicated sharding either way, so the operand list no
+    longer changes with sampling), and the outputs grow the per-round
+    live mask plus the (new_live, new_budget) carry."""
+    from .paged import _spec_superstep_chained_core, _spec_superstep_core
 
     _check_tp(t_config, mesh)
     _check_tp(d_config, mesh)
@@ -512,17 +521,33 @@ def make_tp_spec_superstep(
         if lora_stacked is None
         else (jax.tree.map(lambda _: rep(), lora_stacked), rep(None))
     )
-    samp_sh = (rep(None), rep(), rep(), rep()) if sampling else ()
+    if retire:
+        # live/budget/eos ride after occupancy; rngs [k, 2] is always
+        # present (zeros when greedy); the sampling knobs stay optional.
+        retire_sh = (rep(None), rep(None), rep(None), rep(None, None))
+        samp_sh = (rep(), rep(), rep()) if sampling else ()
+    else:
+        retire_sh = ()
+        samp_sh = (rep(None), rep(), rep(), rep()) if sampling else ()
     in_sh = (
         t_param_sh, d_param_sh, (pool_sh, pool_sh), (pool_sh, pool_sh),
         rep(None, None), rep(None), rep(None), rep(None),
-    ) + lora_sh + samp_sh
-    out_sh = (
-        rep(None, None, None), rep(None, None), rep(None), rep(None),
-        (pool_sh, pool_sh), (pool_sh, pool_sh),
-    )
+    ) + retire_sh + lora_sh + samp_sh
+    if retire:
+        out_sh = (
+            rep(None, None, None), rep(None, None), rep(None, None),
+            rep(None), rep(None), rep(None), rep(None),
+            (pool_sh, pool_sh), (pool_sh, pool_sh),
+        )
+    else:
+        out_sh = (
+            rep(None, None, None), rep(None, None), rep(None), rep(None),
+            (pool_sh, pool_sh), (pool_sh, pool_sh),
+        )
     n_operands = (
-        8 + (2 if lora_stacked is not None else 0) + (4 if sampling else 0)
+        8 + (4 if retire else 0)
+        + (2 if lora_stacked is not None else 0)
+        + ((3 if retire else 4) if sampling else 0)
     )
 
     @partial(
@@ -539,6 +564,27 @@ def make_tp_spec_superstep(
         rest = list(rest)
         cover_pages = rest.pop()  # static, always last
         samp = {}
+        if retire:
+            live, budget, eos, rngs = rest[:4]
+            del rest[:4]
+            if sampling:
+                temperature, top_k, top_p = rest[-3:]
+                del rest[-3:]
+                samp = dict(
+                    sampling=True, temperature=temperature, top_k=top_k,
+                    top_p=top_p,
+                )
+            t_lora = (
+                (rest[0], rest[1], lora_alpha)
+                if lora_stacked is not None else None
+            )
+            return _spec_superstep_chained_core(
+                t_params, d_params, t_pools, d_pools, tables, cur,
+                positions, occupancy, live, budget, eos, rngs,
+                t_config=t_config, d_config=d_config, gamma=gamma, k=k,
+                cover_pages=cover_pages, d_attention_fn=d_attention_fn,
+                t_lora=t_lora, **samp,
+            )
         if sampling:
             rng, temperature, top_k, top_p = rest[-4:]
             del rest[-4:]
