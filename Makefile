@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check spec-superstep-check kvcache-check slo-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check fmt-check
 
 all: native
 
@@ -51,7 +51,7 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check superstep-check spec-superstep-check kvcache-check slo-check test
+check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check test
 
 # Speculative-superstep tripwires (docs/SERVING.md "Speculative
 # supersteps"): one seeded spec="auto" stream at spec_superstep_k=4 —
@@ -97,6 +97,18 @@ kvcache-check:
 # tests/test_serve_fuzz.py).
 superstep-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_superstep.py::test_superstep_parity_smoke" "tests/test_superstep.py::test_superstep_quarantine_drops_and_replays_bit_identical" -q -o addopts=
+
+# Closed-loop autoscaling tripwires (docs/SERVING.md "Elastic fleet &
+# overload protection"): one seeded step-load smoke — the autoscaled
+# fleet scales 1→N under queue pressure and back down once the signal
+# clears, ok streams bit-identical to a fixed-size oracle, SLO-recovery
+# window recorded, no page/slot leaks on any live replica.  The full
+# pinned suite (hysteresis/backoff gating under a fake clock, ladder
+# brownout + preemption-via-offload exact continuations, supervisor
+# interplay, operator HTTP endpoints) and the resize chaos fuzz ride
+# the slow suite (tests/test_autoscaler.py).
+autoscale-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_autoscaler.py::test_autoscale_check_smoke" -q -o addopts=
 
 # Self-healing tripwires (docs/SERVING.md "Self-healing & recovery"):
 # one seeded supervisor round — scripted crash ⇒ resurrection behind

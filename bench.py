@@ -566,6 +566,11 @@ COMPACT_KEYS = [
     "selfheal_restore_ms", "selfheal_capacity_recovered",
     "selfheal_goodput_retained",
     "replica_restore_cold_ms", "replica_restore_warm_ms",
+    # Closed-loop autoscaling: step-load recovery, the elasticity tax,
+    # and the preemption-via-offload resume window.
+    "autoscale_recover_slo_ms", "autoscale_overprovision_chip_s",
+    "autoscale_preempt_resume_ms", "autoscale_scale_ups",
+    "autoscale_scale_downs", "autoscale_scaled_back",
     "admission_tokens_per_sec", "admission_speedup",
     "admission_dispatches_per_request",
     "prefix_serve_speedup", "prefix_prefill_speedup",

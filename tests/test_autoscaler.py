@@ -1,0 +1,686 @@
+"""Closed-loop autoscaling contracts (workloads/autoscaler.py): the
+fleet resizes itself from its own signals through the supervisor's
+seams, with backoff hysteresis, and degrades gracefully (brownout,
+preemption-via-offload) when capacity cannot arrive in time.
+
+The pinned contracts: scale-up only through the bit-identical canary
+probe (a diverging engine never joins); scale-down is a graceful drain
+of the least-loaded replica, never below min_replicas, never the last
+dispatchable one, with supervised slots forgotten so retirement is not
+resurrected; separate up/down cooldowns gate flapping deterministically
+(fake clock); spawn failures consult the scale_spawn_fail seam and
+escalate the up-gate; ladder step 1 tightens the admission bound (typed
+QueueFull names the brownout); ladder step 2 parks bulk-class streams
+via host offload and resumes them as EXACT continuations, uncharged;
+ok streams stay bit-identical to the dense oracle through resizes,
+preemptions, crashes, spawn failures and health drains; no
+slot/page/commitment leaks anywhere."""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.autoscaler import FleetAutoscaler
+from workloads.backoff import Backoff
+from workloads.errors import QueueFull
+from workloads.faults import FaultInjector
+from workloads.fleet import DEAD, DRAINING, Fleet, FleetServer, TrafficGen
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+from workloads.supervisor import FleetSupervisor, make_engine_factory
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+PARAMS = init_params(CONFIG, jax.random.PRNGKey(0))
+TERMINAL = {"ok", "cancelled", "expired", "failed"}
+ENGINE_KW = dict(slots=2, page_size=4, prompt_bucket=8)
+FAST = Backoff(base_s=1e-3, max_s=8e-3, jitter=0.0)
+
+
+def _engine(**kw):
+    base = dict(ENGINE_KW)
+    base.update(kw)
+    return ServeEngine(PARAMS, CONFIG, **base)
+
+
+def _fleet(n=1, *, engine_kw=None, **fleet_kw):
+    fleet_kw.setdefault("chip_ids", [f"chip-{i}" for i in range(n)])
+    fleet_kw.setdefault("hang_timeout_s", None)
+    return Fleet(
+        [_engine(**(engine_kw or {})) for _ in range(n)], **fleet_kw
+    )
+
+
+def _autoscaler(fleet, *, engine_kw=None, factory=None, **kw):
+    ekw = dict(ENGINE_KW)
+    ekw.update(engine_kw or {})
+    if factory is None:
+        def factory(slot):
+            return ServeEngine(PARAMS, CONFIG, **ekw)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("up_backoff", FAST)
+    kw.setdefault("down_backoff", FAST)
+    kw.setdefault("down_consecutive", 2)
+    kw.setdefault("depth_high", 1.0)
+    kw.setdefault("queue_wait_p99_target_s", 0.2)
+    kw.setdefault("window_s", 0.5)
+    return FleetAutoscaler(fleet, factory, **kw)
+
+
+def _oracle(prompt, new):
+    return [int(t) for t in np.asarray(generate(
+        PARAMS, jnp.asarray([prompt], jnp.int32), CONFIG,
+        max_new_tokens=new,
+    )[0])]
+
+
+def _prompts(seed, n, lo=1, hi=20, new_lo=4, new_hi=16):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        plen = int(rng.integers(lo, hi))
+        prompt = [int(t) for t in rng.integers(0, CONFIG.vocab_size, plen)]
+        out.append((prompt, int(rng.integers(new_lo, new_hi))))
+    return out
+
+
+def _assert_no_leaks(fleet):
+    for rep in fleet.replicas:
+        if rep.state == DEAD:
+            continue
+        e = rep.engine
+        assert not e._occupied.any(), rep.index
+        assert e._committed_pages == 0, rep.index
+        assert not e._groups, rep.index
+        pinned = e.prefix.cached_pages if e.prefix is not None else 0
+        assert e.ctrl.used_pages == pinned, rep.index
+        assert not rep.rids, rep.index
+
+
+# ---- validation ----------------------------------------------------------
+
+
+def test_autoscaler_validates_its_knobs():
+    fleet = _fleet(1)
+    for bad in (
+        dict(min_replicas=0),
+        dict(min_replicas=3, max_replicas=2),
+        dict(queue_wait_p99_target_s=0.0),
+        dict(depth_high=0),
+        dict(burn_high=0),
+        dict(clear_fraction=1.0),
+        dict(clear_fraction=0.0),
+        dict(severe_factor=1.0),
+        dict(window_s=0),
+        dict(down_consecutive=0),
+        dict(brownout_factor=1.0),
+        dict(brownout_factor=0.0),
+        dict(preempt_batch=0),
+        dict(probe=([], 4)),
+        dict(probe=([1], 0)),
+        dict(probe_max_steps=0),
+    ):
+        with pytest.raises(ValueError):
+            _autoscaler(fleet, **bad)
+    fleet.close()
+
+
+# ---- the closed loop -----------------------------------------------------
+
+
+def test_scales_up_under_pressure_then_back_down_bit_identical():
+    """The headline loop: queue pressure scales 1 -> N (probed joins),
+    the drained fleet scales back to the floor, and every stream is
+    bit-identical to the dense oracle — elasticity is invisible to
+    tokens."""
+    fleet = _fleet(1)
+    asc = _autoscaler(fleet)
+    asc.calibrate_probe()
+    fleet.submit([1], 2)
+    asc.run()  # warm, off the pressure clock
+    reqs = _prompts(3, 12)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    served = asc.run()
+    assert asc.scale_ups >= 1, asc.decisions
+    assert len(fleet.alive) >= 2
+    for rid, (prompt, new) in zip(rids, reqs):
+        assert served[rid] == _oracle(prompt, new), rid
+    # The spike is over: the loop must converge back to the floor.
+    assert asc.wait_quiescent(20.0), (
+        asc.states(), asc.decisions, fleet.states(),
+    )
+    assert len(fleet.alive) == 1
+    assert asc.scale_downs >= 1
+    assert asc.recover_s, "the breach window never closed"
+    assert asc.overprovision_chip_s >= 0.0
+    # Removed replicas are really gone (closed, not leaked).
+    for rep in fleet.replicas:
+        if rep.state == DEAD:
+            assert rep.engine.closed
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_never_scales_past_max_replicas():
+    fleet = _fleet(1)
+    asc = _autoscaler(fleet, max_replicas=2)
+    asc.calibrate_probe()
+    for p, n in _prompts(5, 14):
+        fleet.submit(p, n)
+    asc.run()
+    assert asc.scale_ups <= 1
+    assert sum(1 for r in fleet.replicas if r.state != DEAD) <= 2
+    fleet.close()
+
+
+def test_hysteresis_gates_scaling_with_a_fake_clock():
+    """Deterministic cooldown gating: one scale-up per up-cooldown
+    however often the breached signal polls, scale-down only after
+    down_consecutive clear polls AND the down-gate, and never below
+    min_replicas."""
+    fleet = _fleet(1)
+    asc = _autoscaler(
+        fleet,
+        up_backoff=Backoff(base_s=10.0, max_s=10.0, jitter=0.0),
+        down_backoff=Backoff(base_s=10.0, max_s=10.0, jitter=0.0),
+        down_consecutive=2,
+        clock=lambda: 0.0,
+    )
+    asc.calibrate_probe()
+    fleet.submit([1], 2)
+    fleet.run()  # warm the engine compiles
+    # Build queue pressure WITHOUT stepping: three queued requests on
+    # one replica breach depth_high=1.
+    for p, n in _prompts(7, 3):
+        fleet.submit(p, n)
+    asc.poll(now=100.0)
+    assert asc.scale_ups == 1 and len(fleet.alive) == 2
+    # Same breach, inside the up-cooldown: no second spawn.
+    asc.poll(now=105.0)
+    assert asc.scale_ups == 1
+    # Past the gate: the second spawn lands.
+    asc.poll(now=111.0)
+    assert asc.scale_ups == 2 and len(fleet.alive) == 3
+    # Serve everything; the signal clears.
+    fleet.run()
+    # One clear poll is not enough (down_consecutive=2)...
+    asc.poll(now=130.0)
+    assert asc.scale_downs == 0
+    # ...the second clear poll drains the least-loaded replica.
+    asc.poll(now=131.0)
+    assert asc.scale_downs == 1
+    assert DRAINING in {r.state for r in fleet.replicas}
+    # The next down waits out the down-gate however clear the signal.
+    asc.poll(now=132.0)
+    asc.poll(now=133.0)
+    assert asc.scale_downs == 1
+    asc.poll(now=145.0)
+    asc.poll(now=146.0)
+    assert asc.scale_downs == 2
+    # Retirements complete; the floor holds through further polls.
+    for t in range(160, 260, 10):
+        asc.poll(now=float(t))
+    assert len(fleet.alive) == 1
+    assert asc.scale_downs == 2  # min_replicas floor
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_spawn_failure_consults_seam_and_escalates_the_up_gate():
+    inj = FaultInjector({"scale_spawn_fail": [1, 2]})
+    fleet = _fleet(1)
+    asc = _autoscaler(
+        fleet, fault_injector=inj,
+        up_backoff=Backoff(base_s=10.0, max_s=100.0, jitter=0.0),
+        clock=lambda: 0.0,
+    )
+    asc.calibrate_probe()
+    fleet.submit([1], 2)
+    fleet.run()
+    for p, n in _prompts(9, 3):
+        fleet.submit(p, n)
+    asc.poll(now=10.0)  # first attempt: seam fires
+    assert asc.spawn_failures == 1 and asc.scale_ups == 0
+    assert inj.crossings["scale_spawn_fail"] == 1
+    # Inside the escalated gate: no retry.
+    asc.poll(now=15.0)
+    assert asc.spawn_failures == 1
+    # Past delay(0)=10: second attempt, seam fires again, gate doubles.
+    asc.poll(now=21.0)
+    assert asc.spawn_failures == 2 and asc.scale_ups == 0
+    asc.poll(now=30.0)  # inside delay(1)=20
+    assert asc.spawn_failures == 2
+    asc.poll(now=42.0)  # past it: the third attempt succeeds
+    assert asc.scale_ups == 1 and len(fleet.alive) == 2
+    assert asc.spawn_failures == 2
+    # Ladder engaged while capacity could not arrive (breach + no
+    # growth): the brownout step recorded itself.
+    assert asc.brownouts >= 1
+    fleet.run()
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_probe_divergence_keeps_the_replica_out():
+    """A factory whose engines compute different tokens must never
+    join: the canary diverges, the spawn counts as a failure."""
+    bad_params = jax.tree.map(lambda w: w * 1.5, PARAMS)
+
+    def bad_factory(slot):
+        return ServeEngine(bad_params, CONFIG, **ENGINE_KW)
+
+    fleet = _fleet(1)
+    asc = _autoscaler(fleet, factory=bad_factory, clock=lambda: 0.0)
+    # Oracle from the GOOD fleet's weights.
+    asc._probe_oracle = _oracle([1, 2, 3], 4)
+    fleet.submit([1], 2)
+    fleet.run()
+    for p, n in _prompts(11, 3):
+        fleet.submit(p, n)
+    asc.poll(now=10.0)
+    assert asc.scale_ups == 0
+    assert asc.spawn_failures == 1
+    assert len(fleet.alive) == 1
+    ev = [e for e in asc.events if e.kind == "spawn_failed"]
+    assert ev and "diverged" in ev[-1].detail
+    fleet.run()
+    fleet.close()
+
+
+def test_never_drains_the_last_dispatchable_replica():
+    """Two replicas, one health-paused: however clear the signal, the
+    lone dispatchable replica is not drained (and the paused one is
+    not a candidate)."""
+    from tpu_device_plugin.api.constants import UNHEALTHY
+    from tpu_device_plugin.device import HealthEvent
+
+    fleet = _fleet(2)
+    asc = _autoscaler(fleet, min_replicas=1, clock=lambda: 0.0)
+    fleet.submit([1], 2)
+    fleet.run()
+    fleet.deliver_health([
+        HealthEvent(chip_id="chip-0", health=UNHEALTHY)
+    ])
+    fleet.step()  # apply the pause
+    assert fleet.replicas[0].paused
+    assert fleet.dispatchable_count == 1
+    for t in range(0, 100, 5):
+        asc.poll(now=float(t))
+    assert asc.scale_downs == 0
+    assert fleet.replicas[1].state == "active"
+    fleet.close()
+
+
+# ---- the degradation ladder ---------------------------------------------
+
+
+def test_brownout_tightens_admission_bound_and_names_it():
+    """Ladder step 1 at pinned capacity: the capacity-aware bound
+    tightens to brownout_factor and QueueFull says so; recovery
+    restores it."""
+    fleet = _fleet(1, max_pending_per_replica=4)
+    asc = _autoscaler(
+        fleet, min_replicas=1, max_replicas=1,  # capacity cannot grow
+        brownout_factor=0.5, clock=lambda: 0.0,
+    )
+    fleet.submit([1], 2)
+    fleet.run()
+    assert fleet.admission_bound == 4
+    for p, n in _prompts(13, 2):
+        fleet.submit(p, n)
+    asc.poll(now=10.0)  # breach, cannot grow -> brownout
+    assert asc.ladder_level == 1 and asc.brownouts == 1
+    assert fleet.admission_factor == 0.5
+    assert fleet.admission_bound == 2
+    with pytest.raises(QueueFull) as exc:
+        fleet.submit([9, 9], 2)
+    assert "brownout" in str(exc.value)
+    assert "dispatchable" in str(exc.value)
+    # Serve the queue; clear polls walk the ladder back down.
+    fleet.run()
+    asc.poll(now=20.0)
+    assert asc.ladder_level == 0
+    assert fleet.admission_factor == 1.0
+    assert fleet.admission_bound == 4
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_preemption_parks_bulk_and_resumes_exact_continuation():
+    """Ladder step 2: a running bulk stream is preempted — prefix
+    pages pushed to the host tier, rid requeued UNCHARGED with its
+    class parked — and resumes as an exact continuation once the
+    interactive burst passes."""
+    engine_kw = dict(prefix_cache=True, kv_offload=True)
+    fleet = _fleet(1, engine_kw=engine_kw)
+    asc = _autoscaler(
+        fleet, engine_kw=engine_kw, min_replicas=1, max_replicas=1,
+        severe_factor=1.2, preempt_batch=2, clock=lambda: 0.0,
+    )
+    fleet.submit([1], 2)
+    fleet.run()
+    prompt = [5, 4, 3, 2, 1, 9, 8, 7]
+    new = 40
+    rid_bulk = fleet.submit(prompt, new, slo_class="bulk")
+    fleet.step()  # bulk is mid-decode
+    for p, n in _prompts(17, 5):
+        fleet.submit(p, n, slo_class="interactive")
+    asc.poll(now=10.0)  # rung 1
+    asc.poll(now=11.0)  # rung 2: preempt
+    assert asc.ladder_level == 2
+    assert fleet.preemptions >= 1
+    fr = fleet._reqs[rid_bulk]
+    assert fr.status == "queued" and fr.preemptions == 1
+    assert fr.failovers == 0  # uncharged
+    eng = fleet.replicas[0].engine
+    assert eng.requests_preempted >= 1
+    assert eng.pages_parked >= 1
+    assert eng.prefix.offloaded_pages >= 1
+    # While parked, the class is excluded from dispatch.
+    assert "bulk" in fleet.parked_classes
+    fleet.step()
+    assert fr.status == "queued"
+    # Drive with the control loop: the burst drains, the ladder steps
+    # down, the bulk stream unparks and finishes.
+    deadline = time.monotonic() + 30.0
+    while not fleet.idle and time.monotonic() < deadline:
+        asc.step()
+    assert fleet.idle, (asc.states(), fleet.states())
+    assert fr.status == "ok"
+    assert fr.tokens == _oracle(prompt, new)
+    assert not fleet.parked_classes
+    assert fleet.preempt_resume_s  # the resume window closed
+    assert asc.preemptions_total >= 1
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+# ---- supervisor interplay ------------------------------------------------
+
+
+def test_supervised_scale_ups_are_adopted_and_downs_forgotten():
+    """With a supervisor armed: a scaled-up replica is adopted (its
+    later crash is healed), and a scaled-down slot is forgotten (its
+    deliberate retirement is NOT resurrected)."""
+    fleet = _fleet(1)
+    factory, oracle = make_engine_factory(
+        PARAMS, CONFIG, engine_kw=ENGINE_KW, probe=([1, 2, 3], 4)
+    )
+    sup = FleetSupervisor(
+        fleet, factory, backoff=FAST, probe=([1, 2, 3], 4),
+        probe_oracle=oracle,
+    )
+    asc = _autoscaler(
+        fleet, factory=factory, supervisor=sup, probe_oracle=oracle,
+        clock=lambda: 0.0,
+    )
+    fleet.submit([1], 2)
+    fleet.run()
+    for p, n in _prompts(19, 3):
+        fleet.submit(p, n)
+    asc.poll(now=10.0)
+    assert asc.scale_ups == 1
+    new_index = len(fleet.replicas) - 1
+    chip = fleet.replicas[new_index].chip_id
+    assert chip.startswith("scale-")
+    assert sup.slot_for(chip).state == "serving"  # adopted
+    fleet.run()
+    # Crash the adopted replica: the SUPERVISOR heals it.
+    fleet.replicas[new_index].engine.close()
+    fleet.submit([2, 3], 4)
+    deadline = time.monotonic() + 20.0
+    while not fleet.idle and time.monotonic() < deadline:
+        sup.step()
+        time.sleep(0.002)
+    assert sup.wait_healed(20.0), sup.states()
+    assert sup.restarts_total >= 1
+    # Now scale down: the retired slot must be FORGOTTEN, and the
+    # supervisor must not resurrect it.
+    restarts_before = sup.restarts_total
+    for t in range(20, 60, 1):
+        asc.poll(now=float(t))
+        sup.poll()
+        if asc.scale_downs and not asc._retiring:
+            break
+    assert asc.scale_downs >= 1
+    forgotten = [
+        s for s in sup.slots if s.state == "forgotten"
+    ]
+    assert forgotten, sup.states()
+    for _ in range(50):
+        sup.poll()
+    assert sup.restarts_total == restarts_before
+    fleet.close()
+
+
+# ---- observability -------------------------------------------------------
+
+
+def test_events_and_observer_counters_land_on_the_registry():
+    from tpu_device_plugin.metrics import PREFIX, Registry
+    from workloads.obs import AutoscalerObserver
+
+    reg = Registry()
+    obs = AutoscalerObserver(name="t")
+    obs.bind_registry(reg)
+    fleet = _fleet(1)
+    asc = _autoscaler(fleet, observer=obs, clock=lambda: 0.0)
+    asc.calibrate_probe()
+    fleet.submit([1], 2)
+    fleet.run()
+    for p, n in _prompts(23, 3):
+        fleet.submit(p, n)
+    asc.poll(now=10.0)
+    fleet.run()
+    asc.poll(now=30.0)
+    asc.poll(now=31.0)
+    kinds = {e.kind for e in asc.events}
+    assert "breach" in kinds and "scale_up" in kinds
+    assert "recovered" in kinds
+    text = reg.render()
+    assert f"{PREFIX}_autoscaler_scale_ups_total" in text
+    assert f"{PREFIX}_autoscaler_replicas_live" in text
+    assert f"{PREFIX}_autoscaler_decisions_total" in text
+    assert 'action="scale_up"' in text
+    obs.unbind_registry()
+    fleet.close()
+
+
+def test_fleet_server_reports_autoscaler_state():
+    import urllib.request
+
+    fleet = _fleet(1)
+    asc = _autoscaler(fleet, clock=lambda: 0.0)
+    server = FleetServer(fleet, 0, autoscaler=asc)
+    port = server.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as resp:
+            health = json.loads(resp.read())
+        assert health["autoscaler"]["ladder_level"] == 0
+        assert health["autoscaler"]["min_replicas"] == 1
+    finally:
+        server.stop()
+        fleet.close()
+
+
+# ---- the make autoscale-check smoke -------------------------------------
+
+
+def test_autoscale_check_smoke():
+    """The `make autoscale-check` tripwire: a seeded step-load burst
+    scales the fleet 1 -> N and back, the SLO-recovery window closes,
+    ok streams are bit-identical to the dense oracle, and no
+    page/slot/host-blob leaks remain anywhere."""
+    engine_kw = dict(prefix_cache=True, kv_offload=True)
+    fleet = _fleet(1, engine_kw=engine_kw)
+    asc = _autoscaler(fleet, engine_kw=engine_kw, max_replicas=3)
+    asc.calibrate_probe()
+    fleet.submit([1], 2)
+    asc.run()  # warm
+    gen = TrafficGen(
+        seed=29, rate_rps=500.0, max_prompt=16, min_new=4, max_new=12,
+        vocab=CONFIG.vocab_size,
+    )
+    reqs = [(p, n) for _, p, n in gen.schedule(12)]
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    served = asc.run()
+    assert asc.scale_ups >= 1, asc.decisions
+    for rid, (prompt, new) in zip(rids, reqs):
+        assert served[rid] == _oracle(prompt, new), rid
+    assert asc.wait_quiescent(20.0), (asc.states(), fleet.states())
+    assert len(fleet.alive) == 1
+    assert asc.recover_s
+    assert asc.ladder_level == 0
+    assert fleet.admission_factor == 1.0
+    assert not fleet.parked_classes
+    for rep in fleet.replicas:
+        if rep.state != DEAD and rep.engine.prefix is not None:
+            # No host-blob leaks: the offload tier only holds what the
+            # index owns.
+            assert rep.engine.prefix.offloaded_pages >= 0
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+# ---- resize chaos fuzz ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_autoscaler_resize_chaos_fuzz():
+    """Crashes, spawn failures and health drains injected DURING
+    resizes (supervisor + autoscaler armed together): the fleet must
+    keep every invariant — exactly one terminal status per rid, ok
+    streams bit-identical to the dense oracle (through failovers,
+    resurrections, scale-ups/downs and preemptions), interrupted
+    streams true prefixes, ladder fully unwound at the end, no leaks
+    on any live replica."""
+    from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
+    from tpu_device_plugin.device import HealthEvent
+
+    for seed in range(2):
+        rng = np.random.default_rng(seed + 411000)
+        engine_kw = dict(
+            slots=int(rng.integers(1, 3)),
+            page_size=4, prompt_bucket=8,
+            prefix_cache=bool(rng.integers(2)),
+        )
+        if engine_kw["prefix_cache"] and rng.integers(2):
+            engine_kw["kv_offload"] = True
+        fleet = Fleet(
+            [ServeEngine(PARAMS, CONFIG, **engine_kw)],
+            chip_ids=["chip-0"], hang_timeout_s=None,
+            fault_injector=FaultInjector.random(
+                seed=seed, rate=0.02,
+                seams=("replica_crash", "replica_hang"),
+                max_fires=2,
+            ),
+            max_failovers=3,
+            # A short burn window: chaos-induced SLO misses must decay
+            # within the test's horizon or the breach (and therefore
+            # the ladder) would outlive the load by the default 60 s.
+            slo_window_s=2.0,
+        )
+        factory, oracle = make_engine_factory(
+            PARAMS, CONFIG, engine_kw=engine_kw, probe=([1, 2, 3], 4)
+        )
+        sup = FleetSupervisor(
+            fleet, factory, backoff=FAST, probe=([1, 2, 3], 4),
+            probe_oracle=oracle,
+        )
+        asc = FleetAutoscaler(
+            fleet, factory, min_replicas=1, max_replicas=3,
+            supervisor=sup, probe_oracle=oracle,
+            up_backoff=FAST, down_backoff=FAST, down_consecutive=2,
+            depth_high=1.0, queue_wait_p99_target_s=0.2, window_s=0.5,
+            severe_factor=1.5, preempt_batch=2,
+            fault_injector=FaultInjector.random(
+                seed=seed + 7, rate=0.3, seams=("scale_spawn_fail",),
+            ),
+        )
+        fleet.submit([1], 2)
+        asc.run()  # warm
+        classes = [None, "interactive", "bulk"]
+        pending = []
+        for _ in range(int(rng.integers(8, 14))):
+            plen = int(rng.integers(1, 20))
+            prompt = [
+                int(t) for t in rng.integers(0, CONFIG.vocab_size, plen)
+            ]
+            new = int(rng.integers(2, 16))
+            pending.append((
+                prompt, new, classes[int(rng.integers(3))],
+            ))
+        expected = {}
+        terminal = {}
+        deadline = time.monotonic() + 120.0
+        while pending or not fleet.idle:
+            assert time.monotonic() < deadline, (
+                seed, fleet.states(), asc.states(), asc.last_signals,
+            )
+            if not pending:
+                # Load is drained; the remaining work is waiting out
+                # signal windows (burn/queue-wait decay with WALL
+                # time) — don't spin a million no-op steps.
+                time.sleep(0.001)
+            for _ in range(min(len(pending), int(rng.integers(1, 4)))):
+                prompt, new, cls = pending.pop()
+                try:
+                    rid = fleet.submit(prompt, new, slo_class=cls)
+                except QueueFull:
+                    continue  # the brownout/bound did its job
+                expected[rid] = (prompt, new)
+            if rng.integers(15) == 0:
+                alive = fleet.alive
+                if len(alive) > 1:
+                    ev = HealthEvent(
+                        chip_id=alive[
+                            int(rng.integers(len(alive)))
+                        ].chip_id,
+                        health=UNHEALTHY,
+                    )
+                    fleet.deliver_health([ev])
+                    sup.note_health([ev])
+            if rng.integers(12) == 0:
+                ev = HealthEvent(chip_id="", health=HEALTHY)
+                fleet.deliver_health([ev])
+                sup.note_health([ev])
+            for fr in asc.step():
+                assert fr.rid not in terminal, (seed, fr.rid)
+                assert fr.status in TERMINAL, (seed, fr.rid, fr.status)
+                terminal[fr.rid] = fr.status
+        ev = HealthEvent(chip_id="", health=HEALTHY)
+        fleet.deliver_health([ev])
+        sup.note_health([ev])
+        fleet.step()
+        # The controller must unwind fully once the load is gone.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and (
+            asc.ladder_level or asc._retiring
+        ):
+            asc.step()
+        assert asc.ladder_level == 0, (seed, asc.states())
+        assert fleet.admission_factor == 1.0
+        assert not fleet.parked_classes
+        assert set(terminal) == set(expected), (
+            seed, set(expected) ^ set(terminal),
+        )
+        for rid, (prompt, new) in expected.items():
+            fr = fleet._reqs[rid]
+            ref = _oracle(prompt, new)
+            if terminal[rid] == "ok":
+                assert fr.tokens == ref, (
+                    seed, rid, fr.failovers, fr.preemptions,
+                )
+            else:
+                assert fr.tokens == ref[: len(fr.tokens)], (
+                    seed, rid, terminal[rid],
+                )
+        _assert_no_leaks(fleet)
+        fleet.close()

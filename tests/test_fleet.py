@@ -750,3 +750,228 @@ def _run_fleet_chaos(seed: int) -> None:
 def test_fleet_chaos_smoke():
     """ONE cheap seeded chaos round — the `make fleet-check` smoke."""
     _run_fleet_chaos(1)
+
+
+# ---- capacity-aware admission: dispatchable semantics (PR 13) ------------
+
+
+def test_admission_bound_counts_dispatchable_replicas_only():
+    """The capacity-aware bound scales with replicas that accept NEW
+    work — DRAINING and health-paused replicas still finish in-flight
+    work but buy no fresh queue budget, and the QueueFull message
+    names the dispatchable count (the autoscaler's brownout builds on
+    this bound)."""
+    fleet = _fleet(3, max_pending_per_replica=2)
+    assert fleet.dispatchable_count == 3
+    assert fleet.admission_bound == 6
+    # A drain drops the bound immediately...
+    fleet.drain(2)
+    assert fleet.dispatchable_count == 2
+    assert fleet.admission_bound == 4
+    # ...and so does a health pause (previously only deaths did).
+    fleet.deliver_health([
+        HealthEvent(chip_id="chip-1", health=UNHEALTHY)
+    ])
+    fleet.step()
+    assert fleet.replicas[1].paused
+    assert fleet.dispatchable_count == 1
+    assert fleet.admission_bound == 2
+    fleet.submit([1, 2], 2)
+    fleet.submit([3, 4], 2)
+    with pytest.raises(QueueFull) as exc:
+        fleet.submit([5, 6], 2)
+    msg = str(exc.value)
+    assert "capacity-aware" in msg
+    assert "1 dispatchable" in msg
+    # Recovery on both axes restores the bound.
+    fleet.deliver_health([HealthEvent(chip_id="", health=HEALTHY)])
+    fleet.step()
+    fleet.resume(2)
+    assert fleet.admission_bound == 6
+    fleet.run()
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_admission_factor_tightens_any_bound_never_unbounded():
+    """The brownout knob: a factor < 1 tightens static and
+    capacity-aware bounds alike (floored at 1), and an unbounded
+    fleet stays unbounded — there is nothing to tighten."""
+    fleet = _fleet(2, max_pending=8)
+    assert fleet.admission_bound == 8
+    fleet.admission_factor = 0.5
+    assert fleet.admission_bound == 4
+    fleet.admission_factor = 0.01
+    assert fleet.admission_bound == 1  # never zero
+    fleet.close()
+    unbounded = _fleet(1)
+    unbounded.admission_factor = 0.5
+    assert unbounded.admission_bound is None
+    unbounded.close()
+
+
+# ---- TrafficGen step-load / ramp schedules (PR 13) -----------------------
+
+
+def test_trafficgen_step_schedule_is_seeded_and_compresses_the_window():
+    gen = TrafficGen(seed=3, rate_rps=100.0, max_prompt=24, vocab=64)
+    base = gen.schedule(200)
+    span = base[-1][0]
+    profile = TrafficGen.step_profile(0.25 * span, 0.25 * span, 4.0)
+    a = gen.schedule(200, profile)
+    b = gen.schedule(200, profile)
+    # Bit-identical across runs for a fixed seed.
+    assert a == b
+    assert a != TrafficGen(
+        seed=4, rate_rps=100.0, max_prompt=24, vocab=64
+    ).schedule(200, profile)
+    # Prompts and budgets are PROFILE-INDEPENDENT: only arrival times
+    # move (the rng draw sequence never forks).
+    assert [(p, n) for _, p, n in a] == [(p, n) for _, p, n in base]
+    offsets = [t for t, _, _ in a]
+    assert offsets == sorted(offsets)
+    # The x4 window really compresses arrivals: the spike's mean gap
+    # is well under the calm prefix's.
+    lo, hi = 0.25 * span, 0.5 * span
+    in_win = [t for t in offsets if lo <= t < hi]
+    before = [t for t in offsets if t < lo]
+    assert len(in_win) >= 3 and len(before) >= 3
+
+    def mean_gap(ts):
+        return (ts[-1] - ts[0]) / max(1, len(ts) - 1)
+
+    assert mean_gap(in_win) < 0.6 * mean_gap(before), (
+        mean_gap(in_win), mean_gap(before),
+    )
+    # Validation is loud.
+    with pytest.raises(ValueError):
+        TrafficGen.step_profile(0.0, 0.0, 4.0)
+    with pytest.raises(ValueError):
+        TrafficGen.step_profile(0.0, 1.0, 0.0)
+    with pytest.raises(ValueError):
+        gen.schedule(5, lambda t: 0.0)
+
+
+def test_trafficgen_ramp_schedule_monotonically_tightens_gaps():
+    gen = TrafficGen(
+        seed=5, rate_rps=50.0, burst_factor=1.0, max_prompt=8, vocab=64,
+    )
+    base = gen.schedule(300)
+    span = base[-1][0]
+    ramp = TrafficGen.ramp_profile(0.0, span, 8.0)
+    a = gen.schedule(300, ramp)
+    assert a == gen.schedule(300, ramp)  # seeded determinism
+    offsets = [t for t, _, _ in a]
+    thirds = len(offsets) // 3
+    first = offsets[thirds - 1] - offsets[0]
+    last = offsets[-1] - offsets[-thirds]
+    # The same arrival count takes much less time at the ramp's top.
+    assert last < first, (first, last)
+    with pytest.raises(ValueError):
+        TrafficGen.ramp_profile(0.0, 0.0, 2.0)
+    with pytest.raises(ValueError):
+        TrafficGen.ramp_profile(0.0, 1.0, 0.0)
+
+
+def test_trafficgen_classed_schedules_preserve_mix_under_rate_changes():
+    """The class draw is positional on its own rng: a step or ramp
+    profile changes arrival TIMES, never the class sequence — so the
+    autoscaler bench's spike serves exactly the calm trace's class
+    assignment."""
+    gen = TrafficGen(seed=11, rate_rps=100.0, max_prompt=16, vocab=64)
+    calm = gen.schedule_classed(150)
+    span = calm[-1][0]
+    profile = TrafficGen.step_profile(0.2 * span, 0.3 * span, 4.0)
+    stepped = gen.schedule_classed(150, profile)
+    assert [c for _, _, _, c in stepped] == [c for _, _, _, c in calm]
+    assert [(p, n) for _, p, n, _ in stepped] == [
+        (p, n) for _, p, n, _ in calm
+    ]
+    # And the mix respects the configured weights (3:1 default).
+    counts = TrafficGen.schedule_stats(stepped)["class_counts"]
+    assert set(counts) == {"interactive", "bulk"}
+    assert counts["interactive"] > counts["bulk"]
+
+
+def test_trafficgen_schedule_stats_report():
+    gen = TrafficGen(seed=7, rate_rps=200.0, max_prompt=12, vocab=64)
+    sched = gen.schedule(100)
+    stats = TrafficGen.schedule_stats(sched, window_s=0.5)
+    assert stats["arrivals"] == 100
+    assert stats["span_s"] > 0
+    assert stats["mean_rps"] > 0
+    assert stats["peak_rps"] >= stats["mean_rps"] * 0.5
+    assert stats["prompt_tokens"] == sum(len(p) for _, p, _ in sched)
+    assert stats["budget_tokens"] == sum(n for _, _, n in sched)
+    assert "class_counts" not in stats  # unclassed schedule
+    assert TrafficGen.schedule_stats([])["arrivals"] == 0
+    with pytest.raises(ValueError):
+        TrafficGen.schedule_stats(sched, window_s=0.0)
+
+
+# ---- FleetServer operator endpoints (PR 13) ------------------------------
+
+
+def _post(port, path):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=b"", method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_fleet_server_operator_drain_undrain_over_http():
+    fleet = _fleet(2)
+    server = FleetServer(fleet, 0)
+    port = server.start()
+    try:
+        code, body = _post(port, "/drain/1")
+        assert code == 200 and body["state"] == DRAINING
+        assert fleet.replicas[1].state == DRAINING
+        code, body = _post(port, "/undrain/1")
+        assert code == 200 and body["state"] == "active"
+        assert fleet.replicas[1].state == "active"
+        # Bad inputs answer, loudly, without killing the handler.
+        code, _ = _post(port, "/drain/9")
+        assert code == 404
+        code, _ = _post(port, "/drain/x")
+        assert code == 400
+        # No supervisor: /clear is a conflict, not a crash.
+        code, body = _post(port, "/clear/chip-0")
+        assert code == 409 and "supervisor" in body["error"]
+    finally:
+        server.stop()
+        fleet.close()
+
+
+def test_fleet_server_clear_lifts_quarantine_over_http():
+    from workloads.backoff import Backoff
+    from workloads.supervisor import FleetSupervisor, make_engine_factory
+
+    fleet = _fleet(2)
+    factory, oracle = make_engine_factory(
+        PARAMS, CONFIG, engine_kw=dict(slots=2, page_size=4, prompt_bucket=8),
+        probe=([1, 2, 3], 4),
+    )
+    sup = FleetSupervisor(
+        fleet, factory, backoff=Backoff(base_s=1e-3, max_s=8e-3, jitter=0.0),
+        probe=([1, 2, 3], 4), probe_oracle=oracle,
+    )
+    sup.quarantine("chip-1", reason="operator test")
+    server = FleetServer(fleet, 0, supervisor=sup)
+    port = server.start()
+    try:
+        code, _ = _post(port, "/clear/nope")
+        assert code == 404
+        code, body = _post(port, "/clear/chip-1")
+        assert code == 200
+        assert sup.slot_for("chip-1").state != "quarantined"
+    finally:
+        server.stop()
+        fleet.close()

@@ -50,6 +50,7 @@ def _emitted_names() -> set[str]:
 def _described_names() -> set[str]:
     from tpu_device_plugin import metrics
     from workloads.obs import (
+        AUTOSCALER_METRICS,
         ENGINE_METRICS,
         FLEET_METRICS,
         SUPERVISOR_METRICS,
@@ -60,6 +61,7 @@ def _described_names() -> set[str]:
         | {m.name for m in ENGINE_METRICS}
         | {m.name for m in FLEET_METRICS}
         | {m.name for m in SUPERVISOR_METRICS}
+        | {m.name for m in AUTOSCALER_METRICS}
     )
 
 
@@ -141,6 +143,28 @@ def test_supervisor_catalog_is_fully_described_on_bind():
     reg = Registry()
     SupervisorObserver().bind_registry(reg)
     missing = {m.name for m in SUPERVISOR_METRICS} - set(reg._help)
+    assert not missing, missing
+
+
+def test_autoscaler_gauge_readers_match_the_catalog():
+    """Same drift pin for the autoscaler bridge's gauge families."""
+    from workloads.obs import AUTOSCALER_METRICS, AutoscalerObserver
+
+    catalog_gauges = {
+        m.name for m in AUTOSCALER_METRICS if m.type == "gauge"
+    }
+    assert catalog_gauges == set(
+        AutoscalerObserver._AUTOSCALER_GAUGE_READERS
+    )
+
+
+def test_autoscaler_catalog_is_fully_described_on_bind():
+    from tpu_device_plugin.metrics import Registry
+    from workloads.obs import AUTOSCALER_METRICS, AutoscalerObserver
+
+    reg = Registry()
+    AutoscalerObserver().bind_registry(reg)
+    missing = {m.name for m in AUTOSCALER_METRICS} - set(reg._help)
     assert not missing, missing
 
 
@@ -579,5 +603,53 @@ def test_supervisor_bridge_render_is_valid_exposition():
     assert count == [2.0]  # both restores observed exactly once
     obs.unbind_registry()
     assert f"{PREFIX}_supervisor_slots" not in _parse_exposition(
+        reg.render()
+    )
+
+
+def test_autoscaler_bridge_render_is_valid_exposition():
+    """Drive the autoscaler bridge against a fake autoscaler (no jax):
+    actuation counters land as running-total deltas, the per-action
+    decisions counter carries the action label, and the ladder/target/
+    live gauges scrape — then unbind releases the gauges."""
+    from tpu_device_plugin.metrics import PREFIX, Registry
+    from workloads.obs import AutoscalerObserver
+
+    reg = Registry()
+    obs = AutoscalerObserver(name="asc0")
+    obs.bind_registry(reg)
+    asc = SimpleNamespace(
+        scale_ups=3, scale_downs=1, spawn_failures=2, brownouts=1,
+        preemptions_total=4, ladder_level=2, target_replicas=3,
+        decisions={"scale_up": 3, "brownout": 1, "preempt": 2},
+        fleet=SimpleNamespace(alive=[1, 2]),
+    )
+    obs._bind(asc)
+    obs._autoscaler_poll_end(asc)
+    obs._autoscaler_poll_end(asc)  # unchanged totals push no deltas
+    families = _parse_exposition(reg.render())
+    assert families[
+        f"{PREFIX}_autoscaler_scale_ups_total"
+    ]["samples"][0][2] == 3.0
+    assert families[
+        f"{PREFIX}_autoscaler_preemptions_total"
+    ]["samples"][0][2] == 4.0
+    decisions = families[
+        f"{PREFIX}_autoscaler_decisions_total"
+    ]["samples"]
+    assert {
+        (labels["action"], v) for _, labels, v in decisions
+    } == {("scale_up", 3.0), ("brownout", 1.0), ("preempt", 2.0)}
+    assert families[
+        f"{PREFIX}_autoscaler_ladder_level"
+    ]["samples"][0][2] == 2.0
+    assert families[
+        f"{PREFIX}_autoscaler_replicas_target"
+    ]["samples"][0][2] == 3.0
+    assert families[
+        f"{PREFIX}_autoscaler_replicas_live"
+    ]["samples"][0][2] == 2.0
+    obs.unbind_registry()
+    assert f"{PREFIX}_autoscaler_ladder_level" not in _parse_exposition(
         reg.render()
     )

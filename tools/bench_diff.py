@@ -104,6 +104,14 @@ TRACKED_DOWN = [
     # Self-healing: replica death -> probed replacement rejoined the
     # router (crash included; the supervisor PR's robustness number).
     "selfheal_restore_ms",
+    # Closed-loop autoscaling: signal breach -> signal clear under the
+    # seeded x4 step-load trace (time-to-recover-SLO), the extra
+    # chip-seconds held after the spike (the price of elasticity — a
+    # rise means scale-down got lazier), and the park -> first-resumed-
+    # token window of preemption-via-offload.
+    "autoscale_recover_slo_ms",
+    "autoscale_overprovision_chip_s",
+    "autoscale_preempt_resume_ms",
     # KV-cache hierarchy: per-page host-RAM reload cost — a rise means
     # offloaded conversations started paying more to come back.
     "kv_offload_reload_ms",

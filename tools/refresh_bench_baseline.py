@@ -101,6 +101,12 @@ def merge(committed: dict, fresh: dict, platform: str, scale: str) -> dict:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--scale", default="tiny", choices=["full", "tiny"])
+    parser.add_argument("--only", default=None, metavar="ARM",
+                        help="run a single perfbench measure_<ARM> arm "
+                        "instead of the whole suite (e.g. --only "
+                        "autoscale) — fills just that family's "
+                        "NO-BASELINE holes, minutes instead of the "
+                        "full harness")
     parser.add_argument("--dry-run", action="store_true",
                         help="print what would be added; write nothing")
     args = parser.parse_args(argv)
@@ -113,11 +119,21 @@ def main(argv=None) -> int:
     platform = jax.devices()[0].platform
     from workloads import perfbench
 
-    fresh = perfbench.run(args.scale, pool_with=None)
-    fresh.pop("train_step_flops", None)
-    # The kernel table ships from chip data when the artifact has any;
-    # the fresh run's picks only fill hosts with no sweep at all.
-    fresh.update(kernel_picks_from_artifact(committed) or {})
+    if args.only:
+        fn = getattr(perfbench, f"measure_{args.only}", None)
+        if fn is None:
+            parser.error(
+                f"no perfbench arm measure_{args.only}; see "
+                "workloads/perfbench.py"
+            )
+        fresh = fn(perfbench.BenchScale.named(args.scale))
+    else:
+        fresh = perfbench.run(args.scale, pool_with=None)
+        fresh.pop("train_step_flops", None)
+        # The kernel table ships from chip data when the artifact has
+        # any; the fresh run's picks only fill hosts with no sweep at
+        # all.
+        fresh.update(kernel_picks_from_artifact(committed) or {})
 
     merged = merge(committed, fresh, platform, args.scale)
     added = merged["baseline_addendum"]["keys"]

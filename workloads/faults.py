@@ -45,6 +45,14 @@ fault domain):
     Scheduling consecutive crossings (``crash_loop_schedule`` below)
     is the repeat-crash-on-restart scenario the crash-loop detector
     quarantines.
+  * ``scale_spawn_fail`` — crossed by the AUTOSCALER
+    (``workloads/autoscaler.py``) once per scale-UP spawn attempt,
+    before the new engine is built: a fault here means elastic
+    capacity cannot arrive (quota exhausted, scheduler refused the
+    pod, a dead provisioning API), which is exactly the condition the
+    degradation ladder (brownout, preemption-via-offload) exists to
+    survive.  Chaos runs schedule it DURING step-load spikes so
+    resizes race the ladder deterministically.
 
 Two scheduling modes, both deterministic:
 
@@ -85,12 +93,14 @@ ENGINE_SEAMS = (
 
 # Replica-level seams (the Fleet's failover machinery recovers from
 # these ACROSS fault domains; ``replica_respawn`` is the supervisor's
-# resurrection seam — see module docstring).
+# resurrection seam, ``scale_spawn_fail`` the autoscaler's scale-up
+# spawn seam — see module docstring).
 REPLICA_SEAMS = (
     "replica_crash",
     "replica_hang",
     "replica_slow",
     "replica_respawn",
+    "scale_spawn_fail",
 )
 
 SEAMS = ENGINE_SEAMS + REPLICA_SEAMS
@@ -309,6 +319,18 @@ def self_check(verbose: bool = True) -> int:
     assert fired == 3, fired
     offset = crash_loop_schedule(2, first=4)
     assert offset == {"replica_respawn": [4, 5]}, offset
+    # The autoscaler's scale-up spawn seam is first-class: scheduled
+    # crossings fire (capacity "cannot arrive"), later crossings pass
+    # (the retry after backoff succeeds).
+    spawn = FaultInjector({"scale_spawn_fail": [1, 2]})
+    spawn_fired = 0
+    for _ in range(3):
+        try:
+            spawn.check("scale_spawn_fail")
+        except InjectedFault as e:
+            assert e.seam == "scale_spawn_fail"
+            spawn_fired += 1
+    assert spawn_fired == 2, spawn_fired
     for bad_loop in (
         lambda: crash_loop_schedule(0),
         lambda: crash_loop_schedule(1, first=0),
@@ -365,8 +387,8 @@ def self_check(verbose: bool = True) -> int:
                 raise
     if verbose:
         print("faults selfcheck OK: schedule, replica seams, crash-loop "
-              "schedules, seeded replay, reset, max_fires, inert, "
-              "validation")
+              "schedules, spawn seam, seeded replay, reset, max_fires, "
+              "inert, validation")
     return 0
 
 

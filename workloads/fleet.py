@@ -168,6 +168,10 @@ class FleetRequest:
     slo_class: str | None = None
     slo_attained: bool | None = None
     attempts: list = field(default_factory=list)
+    # Preemption-via-offload (degradation ladder step 2): times this
+    # stream was parked and requeued uncharged — kept separate from
+    # ``failovers`` because being low priority is not a fault.
+    preemptions: int = 0
 
     @property
     def done(self) -> bool:
@@ -444,6 +448,19 @@ class Fleet:
         # work its surviving capacity cannot absorb — and the bound
         # grows back the moment the supervisor resurrects a replica.
         self.max_pending_per_replica = max_pending_per_replica
+        # Brownout knob (degradation ladder step 1, set by the
+        # autoscaler): < 1.0 tightens the admission bound to this
+        # fraction while overload outruns elastic capacity; QueueFull
+        # messages name the brownout so rejected clients know the shed
+        # is deliberate and temporary.
+        self.admission_factor = 1.0
+        # Degradation ladder step 2: SLO classes parked out of dispatch
+        # (their queued requests hold position but are skipped) while
+        # the autoscaler's preemption-via-offload protects the
+        # interactive class.  Empty outside ladder level 2 — an
+        # abandoned non-empty set would starve the class, so only the
+        # autoscaler's ladder transitions write it.
+        self.parked_classes: set[str] = set()
         self.max_failovers = max_failovers
         self._faults = fault_injector
         if hang_timeout_s is not None and hang_timeout_s <= 0:
@@ -486,6 +503,13 @@ class Fleet:
         self.requests_failed = 0
         self.failover_requeues = 0  # charged (true-fault) failovers
         self.drain_requeues = 0  # uncharged (health/operator) failovers
+        # Preemption-via-offload (degradation ladder step 2): streams
+        # parked by preempt() and requeued uncharged, plus the
+        # preempt -> next-resumed-token windows the bench publishes as
+        # autoscale_preempt_resume_ms.
+        self.preemptions = 0
+        self.preempt_resume_s: list[float] = []
+        self._preempted_at: dict[str, float] = {}
         self.replica_crashes = 0
         self.replica_hangs = 0
         self.replicas_added = 0
@@ -554,27 +578,43 @@ class Fleet:
         return {r.index: r.state for r in self.replicas}
 
     @property
+    def dispatchable_count(self) -> int:
+        """Replicas the router can hand NEW work to right now (ACTIVE
+        and not health-paused) — the capacity the capacity-aware
+        admission bound scales with.  DRAINING and paused replicas
+        still finish their in-flight work, but queueing fresh load
+        against capacity that accepts none of it is exactly the
+        unbounded-growth mode the bound exists to prevent."""
+        return sum(1 for r in self.replicas if r.dispatchable)
+
+    @property
     def admission_bound(self) -> int | None:
         """The fleet queue's CURRENT admission bound: the static
         ``max_pending`` when set, the capacity-scaled
-        ``max_pending_per_replica x max(1, active replicas)`` when that
-        knob is set (never zero — a fully-degraded fleet still queues
-        one replica's worth while recovery runs), else None
-        (unbounded)."""
+        ``max_pending_per_replica x max(1, dispatchable replicas)``
+        when that knob is set (never zero — a fully-degraded fleet
+        still queues one replica's worth while recovery runs), else
+        None (unbounded).  ``admission_factor`` < 1 TIGHTENS whichever
+        bound is in force (the autoscaler's brownout — degradation
+        ladder step 1); it never loosens one and never bounds an
+        unbounded fleet."""
+        bound = None
         if self.max_pending is not None:
-            return self.max_pending
-        if self.max_pending_per_replica is not None:
+            bound = self.max_pending
+        elif self.max_pending_per_replica is not None:
             import math
 
-            active = sum(1 for r in self.replicas if r.state == ACTIVE)
             # ceil of the exact product: a fractional per-replica
             # budget (the supervisor's max_pending/n conversion) yields
             # the operator's EXACT bound at full capacity instead of a
             # rounded-up one.
-            return max(1, math.ceil(
-                self.max_pending_per_replica * max(1, active)
+            bound = max(1, math.ceil(
+                self.max_pending_per_replica
+                * max(1, self.dispatchable_count)
             ))
-        return None
+        if bound is not None and self.admission_factor < 1.0:
+            bound = max(1, int(bound * self.admission_factor))
+        return bound
 
     def _revival_pending(self) -> bool:
         hook = self.revival_hook
@@ -654,13 +694,20 @@ class Fleet:
             if bound is not None and len(self.queue) >= bound:
                 self.queue_rejections += 1
                 scaled = (
-                    " (capacity-aware: scaled to the alive replica "
-                    "count)" if self.max_pending is None else ""
+                    f" (capacity-aware: scaled to "
+                    f"{self.dispatchable_count} dispatchable "
+                    f"replica(s))" if self.max_pending is None else ""
+                )
+                brownout = (
+                    f" (brownout: admission tightened to "
+                    f"{self.admission_factor:g}x while overload "
+                    f"outruns scale-up)"
+                    if self.admission_factor < 1.0 else ""
                 )
                 raise QueueFull(
                     f"fleet queue is full ({len(self.queue)} >= "
-                    f"max_pending {bound}{scaled}); resubmit after "
-                    "completions drain it"
+                    f"max_pending {bound}{scaled}{brownout}); resubmit "
+                    "after completions drain it"
                 )
             rid = rid if rid is not None else f"fleet-{next(self._ids)}"
             if rid in self._reqs and not self._reqs[rid].done:
@@ -707,6 +754,70 @@ class Fleet:
                 return bool(rep.engine.cancel(rid))
             return False
 
+    def preempt(self, rid: str) -> bool:
+        """Preemption-via-offload (degradation ladder step 2): pull one
+        dispatched request back off its replica statuslessly
+        (``ServeEngine.preempt``: pipelined state drained, prompt
+        prefix pages pushed to the host offload tier when armed) and
+        requeue it at the router-queue BACK, uncharged (being low
+        priority is not the request's fault), for later resumption via
+        the ordinary replay path: the re-dispatch re-prefills prompt +
+        emitted tokens (prefix lookup reloads the parked pages), so
+        the resumed greedy stream is an EXACT continuation.  Only
+        requests that had actually ADMITTED (pages committed, work
+        started) count as preemptions and open a preempt-resume
+        window; a rid still waiting in the replica's own queue just
+        moves back to the router (its class park keeps it there) with
+        nothing counted — no pages were parked and no work was lost.
+        Returns True iff the rid was pulled back; router-queued, done,
+        or unreachable rids return False."""
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("fleet is closed")
+            fr = self._reqs.get(rid)
+            if fr is None or fr.done or any(q is fr for q in self.queue):
+                return False
+            rep = (
+                self.replicas[fr.replica] if fr.replica is not None
+                else None
+            )
+            if rep is None or rid not in rep.rids or rep.state == DEAD:
+                return False
+            try:
+                ereq = rep.engine.preempt(rid)
+            except EngineClosed:
+                return False
+            if ereq is None:
+                return False
+            rep.rids.pop(rid, None)
+            self._close_attempt(fr, ereq, "preempt")
+            fr.tokens.extend(int(t) for t in ereq.tokens)
+            fr.replica = None
+            fr.segments += 1
+            if len(fr.tokens) >= fr.max_new_tokens or (
+                fr.eos_token is not None
+                and fr.tokens
+                and fr.tokens[-1] == fr.eos_token
+            ):
+                # The stream is already bit-complete: finishing it ok
+                # beats requeueing a zero-budget replay.
+                self._finished_buffer.append(
+                    self._finish_terminal(fr, "ok")
+                )
+                return True
+            if ereq.t_admit is not None:
+                # Real work was displaced: count it and open the
+                # park -> first-resumed-token window the bench
+                # publishes.  A never-admitted rid pulled off a
+                # replica's queue parked nothing — counting it would
+                # let plain queue-wait pollute the resume metric.
+                self.preemptions += 1
+                fr.preemptions += 1
+                self._preempted_at[rid] = time.perf_counter()
+            fr.status = "queued"
+            self.queue.append(fr)  # BACK: parked bulk yields the spike
+            return True
+
     # ---- terminal bookkeeping -------------------------------------------
 
     def _finish_terminal(
@@ -717,6 +828,7 @@ class Fleet:
         fr.status = status
         fr.error = error
         fr.t_done = time.perf_counter()
+        self._preempted_at.pop(fr.rid, None)
         self._close_attempt(fr, None, status)
         fr.replica = None
         counter = {
@@ -1068,6 +1180,12 @@ class Fleet:
             if fr.t_deadline is not None and now >= fr.t_deadline:
                 finished.append(self._finish_terminal(fr, "expired"))
                 continue
+            if fr.slo_class in self.parked_classes:
+                # Ladder step 2: the class is parked — hold position
+                # in the queue (deadlines above still apply) until the
+                # autoscaler unparks it.
+                still_queued.append(fr)
+                continue
             if not candidates:
                 still_queued.append(fr)
                 continue
@@ -1240,6 +1358,10 @@ class Fleet:
             )
             self._t_fault = None
             self._recovery_rids.clear()
+        if ereq.rid in self._preempted_at and ereq.tokens:
+            self.preempt_resume_s.append(
+                time.perf_counter() - self._preempted_at.pop(ereq.rid)
+            )
         fr.tokens.extend(int(t) for t in ereq.tokens)
         fr.segments += 1
         fr.replica = None
@@ -1280,6 +1402,12 @@ class Fleet:
                 )
                 self._t_fault = None
                 self._recovery_rids.clear()
+            if rid in self._preempted_at and ereq.tokens:
+                # Preempt -> first token of the resumed segment: the
+                # bench's autoscale_preempt_resume_ms window.
+                self.preempt_resume_s.append(
+                    time.perf_counter() - self._preempted_at.pop(rid)
+                )
 
     def step(self) -> list[FleetRequest]:
         """One fleet iteration: route health events and apply every
@@ -1495,14 +1623,72 @@ class TrafficGen:
     # split).
     class_mix: tuple = (("interactive", 3.0), ("bulk", 1.0))
 
-    def schedule(self, n: int) -> list[tuple[float, list[int], int]]:
-        """n arrivals as (t_offset_s, prompt, max_new_tokens)."""
+    @staticmethod
+    def step_profile(start_s: float, duration_s: float, factor: float):
+        """A rate profile for ``schedule(profile=...)``: arrival rate x
+        ``factor`` inside the ``[start_s, start_s + duration_s)``
+        window, x1 outside — the step-load trace the autoscaler bench
+        drives (rate x4 for a bounded window, then back)."""
+        if duration_s <= 0:
+            raise ValueError(
+                f"step duration_s must be > 0, got {duration_s}"
+            )
+        if factor <= 0:
+            raise ValueError(f"step factor must be > 0, got {factor}")
+
+        def profile(t: float) -> float:
+            return factor if start_s <= t < start_s + duration_s else 1.0
+
+        return profile
+
+    @staticmethod
+    def ramp_profile(start_s: float, duration_s: float, peak: float):
+        """A rate profile for ``schedule(profile=...)``: x1 until
+        ``start_s``, then a linear climb to ``peak`` over
+        ``duration_s``, holding ``peak`` after — the gradual-overload
+        trace (does hysteresis track a slow climb without flapping)."""
+        if duration_s <= 0:
+            raise ValueError(
+                f"ramp duration_s must be > 0, got {duration_s}"
+            )
+        if peak <= 0:
+            raise ValueError(f"ramp peak must be > 0, got {peak}")
+
+        def profile(t: float) -> float:
+            if t < start_s:
+                return 1.0
+            if t >= start_s + duration_s:
+                return peak
+            return 1.0 + (peak - 1.0) * (t - start_s) / duration_s
+
+        return profile
+
+    def schedule(
+        self, n: int, profile=None,
+    ) -> list[tuple[float, list[int], int]]:
+        """n arrivals as (t_offset_s, prompt, max_new_tokens).
+
+        ``profile`` optionally modulates the arrival RATE as a function
+        of schedule time (``step_profile`` / ``ramp_profile`` above, or
+        any ``t -> factor`` callable).  The rng draw SEQUENCE is
+        profile-independent — prompts, budgets and the burst chain are
+        bit-identical across profiles for a fixed seed; only the
+        inter-arrival gaps rescale — so a step-load trace serves
+        exactly the calm trace's requests, compressed in time."""
         rng = random.Random(self.seed)
         out = []
         t = 0.0
         burst = False
         for _ in range(n):
             rate = self.rate_rps * (self.burst_factor if burst else 1.0)
+            if profile is not None:
+                factor = float(profile(t))
+                if factor <= 0:
+                    raise ValueError(
+                        f"rate profile must return > 0, got {factor} "
+                        f"at t={t}"
+                    )
+                rate *= factor
             t += rng.expovariate(rate)
             stay = self.burst_dwell if burst else self.calm_dwell
             if rng.random() > stay:
@@ -1522,14 +1708,18 @@ class TrafficGen:
         return out
 
     def schedule_classed(
-        self, n: int,
+        self, n: int, profile=None,
     ) -> list[tuple[float, list[int], int, str]]:
         """``schedule(n)`` with a per-arrival SLO class drawn from
         ``class_mix`` — the per-class arrival streams the attainment
         bench and the SLO scheduler consume.  The class draw uses its
         OWN seeded rng, so the arrival process, prompts and budgets
         stay bit-identical to the unclassed schedule (tagging cannot
-        move tokens, starting with the generator)."""
+        move tokens, starting with the generator) — and, because the
+        draw sequence is positional, a rate ``profile`` changes
+        neither the class sequence nor the mix: a step-load spike
+        serves the calm trace's exact class assignment, compressed in
+        time."""
         if not self.class_mix:
             raise ValueError("schedule_classed needs a non-empty class_mix")
         names = [name for name, _ in self.class_mix]
@@ -1537,8 +1727,46 @@ class TrafficGen:
         rng = random.Random((self.seed << 8) ^ 0x510C1A55)
         return [
             (t, prompt, new, rng.choices(names, weights)[0])
-            for t, prompt, new in self.schedule(n)
+            for t, prompt, new in self.schedule(n, profile)
         ]
+
+    @staticmethod
+    def schedule_stats(schedule, window_s: float = 1.0) -> dict:
+        """Reproducibility stats for a generated schedule (the
+        autoscaler bench logs these next to its measurements so a
+        step-load trace is auditable): arrival count, span, mean rate,
+        the peak rate over any sliding ``window_s`` window (the spike
+        the autoscaler must absorb), prompt/budget token totals, and —
+        for classed schedules — the per-class arrival counts."""
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        entries = list(schedule)
+        out = {
+            "arrivals": len(entries),
+            "span_s": 0.0,
+            "mean_rps": 0.0,
+            "peak_rps": 0.0,
+            "prompt_tokens": sum(len(e[1]) for e in entries),
+            "budget_tokens": sum(int(e[2]) for e in entries),
+        }
+        if not entries:
+            return out
+        offsets = [float(e[0]) for e in entries]
+        span = max(offsets) - min(offsets)
+        out["span_s"] = round(span, 6)
+        out["mean_rps"] = round(len(entries) / max(span, 1e-9), 3)
+        peak, lo = 0, 0
+        for hi in range(len(offsets)):
+            while offsets[hi] - offsets[lo] > window_s:
+                lo += 1
+            peak = max(peak, hi - lo + 1)
+        out["peak_rps"] = round(peak / window_s, 3)
+        if entries and len(entries[0]) > 3:
+            counts: dict[str, int] = {}
+            for e in entries:
+                counts[e[3]] = counts.get(e[3], 0) + 1
+            out["class_counts"] = dict(sorted(counts.items()))
+        return out
 
 
 def drive_open_loop(
@@ -1599,6 +1827,15 @@ class FleetServer:
         then a final ``data: {"done": true, "status": ..., "rid": ...}``.
         Backpressure maps to HTTP 429 (QueueFull), validation to 400.
       * ``GET /healthz`` — fleet liveness + per-replica states JSON.
+      * ``POST /drain/<i>`` / ``POST /undrain/<i>`` — the operator
+        seam over HTTP: stop routing new work to replica ``i`` (its
+        in-flight work finishes there) / take it back.  ``/healthz``
+        already reported the drain states; these make them actionable
+        remotely.
+      * ``POST /clear/<chip_id>`` — lift a supervisor quarantine for
+        one chip slot (409 when no supervisor is armed, 404 for an
+        unknown slot): the remote pendant of
+        ``FleetSupervisor.clear()``, which was in-process only.
 
     ``start()`` binds the port (0 = ephemeral; the bound port lands
     back on ``.port``) and spins the fleet's driver thread; handlers
@@ -1606,15 +1843,19 @@ class FleetServer:
 
     def __init__(
         self, fleet: Fleet, port: int = 0, poll_s: float = 0.002,
-        supervisor=None,
+        supervisor=None, autoscaler=None,
     ):
         self.fleet = fleet
         self.port = port
         self.poll_s = poll_s
         # Optional FleetSupervisor (workloads/supervisor.py): the driver
         # thread then runs the SUPERVISED loop (heal pass per step) and
-        # /healthz reports per-chip-slot supervision states.
+        # /healthz reports per-chip-slot supervision states.  An armed
+        # FleetAutoscaler (workloads/autoscaler.py) takes over the
+        # driver loop (its step wraps the supervisor's, which wraps the
+        # fleet's) and /healthz reports the control-loop state too.
         self.supervisor = supervisor
+        self.autoscaler = autoscaler
         self._httpd = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -1624,6 +1865,7 @@ class FleetServer:
 
         fleet, poll_s, stop = self.fleet, self.poll_s, self._stop
         supervisor = self.supervisor
+        autoscaler = self.autoscaler
 
         class Handler(http.server.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -1654,9 +1896,84 @@ class FleetServer:
                 }
                 if supervisor is not None:
                     health["supervisor"] = supervisor.states()
+                if autoscaler is not None:
+                    health["autoscaler"] = autoscaler.states()
                 self._json(200, health)
 
+            def _operator(self, verb: str, arg: str) -> None:
+                """The remote operator seam: drain/undrain a replica by
+                index, clear a quarantined chip slot by id.  Responses
+                carry the resulting state so a curl loop can watch the
+                transition it caused."""
+                try:
+                    if verb in ("drain", "undrain"):
+                        if not arg.isdigit():
+                            self._json(400, {
+                                "error": f"/{verb}/<replica-index> wants "
+                                         f"an integer, got {arg!r}",
+                            })
+                            return
+                        index = int(arg)
+                        # Decide under the lock, RESPOND outside it: a
+                        # client that stalls reading its response must
+                        # never hold the fleet driver loop hostage.
+                        code, body = None, None
+                        with fleet._lock:
+                            if not 0 <= index < len(fleet.replicas):
+                                code, body = 404, {
+                                    "error": f"no replica {index} "
+                                             f"(fleet has "
+                                             f"{len(fleet.replicas)})",
+                                }
+                            elif fleet.replicas[index].state == DEAD:
+                                code, body = 409, {
+                                    "error": f"replica {index} is dead; "
+                                             "drain/undrain applies to "
+                                             "live replicas",
+                                }
+                            else:
+                                if verb == "drain":
+                                    fleet.drain(index)
+                                else:
+                                    fleet.resume(index)
+                                code, body = 200, {
+                                    "ok": True, "replica": index,
+                                    "state": fleet.replicas[index].state,
+                                }
+                        self._json(code, body)
+                        return
+                    # verb == "clear": a supervisor quarantine lift.
+                    if supervisor is None:
+                        self._json(409, {
+                            "error": "no supervisor is armed; /clear "
+                                     "lifts supervisor quarantines "
+                                     "(--supervise)",
+                        })
+                        return
+                    try:
+                        supervisor.clear(arg)
+                    except KeyError:
+                        self._json(404, {
+                            "error": f"no supervised slot for chip "
+                                     f"{arg!r} (slots: "
+                                     f"{sorted(supervisor.states())})",
+                        })
+                        return
+                    self._json(200, {
+                        "ok": True, "chip_id": arg,
+                        "state": supervisor.states().get(arg),
+                    })
+                except Exception as e:  # noqa: BLE001 — an operator
+                    # endpoint must answer, not kill the handler thread.
+                    self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
             def do_POST(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 2 and parts[0] in (
+                    "drain", "undrain", "clear",
+                ):
+                    self._operator(parts[0], parts[1])
+                    return
                 if self.path != "/v1/generate":
                     self.send_error(404)
                     return
@@ -1719,10 +2036,12 @@ class FleetServer:
             ("", self.port), Handler
         )
         self.port = self._httpd.server_address[1]
-        driver = (
-            self.supervisor.serve_forever if self.supervisor is not None
-            else self.fleet.serve_forever
-        )
+        if self.autoscaler is not None:
+            driver = self.autoscaler.serve_forever
+        elif self.supervisor is not None:
+            driver = self.supervisor.serve_forever
+        else:
+            driver = self.fleet.serve_forever
         for name, target in (
             ("fleet-http", self._httpd.serve_forever),
             ("fleet-driver", lambda: driver(self._stop)),

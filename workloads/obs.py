@@ -325,6 +325,68 @@ SUPERVISOR_METRICS: tuple[MetricSpec, ...] = (
     ),
 )
 
+# Autoscaler-level metric families (workloads/autoscaler.py;
+# AutoscalerObserver below).  Same three-consumer contract as the other
+# catalogs: bind_registry, the lint test, and the rendered
+# docs/OBSERVABILITY.md catalog all read this spec.
+AUTOSCALER_METRICS: tuple[MetricSpec, ...] = (
+    MetricSpec(
+        "autoscaler_decisions_total", "counter", ("autoscaler", "action"),
+        "control-loop decisions by action (scale_up / scale_down / "
+        "spawn_failed / brownout / brownout_clear / preempt / "
+        "preempt_clear) — the audit trail of every actuation the "
+        "closed loop took",
+    ),
+    MetricSpec(
+        "autoscaler_scale_ups_total", "counter", ("autoscaler",),
+        "replicas added by the control loop (engine_factory spawn + "
+        "bit-identical canary probe passed + add_replica; supervised "
+        "fleets adopt the new slot so it heals like a founder)",
+    ),
+    MetricSpec(
+        "autoscaler_scale_downs_total", "counter", ("autoscaler",),
+        "replicas retired by the control loop (graceful drain of the "
+        "least-loaded ACTIVE replica, removed once idle — never below "
+        "min_replicas, never the last dispatchable one)",
+    ),
+    MetricSpec(
+        "autoscaler_spawn_failures_total", "counter", ("autoscaler",),
+        "failed scale-up attempts (scale_spawn_fail seam fault, engine "
+        "factory error, or canary divergence) — each escalates the "
+        "up-gate backoff; persistent failure is what drops the fleet "
+        "onto the degradation ladder",
+    ),
+    MetricSpec(
+        "autoscaler_brownouts_total", "counter", ("autoscaler",),
+        "degradation-ladder step-1 entries: the capacity-aware "
+        "admission bound tightened to brownout_factor while overload "
+        "outran elastic capacity (typed QueueFull names the brownout)",
+    ),
+    MetricSpec(
+        "autoscaler_preemptions_total", "counter", ("autoscaler",),
+        "degradation-ladder step-2 preemptions: running bulk-class "
+        "streams parked via host offload (RadixKV.park) and requeued "
+        "uncharged for post-spike resumption as exact continuations",
+    ),
+    MetricSpec(
+        "autoscaler_ladder_level", "gauge", ("autoscaler",),
+        "current degradation-ladder level (0 = normal, 1 = brownout, "
+        "2 = preemption-via-offload; scrape-time)",
+    ),
+    MetricSpec(
+        "autoscaler_replicas_target", "gauge", ("autoscaler",),
+        "replicas the control loop currently wants (provisioned plus "
+        "in-flight resurrections, clamped to [min_replicas, "
+        "max_replicas]; scrape-time)",
+    ),
+    MetricSpec(
+        "autoscaler_replicas_live", "gauge", ("autoscaler",),
+        "replicas actually alive in the fleet right now (target vs "
+        "live is the convergence lag the step-load bench measures; "
+        "scrape-time)",
+    ),
+)
+
 
 @dataclass
 class RequestSpan:
@@ -1138,6 +1200,109 @@ class SupervisorObserver:
         for secs in fresh:
             reg.observe_seconds("supervisor_restore", secs, labels)
         self._restores_pushed += len(fresh)
+
+
+class AutoscalerObserver:
+    """Autoscaler-level Prometheus bridge (workloads/autoscaler.py):
+    decision/actuation counters, the degradation-ladder level and the
+    replicas-target-vs-live gauges, NEXT TO the fleet, supervisor and
+    per-replica engine series on one shared registry.
+
+    Same discipline as the other bridges: inert (host counters only,
+    never control state), jax-free, counters pushed as deltas against
+    the autoscaler's running totals at each ``poll()``."""
+
+    def __init__(self, *, name: str = "0"):
+        self.name = name
+        self._registry = None
+        self._labels: dict = {}
+        self._autoscaler = None
+        self._pushed: dict[str, float] = {}
+
+    # Scrape-time readers; ``e`` is the bound FleetAutoscaler (the
+    # lint's reader-regex contract shared with the other bridges).
+    _AUTOSCALER_GAUGE_READERS = {
+        "autoscaler_ladder_level": lambda e: [
+            ({}, float(e.ladder_level))
+        ],
+        "autoscaler_replicas_target": lambda e: [
+            ({}, float(e.target_replicas))
+        ],
+        "autoscaler_replicas_live": lambda e: [
+            ({}, float(len(e.fleet.alive)))
+        ],
+    }
+
+    # Counter family -> FleetAutoscaler attribute with the running
+    # total.
+    _AUTOSCALER_COUNTERS = {
+        "autoscaler_scale_ups_total": "scale_ups",
+        "autoscaler_scale_downs_total": "scale_downs",
+        "autoscaler_spawn_failures_total": "spawn_failures",
+        "autoscaler_brownouts_total": "brownouts",
+        "autoscaler_preemptions_total": "preemptions_total",
+    }
+
+    def bind_registry(self, reg, labels: dict | None = None) -> None:
+        self._registry = reg
+        self._labels = dict(labels or {})
+        self._labels.setdefault("autoscaler", self.name)
+        for m in AUTOSCALER_METRICS:
+            if m.type == "histogram":
+                reg.describe(m.name, m.help, buckets=SERVE_SECONDS_BUCKETS)
+            else:
+                reg.describe(m.name, m.help)
+        for name, reader in self._AUTOSCALER_GAUGE_READERS.items():
+            reg.register_gauge(
+                name, lambda reader=reader: self._gauge(reader),
+                key=f"autoscaler:{self.name}",
+            )
+
+    def unbind_registry(self) -> None:
+        reg, self._registry = self._registry, None
+        if reg is None:
+            return
+        for name in self._AUTOSCALER_GAUGE_READERS:
+            reg.unregister_gauge(name, key=f"autoscaler:{self.name}")
+        self._autoscaler = None
+
+    def _gauge(self, value_fn) -> list[tuple[dict, float]]:
+        asc = self._autoscaler
+        if asc is None:
+            return []
+        try:
+            return [
+                ({**self._labels, **labels}, float(v))
+                for labels, v in value_fn(asc)
+            ]
+        except Exception:
+            return []  # a gauge must never fail a scrape mid-teardown
+
+    # ---- autoscaler-facing hooks ----------------------------------------
+
+    def _bind(self, autoscaler) -> None:
+        self._autoscaler = autoscaler
+
+    def _autoscaler_poll_end(self, autoscaler) -> None:
+        reg = self._registry
+        if reg is None:
+            return
+        labels = self._labels
+        for metric, attr in self._AUTOSCALER_COUNTERS.items():
+            total = float(getattr(autoscaler, attr, 0))
+            delta = total - self._pushed.get(metric, 0.0)
+            if delta:
+                reg.inc(metric, labels, delta)
+                self._pushed[metric] = total
+        for action, total in autoscaler.decisions.items():
+            key = f"autoscaler_decisions_total:{action}"
+            delta = float(total) - self._pushed.get(key, 0.0)
+            if delta:
+                reg.inc(
+                    "autoscaler_decisions_total",
+                    {**labels, "action": action}, delta,
+                )
+                self._pushed[key] = float(total)
 
 
 def _us(t: float, t0: float) -> float:

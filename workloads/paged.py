@@ -606,6 +606,50 @@ class RadixKV:
                 break
         return freed
 
+    def park(
+        self, tokens: list[int], salt: str = "", spill=None,
+    ) -> int:
+        """Preemption-via-offload: push THIS path's resident pages out
+        to the host tier NOW (LRU coldness notwithstanding), so a
+        preempted stream's prefix stops holding HBM the moment its slot
+        is reclaimed — the degradation ladder's step-2 primitive
+        (docs/SERVING.md "Elastic fleet & overload protection").  Walks
+        the ``tokens`` path under ``salt`` and spills every resident
+        page only the index holds (pool refcount 1 — a page another
+        live sequence still reads stays put); already-offloaded nodes
+        are skipped, and without a ``spill`` callback or host budget
+        nothing moves (graceful degrade: the pages stay resident and
+        ordinary LRU pressure evicts them later).  Returns the pages
+        parked; resumption is just a lookup — the reload callback
+        brings them back bit-exactly."""
+        if spill is None:
+            return 0
+        node = self._roots.get(salt)
+        if node is None:
+            return 0
+        ps, parked = self.page_size, 0
+        for i in range(len(tokens) // ps):
+            node = node.children.get(tuple(tokens[i * ps : (i + 1) * ps]))
+            if node is None:
+                break
+            if node.page is None:
+                continue  # already in the host tier
+            if self.ctrl.refcounts.get(node.page) != 1:
+                continue  # a live reader still holds it
+            if not self._host_budget_left():
+                break
+            blob = spill(node.page)
+            if blob is None:
+                break
+            self.ctrl.release_page(node.page)
+            node.page = None
+            node.host = blob
+            self._resident -= 1
+            self._offloaded += 1
+            self.spills += 1
+            parked += 1
+        return parked
+
     def clear(self) -> None:
         """Drop the whole index: resident pages release back to the
         pool, host blobs free — the close/quarantine-flush path (an

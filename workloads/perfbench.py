@@ -1725,6 +1725,282 @@ def measure_selfheal(scale: BenchScale) -> dict:
     }
 
 
+def measure_autoscale(scale: BenchScale) -> dict:
+    """Closed-loop autoscaling economics (docs/SERVING.md "Elastic
+    fleet & overload protection"), on the measure_selfheal engine shape
+    (pipelined, radix prefix cache + host offload so preemption can
+    park pages, greedy so streams bit-compare):
+
+      1. **Step-load recovery** — a seeded TrafficGen STEP schedule
+         (arrival rate x4 for a bounded window; the calm rate is
+         calibrated to ~70% of this host's measured one-replica service
+         rate so the spike genuinely overloads one replica on any
+         machine) drives a fleet that starts at ONE replica with the
+         ``FleetAutoscaler`` armed (1..N replicas, fast seeded-jitter
+         cooldowns).  Every ok token stream is ASSERTED bit-identical
+         to a FIXED-size oracle fleet of N replicas serving the same
+         schedule (a correctness lie hard-fails the arm); the
+         robustness outcomes publish honestly:
+         ``autoscale_recover_slo_ms`` (signal breach -> signal clear),
+         ``autoscale_overprovision_chip_s`` (extra chip-seconds held
+         while the signal was already clear — the price of elasticity,
+         integrated until the loop converges back to one replica), and
+         the up/down actuation counts.
+      2. **Preemption-via-offload** — a fleet PINNED at its
+         ``max_replicas`` (capacity cannot arrive) serves one long
+         bulk-class stream; an interactive burst then drives the
+         degradation ladder to step 2, which parks the bulk stream's
+         prefix pages in the host tier and requeues it uncharged.  The
+         parked stream must RESUME as an exact continuation
+         (bit-identical to an unpreempted oracle run), publishing
+         ``autoscale_preempt_resume_ms`` (park -> first resumed
+         token)."""
+    import statistics
+
+    from .autoscaler import FleetAutoscaler
+    from .backoff import Backoff
+    from .fleet import Fleet, TrafficGen, drive_open_loop
+    from .serve import ServeEngine
+
+    batch, ps = scale.batch, scale.page_size
+    chunk = ps
+    hi = scale.serve_chunks[1]
+    prompt_len = scale.decode_prompt
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
+        n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=prompt_len + 1 + hi * chunk,
+    )
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype),
+        init_params(config, jax.random.PRNGKey(0)),
+    )
+    n_max = 3
+    n_req = 8 * batch
+    engine_kw = dict(
+        slots=batch, page_size=ps, chunk=chunk,
+        prompt_bucket=-(-prompt_len // ps) * ps, pipelined=True,
+        prefix_cache=True, kv_offload=True,
+    )
+
+    def factory(slot):
+        return ServeEngine(params, config, **engine_kw)
+
+    fast = Backoff(base_s=2e-3, max_s=2e-2, jitter=0.1, seed=7)
+
+    def build_autoscaler(fleet, *, n_min, cap, **kw):
+        asc = FleetAutoscaler(
+            fleet, factory, min_replicas=n_min, max_replicas=cap,
+            queue_wait_p99_target_s=0.25, depth_high=1.5,
+            clear_fraction=0.4, window_s=1.0,
+            up_backoff=fast, down_backoff=fast, down_consecutive=2,
+            **kw,
+        )
+        asc.calibrate_probe()
+        return asc
+
+    # Calibrate the calm arrival rate to THIS host: one warm replica's
+    # closed-loop service rate over a burn-in batch (requests/s), so
+    # the x4 step overloads one replica on any machine.  The first
+    # pass pays the XLA compiles and is NOT timed — a cold-compile
+    # "service rate" would undershoot the calm rate so far the spike
+    # never overloads anything.
+    cal = Fleet([factory(None)], hang_timeout_s=None)
+    gen0 = TrafficGen(
+        seed=13, rate_rps=1000.0, min_prompt=1, max_prompt=prompt_len,
+        min_new=1 + chunk, max_new=1 + hi * chunk,
+        vocab=config.vocab_size,
+    )
+    warm = [(p, nw) for _, p, nw in gen0.schedule(2 * batch)]
+    for p, nw in warm:
+        cal.submit(p, nw)
+    cal.run()  # compiles land here, off the clock
+    for p, nw in warm:
+        cal.submit(p, nw)
+    t0 = time.perf_counter()
+    cal.run()
+    service_rps = len(warm) / max(time.perf_counter() - t0, 1e-9)
+    cal.close()
+    calm_rps = max(1.0, 0.7 * service_rps)
+
+    gen = TrafficGen(
+        seed=13, rate_rps=calm_rps, min_prompt=1, max_prompt=prompt_len,
+        min_new=1 + chunk, max_new=1 + hi * chunk,
+        vocab=config.vocab_size,
+    )
+    calm_span = n_req / calm_rps
+    profile = TrafficGen.step_profile(
+        0.25 * calm_span, 0.25 * calm_span, 4.0
+    )
+    sched = gen.schedule(n_req, profile)
+    stats = TrafficGen.schedule_stats(sched)
+
+    def serve_fixed(n_rep: int) -> dict:
+        fleet = Fleet(
+            [factory(None) for _ in range(n_rep)],
+            chip_ids=[f"chip-{i}" for i in range(n_rep)],
+            hang_timeout_s=None,
+        )
+        for i in range(n_rep):  # warm every replica, off the clock
+            fleet.submit([1 + i], 1 + chunk)
+        fleet.run()
+        fleet.drain_completed()
+        streams = drive_open_loop(fleet, sched)
+        done = fleet.drain_completed()
+        statuses = {fr.status for fr in done}
+        if len(done) != n_req or statuses != {"ok"}:
+            raise RuntimeError(
+                f"autoscale bench oracle: {len(done)} finished with "
+                f"statuses {statuses}, expected {n_req} ok"
+            )
+        fleet.close()
+        return streams
+
+    oracle = serve_fixed(n_max)
+
+    fleet = Fleet([factory(None)], chip_ids=["chip-0"],
+                  hang_timeout_s=None)
+    fleet.submit([1], 1 + chunk)
+    fleet.run()
+    fleet.drain_completed()
+    asc = build_autoscaler(fleet, n_min=1, cap=n_max)
+    streams = drive_open_loop(asc, sched)
+    done = fleet.drain_completed()
+    statuses = {fr.status for fr in done}
+    if len(done) != n_req or statuses != {"ok"}:
+        raise RuntimeError(
+            f"autoscale bench: {len(done)} finished with statuses "
+            f"{statuses}, expected {n_req} ok"
+        )
+    # Positional compare: drive_open_loop fills its dict in schedule
+    # order, and the two runs' rid serials differ by their warm-up
+    # counts (1 vs n_max warm submissions).
+    if list(streams.values()) != list(oracle.values()):
+        raise RuntimeError(
+            "autoscale bench: autoscaled streams diverged from the "
+            "fixed-size oracle fleet — elasticity is supposed to be "
+            "invisible to tokens"
+        )
+    scaled_back = asc.wait_quiescent(timeout_s=30.0)
+    alive_end = len(fleet.alive)
+    recover = list(asc.recover_s)
+    overprov = asc.overprovision_chip_s
+    ups, downs = asc.scale_ups, asc.scale_downs
+    fleet.close()
+    if ups < 1:
+        raise RuntimeError(
+            "autoscale bench: the x4 step never triggered a scale-up "
+            f"(calm {calm_rps:.1f} rps vs service {service_rps:.1f} "
+            "rps) — the spike must overload one replica"
+        )
+    if not recover:
+        raise RuntimeError(
+            "autoscale bench: the breach window never closed — there "
+            "is no recovery latency to publish"
+        )
+
+    # ---- preemption-via-offload arm -------------------------------------
+    # Capacity pinned (min == max == 1): the ladder is the only lever.
+    long_new = 1 + hi * chunk
+    bulk_prompts = [
+        [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(77 + i), (prompt_len,), 0,
+            config.vocab_size, jnp.int32,
+        )]
+        for i in range(min(2, batch))
+    ]
+    burst = [(p, nw) for _, p, nw in gen0.schedule(3 * batch)]
+
+    def serve_preempt(autoscaled: bool):
+        fleet = Fleet([factory(None)], chip_ids=["chip-0"],
+                      hang_timeout_s=None)
+        fleet.submit([1], 1 + chunk)
+        fleet.run()
+        fleet.drain_completed()
+        asc = None
+        if autoscaled:
+            asc = build_autoscaler(
+                fleet, n_min=1, cap=1, severe_factor=1.2,
+                preempt_batch=batch,
+            )
+        bulk_rids = [
+            fleet.submit(p, long_new, slo_class="bulk")
+            for p in bulk_prompts
+        ]
+        fleet.step()  # the bulk streams are mid-decode
+        for p, nw in burst:
+            fleet.submit(p, nw, slo_class="interactive")
+        if asc is not None:
+            # Two control polls against the live burst: rung 1
+            # (brownout), then rung 2 (preempt) — the ladder fires
+            # WHILE the bulk streams still hold slots, whatever this
+            # host's step speed.
+            asc.poll()
+            asc.poll()
+        driver = asc if asc is not None else fleet
+        steps = 0
+        while not fleet.idle:
+            steps += 1
+            if steps > 20000:
+                raise RuntimeError(
+                    "autoscale bench preempt arm failed to converge "
+                    f"(ladder {getattr(asc, 'ladder_level', None)}, "
+                    f"queue {fleet.queue_depth})"
+                )
+            driver.step()
+        done = {fr.rid: fr for fr in fleet.drain_completed()}
+        statuses = {fr.status for fr in done.values()}
+        if statuses != {"ok"}:
+            raise RuntimeError(
+                f"autoscale bench preempt arm: statuses {statuses}, "
+                "expected all ok"
+            )
+        out = (
+            {rid: fr.tokens for rid, fr in done.items()},
+            fleet.preemptions,
+            list(fleet.preempt_resume_s),
+            [done[rid].tokens for rid in bulk_rids],
+        )
+        fleet.close()
+        return out
+
+    ref_streams, _, _, ref_bulk = serve_preempt(False)
+    got_streams, preempts, resume_s, got_bulk = serve_preempt(True)
+    if got_bulk != ref_bulk or got_streams != ref_streams:
+        raise RuntimeError(
+            "autoscale bench preempt arm: preempted-then-resumed "
+            "streams diverged from the unpreempted oracle — resumption "
+            "is supposed to be an exact continuation"
+        )
+    if preempts < 1 or not resume_s:
+        raise RuntimeError(
+            f"autoscale bench preempt arm: the ladder never preempted "
+            f"({preempts} preemptions, {len(resume_s)} resume windows)"
+        )
+
+    rec_ms = [s * 1000 for s in recover]
+    resume_ms = [s * 1000 for s in resume_s]
+    return {
+        "autoscale_replicas_min": 1,
+        "autoscale_replicas_max": n_max,
+        "autoscale_requests": n_req,
+        "autoscale_spike_factor": 4.0,
+        "autoscale_calm_rps": round(calm_rps, 2),
+        "autoscale_peak_rps": stats["peak_rps"],
+        "autoscale_scale_ups": ups,
+        "autoscale_scale_downs": downs,
+        "autoscale_scaled_back": bool(scaled_back and alive_end == 1),
+        "autoscale_recover_slo_ms": round(statistics.median(rec_ms), 2),
+        "autoscale_recover_slo_ms_min": round(min(rec_ms), 2),
+        "autoscale_recover_slo_ms_max": round(max(rec_ms), 2),
+        "autoscale_overprovision_chip_s": round(overprov, 3),
+        "autoscale_preempts": preempts,
+        "autoscale_preempt_resume_ms": round(
+            statistics.median(resume_ms), 2
+        ),
+    }
+
+
 def measure_admission(scale: BenchScale) -> dict:
     """Admission throughput: serial (one batch-1 prefill dispatch + one
     first-token readback PER admitted request) vs BATCHED (one multi-row
@@ -2930,6 +3206,7 @@ def run(scale_name: str = "full", pool_with: dict | None = None) -> dict:
     out.update(measure_fault_recovery(scale))
     out.update(measure_fleet(scale))
     out.update(measure_selfheal(scale))
+    out.update(measure_autoscale(scale))
     out.update(measure_admission(scale))
     out.update(measure_prefix_serve(scale))
     kvh = measure_kv_hierarchy(scale)

@@ -562,6 +562,8 @@ class ServeEngine:
         self.requests_expired = 0
         self.requests_failed = 0
         self.requests_retried = 0  # replay requeues after a quarantine
+        self.requests_preempted = 0  # statusless reclaims via preempt()
+        self.pages_parked = 0  # prefix pages pushed host-side at preempt
         self.queue_rejections = 0
         self.steps_quarantined = 0
         self.fault_recovery_s: list[float] = []  # quarantine -> next good readback
@@ -1276,6 +1278,81 @@ class ServeEngine:
                 req.group = None
                 return req
         return None
+
+    def preempt(self, rid: str) -> Request | None:
+        """Reclaim one request WITHOUT a terminal status — ``withdraw``
+        extended to RUNNING and mid-prefill requests: the degradation
+        ladder's preemption-via-offload seam (an external scheduler
+        parks a low-priority stream and replays prompt + emitted tokens
+        later; greedy continuations are bit-identical, the PR-4/6
+        replay contract).
+
+        For a slotted request the reclaim is a PARK, not a drop: any
+        pipelined in-flight state drains first (host mirrors sync, so
+        ``req.tokens`` is complete), the stream's PROMPT pages
+        re-register in the radix prefix index (refreshing LRU — they
+        are already there from admission when the cache is on), the
+        slot and its pages release, and with the host offload tier
+        armed the prefix pages push out to host RAM immediately
+        (``RadixKV.park``) so the preempted stream stops holding HBM
+        the moment it yields — resumption's prefix lookup reloads them
+        bit-exactly.  Fan-out group members are not preemptible
+        (``None``); cancel() is the API that can reach those.  Returns
+        the statusless Request, or None when the rid is not live
+        here."""
+        if self._closed:
+            raise EngineClosed("engine is closed")
+        got = self.withdraw(rid)
+        if got is not None:
+            self.requests_preempted += 1
+            return got
+        for plan in self._inflight_prefill:
+            if plan["req"].rid == rid:
+                if plan["req"].group is not None:
+                    return None
+                req = self._reclaim_partial(plan)
+                req.group = None
+                self.requests_preempted += 1
+                return req
+        target = None
+        for slot, req in self._slot_req.items():
+            if req.rid == rid:
+                target = slot
+                break
+        if target is None or self._slot_req[target].group is not None:
+            return None
+        # Sync pipelined device state before touching the slot; the
+        # drain may RETIRE the request (nothing left to preempt) or
+        # QUARANTINE it back into the queue (withdraw it there).
+        self._finished_buffer.extend(self._drain_all_pending())
+        if target not in self._slot_req or self._slot_req[target].rid != rid:
+            got = self.withdraw(rid)
+            if got is not None:
+                self.requests_preempted += 1
+            return got
+        req = self._slot_req[target]
+        salt = ""
+        if self.prefix is not None:
+            aidx = self._adapter_ids.get(req.adapter, 0)
+            salt = f"lora:{aidx}" if aidx else ""
+            # Re-register the prompt pages (idempotent: admission
+            # already inserted them on a prefix_cache engine; this
+            # refreshes LRU so the about-to-park path is coherent) —
+            # BEFORE the slot releases, while the seq still owns its
+            # table.
+            self.prefix.insert(
+                req.prompt,
+                self.ctrl.tables[self._seq_id(target, req)],
+                salt=salt,
+            )
+        req = self._release_slot(target)
+        if self.prefix is not None and self._kv_offload:
+            self.pages_parked += self.prefix.park(
+                req.prompt, salt=salt, spill=self._spill_page
+            )
+        req.group = None
+        self.requests_preempted += 1
+        return req
 
     def _drain_all_pending(self) -> list[Request]:
         """Consume any pipelined in-flight chunk AND superstep (host
@@ -3439,18 +3516,28 @@ def _run_fleet_cli(
             f"unknown seams in --inject-fault: "
             f"{sorted(set(schedule) - set(fleet_schedule) - set(engine_schedule))}"
         )
-    # The supervisor's resurrection seam: consulted once per respawn
-    # attempt by FleetSupervisor, not by the fleet's step loop.
+    # The supervisor's resurrection seam and the autoscaler's scale-up
+    # spawn seam: consulted by their controllers, not by the fleet's
+    # step loop.
     respawn_schedule = {
         s: n for s, n in fleet_schedule.items() if s == "replica_respawn"
     }
+    spawn_schedule = {
+        s: n for s, n in fleet_schedule.items() if s == "scale_spawn_fail"
+    }
     fleet_schedule = {
-        s: n for s, n in fleet_schedule.items() if s != "replica_respawn"
+        s: n for s, n in fleet_schedule.items()
+        if s not in ("replica_respawn", "scale_spawn_fail")
     }
     if respawn_schedule and not args.supervise:
         parser.error(
             "--inject-fault replica_respawn:N schedules supervised "
             "resurrection crashes; it needs --supervise"
+        )
+    if spawn_schedule and not args.autoscale:
+        parser.error(
+            "--inject-fault scale_spawn_fail:N kills autoscaler "
+            "scale-up spawns; it needs --autoscale MIN:MAX"
         )
     # SEAM@REPLICA:N targeting: engine seams only (replica seams are
     # fleet-level, scheduled by crossing), and the target must exist.
@@ -3601,6 +3688,80 @@ def _run_fleet_cli(
             f"{args.max_restarts}, capacity-aware admission bound="
             f"{fleet.admission_bound}"
         )
+    autoscaler = None
+    asc_obs = None
+    if args.autoscale is not None:
+        from .autoscaler import FleetAutoscaler
+
+        a_min, a_max = args.autoscale
+        if args.metrics_port is not None or args.trace_out:
+            from .obs import AutoscalerObserver
+
+            asc_obs = AutoscalerObserver()
+            if args.metrics_port is not None:
+                from tpu_device_plugin.metrics import registry
+
+                asc_obs.bind_registry(registry)
+
+        def scale_factory(slot):
+            # Scale-ups share the fleet's weights and in-process
+            # compile caches under a FIXED rng — the canary probe's
+            # bit-identity check needs a deterministic stream.  A real
+            # slot handle (scale-ups; calibration scratch engines pass
+            # None) gets its own observer so the new replica's
+            # timeline lands on the merged trace/registry exactly like
+            # a founder's or a respawn's.
+            obs = None
+            if slot is not None and (
+                args.metrics_port is not None or args.trace_out
+            ):
+                from .obs import EngineObserver
+
+                obs = EngineObserver(
+                    name=f"scaleup-{slot.chip_id}",
+                    replica=f"scaleup-{slot.chip_id}",
+                )
+                if args.metrics_port is not None:
+                    from tpu_device_plugin.metrics import registry
+
+                    obs.bind_registry(registry)
+                respawn_observers.append(obs)
+            return ServeEngine(
+                params, config, slots=args.slots, page_size=page_size,
+                observer=obs,
+                prompt_bucket=bucket, temperature=args.temperature,
+                top_k=args.top_k, top_p=args.top_p,
+                rng=jax.random.PRNGKey(4242), pipelined=args.pipelined,
+                superstep_k=args.superstep_k,
+                prefill_budget=args.prefill_budget,
+                prefix_cache=args.prefix_cache,
+                kv_offload=args.kv_offload,
+                kv_host_pages=args.kv_host_pages, adapters=adapters,
+                max_retries=args.max_retries,
+                retry_backoff_s=args.retry_backoff_s, **spec_kw,
+            )
+
+        autoscaler = FleetAutoscaler(
+            fleet,
+            respawn_factory if args.supervise else scale_factory,
+            min_replicas=a_min, max_replicas=a_max,
+            supervisor=supervisor,
+            # A CLI run lives seconds, not hours: a short signal
+            # window lets the loop demonstrate the full up -> clear ->
+            # down cycle before the exit summary prints.
+            window_s=3.0,
+            fault_injector=(
+                FaultInjector(spawn_schedule) if spawn_schedule else None
+            ),
+            observer=asc_obs,
+        )
+        autoscaler.calibrate_probe()
+        print(
+            f"autoscaler armed: replicas in [{a_min}, {a_max}] "
+            f"(starting at {args.fleet}), brownout factor "
+            f"{autoscaler.brownout_factor:g}, preempt class "
+            f"{autoscaler.preempt_class!r}"
+        )
     # SLO-classed traffic: --slo-mix tags every arrival with a class
     # drawn from the weighted mix; attainment is scored by the fleet's
     # default interactive/bulk targets and summarized at exit.
@@ -3644,7 +3805,10 @@ def _run_fleet_cli(
         import threading
         import urllib.request
 
-        server = FleetServer(fleet, args.http_port, supervisor=supervisor)
+        server = FleetServer(
+            fleet, args.http_port, supervisor=supervisor,
+            autoscaler=autoscaler,
+        )
         port = server.start()
         print(f"fleet SSE front end: http://127.0.0.1:{port}/v1/generate")
         statuses: dict[str, int] = {}
@@ -3688,11 +3852,19 @@ def _run_fleet_cli(
         server.stop()
         print(f"SSE streams closed: statuses={statuses}")
     else:
-        drive_open_loop(
-            supervisor if supervisor is not None else fleet, sched
-        )
+        driver = fleet
+        if autoscaler is not None:
+            driver = autoscaler
+        elif supervisor is not None:
+            driver = supervisor
+        drive_open_loop(driver, sched)
     if supervisor is not None:
         supervisor.wait_healed(timeout_s=30.0)
+    if autoscaler is not None:
+        # Let the loop scale back down after the stream drains, so the
+        # summary line reports the converged fleet (bounded: classed
+        # overload can legitimately hold the burn window longer).
+        autoscaler.wait_quiescent(timeout_s=20.0)
     elapsed = time.perf_counter() - t0
     generated = fleet.generated_tokens - tokens0
     rate = generated / elapsed if elapsed > 0 and generated else 0.0
@@ -3729,6 +3901,20 @@ def _run_fleet_cli(
             f"slots={supervisor.states()} "
             f"restore_ms={supervisor.restore_ms}"
         )
+    if autoscaler is not None:
+        print(
+            f"autoscale: ups={autoscaler.scale_ups} "
+            f"downs={autoscaler.scale_downs} "
+            f"spawn_failures={autoscaler.spawn_failures} "
+            f"brownouts={autoscaler.brownouts} "
+            f"preemptions={autoscaler.preemptions_total} "
+            f"ladder={autoscaler.ladder_level} "
+            f"replicas={len(fleet.alive)}/{autoscaler.target_replicas} "
+            f"[{autoscaler.min_replicas},{autoscaler.max_replicas}] "
+            f"recover_ms={autoscaler.recover_ms} "
+            f"overprovision_chip_s="
+            f"{round(autoscaler.overprovision_chip_s, 3)}"
+        )
     attainment = fleet.slo_attainment()
     if any(v is not None for v in attainment.values()):
         burn = fleet.slo_burn_rates()
@@ -3742,11 +3928,20 @@ def _run_fleet_cli(
     if args.trace_out and fleet_obs is not None:
         from .obs import export_fleet_trace
 
+        control_events = list(
+            supervisor.events if supervisor is not None else ()
+        )
+        if autoscaler is not None:
+            # Autoscaler decisions share the supervisor trace lane —
+            # one control-plane timeline, sorted so the merged lane
+            # reads in wall order.
+            control_events = sorted(
+                control_events + list(autoscaler.events),
+                key=lambda ev: ev.t,
+            )
         n_events, n_replicas = export_fleet_trace(
             args.trace_out, fleet_obs, list(observers) + respawn_observers,
-            supervisor_events=(
-                supervisor.events if supervisor is not None else ()
-            ),
+            supervisor_events=control_events,
         )
         print(
             f"fleet trace: {n_events} events covering {n_replicas} "
@@ -3898,7 +4093,9 @@ def main(argv=None) -> int:
                         "with --fleet, replica seams replica_crash / "
                         "replica_hang / replica_slow drive router "
                         "failover, replica_respawn kills supervised "
-                        "resurrections (--supervise), and engine seams "
+                        "resurrections (--supervise), scale_spawn_fail "
+                        "kills autoscaler scale-up spawns "
+                        "(--autoscale), and engine seams "
                         "land on replica 0 unless targeted: "
                         "SEAM@REPLICA:N lands the Nth crossing on that "
                         "replica's engine, so chaos runs can fault any "
@@ -3925,6 +4122,22 @@ def main(argv=None) -> int:
                         "burn rates print at exit and land on the "
                         "registry/trace (docs/OBSERVABILITY.md "
                         "'Distributed tracing & SLO attainment')")
+    parser.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                        help="with --fleet: arm the closed-loop "
+                        "FleetAutoscaler (workloads/autoscaler.py) — "
+                        "the fleet resizes itself between MIN and MAX "
+                        "replicas from its own signals (p99 "
+                        "queue-wait, queue depth per dispatchable "
+                        "replica, per-class SLO burn rates): scale-up "
+                        "via canary-probed spawns, scale-down via "
+                        "graceful drain of the least-loaded replica, "
+                        "with backoff hysteresis; when capacity can't "
+                        "arrive in time a degradation ladder tightens "
+                        "admission (brownout) and parks bulk-class "
+                        "streams via host offload for post-spike "
+                        "resumption (docs/SERVING.md 'Elastic fleet & "
+                        "overload protection'); --fleet N is the "
+                        "starting size and must sit in [MIN, MAX]")
     parser.add_argument("--supervise", action="store_true",
                         help="with --fleet: arm the self-healing "
                         "FleetSupervisor (workloads/supervisor.py) — "
@@ -3932,8 +4145,8 @@ def main(argv=None) -> int:
                         "exponential backoff, rejoin only after a "
                         "bit-identical half-open canary probe, crash "
                         "loops quarantine the slot, and fleet admission "
-                        "scales with alive capacity (docs/SERVING.md "
-                        "'Self-healing & recovery')")
+                        "scales with dispatchable capacity "
+                        "(docs/SERVING.md 'Self-healing & recovery')")
     parser.add_argument("--max-restarts", type=int, default=None,
                         metavar="N",
                         help="with --supervise: lifetime resurrection "
@@ -3979,6 +4192,22 @@ def main(argv=None) -> int:
         parser.error("--max-restarts must be >= 0 (omit for unbounded)")
     if args.slo_mix and args.fleet is None:
         parser.error("--slo-mix tags fleet traffic; it needs --fleet N")
+    if args.autoscale is not None:
+        if args.fleet is None:
+            parser.error("--autoscale resizes a fleet; it needs "
+                         "--fleet N (the starting size)")
+        lo, sep, hi = args.autoscale.partition(":")
+        if not sep or not lo.isdigit() or not hi.isdigit():
+            parser.error("--autoscale wants MIN:MAX with integer "
+                         f"bounds, got {args.autoscale!r}")
+        args.autoscale = (int(lo), int(hi))
+        if args.autoscale[0] < 1 or args.autoscale[1] < args.autoscale[0]:
+            parser.error("--autoscale wants 1 <= MIN <= MAX, got "
+                         f"{args.autoscale[0]}:{args.autoscale[1]}")
+        if not args.autoscale[0] <= args.fleet <= args.autoscale[1]:
+            parser.error(f"--fleet {args.fleet} must sit inside "
+                         f"--autoscale [{args.autoscale[0]}, "
+                         f"{args.autoscale[1]}]")
 
     from . import lease
 

@@ -36,11 +36,14 @@ DEAD, the supervisor schedules a resurrection:
     death → rejoined window lands in ``restore_ms`` (the bench's
     ``selfheal_restore_ms``; ``measure_selfheal`` prices cold vs warm).
   * **Capacity-aware load shedding.**  While capacity is degraded the
-    fleet's admission bound scales down with the alive replica count
-    (``Fleet(max_pending_per_replica=...)`` — ``capacity_aware=True``
-    converts a static ``max_pending`` on arming), so pressure surfaces
-    as typed ``QueueFull`` backpressure instead of unbounded queue
-    growth over capacity that no longer exists.
+    fleet's admission bound scales down with the DISPATCHABLE replica
+    count — ACTIVE and not health-paused; a paused or draining
+    replica finishes its in-flight work but buys no fresh queue
+    budget (``Fleet(max_pending_per_replica=...)`` —
+    ``capacity_aware=True`` converts a static ``max_pending`` on
+    arming), so pressure surfaces as typed ``QueueFull`` backpressure
+    instead of unbounded queue growth over capacity that no longer
+    exists.
 
 The supervisor is cooperative and deterministic like the fleet itself:
 ``poll()`` runs after each ``fleet.step()`` (or use
@@ -191,7 +194,7 @@ class FleetSupervisor:
                 slot.next_due = now  # already down: no grace owed
             self.slots.append(slot)
         # Capacity-aware shedding: convert a static fleet-wide bound to
-        # the per-replica knob so admission tracks alive capacity from
+        # the per-replica knob so admission tracks dispatchable capacity from
         # here on.  The EXACT fraction is kept (Fleet.admission_bound
         # ceils the product), so the operator's configured bound is
         # preserved bit-for-bit at full capacity.
@@ -564,18 +567,12 @@ class FleetSupervisor:
         oracle.  Greedy canaries make that a real equivalence check;
         the first success seeds the oracle when none was injected."""
         self._probes += 1
-        rid = f"canary-{self._probes}"
         try:
-            engine.submit(self.probe_prompt, self.probe_new, rid=rid)
-            tokens: list[int] | None = None
-            status = None
-            for _ in range(self.probe_max_steps):
-                for req in engine.step():
-                    if req.rid == rid:
-                        tokens = [int(t) for t in req.tokens]
-                        status = req.status
-                if tokens is not None or engine.idle:
-                    break
+            tokens, status = run_canary(
+                engine, self.probe_prompt, self.probe_new,
+                rid=f"canary-{self._probes}",
+                max_steps=self.probe_max_steps,
+            )
         except Exception as exc:  # noqa: BLE001 — a probe blowing up IS
             # the signal the half-open state exists for.
             return False, f"{type(exc).__name__}: {exc}"
@@ -650,17 +647,11 @@ class FleetSupervisor:
         """The supervised front-end driver loop (the fleet's
         ``serve_forever`` plus a heal pass per iteration) —
         ``FleetServer(fleet, supervisor=...)`` runs exactly this."""
-        while not stop_event.is_set():
-            with self.fleet._lock:
-                busy = not self.fleet.idle and not self.fleet.closed
-                if busy:
-                    self.fleet.step()
-                parked = busy and self._parked()
-            self.poll()
-            if not busy:
-                time.sleep(0.002)
-            elif parked:
-                time.sleep(0.001)
+        drive_forever(
+            self.fleet, stop_event,
+            step_fn=self.fleet.step, poll_fn=self.poll,
+            parked_fn=self._parked,
+        )
 
     def wait_healed(self, timeout_s: float = 30.0) -> bool:
         """Step the (possibly idle) fleet until every supervised,
@@ -679,6 +670,50 @@ class FleetSupervisor:
                     if wait > 0:
                         time.sleep(min(wait, 0.05))
         return self.healed
+
+
+def drive_forever(fleet, stop_event, *, step_fn, poll_fn, parked_fn) -> None:
+    """The shared front-end driver loop (one copy, three controllers:
+    Fleet.serve_forever stays the bare two-state original;
+    FleetSupervisor and FleetAutoscaler run this): step under the
+    fleet lock while busy, run the CONTROL pass outside it — a heal or
+    scale poll may build an engine and run a canary, seconds of work
+    the HTTP handler threads must never block on — and sleep when idle
+    or parked."""
+    while not stop_event.is_set():
+        with fleet._lock:
+            busy = not fleet.idle and not fleet.closed
+            if busy:
+                step_fn()
+            parked = busy and parked_fn()
+        poll_fn()
+        if not busy:
+            time.sleep(0.002)
+        elif parked:
+            time.sleep(0.001)
+
+
+def run_canary(
+    engine, prompt, new: int, *, rid: str = "canary",
+    max_steps: int = 400,
+) -> tuple[list[int] | None, str | None]:
+    """Drive ONE request to completion on a not-yet-joined engine — the
+    canary primitive shared by the supervisor's half-open probe and the
+    autoscaler's probed scale-up.  Returns ``(tokens, status)``; tokens
+    is None when the request never finished within ``max_steps``.
+    Exceptions propagate — blowing up IS the signal probes exist for,
+    and each caller words its own verdict."""
+    engine.submit(prompt, new, rid=rid)
+    tokens: list[int] | None = None
+    status = None
+    for _ in range(max_steps):
+        for req in engine.step():
+            if req.rid == rid:
+                tokens = [int(t) for t in req.tokens]
+                status = req.status
+        if tokens is not None or engine.idle:
+            break
+    return tokens, status
 
 
 def make_engine_factory(params, config, *, engine_kw=None, probe=None):
