@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check disagg-check fmt-check
 
 all: native
 
@@ -51,7 +51,22 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check test
+check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check disagg-check test
+
+# Disaggregated prefill/decode tripwires (docs/SERVING.md
+# "Disaggregated prefill/decode"): one seeded two-pool smoke — a
+# prefill+decode split fleet serves a seeded stream BIT-IDENTICALLY to
+# the mixed fleet and the dense oracle, with real KV movement (export
+# off the prefill replica via one gathered device_get, graft into the
+# decode replica's radix index, reload on its admission sweep), every
+# handoff window recorded, and no page/slot leaks on either pool.  The
+# full suite (mid-handoff cancel/deadline, exporter crash after the
+# spill, decode-pool death degrading to mixed, WFQ ordering, batched
+# spill bit-exactness, per-class traffic determinism) and the
+# roles-randomized fleet chaos fuzz ride the slow suite
+# (tests/test_disagg.py, tests/test_serve_fuzz.py).
+disagg-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_disagg.py::test_disagg_check_smoke" -q -o addopts=
 
 # Speculative-superstep tripwires (docs/SERVING.md "Speculative
 # supersteps"): one seeded spec="auto" stream at spec_superstep_k=4 —

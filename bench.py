@@ -563,6 +563,15 @@ COMPACT_KEYS = [
     "fleet_slo_attainment_interactive", "fleet_slo_attainment_bulk",
     "fleet_interactive_ttft_p99_ms", "fleet_bulk_tpot_p99_ms",
     "fleet_trace_overhead_pct", "fleet_trace_on_tokens_per_sec",
+    # Disaggregated prefill/decode pools: the KV-handoff price, the
+    # bulk decode-dip vs the mixed fleet, the interactive TTFT tail
+    # under WFQ, and the attainment deltas the split buys.
+    "disagg_handoff_ms", "disagg_decode_dip_pct",
+    "disagg_mixed_decode_dip_pct", "disagg_interactive_ttft_p99_ms",
+    "disagg_mixed_interactive_ttft_p99_ms",
+    "disagg_vs_mixed_tokens_per_sec", "disagg_handoffs",
+    "disagg_attainment_delta_interactive",
+    "disagg_attainment_delta_bulk",
     "selfheal_restore_ms", "selfheal_capacity_recovered",
     "selfheal_goodput_retained",
     "replica_restore_cold_ms", "replica_restore_warm_ms",

@@ -1,0 +1,736 @@
+"""Disaggregated prefill/decode pools with KV handoff over the host
+tier + SLO-class weighted fair scheduling (workloads/fleet.py
+``Fleet(roles=)``, docs/SERVING.md "Disaggregated prefill/decode").
+
+The pinned contracts: greedy streams on a prefill/decode split fleet
+are BIT-IDENTICAL to the same seeded request stream on a mixed fleet
+(and to the dense oracle); a handoff actually moves pages — exported
+off the prefill replica with ONE gathered device_get, grafted into the
+decode replica's radix index, reloaded on its admission sweep; the
+full lifecycle composes (mid-handoff cancel/deadline, exporter crash
+after the spill, decode-pool death degrading to mixed dispatch); WFQ
+splits fresh-prompt dispatch in weight proportion with continuations
+holding absolute precedence; ``Replica.load`` weighs mid-prefill
+backlog by remaining prompt-bucket units; and ``schedule_per_class``
+is a deterministic merge of per-class independent arrival processes."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.fleet import (
+    DEAD,
+    Fleet,
+    FleetRequest,
+    KVHandoff,
+    Router,
+    TrafficGen,
+)
+from workloads.generate import generate
+from workloads.model import ModelConfig, init_params
+from workloads.paged import RadixKV, read_page, read_pages
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+PARAMS = init_params(CONFIG, jax.random.PRNGKey(0))
+
+
+def _engine(**kw):
+    base = dict(
+        slots=2, page_size=4, prompt_bucket=4,
+        prefix_cache=True, kv_offload=True,
+    )
+    base.update(kw)
+    return ServeEngine(PARAMS, CONFIG, **base)
+
+
+def _fleet(n, roles=None, *, engine_kw=None, **fleet_kw):
+    fleet_kw.setdefault("chip_ids", [f"chip-{i}" for i in range(n)])
+    fleet_kw.setdefault("hang_timeout_s", None)
+    return Fleet(
+        [_engine(**(engine_kw or {})) for _ in range(n)],
+        roles=roles, **fleet_kw,
+    )
+
+
+def _oracle(prompt, new):
+    return [int(t) for t in np.asarray(generate(
+        PARAMS, jnp.asarray([prompt], jnp.int32), CONFIG,
+        max_new_tokens=new,
+    )[0])]
+
+
+def _prompts(seed, n, lo=4, hi=24, new_lo=3, new_hi=12):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        plen = int(rng.integers(lo, hi))
+        prompt = [int(t) for t in rng.integers(0, CONFIG.vocab_size, plen)]
+        out.append((prompt, int(rng.integers(new_lo, new_hi))))
+    return out
+
+
+def _assert_no_leaks(fleet):
+    for rep in fleet.replicas:
+        if rep.state == DEAD:
+            continue
+        e = rep.engine
+        assert not e._occupied.any(), rep.index
+        assert e._committed_pages == 0, rep.index
+        pinned = e.prefix.cached_pages if e.prefix is not None else 0
+        assert e.ctrl.used_pages == pinned, rep.index
+        assert not rep.rids, rep.index
+
+
+# ---- validation ----------------------------------------------------------
+
+
+def test_roles_validation():
+    with pytest.raises(ValueError, match="roles"):
+        _fleet(2, roles=["prefill"])  # length mismatch
+    with pytest.raises(ValueError, match="roles"):
+        _fleet(2, roles=["prefill", "verbs"])  # unknown role
+    with pytest.raises(ValueError, match="wfq_weights"):
+        _fleet(1, wfq_weights={"interactive": 0.0})
+    fleet = _fleet(2, roles=["prefill", "decode"])
+    assert fleet.roles() == {0: "prefill", 1: "decode"}
+    assert fleet.disaggregated
+    idx = fleet.add_replica(_engine(), "chip-2", role="decode")
+    assert fleet.roles()[idx] == "decode"
+    fleet.close()
+    plain = _fleet(2)
+    assert not plain.disaggregated
+    assert plain.roles() == {0: "mixed", 1: "mixed"}
+    plain.close()
+
+
+# ---- the headline parity pin --------------------------------------------
+
+
+def test_disagg_streams_bit_identical_to_mixed_and_oracle():
+    """THE acceptance pin: the same seeded stream through a
+    prefill/decode split fleet (WFQ armed) and an all-mixed fleet
+    produces bit-identical greedy streams — and both match the dense
+    oracle — while the split fleet actually hands off: tickets carry
+    pages, decode replicas graft and reload them, and every handoff
+    records its prefill-done -> first-decode-token window."""
+    reqs = _prompts(3, 8, lo=9, hi=24)  # >= 2 full pages: pages move
+
+    def run(roles, wfq=None):
+        fleet = _fleet(3, roles, wfq_weights=wfq)
+        for i, (p, nw) in enumerate(reqs):
+            fleet.submit(p, nw, slo_class="interactive" if i % 2 else "bulk")
+        streams = fleet.run()
+        return streams, fleet
+
+    mixed, mfleet = run(None)
+    split, sfleet = run(
+        ["prefill", "decode", "decode"],
+        wfq={"interactive": 3.0, "bulk": 1.0},
+    )
+    assert split == mixed
+    for rid, (p, nw) in zip(
+        sorted(split, key=lambda r: int(r.split("-")[1])), reqs
+    ):
+        assert split[rid] == _oracle(p, nw), rid
+    assert mfleet.kv_handoffs == 0
+    assert sfleet.kv_handoffs == len(reqs)
+    assert sfleet.handoff_pages > 0  # ticket pages actually grafted
+    assert len(sfleet.handoff_s) == len(reqs)
+    assert all(s > 0 for s in sfleet.handoff_s)
+    # The prefill pool exported, the decode pool adopted + reloaded.
+    assert sfleet.replicas[0].engine.kv_handoff_pages_out > 0
+    decode_in = sum(
+        sfleet.replicas[i].engine.kv_handoff_pages_in for i in (1, 2)
+    )
+    decode_reloads = sum(
+        sfleet.replicas[i].engine.prefix.reloads for i in (1, 2)
+    )
+    assert decode_in > 0 and decode_reloads > 0
+    # WFQ charged only fresh prompts, by class.
+    assert sum(sfleet.wfq_dispatches.values()) == len(reqs)
+    _assert_no_leaks(mfleet)
+    _assert_no_leaks(sfleet)
+    mfleet.close()
+    sfleet.close()
+
+
+def test_disagg_without_offload_degrades_bit_identical():
+    """Roles on engines WITHOUT a prefix cache: export returns None,
+    tickets ship empty, and the decode pool re-prefills — the split
+    degrades to the replay path with identical tokens."""
+    reqs = _prompts(5, 5)
+    kw = dict(prefix_cache=False, kv_offload=False)
+    mixed = _fleet(2, engine_kw=kw)
+    split = _fleet(2, ["prefill", "decode"], engine_kw=kw)
+    for p, nw in reqs:
+        mixed.submit(p, nw)
+    for p, nw in reqs:
+        split.submit(p, nw)
+    assert mixed.run() == split.run()
+    assert split.kv_handoffs == len(reqs)
+    assert split.handoff_pages == 0  # nothing to ship, still correct
+    _assert_no_leaks(split)
+    mixed.close()
+    split.close()
+
+
+def test_handoff_composes_with_budget_superstep_and_lora():
+    """prefill_budget + superstep_k + a LoRA adapter on a split fleet:
+    still bit-identical to the mixed fleet (the adapter salt rides the
+    ticket)."""
+    from workloads.multi_lora import synthetic_adapters
+
+    adapters = synthetic_adapters(CONFIG, 1, rank=2, seed=5)
+    adapters = {"tenant": adapters["tenant-0"]}
+    kw = dict(
+        prompt_bucket=8, prefill_budget=8, superstep_k=2,
+        adapters=adapters,
+    )
+    reqs = _prompts(7, 6, lo=9, hi=20)
+
+    def run(roles):
+        fleet = _fleet(2, roles, engine_kw=kw)
+        for i, (p, nw) in enumerate(reqs):
+            fleet.submit(p, nw, adapter="tenant" if i % 2 else None)
+        out = fleet.run()
+        _assert_no_leaks(fleet)
+        fleet.close()
+        return out
+
+    assert run(None) == run(["prefill", "decode"])
+
+
+# ---- lifecycle composition ----------------------------------------------
+
+
+def _run_until_ticket(fleet, rid):
+    """Step until the rid's handoff ticket sits in the router queue."""
+    for _ in range(200):
+        fleet.step()
+        fr = fleet._reqs[rid]
+        if fr.handoff is not None and any(q is fr for q in fleet.queue):
+            return fr
+    raise AssertionError("no handoff ticket appeared")
+
+
+def test_cancel_mid_handoff():
+    fleet = _fleet(2, ["prefill", "decode"])
+    p, nw = _prompts(11, 1, lo=9)[0]
+    rid = fleet.submit(p, nw)
+    # A second stream keeps the fleet busy so cancel's surfacing step
+    # has work to return.
+    other = fleet.submit([5] * 10, 6)
+    fr = _run_until_ticket(fleet, rid)
+    assert fleet.cancel(rid)
+    assert fr.status == "cancelled"
+    assert fr.handoff is None  # the ticket's blobs freed with it
+    assert rid not in fleet._handoff_at
+    fleet.run()
+    assert fleet._reqs[other].status == "ok"
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_deadline_expires_mid_handoff():
+    fleet = _fleet(2, ["prefill", "decode"])
+    p, nw = _prompts(13, 1, lo=9)[0]
+    rid = fleet.submit(p, nw, deadline_s=0.05)
+    fr = _run_until_ticket(fleet, rid)
+    import time as _time
+
+    _time.sleep(0.06)
+    fleet.run()
+    assert fr.status == "expired"
+    assert fr.handoff is None
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_prefill_crash_after_export_ticket_survives():
+    """The exporter dying AFTER the spill cannot strand the ticket:
+    its blobs are host RAM, independent of the dead engine — the
+    decode pool grafts them and the stream completes bit-identically."""
+    fleet = _fleet(2, ["prefill", "decode"])
+    p, nw = _prompts(17, 1, lo=9, new_lo=6)[0]
+    rid = fleet.submit(p, nw)
+    fr = _run_until_ticket(fleet, rid)
+    assert fr.handoff.blobs  # the ticket really carries pages
+    fleet._fail_replica(
+        fleet.replicas[0], RuntimeError("injected"), "crash"
+    )
+    assert fleet.replicas[0].state == DEAD
+    fleet.run()
+    assert fr.status == "ok"
+    assert fr.tokens == _oracle(p, nw)
+    assert fleet.replicas[1].engine.kv_handoff_pages_in > 0
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_decode_pool_death_degrades_to_mixed_dispatch():
+    """A dead decode pool must not strand tickets OR fresh prompts:
+    dispatch degrades to the surviving prefill replica as mixed — the
+    budget cap lifts (no live handoff target), streams complete
+    bit-identically."""
+    fleet = _fleet(2, ["prefill", "decode"])
+    reqs = _prompts(19, 4, lo=9, new_lo=5)
+    rids = [fleet.submit(p, nw) for p, nw in reqs]
+    fleet._fail_replica(
+        fleet.replicas[1], RuntimeError("injected"), "crash"
+    )
+    fleet.run()
+    for rid, (p, nw) in zip(rids, reqs):
+        fr = fleet._reqs[rid]
+        assert fr.status == "ok", (rid, fr.error)
+        assert fr.tokens == _oracle(p, nw)
+    # No handoffs happened: the cap only arms with a live target.
+    assert fleet.kv_handoffs == 0
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_decode_pool_death_after_ticket_still_serves_it():
+    """The harder ordering: the ticket exists FIRST, then the whole
+    decode pool dies — the ticketed continuation degrades back onto
+    the prefill replica (its own index still holds the pages) and
+    completes bit-identically."""
+    fleet = _fleet(2, ["prefill", "decode"])
+    p, nw = _prompts(23, 1, lo=9, new_lo=6)[0]
+    rid = fleet.submit(p, nw)
+    fr = _run_until_ticket(fleet, rid)
+    fleet._fail_replica(
+        fleet.replicas[1], RuntimeError("injected"), "crash"
+    )
+    fleet.run()
+    assert fr.status == "ok"
+    assert fr.tokens == _oracle(p, nw)
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_supervisor_resurrects_pool_role():
+    """A resurrected pool member rejoins ITS pool: the supervisor
+    carries the dead slot's role through the respawn."""
+    from workloads.backoff import Backoff
+    from workloads.supervisor import FleetSupervisor, make_engine_factory
+
+    fleet = _fleet(2, ["prefill", "decode"])
+    factory, oracle = make_engine_factory(
+        PARAMS, CONFIG, engine_kw=dict(
+            slots=2, page_size=4, prompt_bucket=4,
+            prefix_cache=True, kv_offload=True,
+        ), probe=([1, 2, 3], 4),
+    )
+    sup = FleetSupervisor(
+        fleet, factory,
+        backoff=Backoff(base_s=1e-3, max_s=1e-3, jitter=0.0),
+        probe=([1, 2, 3], 4), probe_oracle=oracle,
+    )
+    assert sup.slot_for("chip-0").role == "prefill"
+    fleet._fail_replica(
+        fleet.replicas[0], RuntimeError("injected"), "crash"
+    )
+    assert sup.wait_healed(30.0), sup.states()
+    new_idx = sup.slot_for("chip-0").index
+    assert fleet.replicas[new_idx].role == "prefill"
+    fleet.close()
+
+
+# ---- router load scoring (satellite) ------------------------------------
+
+
+def test_load_weights_midprefill_backlog():
+    """A parked mid-prefill row weighs its REMAINING prompt tokens in
+    prompt-bucket units — a long prompt two chunks in no longer looks
+    as cheap as a finishing decode row — and the router therefore
+    routes the next prompt AWAY from the replica chewing a long
+    prefill."""
+    kw = dict(prompt_bucket=4, prefill_budget=4)
+    fleet = _fleet(2, engine_kw=kw)
+    long_prompt = [7] * 32  # 8 bucket-units of sweep work
+    fleet.submit(long_prompt, 4)
+    fleet.step()  # dispatch + first budgeted chunk; the rest parks
+    rep0 = fleet.replicas[0]
+    assert rep0.engine._inflight_prefill  # genuinely parked mid-prefill
+    # 32 prompt tokens at budget 4/step: >= 6 bucket-units remain.
+    assert rep0.load() >= 6
+    # The old scalar would have said 1 — equal to one queued request —
+    # and least-loaded would have tied; now the short prompt must land
+    # on the idle replica.
+    rid2 = fleet.submit([9] * 4, 3)
+    fleet.step()
+    fr2 = fleet._reqs[rid2]
+    assert fr2.replica == 1 or fr2.status == "ok"
+    assert rid2 in fleet.replicas[1].rids or fr2.status == "ok"
+    fleet.run()
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+# ---- batched spills (satellite) -----------------------------------------
+
+
+def test_gathered_spill_bit_exact_and_single_sync(monkeypatch):
+    """``_spill_pages`` pays ONE fused device_get for an n-page park
+    and its per-page blobs are bit-exact against ``read_page``."""
+    engine = _engine()
+    prompt = [3] * 12  # 3 full pages
+    engine.submit(prompt, 2)
+    engine.run()
+    pages = engine.prefix.lookup(prompt, 3, salt="")
+    assert len(pages) == 3
+    # Per-page reference bytes BEFORE the park moves anything.
+    ref = [jax.device_get(read_page(engine.pools, p)) for p in pages]
+    calls = []
+    real = jax.device_get
+
+    def counting(x):
+        calls.append(1)
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    blobs = engine._spill_pages(pages)
+    assert len(calls) == 1  # the n-fold round-trip collapse
+    monkeypatch.undo()
+    assert len(blobs) == 3
+    for (main, draft), (rk, rv) in zip(blobs, ref):
+        assert draft is None
+        assert np.array_equal(np.asarray(main[0]), rk)
+        assert np.array_equal(np.asarray(main[1]), rv)
+    engine.close()
+
+
+def test_park_spill_many_matches_serial_spill():
+    """park(spill_many=) and park(spill=) produce identical host-tier
+    state: same pages parked, and reloaded streams stay bit-identical
+    (the serial/batched seam can never change a token)."""
+    def parked_state(batched):
+        engine = _engine()
+        prompt = [4] * 16
+        engine.submit(prompt, 2)
+        engine.run()
+        kw = (
+            dict(spill_many=engine._spill_pages) if batched
+            else dict(spill=engine._spill_page)
+        )
+        n = engine.prefix.park(prompt, salt="", **kw)
+        out = (n, engine.prefix.offloaded_pages)
+        # Resume: the next lookup reloads the parked pages and the
+        # continuation must match the dense oracle.
+        engine.submit(prompt, 5)
+        streams = engine.run()
+        engine.close()
+        return out, list(streams.values())[0]
+
+    (n_b, off_b), toks_b = parked_state(True)
+    (n_s, off_s), toks_s = parked_state(False)
+    assert (n_b, off_b) == (n_s, off_s)
+    assert n_b == 4
+    assert toks_b == toks_s == _oracle([4] * 16, 5)
+
+
+def test_import_kv_refuses_incompatible_tickets():
+    """Heterogeneous fleets are legal, so import must DEGRADE (refuse
+    the graft, let replay re-prefill) rather than adopt blobs that
+    would wedge a future admission's reload: a different page_size,
+    and an adapter this engine does not serve (grafting it under the
+    base salt would poison the base prefix cache with LoRA KV)."""
+    src = _engine(page_size=8, prompt_bucket=8)
+    prompt = [6] * 16
+    src.submit(prompt, 2)
+    src.run()
+    blobs = src.export_kv(prompt)
+    assert blobs
+    dst = _engine()  # page_size=4: wrong shape — must refuse
+    assert dst.import_kv(prompt, blobs) == 0
+    assert dst.prefix.offloaded_pages == 0
+    # Unknown adapter: refused outright, base salt untouched.
+    src4 = _engine()
+    src4.submit(prompt, 2)
+    src4.run()
+    blobs4 = src4.export_kv(prompt)
+    assert dst.import_kv(prompt, blobs4, adapter="ghost") == 0
+    assert dst.prefix.offloaded_pages == 0
+    # And the compatible same-shape ticket still grafts.
+    assert dst.import_kv(prompt, blobs4) == len(blobs4)
+    for e in (src, dst, src4):
+        e.close()
+
+
+def test_heterogeneous_page_size_split_fleet_stays_oracle_true():
+    """A split fleet whose pools disagree on page_size: every handoff
+    ticket is refused at import (shape guard) and the continuation
+    re-prefills — streams still bit-identical to the oracle."""
+    engines = [
+        _engine(page_size=8, prompt_bucket=8),
+        _engine(page_size=4, prompt_bucket=4),
+    ]
+    fleet = Fleet(
+        engines, chip_ids=["chip-0", "chip-1"], hang_timeout_s=None,
+        roles=["prefill", "decode"],
+    )
+    reqs = _prompts(37, 4, lo=9, new_lo=4)
+    rids = [fleet.submit(p, nw) for p, nw in reqs]
+    fleet.run()
+    for rid, (p, nw) in zip(rids, reqs):
+        fr = fleet._reqs[rid]
+        assert fr.status == "ok"
+        assert fr.tokens == _oracle(p, nw)
+    assert fleet.kv_handoffs == len(reqs)
+    assert fleet.handoff_pages == 0  # every graft refused, none wedged
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_spill_blobs_own_their_memory():
+    """Gathered-spill blobs must be OWNED copies, not views into the
+    padded batch buffer — one long-lived blob (a parked node, a
+    handoff ticket) must pin one page of host RAM, not the whole
+    gather."""
+    engine = _engine()
+    prompt = [8] * 12
+    engine.submit(prompt, 2)
+    engine.run()
+    pages = engine.prefix.lookup(prompt, 3, salt="")
+    for (mk, mv), draft in engine._spill_pages(pages):
+        assert mk.base is None and mv.base is None
+        assert draft is None
+    engine.close()
+
+
+def test_handoff_ticket_survives_engine_closed_race():
+    """A decode replica closing between the dispatchable check and the
+    submit must NOT consume the ticket: the requeued request keeps it
+    for the next dispatch onto a live replica."""
+    from workloads.errors import EngineClosed
+
+    fleet = _fleet(2, ["prefill", "decode"])
+    p, nw = _prompts(41, 1, lo=9, new_lo=6)[0]
+    rid = fleet.submit(p, nw)
+    fr = _run_until_ticket(fleet, rid)
+    ticket = fr.handoff
+    pages0 = fleet.handoff_pages
+    fleet.replicas[1].engine.close()  # dies under the router
+    with pytest.raises(EngineClosed):
+        fleet._dispatch_to(fr, fleet.replicas[1])
+    assert fr.handoff is ticket  # still attached
+    assert fleet.handoff_pages == pages0  # nothing counted as served
+    fleet.close()
+
+
+def test_load_requests_keeps_request_units():
+    """The autoscaler's depth signal reads load_requests() — one unit
+    per request regardless of prompt length — while the router's
+    load() weighs mid-prefill backlog; one long prompt must never read
+    as dozens of queued requests to the scaling loop."""
+    kw = dict(prompt_bucket=4, prefill_budget=4)
+    fleet = _fleet(1, engine_kw=kw)
+    fleet.submit([7] * 32, 4)
+    fleet.step()
+    rep = fleet.replicas[0]
+    assert rep.load() >= 6  # router: bucket-weighted
+    assert rep.load_requests() == 1  # autoscaler: request-count
+    fleet.run()
+    fleet.close()
+
+
+def test_graft_respects_host_budget():
+    """A partial graft (host budget exhausted) is a shorter future hit,
+    never an error — and the continuation still streams bit-identically
+    via re-prefill of the un-grafted suffix."""
+    src = _engine()
+    prompt = [6] * 16  # 4 pages
+    src.submit(prompt, 2)
+    src.run()
+    blobs = src.export_kv(prompt)
+    assert len(blobs) == 4
+    dst = _engine(kv_host_pages=2)
+    assert dst.import_kv(prompt, blobs) == 2  # budget-capped
+    assert dst.prefix.offloaded_pages == 2
+    dst.submit(prompt, 5)
+    assert list(dst.run().values())[0] == _oracle(prompt, 5)
+    src.close()
+    dst.close()
+
+
+# ---- WFQ (tentpole) ------------------------------------------------------
+
+
+def _fr(rid, cls, prompt_len=4, tokens=()):
+    fr = FleetRequest(
+        rid, [1] * prompt_len, 8, None, slo_class=cls,
+    )
+    fr.tokens = list(tokens)
+    return fr
+
+
+def test_wfq_orders_by_weight_and_respects_continuations():
+    fleet = _fleet(1, wfq_weights={"a": 3.0, "b": 1.0})
+    fresh = [_fr(f"a{i}", "a") for i in range(4)] + [
+        _fr(f"b{i}", "b") for i in range(4)
+    ]
+    cont = [_fr("c0", "b", tokens=[5])]
+    order = [fr.rid for fr in fleet._wfq_order(cont + fresh)]
+    # Continuations first; then finish-tag order: 'a' (weight 3) takes
+    # 3 of the first 4 slots, 'b' lands at its virtual finish of 1.
+    assert order[0] == "c0"
+    assert order[1:] == ["a0", "a1", "a2", "b0", "a3", "b1", "b2", "b3"]
+    # Finish tags weigh COST against weight: a 4-bucket 'a' prompt
+    # finishes at 4/3, so the 1-bucket 'b' (finish 1) beats it to the
+    # first slot DESPITE 'a' holding 3x the weight — and 'a' still
+    # beats b1 (finish 2).
+    big = [_fr(f"A{i}", "a", prompt_len=16) for i in range(2)] + [
+        _fr(f"B{i}", "b") for i in range(2)
+    ]
+    order2 = [fr.rid for fr in fleet._wfq_order(big)]
+    assert order2 == ["B0", "A0", "B1", "A1"]
+    fleet.close()
+
+
+def test_wfq_idle_class_banks_no_credit():
+    """A class that idled while another was served re-enters at the
+    CURRENT virtual time — it cannot monopolize dispatch to 'catch
+    up' on credit it never queued for."""
+    fleet = _fleet(1, wfq_weights={"a": 1.0, "b": 1.0})
+    for i in range(6):  # six one-dispatch batches, as the loop would run
+        fleet._wfq_charge(_fr(f"a{i}", "a"), fleet._wfq_v)
+        fleet._wfq_v = fleet._wfq_vtime["a"]
+    assert fleet._wfq_vtime["a"] == pytest.approx(6.0)
+    order = [
+        fr.rid for fr in fleet._wfq_order(
+            [_fr("b0", "b"), _fr("a6", "a"), _fr("b1", "b")]
+        )
+    ]
+    # 'b' starts at v_now (not 0), so it alternates instead of draining
+    # every 'b' before 'a' gets another slot.
+    assert order == ["a6", "b0", "b1"] or order == ["b0", "a6", "b1"]
+    fleet.close()
+
+
+def test_wfq_dispatch_split_on_one_replica():
+    """End-to-end: a starved 1-replica fleet under WFQ serves the
+    heavy class ~3x as often among the first dispatches, and every
+    stream still finishes ok with oracle tokens."""
+    fleet = _fleet(
+        1, engine_kw=dict(slots=1), wfq_weights={"hi": 3.0, "lo": 1.0},
+        slo_classes=None,
+    )
+    # slo classes: reuse defaults for validation; tag via wfq-only
+    # classes is fine — wfq_weights classes need not be SLO classes.
+    reqs = _prompts(29, 8, lo=4, hi=8, new_lo=2, new_hi=4)
+    rids = []
+    for i, (p, nw) in enumerate(reqs):
+        rids.append(fleet.submit(
+            p, nw, slo_class="interactive" if i < 4 else "bulk",
+        ))
+    fleet.wfq_weights = {"interactive": 3.0, "bulk": 1.0}
+    fleet.run()
+    assert fleet.wfq_dispatches["interactive"] == 4
+    assert fleet.wfq_dispatches["bulk"] == 4
+    for rid, (p, nw) in zip(rids, reqs):
+        assert fleet._reqs[rid].tokens == _oracle(p, nw)
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+# ---- per-class traffic (satellite) --------------------------------------
+
+
+def test_schedule_per_class_deterministic_and_independent():
+    gen = TrafficGen(
+        seed=5, rate_rps=50.0, class_mix=(("interactive", 3.0), ("bulk", 1.0)),
+    )
+    a = gen.schedule_per_class(16)
+    b = gen.schedule_per_class(16)
+    assert a == b  # deterministic per seed
+    # Reordering the mix cannot move a token of either class.
+    flipped = dataclasses.replace(
+        gen, class_mix=(("bulk", 1.0), ("interactive", 3.0)),
+    )
+    assert flipped.schedule_per_class(16) == a
+    # Each class's sub-stream IS its standalone process at its share.
+    import zlib
+
+    share = 3.0 / 4.0
+    solo = dataclasses.replace(
+        gen,
+        seed=(gen.seed << 16) ^ zlib.crc32(b"interactive"),
+        rate_rps=gen.rate_rps * share,
+    ).schedule(12)  # round(16 * 0.75)
+    sub = [(t, p, n) for t, p, n, c in a if c == "interactive"]
+    assert sorted(sub) == sorted(solo)
+    # And the class draw is genuinely per-process: bulk arrivals exist.
+    stats = TrafficGen.schedule_stats(a)
+    assert stats["class_counts"] == {"bulk": 4, "interactive": 12}
+    assert set(stats["class_mean_rps"]) == {"bulk", "interactive"}
+    assert all(
+        r is None or 0 < r < 1e6
+        for r in stats["class_mean_rps"].values()
+    )
+    # A single-arrival class has no span: its rate reads None, not
+    # the absurd 1/epsilon.
+    one = TrafficGen.schedule_stats([(0.5, [1], 2, "solo")])
+    assert one["class_mean_rps"] == {"solo": None}
+    with pytest.raises(ValueError, match="class_mix"):
+        dataclasses.replace(gen, class_mix=()).schedule_per_class(4)
+
+
+# ---- smoke for make disagg-check ----------------------------------------
+
+
+def test_disagg_check_smoke():
+    """ONE seeded two-pool round — the `make disagg-check` tripwire:
+    a prefill+decode split serves a seeded stream bit-identically to
+    the mixed fleet AND the dense oracle, with real page movement
+    (export -> graft -> reload), every handoff window recorded, and no
+    page/slot leaks on either pool."""
+    reqs = _prompts(31, 6, lo=9, hi=24, new_lo=4)
+
+    def run(roles):
+        fleet = _fleet(2, roles, wfq_weights=(
+            {"interactive": 3.0, "bulk": 1.0} if roles else None
+        ))
+        for i, (p, nw) in enumerate(reqs):
+            fleet.submit(p, nw, slo_class="interactive" if i % 2 else "bulk")
+        streams = fleet.run()
+        _assert_no_leaks(fleet)
+        return streams, fleet
+
+    mixed, mf = run(None)
+    split, sf = run(["prefill", "decode"])
+    assert split == mixed
+    for rid, (p, nw) in zip(
+        sorted(split, key=lambda r: int(r.split("-")[1])), reqs
+    ):
+        assert split[rid] == _oracle(p, nw)
+    assert sf.kv_handoffs == len(reqs)
+    assert sf.handoff_pages > 0
+    assert len(sf.handoff_s) == len(reqs)
+    assert sf.replicas[1].engine.prefix.grafts > 0
+    assert sf.replicas[1].engine.prefix.reloads > 0
+    mf.close()
+    sf.close()
+
+
+def test_read_pages_matches_read_page():
+    """The gathered-spill primitive is a pure batching of read_page:
+    column i of read_pages == read_page(srcs[i]), bit-for-bit."""
+    from workloads.paged import init_page_pools
+
+    pools = init_page_pools(CONFIG, 8, 4)
+    k = jax.random.PRNGKey(1)
+    pools = (
+        jax.random.normal(k, pools[0].shape, pools[0].dtype),
+        jax.random.normal(jax.random.PRNGKey(2), pools[1].shape,
+                          pools[1].dtype),
+    )
+    srcs = [5, 0, 3]
+    gk, gv = jax.device_get(read_pages(pools, np.asarray(srcs, np.int32)))
+    for i, s in enumerate(srcs):
+        rk, rv = jax.device_get(read_page(pools, s))
+        assert np.array_equal(gk[:, i], rk)
+        assert np.array_equal(gv[:, i], rv)

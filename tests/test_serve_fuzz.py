@@ -417,6 +417,11 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
             prefix_cache=bool(rng.integers(2)),
             pipelined=bool(rng.integers(2)),
         )
+        # The KV handoff's transfer fabric (disaggregated fleets
+        # export/graft through the host tier when armed; degrade to
+        # replay re-prefill when not — both must stay oracle-true).
+        if kw["prefix_cache"] and rng.integers(2):
+            kw["kv_offload"] = True
         kw["prompt_bucket"] = int(kw["page_size"] * rng.choice([2, 3]))
         if rng.integers(2):
             kw["prefill_budget"] = int(
@@ -432,6 +437,17 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
             ),
             max_retries=2, **kw,
         ))
+    # Disaggregated prefill/decode pools on half the seeds: random
+    # per-replica roles (any combination is legal — a missing pool
+    # degrades to mixed dispatch), so crashes/hangs/health drains land
+    # on exporters mid-handoff, on decode pools holding tickets, and
+    # on degenerate all-prefill fleets alike.
+    roles = None
+    if rng.integers(2):
+        roles = [
+            str(rng.choice(["prefill", "decode", "mixed"]))
+            for _ in range(n)
+        ]
     fleet = Fleet(
         engines, chip_ids=[f"chip-{i}" for i in range(n)],
         fault_injector=fleet_inj, max_failovers=2, slow_readback_s=0.0,
@@ -440,6 +456,7 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
         # times into nondeterministic replica kills.
         hang_timeout_s=None,
         max_pending=int(rng.choice([4, 32])),
+        roles=roles,
     )
     names = [None] + (sorted(adapters) if use_adapters else [])
     expected = {}

@@ -101,6 +101,16 @@ TRACKED_DOWN = [
     # mix.
     "fleet_interactive_ttft_p99_ms",
     "fleet_bulk_tpot_p99_ms",
+    # Disaggregated prefill/decode pools: the prefill-done ->
+    # first-decode-token KV handoff window (a rise means the transfer
+    # fabric — park, gathered device_get, graft, admission-sweep
+    # reload — got more expensive), the bulk class's TPOT tail stretch
+    # while long prompts arrive (the dip the split exists to hold
+    # down), and the interactive TTFT tail under WFQ on the split
+    # fleet.
+    "disagg_handoff_ms",
+    "disagg_decode_dip_pct",
+    "disagg_interactive_ttft_p99_ms",
     # Self-healing: replica death -> probed replacement rejoined the
     # router (crash included; the supervisor PR's robustness number).
     "selfheal_restore_ms",

@@ -378,8 +378,14 @@ class FleetAutoscaler:
             )
             for r in fleet.replicas:
                 if r.state != "dead":
+                    # load_requests, NOT load(): this signal is
+                    # calibrated in requests per replica (depth_high);
+                    # the router's bucket-weighted load() would let one
+                    # long mid-prefill prompt read as dozens of queued
+                    # requests.
                     depth += max(
-                        0, r.load() - getattr(r.engine, "slots", 0)
+                        0,
+                        r.load_requests() - getattr(r.engine, "slots", 0),
                     )
             dispatchable = max(1, fleet.dispatchable_count)
         depth_per = depth / dispatchable

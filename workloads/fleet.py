@@ -74,6 +74,15 @@ ACTIVE = "active"
 DRAINING = "draining"
 DEAD = "dead"
 
+# Replica roles (Fleet(roles=...), docs/SERVING.md "Disaggregated
+# prefill/decode"): a ``prefill`` replica runs fresh prompts' budgeted
+# sweeps to completion and hands the finished KV off; a ``decode``
+# replica holds token-by-token residency and takes no fresh prompts
+# while prefill capacity lives; ``mixed`` (the default) does both —
+# today's behavior, and what every pool degrades to when its
+# counterpart pool dies.
+ROLES = ("prefill", "decode", "mixed")
+
 
 @dataclass(frozen=True)
 class SLOClass:
@@ -134,6 +143,24 @@ DEFAULT_SLO_CLASSES = (
 
 
 @dataclass
+class KVHandoff:
+    """One prefill→decode KV handoff ticket: the finished prompt's
+    page payloads (host-RAM blobs out of the prefill replica's
+    ``ServeEngine.export_kv`` — independent of the engine that produced
+    them, so a prefill replica dying AFTER the spill cannot strand the
+    ticket) plus enough identity to graft them into the target replica's
+    radix index (``import_kv``) under the right adapter salt.  An empty
+    ``blobs`` list is a valid ticket: the continuation then re-prefills
+    — bit-identical, just without the transfer discount."""
+
+    prompt: list[int]
+    adapter: str | None
+    blobs: list
+    src_replica: int
+    t_export: float
+
+
+@dataclass
 class FleetRequest:
     """One request through the fleet.  ``tokens`` is the STITCHED stream
     across replica segments (each failover's survivor segment appends);
@@ -172,6 +199,15 @@ class FleetRequest:
     # stream was parked and requeued uncharged — kept separate from
     # ``failovers`` because being low priority is not a fault.
     preemptions: int = 0
+    # Disaggregated prefill/decode: ``handoff_pending`` marks a dispatch
+    # onto a prefill-pool replica whose budget was capped at the first
+    # token (the prefill-complete signal); ``handoff`` carries the KV
+    # ticket between the prefill retire and the decode re-dispatch;
+    # ``handoffs`` counts completed prefill→decode transfers (uncharged
+    # — a handoff is the plan, not a fault).
+    handoff_pending: bool = False
+    handoff: KVHandoff | None = None
+    handoffs: int = 0
 
     @property
     def done(self) -> bool:
@@ -216,12 +252,19 @@ class Replica:
     time-slice it serves on, so health events route to exactly the
     replicas the sick chip backs."""
 
-    def __init__(self, index: int, engine, chip_id: str = ""):
+    def __init__(
+        self, index: int, engine, chip_id: str = "", role: str = "mixed",
+    ):
         import queue as _queue
 
+        if role not in ROLES:
+            raise ValueError(
+                f"replica role must be one of {ROLES}, got {role!r}"
+            )
         self.index = index
         self.engine = engine
         self.chip_id = chip_id
+        self.role = role
         self.state = ACTIVE
         self.rids: dict[str, object] = {}  # fleet rid -> engine Request
         self.slow_steps = 0
@@ -246,8 +289,33 @@ class Replica:
 
     def load(self) -> int:
         """The router's least-loaded scalar: queued + mid-prefill +
-        occupied slots (every unit is one request the replica still owes
-        work to)."""
+        occupied slots.  Queued and slotted requests count 1 each, but a
+        row parked MID-PREFILL weighs its REMAINING prompt tokens in
+        prompt-bucket units — a 4k-token prompt two chunks in is many
+        steps of sweep work, and counting it as 1 (like a finishing
+        one-token decode row) made long-prompt replicas look cheap
+        exactly when they were busiest (pinned by
+        tests/test_disagg.py::test_load_weights_midprefill_backlog)."""
+        e = self.engine
+        bucket = max(1, getattr(e, "prompt_bucket", 1))
+        backlog = 0
+        for plan in getattr(e, "_inflight_prefill", ()):
+            if not plan.get("prefill", False):
+                # Fan-out reuse rows wait on a sibling's logits — no
+                # sweep work of their own; one unit, as before.
+                backlog += 1
+                continue
+            remaining = plan["n"] - plan["cursor"] * bucket
+            backlog += max(1, -(-remaining // bucket))
+        return len(e.pending) + backlog + int(e._occupied.sum())
+
+    def load_requests(self) -> int:
+        """The PRE-weighting scalar: queued + mid-prefill + occupied,
+        one unit per REQUEST.  The autoscaler's queue-depth signal is
+        calibrated in requests per replica (``depth_high``), so it
+        reads this — feeding it ``load()``'s bucket-weighted units
+        would let one long mid-prefill prompt read as dozens of queued
+        requests and trip a spurious scale-up/brownout."""
         e = self.engine
         return (
             len(e.pending)
@@ -406,10 +474,36 @@ class Fleet:
         observer=None,
         slo_classes=None,
         slo_window_s: float = 60.0,
+        roles=None,
+        wfq_weights=None,
     ):
         engines = list(engines)
         if not engines:
             raise ValueError("a fleet needs at least one engine")
+        if roles is not None:
+            roles = list(roles)
+            if len(roles) != len(engines):
+                raise ValueError(
+                    f"roles ({len(roles)}) must match engines "
+                    f"({len(engines)})"
+                )
+            bad = [r for r in roles if r not in ROLES]
+            if bad:
+                raise ValueError(
+                    f"replica roles must be from {ROLES}, got {bad}"
+                )
+        if wfq_weights is not None:
+            import math
+
+            wfq_weights = dict(wfq_weights)
+            for cls, w in wfq_weights.items():
+                if not isinstance(w, (int, float)) or not math.isfinite(
+                    w
+                ) or w <= 0:
+                    raise ValueError(
+                        f"wfq_weights[{cls!r}] must be a finite weight "
+                        f"> 0, got {w!r}"
+                    )
         if max_pending is not None and max_pending < 1:
             raise ValueError(
                 f"max_pending must be >= 1 or None (unbounded), got "
@@ -437,9 +531,30 @@ class Fleet:
             )
         self.router = router if router is not None else Router()
         self.replicas: list[Replica] = [
-            Replica(i, eng, (chip_ids or [""] * len(engines))[i])
+            Replica(
+                i, eng, (chip_ids or [""] * len(engines))[i],
+                role=(roles or ["mixed"] * len(engines))[i],
+            )
             for i, eng in enumerate(engines)
         ]
+        # SLO-class weighted fair queuing (docs/SERVING.md
+        # "Disaggregated prefill/decode"): with weights set, fresh
+        # prompts dispatch in per-class virtual-time order instead of
+        # strict FIFO — a class's virtual time advances by its
+        # prefill cost (prompt-bucket units) over its weight per
+        # dispatch, so the contended prefill slots split in weight
+        # proportion while continuations (failover replays, handoff
+        # tickets, preempted resumptions) keep absolute precedence:
+        # they already started service.  None keeps FIFO (today's
+        # behavior).  The PR-13 preemption ladder stays the priority
+        # backstop above this: parked classes skip dispatch entirely.
+        self.wfq_weights: dict[str, float] | None = wfq_weights
+        self._wfq_vtime: dict[str, float] = {}
+        self._wfq_v = 0.0
+        self.wfq_dispatches: dict[str, int] = {}
+        self._bucket = max(
+            1, getattr(engines[0], "prompt_bucket", 1)
+        )
         self.max_pending = max_pending
         # Capacity-aware load shedding: with ``max_pending_per_replica``
         # the fleet-wide admission bound is per-replica budget x the
@@ -510,6 +625,13 @@ class Fleet:
         self.preemptions = 0
         self.preempt_resume_s: list[float] = []
         self._preempted_at: dict[str, float] = {}
+        # Disaggregated prefill/decode: completed KV handoffs, pages
+        # shipped on tickets, and the prefill-done -> first-decode-token
+        # windows the bench publishes as disagg_handoff_ms.
+        self.kv_handoffs = 0
+        self.handoff_pages = 0
+        self.handoff_s: list[float] = []
+        self._handoff_at: dict[str, float] = {}
         self.replica_crashes = 0
         self.replica_hangs = 0
         self.replicas_added = 0
@@ -576,6 +698,17 @@ class Fleet:
 
     def states(self) -> dict[int, str]:
         return {r.index: r.state for r in self.replicas}
+
+    def roles(self) -> dict[int, str]:
+        return {r.index: r.role for r in self.replicas}
+
+    @property
+    def disaggregated(self) -> bool:
+        """Does any live replica carry a specialist role?  False keeps
+        every dispatch on today's role-blind path."""
+        return any(
+            r.role != "mixed" for r in self.replicas if r.state != DEAD
+        )
 
     @property
     def dispatchable_count(self) -> int:
@@ -829,6 +962,8 @@ class Fleet:
         fr.error = error
         fr.t_done = time.perf_counter()
         self._preempted_at.pop(fr.rid, None)
+        self._handoff_at.pop(fr.rid, None)
+        fr.handoff = None  # a terminal ticket's blobs free with it
         self._close_attempt(fr, None, status)
         fr.replica = None
         counter = {
@@ -956,13 +1091,18 @@ class Fleet:
 
     # ---- membership ------------------------------------------------------
 
-    def add_replica(self, engine, chip_id: str = "") -> int:
+    def add_replica(
+        self, engine, chip_id: str = "", role: str = "mixed",
+    ) -> int:
         """Join a fresh engine live; the router dispatches to it from
-        the next step.  Returns the new replica index."""
+        the next step.  ``role`` places it in a disaggregated fleet's
+        prefill/decode pools (the supervisor passes the dead slot's
+        original role back so a resurrected pool member rejoins its
+        pool).  Returns the new replica index."""
         with self._lock:
             if self._closed:
                 raise EngineClosed("fleet is closed")
-            rep = Replica(len(self.replicas), engine, chip_id)
+            rep = Replica(len(self.replicas), engine, chip_id, role=role)
             self.replicas.append(rep)
             self.replicas_added += 1
             return rep.index
@@ -1158,36 +1298,149 @@ class Fleet:
 
     # ---- dispatch --------------------------------------------------------
 
+    def _phase(self, fr: FleetRequest) -> str:
+        """Which pool serves this request NEXT: a request with no tokens
+        yet needs its prompt prefilled; one carrying tokens (a handoff
+        continuation, failover replay or preempted resumption) is
+        decode-bound residency."""
+        return "decode" if (fr.tokens or fr.handoff is not None) else (
+            "prefill"
+        )
+
+    def _role_candidates(
+        self, fr: FleetRequest, dispatchable: list[Replica],
+    ) -> list[Replica]:
+        """Role-filter the dispatchable set for one request: fresh
+        prompts prefer the prefill pool, continuations the decode pool,
+        ``mixed`` replicas serve both.  An EMPTY preferred pool (its
+        replicas dead, paused or draining) degrades to every
+        dispatchable replica — a dead decode pool turns the fleet back
+        into mixed dispatch rather than stranding handoff tickets."""
+        if not self.disaggregated:
+            return dispatchable
+        phase = self._phase(fr)
+        pref = [
+            r for r in dispatchable if r.role in (phase, "mixed")
+        ]
+        return pref or dispatchable
+
+    def _wfq_cost(self, fr: FleetRequest) -> float:
+        """A fresh prompt's service cost in prompt-bucket units — the
+        prefill-slot work WFQ meters (a 4k-token prompt charges its
+        class ~bucket-count times a one-bucket chat turn).  Metered in
+        the FOUNDING engine's bucket (one fleet-level normalization:
+        class fairness needs a single unit even when heterogeneous
+        replicas bucket differently)."""
+        return float(max(1, -(-len(fr.prompt) // self._bucket)))
+
+    def _wfq_order(
+        self, entries: list[FleetRequest],
+    ) -> list[FleetRequest]:
+        """Order one step's dispatch attempts by SLO-class weighted
+        fair queuing: continuations first (FIFO — they already hold
+        service), then fresh prompts by per-class virtual finish time
+        (class virtual time + cost/weight, FIFO within a class; ties
+        break on class name, then arrival).  A class re-entering
+        service starts at the fleet's current virtual time — idling
+        banks no credit.  Pure simulation over copies: the persistent
+        clocks only advance on ACTUAL dispatch, so a request that
+        finds no candidate charges nothing."""
+        cont = [fr for fr in entries if self._phase(fr) == "decode"]
+        fresh = [fr for fr in entries if self._phase(fr) == "prefill"]
+        if not fresh:
+            return cont
+        weights = self.wfq_weights or {}
+        per_class: dict[str, deque[FleetRequest]] = {}
+        for fr in fresh:
+            per_class.setdefault(fr.slo_class or "", deque()).append(fr)
+        # Each backlogged class's virtual clock floors to the fleet's
+        # current virtual time ONCE, at batch entry (no banked credit
+        # from idling) — flooring per pick would drag waiting classes
+        # forward with every other class's service and serialize the
+        # batch instead of interleaving it.
+        vt = {
+            c: max(self._wfq_vtime.get(c, 0.0), self._wfq_v)
+            for c in per_class
+        }
+        ordered: list[FleetRequest] = []
+
+        def finish_tag(cls: str) -> tuple[float, str]:
+            # Classic WFQ picks by virtual FINISH time of each class's
+            # head (start + cost/weight), not start time — on equal
+            # starts, a light high-weight prompt must beat a heavy
+            # low-weight one, which start-time selection would decide
+            # by name alone.
+            head = per_class[cls][0]
+            return (
+                vt[cls] + self._wfq_cost(head) / weights.get(cls, 1.0),
+                cls,
+            )
+
+        while per_class:
+            cls = min(per_class, key=finish_tag)
+            fr = per_class[cls].popleft()
+            if not per_class[cls]:
+                del per_class[cls]
+            vt[cls] += self._wfq_cost(fr) / weights.get(cls, 1.0)
+            ordered.append(fr)
+        return cont + ordered
+
+    def _wfq_charge(self, fr: FleetRequest, v_base: float) -> None:
+        """Advance the persistent WFQ clocks for one ACTUAL dispatch —
+        the same recurrence ``_wfq_order`` simulated: each class floors
+        ONCE against the batch-entry virtual time ``v_base`` (flooring
+        against a per-dispatch ratchet would overcharge classes whose
+        heads dispatch later in the batch and skew the cross-step
+        share below the configured weights).  Continuations are free."""
+        if self.wfq_weights is None or self._phase(fr) != "prefill":
+            return
+        cls = fr.slo_class or ""
+        start = max(self._wfq_vtime.get(cls, 0.0), v_base)
+        self._wfq_vtime[cls] = start + self._wfq_cost(fr) / (
+            self.wfq_weights.get(cls, 1.0)
+        )
+        self.wfq_dispatches[cls] = self.wfq_dispatches.get(cls, 0) + 1
+
     def _dispatch_queued(self) -> list[FleetRequest]:
         """Hand router-queued requests to replicas: least-loaded +
         affinity via the Router, against a WORKING load view bumped per
         dispatch so one step spreads its admissions.  Failover replays
         sit at the queue front and re-prefill prompt + stitched tokens.
-        Returns requests that finished terminally at dispatch (expired
-        in queue, or nothing left to serve them)."""
+        With roles set, fresh prompts go to the prefill pool and
+        continuations (handoff tickets included) to the decode pool
+        (mixed serves both; an empty pool degrades to any replica);
+        with ``wfq_weights`` set, fresh prompts dispatch in per-class
+        weighted-fair order instead of strict FIFO.  Returns requests
+        that finished terminally at dispatch (expired in queue, or
+        nothing left to serve them)."""
         finished: list[FleetRequest] = []
         if not self.queue:
             return finished
         t0 = time.perf_counter()
         now = t0
-        candidates = [r for r in self.replicas if r.dispatchable]
-        loads = {r.index: r.load() for r in candidates}
-        still_queued: deque[FleetRequest] = deque()
-        while self.queue:
-            fr = self.queue.popleft()
-            if fr.done:
-                continue
+        dispatchable = [r for r in self.replicas if r.dispatchable]
+        loads = {r.index: r.load() for r in dispatchable}
+        entries = [fr for fr in self.queue if not fr.done]
+        self.queue.clear()
+        order = (
+            self._wfq_order(entries) if self.wfq_weights is not None
+            else entries
+        )
+        v_base = self._wfq_v  # batch-entry virtual time; see _wfq_charge
+        charged: set[str] = set()
+        removed: set[int] = set()
+        for fr in order:
             if fr.t_deadline is not None and now >= fr.t_deadline:
                 finished.append(self._finish_terminal(fr, "expired"))
+                removed.add(id(fr))
                 continue
             if fr.slo_class in self.parked_classes:
-                # Ladder step 2: the class is parked — hold position
-                # in the queue (deadlines above still apply) until the
-                # autoscaler unparks it.
-                still_queued.append(fr)
+                # Ladder step 2 (WFQ's priority backstop): the class is
+                # parked — hold position in the queue (deadlines above
+                # still apply) until the autoscaler unparks it.
                 continue
+            candidates = self._role_candidates(fr, dispatchable)
             if not candidates:
-                still_queued.append(fr)
                 continue
             pick = self.router.choose(fr, candidates, loads)
             try:
@@ -1198,12 +1451,41 @@ class Fleet:
                 finished.append(self._finish_terminal(
                     fr, "failed", error=f"{type(exc).__name__}: {exc}"
                 ))
+                removed.add(id(fr))
                 continue
             except EngineClosed:
-                still_queued.append(fr)  # raced a death; redispatch next step
-                continue
-            loads[pick] += 1
-        self.queue = still_queued
+                continue  # raced a death; redispatch next step
+            if self.wfq_weights is not None and (
+                self._phase(fr) == "prefill"
+            ):
+                self._wfq_charge(fr, v_base)
+                charged.add(fr.slo_class or "")
+            # Bump the working view by the request's PREFILL cost in
+            # the same bucket units Replica.load() now reports — a +1
+            # bump would let one step pile short prompts onto the
+            # replica that just took a 4k-token prefill.  The CHOSEN
+            # replica's own bucket, not the fleet norm: heterogeneous
+            # fleets are legal and load() reports per-engine units.
+            rep_bucket = max(1, getattr(
+                self.replicas[pick].engine, "prompt_bucket", 1
+            ))
+            loads[pick] += max(1, -(-(
+                len(fr.prompt) + len(fr.tokens)
+            ) // rep_bucket))
+            removed.add(id(fr))
+        if charged:
+            # The fleet's virtual time after the batch: the LEAST
+            # advanced served class's clock (monotone — every charge
+            # floored at v_base and added positive cost/weight).  An
+            # idle class re-entering next batch floors to this.
+            self._wfq_v = min(
+                self._wfq_vtime[c] for c in charged
+            )
+        # Undispatched requests keep their ARRIVAL order (WFQ reorders
+        # dispatch attempts, never the queue itself).
+        self.queue = deque(
+            fr for fr in entries if id(fr) not in removed
+        )
         self.router_secs += time.perf_counter() - t0
         return finished
 
@@ -1213,9 +1495,48 @@ class Fleet:
         tokens, the budget the remaining tokens — greedy continuation
         of prompt+emitted is bit-identical to the uninterrupted
         stream, so a failed-over stream resumes exactly where the
-        client's stopped."""
+        client's stopped.
+
+        Disaggregation hooks: a fresh prompt landing on a PREFILL-pool
+        replica (with a live handoff target elsewhere) caps its budget
+        at the first token — the token that rides the fused prefill
+        readback — so the replica retires it at prefill-complete and
+        ``_absorb_finished`` turns the retirement into a KV handoff.
+        A request carrying a handoff ticket grafts the ticket's page
+        blobs into THIS replica's radix index first (``import_kv``),
+        so the submit's admission lookup reloads them instead of
+        re-running the prefill; a failed graft just means the replay
+        re-prefills — bit-identical either way."""
         prompt = fr.prompt + fr.tokens
         remaining = fr.max_new_tokens - len(fr.tokens)
+        fr.handoff_pending = False
+        if (
+            rep.role == "prefill"
+            and not fr.tokens
+            and remaining > 1
+            and any(
+                r.role in ("decode", "mixed")
+                for r in self.replicas
+                if r.state != DEAD and r.index != rep.index
+            )
+        ):
+            remaining = 1
+            fr.handoff_pending = True
+        ticket = fr.handoff
+        pages_in = 0
+        if (
+            ticket is not None
+            and ticket.blobs
+            and rep.index != ticket.src_replica
+        ):
+            # Back on the exporter (degrade): its own index still holds
+            # the pages — grafting would be a no-op by construction.
+            try:
+                pages_in = rep.engine.import_kv(
+                    ticket.prompt, ticket.blobs, adapter=ticket.adapter,
+                )
+            except Exception:  # noqa: BLE001 — a graft failure must
+                pass  # degrade to plain re-prefill, never block dispatch
         deadline = None
         if fr.t_deadline is not None:
             deadline = max(fr.t_deadline - time.perf_counter(), 1e-6)
@@ -1223,6 +1544,13 @@ class Fleet:
             prompt, remaining, eos_token=fr.eos_token, rid=fr.rid,
             adapter=fr.adapter, deadline_s=deadline,
         )
+        # The ticket is consumed (and its pages counted) only once the
+        # submit LANDED: an EngineClosed race requeues the request
+        # still carrying its ticket, so the next dispatch onto a live
+        # decode replica keeps the transfer discount (a graft into the
+        # dying engine is gone with it — harmless).
+        fr.handoff = None
+        self.handoff_pages += pages_in
         ereq = rep.engine.pending[-1]  # submit() appends its Request
         rep.rids[fr.rid] = ereq
         fr.replica = rep.index
@@ -1362,10 +1690,25 @@ class Fleet:
             self.preempt_resume_s.append(
                 time.perf_counter() - self._preempted_at.pop(ereq.rid)
             )
+        if ereq.rid in self._handoff_at and ereq.tokens:
+            self.handoff_s.append(
+                time.perf_counter() - self._handoff_at.pop(ereq.rid)
+            )
         fr.tokens.extend(int(t) for t in ereq.tokens)
         fr.segments += 1
         fr.replica = None
         if ereq.status == "ok":
+            if fr.handoff_pending and not (
+                len(fr.tokens) >= fr.max_new_tokens
+                or (
+                    fr.eos_token is not None
+                    and fr.tokens
+                    and fr.tokens[-1] == fr.eos_token
+                )
+            ):
+                # Prefill-complete, stream not: retire here becomes a
+                # KV handoff to the decode pool instead of a terminal.
+                return self._handoff(rep, fr)
             return [self._finish_terminal(fr, "ok")]
         if ereq.status in ("cancelled", "expired"):
             return [self._finish_terminal(fr, ereq.status, ereq.error)]
@@ -1374,6 +1717,35 @@ class Fleet:
             [fr], charge=True,
             error=ereq.error or "engine retry budget exhausted",
         )
+
+    def _handoff(self, rep: Replica, fr: FleetRequest) -> list:
+        """Turn a prefill-pool retirement into a KV handoff: export the
+        finished prompt's pages off the prefill replica (parked to the
+        host tier — one gathered device_get — and packaged as blobs
+        that outlive the exporter), attach the ticket, and requeue the
+        stream at the queue FRONT for the decode pool, UNCHARGED (a
+        handoff is the plan, not a fault).  An export failure ships an
+        empty ticket: the decode replica re-prefills — bit-identical,
+        just without the transfer discount.  Opens the prefill-done ->
+        first-decode-token window published as disagg_handoff_ms."""
+        fr.handoff_pending = False
+        t_export = time.perf_counter()
+        blobs = None
+        try:
+            blobs = rep.engine.export_kv(fr.prompt, adapter=fr.adapter)
+        except Exception:  # noqa: BLE001 — a failed export degrades to
+            blobs = None  # replay re-prefill, never fails the stream
+        fr.handoff = KVHandoff(
+            prompt=list(fr.prompt), adapter=fr.adapter,
+            blobs=list(blobs or ()), src_replica=rep.index,
+            t_export=t_export,
+        )
+        fr.handoffs += 1
+        self.kv_handoffs += 1
+        self._handoff_at[fr.rid] = t_export
+        fr.status = "queued"
+        self.queue.appendleft(fr)
+        return []
 
     def _observe_progress(self, rep: Replica) -> None:
         """Per-step stamps off the replica's live requests: fleet-level
@@ -1407,6 +1779,12 @@ class Fleet:
                 # bench's autoscale_preempt_resume_ms window.
                 self.preempt_resume_s.append(
                     time.perf_counter() - self._preempted_at.pop(rid)
+                )
+            if rid in self._handoff_at and ereq.tokens:
+                # Prefill-done -> first decode-pool token: the bench's
+                # disagg_handoff_ms window.
+                self.handoff_s.append(
+                    time.perf_counter() - self._handoff_at.pop(rid)
                 )
 
     def step(self) -> list[FleetRequest]:
@@ -1730,6 +2108,53 @@ class TrafficGen:
             for t, prompt, new in self.schedule(n, profile)
         ]
 
+    def schedule_per_class(
+        self, n: int, profile=None,
+    ) -> list[tuple[float, list[int], int, str]]:
+        """TRUE per-class arrival streams (ROADMAP item 1): one
+        INDEPENDENT seeded Markov-modulated arrival process per SLO
+        class in ``class_mix``, merged by arrival time.  Each class's
+        process runs at its weight share of ``rate_rps`` with its own
+        derived seed (stable hash of the class name — not Python's
+        salted ``hash``), so its arrivals, bursts, prompts and budgets
+        are a deterministic function of (seed, class name, weight
+        share, its arrival count) ALONE: reordering ``class_mix``
+        entries, or the draws of any other class, cannot move a single
+        token of this class's sub-stream (pinned by
+        tests/test_disagg.py).  This is what ``schedule_classed``'s
+        shared-process class draw could not give: bursty interactive
+        chat and smooth bulk generation as genuinely different arrival
+        processes, not one process wearing two tags.  ``n`` splits
+        across classes in weight proportion (each class gets >= 1
+        arrival); ``profile`` rescales every class's gaps alike."""
+        import zlib
+
+        if not self.class_mix:
+            raise ValueError(
+                "schedule_per_class needs a non-empty class_mix"
+            )
+        total = sum(float(w) for _, w in self.class_mix)
+        if total <= 0:
+            raise ValueError(
+                f"class_mix weights must sum > 0, got {self.class_mix}"
+            )
+        import dataclasses
+
+        merged: list[tuple[float, list[int], int, str]] = []
+        for name, w in self.class_mix:
+            share = float(w) / total
+            sub = dataclasses.replace(
+                self,
+                seed=(self.seed << 16) ^ zlib.crc32(name.encode()),
+                rate_rps=self.rate_rps * share,
+            )
+            for t, prompt, new in sub.schedule(
+                max(1, round(n * share)), profile
+            ):
+                merged.append((t, prompt, new, name))
+        merged.sort(key=lambda e: (e[0], e[3]))
+        return merged
+
     @staticmethod
     def schedule_stats(schedule, window_s: float = 1.0) -> dict:
         """Reproducibility stats for a generated schedule (the
@@ -1766,6 +2191,21 @@ class TrafficGen:
             for e in entries:
                 counts[e[3]] = counts.get(e[3], 0) + 1
             out["class_counts"] = dict(sorted(counts.items()))
+            # Per-class mean arrival rate over the class's OWN span —
+            # the audit line for per-class streams (schedule_per_class):
+            # each class's realized rate should sit near its weight
+            # share of the generator's rate.
+            rates: dict[str, float | None] = {}
+            for name in counts:
+                offs = [float(e[0]) for e in entries if e[3] == name]
+                span = max(offs) - min(offs)
+                # A single-arrival class has no span to rate over —
+                # None, not the absurd 1/epsilon.
+                rates[name] = (
+                    round(len(offs) / span, 3)
+                    if len(offs) > 1 and span > 0 else None
+                )
+            out["class_mean_rps"] = dict(sorted(rates.items()))
         return out
 
 

@@ -284,6 +284,37 @@ FLEET_METRICS: tuple[MetricSpec, ...] = (
         "per-token decode time (first token -> done over tokens-1), by "
         "SLO class (the bulk class's bound)",
     ),
+    # Disaggregated prefill/decode pools (Fleet(roles=...), docs/
+    # SERVING.md "Disaggregated prefill/decode"): KV handoff volume and
+    # latency, the per-class WFQ dispatch split, and the live role map.
+    MetricSpec(
+        "fleet_kv_handoffs_total", "counter", ("fleet",),
+        "prefill→decode KV handoffs: prompts whose finished pages were "
+        "exported off a prefill-pool replica and continued on the "
+        "decode pool (greedy streams bit-identical to mixed dispatch)",
+    ),
+    MetricSpec(
+        "fleet_handoff_pages_total", "counter", ("fleet",),
+        "KV pages adopted from handoff tickets by decode-pool replicas "
+        "(grafted into the target's radix index; reloaded on the "
+        "admission sweep)",
+    ),
+    MetricSpec(
+        "fleet_handoff_seconds", "histogram", ("fleet",),
+        "prefill-done -> first decode-pool token per handed-off stream "
+        "(the bench's disagg_handoff_ms window)",
+    ),
+    MetricSpec(
+        "fleet_wfq_dispatches_total", "counter", ("fleet", "slo_class"),
+        "fresh-prompt dispatches granted by the SLO-class weighted "
+        "fair queue, by class (wfq_weights=; continuations are free — "
+        "they already hold service)",
+    ),
+    MetricSpec(
+        "fleet_replica_role", "gauge", ("fleet", "replica", "role"),
+        "1 for each live replica's disaggregation role "
+        "(prefill/decode/mixed; scrape-time)",
+    ),
 )
 
 # Supervisor-level metric families (workloads/supervisor.py;
@@ -985,6 +1016,10 @@ class FleetObserver:
             ({"slo_class": name}, float(rate))
             for name, rate in sorted(e.slo_burn_rates().items())
         ],
+        "fleet_replica_role": lambda e: [
+            ({"replica": str(r.index), "role": r.role}, 1.0)
+            for r in e.replicas if r.state != "dead"
+        ],
     }
 
     # Counter family -> Fleet attribute carrying the running total.
@@ -994,6 +1029,8 @@ class FleetObserver:
         "fleet_failovers_total": "failover_requeues",
         "fleet_drain_requeues_total": "drain_requeues",
         "fleet_queue_rejections_total": "queue_rejections",
+        "fleet_kv_handoffs_total": "kv_handoffs",
+        "fleet_handoff_pages_total": "handoff_pages",
     }
 
     def bind_registry(self, reg, labels: dict | None = None) -> None:
@@ -1076,6 +1113,24 @@ class FleetObserver:
                     {**labels, "kind": kind}, delta,
                 )
                 self._pushed[metric] = total
+        for cls, total in sorted(
+            getattr(fleet, "wfq_dispatches", {}).items()
+        ):
+            metric = f"fleet_wfq_dispatches_total:{cls}"
+            delta = float(total) - self._pushed.get(metric, 0.0)
+            if delta:
+                reg.inc(
+                    "fleet_wfq_dispatches_total",
+                    {**labels, "slo_class": cls or "untagged"}, delta,
+                )
+                self._pushed[metric] = float(total)
+        # Handoff windows closed since the last step (the list only
+        # appends, so the pushed length is the delta cursor).
+        windows = getattr(fleet, "handoff_s", ())
+        seen = int(self._pushed.get("fleet_handoff_seconds:n", 0.0))
+        for secs in list(windows)[seen:]:
+            reg.observe_seconds("fleet_handoff", secs, labels)
+        self._pushed["fleet_handoff_seconds:n"] = float(len(windows))
         for span in new_spans:
             if span.queue_wait_secs is not None:
                 reg.observe_seconds(

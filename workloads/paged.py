@@ -416,6 +416,7 @@ class RadixKV:
         self.misses = 0  # lookups that matched nothing
         self.reloads = 0  # pages brought back from the host tier
         self.spills = 0  # pages pushed out to the host tier
+        self.grafts = 0  # pages adopted from another index's handoff
         self._resident = 0
         self._offloaded = 0
 
@@ -608,6 +609,7 @@ class RadixKV:
 
     def park(
         self, tokens: list[int], salt: str = "", spill=None,
+        spill_many=None,
     ) -> int:
         """Preemption-via-offload: push THIS path's resident pages out
         to the host tier NOW (LRU coldness notwithstanding), so a
@@ -617,17 +619,25 @@ class RadixKV:
         the ``tokens`` path under ``salt`` and spills every resident
         page only the index holds (pool refcount 1 — a page another
         live sequence still reads stays put); already-offloaded nodes
-        are skipped, and without a ``spill`` callback or host budget
+        are skipped, and without a spill callback or host budget
         nothing moves (graceful degrade: the pages stay resident and
         ordinary LRU pressure evicts them later).  Returns the pages
         parked; resumption is just a lookup — the reload callback
-        brings them back bit-exactly."""
-        if spill is None:
+        brings them back bit-exactly.
+
+        ``spill_many(pages) -> blobs`` is the BATCHED spill seam: the
+        whole path's victims are collected first and copied out in one
+        gathered call (the engine pays ONE fused device_get per park
+        instead of one round trip per page); ``spill(page) -> blob``
+        remains as the per-page fallback.  Both produce identical blobs
+        (pinned), so which seam ran can never change a stream."""
+        if spill is None and spill_many is None:
             return 0
         node = self._roots.get(salt)
         if node is None:
             return 0
-        ps, parked = self.page_size, 0
+        ps = self.page_size
+        victims: list[RadixNode] = []
         for i in range(len(tokens) // ps):
             node = node.children.get(tuple(tokens[i * ps : (i + 1) * ps]))
             if node is None:
@@ -636,19 +646,104 @@ class RadixKV:
                 continue  # already in the host tier
             if self.ctrl.refcounts.get(node.page) != 1:
                 continue  # a live reader still holds it
-            if not self._host_budget_left():
+            if self.host_pages is not None and (
+                self._offloaded + len(victims) >= self.host_pages
+            ):
                 break
-            blob = spill(node.page)
+            victims.append(node)
+        if not victims:
+            return 0
+        if spill_many is not None:
+            blobs = list(spill_many([n.page for n in victims]))
+        else:
+            blobs = [spill(n.page) for n in victims]
+        parked = 0
+        for n, blob in zip(victims, blobs):
             if blob is None:
                 break
-            self.ctrl.release_page(node.page)
-            node.page = None
-            node.host = blob
+            self.ctrl.release_page(n.page)
+            n.page = None
+            n.host = blob
             self._resident -= 1
             self._offloaded += 1
             self.spills += 1
             parked += 1
         return parked
+
+    # ---- cross-engine KV handoff ----------------------------------------
+
+    def export_path(self, tokens, salt: str = "", copy_many=None) -> list:
+        """The ``tokens`` path's page payloads, in path order — the KV
+        handoff EXPORT half (docs/SERVING.md "Disaggregated
+        prefill/decode").  Offloaded nodes contribute their host blob
+        by reference (blobs are immutable once written, so trees can
+        share them); resident nodes copy their bytes out through
+        ``copy_many(pages) -> blobs`` (the engine's gathered spill —
+        one fused device_get for the whole path) WITHOUT releasing or
+        moving anything: exporting never changes what this index
+        holds.  The payload is always a CONTIGUOUS prefix of the path
+        — it stops at the first unknown block, or at the first
+        resident node when no ``copy_many`` is given."""
+        node = self._roots.get(salt)
+        if node is None:
+            return []
+        ps = self.page_size
+        entries: list[tuple[str, object]] = []
+        for i in range(len(tokens) // ps):
+            node = node.children.get(tuple(tokens[i * ps : (i + 1) * ps]))
+            if node is None:
+                break
+            if node.host is not None:
+                entries.append(("host", node.host))
+            elif node.page is not None:
+                if copy_many is None:
+                    break  # cannot copy a resident page: stop before it
+                entries.append(("page", node.page))
+            else:
+                break  # defensive: a payload gap ends the contiguous run
+        pages = [p for kind, p in entries if kind == "page"]
+        copies = iter(copy_many(pages)) if pages else iter(())
+        return [
+            payload if kind == "host" else next(copies)
+            for kind, payload in entries
+        ]
+
+    def graft(self, tokens, blobs: list, salt: str = "") -> int:
+        """Adopt another index's exported payload as OFFLOADED nodes —
+        the KV handoff IMPORT half: ``blobs`` are ``export_path``'s
+        host blobs for the first ``len(blobs)`` page blocks of
+        ``tokens``.  Blocks this tree already knows (resident or
+        offloaded) just refresh LRU — their bytes are identical by
+        construction — and new nodes land in the host tier under the
+        ordinary ``host_pages`` budget (a partial graft is a shorter
+        future hit, never an error).  The next lookup reloads grafted
+        pages through the usual reload callback, riding the admission
+        sweep like any offloaded hit; the round trip is bit-exact, so
+        a grafted continuation streams identically to a re-prefilled
+        one (pinned by tests/test_disagg.py)."""
+        ps = self.page_size
+        if len(blobs) > len(tokens) // ps:
+            raise ValueError(
+                f"graft got {len(blobs)} page blobs but tokens cover "
+                f"only {len(tokens) // ps} full pages"
+            )
+        node = self._roots.setdefault(salt, RadixNode(None, None))
+        grafted = 0
+        for i, blob in enumerate(blobs):
+            block = tuple(tokens[i * ps : (i + 1) * ps])
+            child = node.children.get(block)
+            if child is None:
+                if blob is None or not self._host_budget_left():
+                    break
+                child = RadixNode(block, node)
+                child.host = blob
+                node.children[block] = child
+                self._offloaded += 1
+                self.grafts += 1
+                grafted += 1
+            child.last_use = self._tick()
+            node = child
+        return grafted
 
     def clear(self) -> None:
         """Drop the whole index: resident pages release back to the
@@ -694,6 +789,27 @@ def read_page(pools: tuple[jax.Array, jax.Array], src):
 
     def one(pool):
         return jax.lax.dynamic_slice_in_dim(pool, src, 1, axis=1)[:, 0]
+
+    return one(k_pages), one(v_pages)
+
+
+@jax.jit
+def read_pages(pools: tuple[jax.Array, jax.Array], srcs):
+    """Gather N physical pages (all layers, k and v) out of the pools in
+    ONE dispatch — the BATCHED spill primitive: a multi-page park or KV
+    handoff export device_gets the returned pair once instead of paying
+    one ``read_page`` round trip per page (kv_offload_spill_ms drops
+    ~n-fold for n-page parks).  ``srcs`` is a traced [n] vector, so
+    every same-count spill shares one compile; callers pad the count to
+    a bucket (the engine pads to the next power of two) to bound the
+    compile set.  Returns (k [L, n, Hkv, ps, hd], v [L, n, Hkv, ps, hd])
+    — slicing column ``i`` yields exactly ``read_page``'s bytes for
+    ``srcs[i]`` (bit-exactness pinned by tests)."""
+    k_pages, v_pages = pools
+    srcs = jnp.asarray(srcs, jnp.int32)
+
+    def one(pool):
+        return jnp.take(pool, srcs, axis=1)
 
     return one(k_pages), one(v_pages)
 

@@ -1372,16 +1372,18 @@ def measure_fleet(scale: BenchScale) -> dict:
         fleet.close()
     rec_ms = [r * 1000 for r in recoveries]
 
-    # Per-class SLO attainment: the same open-loop generator with
-    # class-tagged arrivals (schedule_classed keeps arrivals, prompts
-    # and budgets bit-identical to the unclassed stream — tagging
-    # cannot move tokens, and the class draw is its own seeded rng).
-    classed = gen.schedule_classed(n_req)
+    # Per-class SLO attainment: the same generator with TRUE per-class
+    # arrival streams (schedule_per_class: one independent seeded
+    # Markov-modulated process per class at its weight share of the
+    # rate — bursty interactive chat and smoother bulk generation as
+    # genuinely different processes, not one process wearing two tags).
+    classed = gen.schedule_per_class(n_req)
     fleet_slo = build_fleet(n_rep)
     streams = drive_open_loop(fleet_slo, classed, session_every=4)
-    if len(streams) != n_req:
+    if len(streams) != len(classed):
         raise RuntimeError(
-            f"fleet SLO bench served {len(streams)} of {n_req} requests"
+            f"fleet SLO bench served {len(streams)} of "
+            f"{len(classed)} requests"
         )
     done = fleet_slo.drain_completed()
     attainment = fleet_slo.slo_attainment()
@@ -1490,6 +1492,241 @@ def measure_fleet(scale: BenchScale) -> dict:
         "failover_recovery_ms_max": round(max(rec_ms), 2),
         "failover_requeued": requeued,
     }
+
+
+def measure_disagg(scale: BenchScale) -> dict:
+    """Disaggregated prefill/decode pools vs a mixed fleet
+    (docs/SERVING.md "Disaggregated prefill/decode"), measured as
+    INTERLEAVED repeats of the SAME seeded per-class open-loop stream
+    (schedule_per_class: independent interactive/bulk arrival
+    processes) through two 3-replica fleets — all-mixed vs
+    roles=[prefill, decode, decode] with SLO-class WFQ armed — with
+    every pair's token streams ASSERTED bit-identical before any
+    number is published (the split may move WHERE work runs, never
+    what a client receives):
+
+      * ``disagg_handoff_ms`` — prefill-done -> first decode-pool
+        token per handed-off stream (the KV transfer's price: park +
+        one gathered device_get on the prefill replica, graft + a
+        write_page reload riding the decode replica's admission
+        sweep), pooled across repeats with min/max spread.
+      * ``disagg_decode_dip_pct`` — the bulk class's TPOT tail
+        stretch (p99/p50 - 1) on the DISAGG arm: how much long
+        prompts arriving dents steady decode cadence when prefill
+        runs on its own pool.  ``disagg_mixed_decode_dip_pct`` is the
+        same number on the mixed arm — the headline comparison (the
+        split should hold the disagg dip at or below mixed).
+      * ``disagg_interactive_ttft_p99_ms`` — the interactive class's
+        TTFT tail on the disagg arm (WFQ prefers it into prefill
+        slots), next to the mixed arm's for the delta.
+      * per-class ATTAINMENT deltas (disagg minus mixed) and the
+        throughput ratio ``disagg_vs_mixed_tokens_per_sec``.
+
+    Every handoff ships real pages: the arm asserts >= 1 handoff AND
+    >= 1 ticket page grafted into a decode replica per disagg run."""
+    import statistics
+
+    from .fleet import Fleet, TrafficGen, drive_open_loop
+    from .quant import quantize_params
+    from .serve import ServeEngine
+
+    batch, ps = scale.batch, scale.page_size
+    chunk = ps
+    hi = scale.serve_chunks[1]
+    prompt_len = max(scale.decode_prompt, 2 * ps)
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
+        n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=prompt_len + 1 + hi * chunk,
+    )
+    params = quantize_params(
+        jax.tree.map(
+            lambda w: w.astype(config.dtype),
+            init_params(config, jax.random.PRNGKey(0)),
+        )
+    )
+    n_rep = 3
+    roles = ["prefill", "decode", "decode"]
+    n_req = 4 * batch
+    gen = TrafficGen(
+        # min_prompt = one full page so EVERY prompt has pages to hand
+        # off; the Pareto tail reaches prompt_len — the long prompts
+        # whose prefill the dip metric watches.
+        seed=13, rate_rps=100.0, min_prompt=ps, max_prompt=prompt_len,
+        min_new=1 + chunk, max_new=1 + hi * chunk,
+        vocab=config.vocab_size,
+    )
+    classed = gen.schedule_per_class(n_req)
+    sched_stats = TrafficGen.schedule_stats(classed)
+
+    def build_fleet(split: bool) -> Fleet:
+        engines = [
+            ServeEngine(
+                params, config, slots=batch, page_size=ps, chunk=chunk,
+                # One-page buckets + a one-chunk budget: prompts run
+                # the BUDGETED sweep (page-granular prefix hits, so a
+                # grafted ticket always reloads), the tentpole's
+                # composition claim.
+                prompt_bucket=ps, prefill_budget=ps, pipelined=True,
+                prefix_cache=True, kv_offload=True,
+            )
+            for _ in range(n_rep)
+        ]
+        fleet = Fleet(
+            engines, chip_ids=[f"chip-{i}" for i in range(n_rep)],
+            hang_timeout_s=60.0,
+            roles=roles if split else None,
+            wfq_weights=(
+                {"interactive": 3.0, "bulk": 1.0} if split else None
+            ),
+        )
+        # Warm every pool's compiles AND the handoff path itself (one
+        # multi-page prompt covers the gathered-spill shapes), off the
+        # measured clock.
+        for i in range(n_rep):
+            fleet.submit([1 + i] * ps, 2, session=f"warm-{i}")
+        fleet.submit(list(range(2, 2 + prompt_len)), 1 + chunk)
+        fleet.run()
+        fleet.drain_completed()
+        return fleet
+
+    def run_arm(split: bool) -> dict:
+        fleet = build_fleet(split)
+        handoffs0 = fleet.kv_handoffs
+        pages0 = fleet.handoff_pages
+        windows0 = len(fleet.handoff_s)
+        tokens0 = fleet.generated_tokens
+        t0 = time.perf_counter()
+        streams = drive_open_loop(fleet, classed, session_every=4)
+        secs = time.perf_counter() - t0
+        if len(streams) != len(classed):
+            raise RuntimeError(
+                f"disagg bench served {len(streams)} of {len(classed)} "
+                "requests"
+            )
+        done = fleet.drain_completed()
+        statuses = {fr.status for fr in done}
+        if statuses != {"ok"}:
+            raise RuntimeError(
+                f"disagg bench expected every request ok, saw {statuses}"
+            )
+        out = {
+            "streams": streams,
+            "rate": (fleet.generated_tokens - tokens0) / secs,
+            "handoffs": fleet.kv_handoffs - handoffs0,
+            "pages": fleet.handoff_pages - pages0,
+            "handoff_ms": [
+                s * 1000 for s in fleet.handoff_s[windows0:]
+            ],
+            "attainment": fleet.slo_attainment(),
+        }
+        for name in ("interactive", "bulk"):
+            frs = [fr for fr in done if fr.slo_class == name]
+            ttfts = [
+                fr.ttft_secs * 1000 for fr in frs
+                if fr.ttft_secs is not None
+            ]
+            tpots = [
+                fr.tpot_secs * 1000 for fr in frs
+                if fr.tpot_secs is not None
+            ]
+            out[f"{name}_ttft_p99_ms"] = (
+                _pctl(ttfts, 0.99) if ttfts else None
+            )
+            out[f"{name}_tpot_p50_ms"] = (
+                _pctl(tpots, 0.50) if tpots else None
+            )
+            out[f"{name}_tpot_p99_ms"] = (
+                _pctl(tpots, 0.99) if tpots else None
+            )
+        if split:
+            if out["handoffs"] < 1 or out["pages"] < 1:
+                raise RuntimeError(
+                    f"disagg bench moved no KV: {out['handoffs']} "
+                    f"handoffs, {out['pages']} ticket pages grafted — "
+                    "the split fleet is not actually handing off"
+                )
+        fleet.close()
+        return out
+
+    mixed_runs, disagg_runs = _interleaved_repeats(
+        lambda: run_arm(False), lambda: run_arm(True), repeats=2,
+    )
+    for m, d in zip(mixed_runs, disagg_runs):
+        if m["streams"] != d["streams"]:
+            raise RuntimeError(
+                "disagg bench: split-fleet streams diverged from the "
+                "mixed fleet on the same seeded stream — the "
+                "prefill/decode handoff is supposed to be bit-identical"
+            )
+
+    def dip(run: dict) -> float | None:
+        p50, p99 = run["bulk_tpot_p50_ms"], run["bulk_tpot_p99_ms"]
+        if not p50 or p99 is None:
+            return None
+        return (p99 / p50 - 1.0) * 100.0
+
+    handoff_ms = sorted(
+        ms for r in disagg_runs for ms in r["handoff_ms"]
+    )
+    dips_d = [v for v in (dip(r) for r in disagg_runs) if v is not None]
+    dips_m = [v for v in (dip(r) for r in mixed_runs) if v is not None]
+    ttfts_d = [
+        r["interactive_ttft_p99_ms"] for r in disagg_runs
+        if r["interactive_ttft_p99_ms"] is not None
+    ]
+    ttfts_m = [
+        r["interactive_ttft_p99_ms"] for r in mixed_runs
+        if r["interactive_ttft_p99_ms"] is not None
+    ]
+    ratios = [
+        d["rate"] / m["rate"]
+        for d, m in zip(disagg_runs, mixed_runs)
+    ]
+    out = {
+        "disagg_replicas": n_rep,
+        "disagg_roles": ",".join(roles),
+        "disagg_requests": len(classed),
+        "disagg_schedule_stats": sched_stats,
+        "disagg_handoffs": disagg_runs[-1]["handoffs"],
+        "disagg_handoff_pages": disagg_runs[-1]["pages"],
+        "disagg_handoff_ms": round(statistics.median(handoff_ms), 2),
+        "disagg_handoff_ms_min": round(handoff_ms[0], 2),
+        "disagg_handoff_ms_max": round(handoff_ms[-1], 2),
+        "disagg_vs_mixed_tokens_per_sec": round(
+            statistics.median(ratios), 3
+        ),
+        "disagg_vs_mixed_tokens_per_sec_min": round(min(ratios), 3),
+        "disagg_vs_mixed_tokens_per_sec_max": round(max(ratios), 3),
+    }
+    if dips_d:
+        out["disagg_decode_dip_pct"] = round(statistics.median(dips_d), 2)
+        out["disagg_decode_dip_pct_min"] = round(min(dips_d), 2)
+        out["disagg_decode_dip_pct_max"] = round(max(dips_d), 2)
+    if dips_m:
+        out["disagg_mixed_decode_dip_pct"] = round(
+            statistics.median(dips_m), 2
+        )
+    if ttfts_d:
+        out["disagg_interactive_ttft_p99_ms"] = round(
+            statistics.median(ttfts_d), 2
+        )
+        out["disagg_interactive_ttft_p99_ms_min"] = round(min(ttfts_d), 2)
+        out["disagg_interactive_ttft_p99_ms_max"] = round(max(ttfts_d), 2)
+    if ttfts_m:
+        out["disagg_mixed_interactive_ttft_p99_ms"] = round(
+            statistics.median(ttfts_m), 2
+        )
+    for name in ("interactive", "bulk"):
+        att_d = disagg_runs[-1]["attainment"].get(name)
+        att_m = mixed_runs[-1]["attainment"].get(name)
+        if att_d is not None:
+            out[f"disagg_attainment_{name}"] = round(att_d, 3)
+        if att_d is not None and att_m is not None:
+            out[f"disagg_attainment_delta_{name}"] = round(
+                att_d - att_m, 3
+            )
+    return out
 
 
 def measure_selfheal(scale: BenchScale) -> dict:
@@ -3205,6 +3442,7 @@ def run(scale_name: str = "full", pool_with: dict | None = None) -> dict:
     out.update(measure_obs_overhead(scale))
     out.update(measure_fault_recovery(scale))
     out.update(measure_fleet(scale))
+    out.update(measure_disagg(scale))
     out.update(measure_selfheal(scale))
     out.update(measure_autoscale(scale))
     out.update(measure_admission(scale))

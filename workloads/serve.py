@@ -86,6 +86,7 @@ from .paged import (
     paged_prefill,
     paged_prefill_chunk,
     read_page,
+    read_pages,
     table_array,
     write_page,
 )
@@ -564,6 +565,11 @@ class ServeEngine:
         self.requests_retried = 0  # replay requeues after a quarantine
         self.requests_preempted = 0  # statusless reclaims via preempt()
         self.pages_parked = 0  # prefix pages pushed host-side at preempt
+        # Disaggregated prefill/decode (docs/SERVING.md "Disaggregated
+        # prefill/decode"): pages this engine packaged for a KV handoff
+        # ticket (export_kv) and pages it adopted from one (import_kv).
+        self.kv_handoff_pages_out = 0
+        self.kv_handoff_pages_in = 0
         self.queue_rejections = 0
         self.steps_quarantined = 0
         self.fault_recovery_s: list[float] = []  # quarantine -> next good readback
@@ -958,6 +964,47 @@ class ServeEngine:
         self.kv_spill_s += time.perf_counter() - t0
         return blob
 
+    def _spill_pages(self, pages: list[int]) -> list:
+        """Batched spill: gather EVERY page in one dispatch per pool
+        (paged.read_pages) and pay ONE fused device_get for the whole
+        batch — an n-page park or handoff export costs one host sync
+        instead of n (`kv_offload_spill_ms` drops ~n-fold).  The page
+        count pads to the next power of two so the gather's compile set
+        stays logarithmic.  Returns per-page blobs in ``_spill_page``'s
+        exact format — the reload path is unchanged, and slicing the
+        gathered arrays yields the same bytes the per-page reads would
+        (bit-exactness pinned by tests)."""
+        if not pages:
+            return []
+        t0 = time.perf_counter()
+        n = len(pages)
+        padded = 1 << (n - 1).bit_length()
+        srcs = np.asarray(
+            list(pages) + [pages[0]] * (padded - n), np.int32
+        )
+        main = read_pages(self.pools, srcs)
+        draft = (
+            read_pages(self.d_pools, srcs)
+            if self.d_pools is not None else None
+        )
+        (mk, mv), d = jax.device_get((main, draft))
+        # OWNED per-page copies, not views: a view's .base pins the
+        # whole padded gathered buffer, so one long-lived blob (a
+        # parked node, a handoff ticket) would hold every page's host
+        # RAM while the budget counts one.
+        blobs = [
+            (
+                (np.ascontiguousarray(mk[:, i]),
+                 np.ascontiguousarray(mv[:, i])),
+                (np.ascontiguousarray(d[0][:, i]),
+                 np.ascontiguousarray(d[1][:, i]))
+                if d is not None else None,
+            )
+            for i in range(n)
+        ]
+        self.kv_spill_s += time.perf_counter() - t0
+        return blobs
+
     def _reload_page(self, blob):
         """Bring one offloaded page's bytes back into a freshly taken
         pool page (evicting/spilling colder index pages if the free list
@@ -1348,11 +1395,111 @@ class ServeEngine:
         req = self._release_slot(target)
         if self.prefix is not None and self._kv_offload:
             self.pages_parked += self.prefix.park(
-                req.prompt, salt=salt, spill=self._spill_page
+                req.prompt, salt=salt, spill_many=self._spill_pages
             )
         req.group = None
         self.requests_preempted += 1
         return req
+
+    # ---- disaggregated prefill/decode: KV handoff seams -----------------
+
+    def _handoff_salt(self, adapter: str | None) -> str:
+        aidx = self._adapter_ids.get(adapter, 0)
+        return f"lora:{aidx}" if aidx else ""
+
+    def export_kv(self, prompt, adapter: str | None = None):
+        """Package one finished prompt's KV pages for a CROSS-ENGINE
+        handoff (docs/SERVING.md "Disaggregated prefill/decode"): park
+        the prompt's prefix pages to the host tier (one gathered
+        device_get for the whole path — ``_spill_pages``; pages another
+        live stream still reads are copied without moving), then hand
+        back the path's host blobs in page order.  The fleet router
+        carries the blobs to a decode replica's ``import_kv``; this
+        engine keeps its own (now host-tier) copies, so a later prefix
+        hit here still pays off.  Returns None when this engine cannot
+        export (no radix prefix index) — the caller degrades to a plain
+        replay re-prefill, which is bit-identical anyway."""
+        if self._closed:
+            raise EngineClosed("engine is closed")
+        prompt = [int(t) for t in prompt]
+        park = getattr(self.prefix, "park", None)
+        export = getattr(self.prefix, "export_path", None)
+        if park is None or export is None:
+            return None  # no index, or the flat baseline: nothing to ship
+        salt = self._handoff_salt(adapter)
+        if self._kv_offload:
+            # Free this replica's HBM the moment the prompt is done —
+            # the disaggregation dividend: a prefill pool holds pages
+            # only while prefilling.  Without the offload tier the
+            # pages stay resident (ordinary LRU evicts them later) and
+            # the export below copies instead of moving.
+            self.pages_parked += park(
+                prompt, salt=salt, spill_many=self._spill_pages
+            )
+        blobs = export(prompt, salt=salt, copy_many=self._spill_pages)
+        self.kv_handoff_pages_out += len(blobs)
+        return blobs or None
+
+    def _blob_compatible(self, blob) -> bool:
+        """Would this engine's pools accept the blob's bytes?  A page
+        blob from a DIFFERENT engine shape (per-replica ``page_size``,
+        kv heads, layers — heterogeneous fleets are legal) must never
+        graft: the reload's ``write_page`` would raise mid-admission,
+        or worse, shape-coincide into silently wrong KV."""
+        try:
+            main, draft = blob
+            k_pages = self.pools[0]
+            # pool: [L, n_pages+1, Hkv, ps, hd]; blob k: [L, Hkv, ps, hd]
+            want = (k_pages.shape[0],) + k_pages.shape[2:]
+            if tuple(main[0].shape) != want:
+                return False
+            # Draft pools must agree in PRESENCE too: a draft-less blob
+            # reloaded into a spec engine would leave stale draft-pool
+            # bytes behind the grafted page.
+            if (draft is None) != (self.d_pools is None):
+                return False
+            if draft is not None:
+                d_want = (
+                    (self.d_pools[0].shape[0],) + self.d_pools[0].shape[2:]
+                )
+                return tuple(draft[0].shape) == d_want
+            return True
+        except Exception:  # noqa: BLE001 — an unreadable blob is
+            return False  # incompatible by definition
+
+    def import_kv(self, prompt, blobs: list, adapter: str | None = None) -> int:
+        """Adopt a KV handoff ticket's page payload into this engine's
+        radix index as offloaded host-tier nodes — the IMPORT half: the
+        next admission's prefix lookup reloads them through the
+        ordinary ``write_page`` path, riding the admission sweep (no
+        extra host sync), so the handed-off stream continues without
+        re-running the prefill.  Needs the radix index AND the offload
+        tier (the reload callback only arms with ``kv_offload=True``);
+        returns the pages grafted — 0 means the caller's re-prefill
+        replay serves the request instead, bit-identically.
+
+        Defensive degrades (heterogeneous fleets are legal): a ticket
+        for an adapter THIS engine does not serve is refused outright —
+        defaulting it to the base salt would poison the base prefix
+        cache with LoRA-adapted KV — and blobs whose shape does not
+        match this engine's pools (a different page_size or model
+        shape) are refused before they can wedge a future admission's
+        reload."""
+        if self._closed:
+            raise EngineClosed("engine is closed")
+        graft = getattr(self.prefix, "graft", None)
+        if graft is None or not self._kv_offload:
+            return 0
+        if adapter is not None and adapter not in self._adapter_ids:
+            return 0
+        if not blobs or not self._blob_compatible(blobs[0]):
+            return 0
+        n = graft(
+            [int(t) for t in prompt], blobs,
+            salt=self._handoff_salt(adapter),
+        )
+        self.kv_handoff_pages_in += n
+        return n
 
     def _drain_all_pending(self) -> list[Request]:
         """Consume any pipelined in-flight chunk AND superstep (host
@@ -3502,8 +3649,50 @@ def _run_fleet_cli(
     targeting via ``SEAM@REPLICA:N``), optional self-healing
     supervision (``--supervise``), and a lifecycle summary."""
     from .faults import ENGINE_SEAMS, FaultInjector, REPLICA_SEAMS
-    from .fleet import Fleet, FleetServer, TrafficGen, drive_open_loop
+    from .fleet import (
+        ROLES,
+        Fleet,
+        FleetServer,
+        TrafficGen,
+        drive_open_loop,
+    )
 
+    # Disaggregated prefill/decode pools (--roles) + SLO-class weighted
+    # fair queuing (--wfq): validated here so a typo fails before any
+    # engine compiles.
+    roles = None
+    if args.roles is not None:
+        roles = [r.strip() for r in args.roles.split(",")]
+        if len(roles) != args.fleet:
+            parser.error(
+                f"--roles wants one role per replica ({args.fleet}), "
+                f"got {len(roles)}: {args.roles!r}"
+            )
+        bad = [r for r in roles if r not in ROLES]
+        if bad:
+            parser.error(
+                f"--roles values must be from {ROLES}, got {bad}"
+            )
+    wfq_weights = None
+    if args.wfq is not None:
+        import math
+
+        wfq_weights = {}
+        for part in args.wfq.split(","):
+            name, sep, weight = part.partition(":")
+            name = name.strip()
+            try:
+                w = float(weight) if sep else 1.0
+            except ValueError:
+                parser.error(
+                    f"--wfq wants CLASS[:WEIGHT] pairs, got {part!r}"
+                )
+            if not name or not math.isfinite(w) or w <= 0:
+                parser.error(
+                    f"--wfq wants a class name with a positive weight, "
+                    f"got {part!r}"
+                )
+            wfq_weights[name] = w
     replica_schedules = dict(replica_schedules or {})
     fleet_schedule = {
         s: n for s, n in schedule.items() if s in REPLICA_SEAMS
@@ -3616,10 +3805,18 @@ def _run_fleet_cli(
         # (decode programs compile on step 2) must not read as hangs.
         hang_timeout_s=60.0,
         observer=fleet_obs,
+        roles=roles, wfq_weights=wfq_weights,
     )
-    # Warm every replica's compile with one request each, off the clock.
+    if roles is not None:
+        print(f"disaggregated pools: roles={fleet.roles()}" + (
+            f", wfq={wfq_weights}" if wfq_weights else ""
+        ))
+    # Warm every replica's compile with one request each, off the clock
+    # (two tokens on a disagg fleet, so the warm prompts hand off and
+    # warm BOTH pools plus the transfer path itself).
     for i in range(args.fleet):
-        fleet.submit([1 + i], 1, session=f"warm-{i}")
+        fleet.submit([1 + i], 2 if roles is not None else 1,
+                     session=f"warm-{i}")
     fleet.run()
     supervisor = None
     respawn_observers: list = []
@@ -3877,6 +4074,15 @@ def _run_fleet_cli(
         f"{fleet.router.affinity_hits}, queue rejections="
         f"{fleet.queue_rejections})"
     )
+    if fleet.kv_handoffs or fleet.wfq_dispatches:
+        handoff_ms = [round(s * 1000, 2) for s in fleet.handoff_s[:8]]
+        print(
+            f"disagg: handoffs={fleet.kv_handoffs} "
+            f"pages_transferred={fleet.handoff_pages} "
+            f"handoff_ms={handoff_ms}"
+            f"{'…' if len(fleet.handoff_s) > 8 else ''} "
+            f"wfq_dispatches={dict(sorted(fleet.wfq_dispatches.items()))}"
+        )
     if (
         fleet.replica_crashes or fleet.replica_hangs
         or fleet.failover_requeues or fleet.drain_requeues
@@ -4113,6 +4319,29 @@ def main(argv=None) -> int:
                         "on this port (0 = ephemeral) and push the "
                         "synthetic request stream through it as real "
                         "SSE clients instead of the in-process API")
+    parser.add_argument("--roles", default=None, metavar="R0,R1,...",
+                        help="with --fleet: disaggregate the replicas "
+                        "into prefill/decode pools — a comma list of "
+                        "per-replica roles from {prefill,decode,mixed}, "
+                        "one per replica (e.g. --roles "
+                        "prefill,decode,decode).  Fresh prompts prefill "
+                        "on the prefill pool, hand their finished KV "
+                        "off over the host tier, and continue on the "
+                        "decode pool (greedy streams bit-identical to "
+                        "mixed dispatch; a dead pool degrades to mixed "
+                        "— docs/SERVING.md 'Disaggregated "
+                        "prefill/decode').  Pair with --prefix-cache "
+                        "--kv-offload for the page transfer; without "
+                        "them the handoff degrades to replay "
+                        "re-prefill, still bit-identical")
+    parser.add_argument("--wfq", default=None, metavar="CLASS:W,...",
+                        help="with --fleet: SLO-class weighted fair "
+                        "queuing for fresh-prompt dispatch (e.g. --wfq "
+                        "interactive:3,bulk:1) — per-class virtual-time "
+                        "queues split the contended prefill slots in "
+                        "weight proportion; continuations (handoff "
+                        "tickets, failover replays) keep absolute "
+                        "precedence.  Default: FIFO")
     parser.add_argument("--slo-mix", default=None,
                         metavar="CLASS[:WEIGHT],...",
                         help="with --fleet: tag the traffic stream with "
@@ -4315,6 +4544,12 @@ def main(argv=None) -> int:
     if args.http_port is not None:
         parser.error("--http-port needs --fleet (the SSE front end is "
                      "the fleet's)")
+    if args.roles is not None:
+        parser.error("--roles splits a FLEET into prefill/decode "
+                     "pools; it needs --fleet")
+    if args.wfq is not None:
+        parser.error("--wfq orders the FLEET router's dispatch; it "
+                     "needs --fleet")
     if args.supervise:
         parser.error("--supervise needs --fleet (the supervisor heals "
                      "fleet replicas)")

@@ -85,6 +85,10 @@ class ReplicaSlot:
 
     chip_id: str
     index: int | None  # current fleet replica index; None while down
+    # The replica's disaggregation role (Fleet(roles=...)): a
+    # resurrected pool member rejoins ITS pool — respawning a dead
+    # prefill replica as mixed would silently dissolve the split.
+    role: str = "mixed"
     state: str = SERVING
     attempt: int = 0  # consecutive failures since the last success
     restarts: int = 0  # successful resurrections, lifetime
@@ -186,7 +190,10 @@ class FleetSupervisor:
             if not chip_id or chip_id in seen_ids:
                 chip_id = f"replica-{rep.index}"
             seen_ids.add(chip_id)
-            slot = ReplicaSlot(chip_id=chip_id, index=rep.index)
+            slot = ReplicaSlot(
+                chip_id=chip_id, index=rep.index,
+                role=getattr(rep, "role", "mixed"),
+            )
             if rep.state == "dead":
                 slot.state = BACKOFF
                 slot.index = None
@@ -301,7 +308,12 @@ class FleetSupervisor:
             raise ValueError(
                 f"chip {chip_id!r} is already supervised"
             )
-        self.slots.append(ReplicaSlot(chip_id=chip_id, index=index))
+        role = "mixed"
+        if 0 <= index < len(self.fleet.replicas):
+            role = getattr(self.fleet.replicas[index], "role", "mixed")
+        self.slots.append(
+            ReplicaSlot(chip_id=chip_id, index=index, role=role)
+        )
 
     def forget(self, chip_id: str) -> None:
         """Stand down for one chip slot (an operator decommissioning
@@ -533,7 +545,9 @@ class FleetSupervisor:
             )
             return
         try:
-            slot.index = self.fleet.add_replica(engine, slot.chip_id)
+            slot.index = self.fleet.add_replica(
+                engine, slot.chip_id, role=slot.role,
+            )
         except EngineClosed:
             # The fleet shut down under us; discard the probed engine
             # rather than leak its pools.
