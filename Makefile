@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check disagg-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check disagg-check ledger-check fmt-check
 
 all: native
 
@@ -51,7 +51,22 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check disagg-check test
+check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check disagg-check ledger-check test
+
+# Chip-time-ledger tripwires (docs/OBSERVABILITY.md "Chip-time ledger,
+# goodput & postmortems"): one seeded fault run with the ledger and
+# flight recorder armed — streams bit-identical ledger on/off, the
+# scripted quarantine charges exactly the re-prefilled tokens to the
+# `replay` waste class, totals reconcile (goodput + waste + pending ==
+# tokens accounted), and the quarantine-triggered postmortem bundle
+# passes tools/postmortem.py validation — plus the recorder's jax-free
+# synthetic round trip.  The full pinned suite (preempt recompute,
+# spec_rejected, cancelled classification, fleet failover roll-up) and
+# the ledger-randomized chaos fuzz ride the slow suite
+# (tests/test_ledger.py, tests/test_serve_fuzz.py).
+ledger-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_ledger.py::test_ledger_check_smoke" -q -o addopts=
+	JAX_PLATFORMS=cpu $(PYTHON) tools/postmortem.py --selfcheck
 
 # Disaggregated prefill/decode tripwires (docs/SERVING.md
 # "Disaggregated prefill/decode"): one seeded two-pool smoke — a

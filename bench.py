@@ -555,6 +555,13 @@ COMPACT_KEYS = [
     "decode_host_sync_ms", "superstep_speedup",
     "superstep_overdecode_pct",
     "obs_overhead_pct", "obs_on_tokens_per_sec",
+    # Chip-time ledger: fleet-wide goodput/waste accounting — the
+    # goodput share of all charged device work under a faulted spec
+    # stream, the replay/spec-rejected waste shares, and the always-on
+    # accounting tax (streams asserted bit-identical ledger on/off).
+    "ledger_goodput_fraction", "ledger_waste_replay_pct",
+    "ledger_waste_spec_rejected_pct", "ledger_overhead_pct",
+    "ledger_on_tokens_per_sec",
     "fault_recovery_ms", "fault_injector_off_overhead_pct",
     "fleet_tokens_per_sec", "fleet_ttft_p99_ms",
     "router_overhead_ms", "failover_recovery_ms",

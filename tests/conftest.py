@@ -43,6 +43,10 @@ _FAST_DESPITE_JAX = {
     # workloads.backoff (deliberately jax-free) for the restart-backoff
     # pin; never traces a jax program.
     "test_daemon",
+    # Chip-time-ledger attribution + flight-recorder/postmortem units:
+    # imports workloads.ledger (deliberately jax-free) and drives it
+    # with fake engines; never traces a jax program.
+    "test_postmortem",
 }
 _JAX_IMPORT_RE = re.compile(r"^\s*(?:import|from)\s+(?:jax|workloads)\b", re.MULTILINE)
 _slow_file_cache: dict[str, bool] = {}

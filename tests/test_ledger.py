@@ -1,0 +1,407 @@
+"""Chip-time ledger contracts (workloads/ledger.py): the ledger is
+INERT — token streams bit-identical on/off — while its goodput/waste
+taxonomy describes the run exactly: a quarantine replay charges
+precisely the re-prefilled tokens to `replay`, a preempt/resume charges
+only the recompute to `preempt_recompute`, speculative rejects and
+over-decode land in their classes, terminal classification reconciles
+(goodput + waste + pending == tokens accounted, pending 0 at
+quiescence) across engine modes and fleet failover, and the flight
+recorder turns a scripted quarantine into a postmortem bundle
+tools/postmortem.py accepts."""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.faults import FaultInjector
+from workloads.fleet import Fleet
+from workloads.generate import generate
+from workloads.ledger import (
+    ChipTimeLedger,
+    FleetLedger,
+    FlightRecorder,
+    PHASES,
+    WASTE_CLASSES,
+)
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"),
+)
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+DRAFT_CONFIG = ModelConfig(
+    max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def models():
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    draft = init_params(DRAFT_CONFIG, jax.random.PRNGKey(7))
+    return params, draft
+
+
+def _engine(params, **kw):
+    base = dict(slots=2, page_size=4, prompt_bucket=8)
+    base.update(kw)
+    return ServeEngine(params, CONFIG, **base)
+
+
+STREAM = (([1, 2, 3], 10), ([4, 5], 6), ([7, 8, 9, 10], 4), ([6], 1))
+
+
+def _run_stream(engine):
+    rids = [engine.submit(p, n) for p, n in STREAM]
+    out = engine.run()
+    return [list(out[r]) for r in rids]
+
+
+def _oracle(params, prompt, n):
+    return [int(t) for t in np.asarray(generate(
+        params, jnp.asarray([prompt], jnp.int32), CONFIG,
+        max_new_tokens=n,
+    )[0])]
+
+
+# ---- inertness ----------------------------------------------------------
+
+
+def test_streams_bit_identical_ledger_on_off(models):
+    """The headline pin: the ledger (and the flight recorder) never
+    move a token, across serial / pipelined / spec="auto" / superstep
+    engines — sampling on for one arm so the RNG key schedule is
+    pinned too."""
+    params, draft = models
+    configs = [
+        dict(),
+        dict(pipelined=True, temperature=0.8, top_k=20, top_p=0.9,
+             rng=jax.random.PRNGKey(5)),
+        dict(superstep_k=2),
+        dict(draft_params=draft, draft_config=DRAFT_CONFIG, gamma=2,
+             spec="auto", spec_breakeven=1.0),
+    ]
+    for kw in configs:
+        bare = _run_stream(_engine(params, **kw))
+        led = ChipTimeLedger()
+        rec_engine = _engine(params, ledger=led, **kw)
+        recorder = FlightRecorder(out_dir="/tmp")
+        recorder.attach_engine("0", rec_engine)
+        rids = [rec_engine.submit(p, n) for p, n in STREAM]
+        while not rec_engine.idle:
+            rec_engine.step()
+            recorder.poll()
+        by_rid = {r.rid: list(r.tokens) for r in rec_engine.completed}
+        got = [by_rid[r] for r in rids]
+        assert got == bare, kw
+        assert led.reconcile(expect_quiescent=True)["ok"], kw
+        assert not recorder.dumped  # a clean run triggers nothing
+
+
+# ---- taxonomy contracts -------------------------------------------------
+
+
+def test_quarantine_replay_charges_exact_tokens(models):
+    """A quarantined step requeues its victims for replay; the ledger's
+    `replay` class must carry EXACTLY the tokens the replay will
+    re-prefill — prompt + everything emitted before the fault — and
+    the resumed stream must still reconcile to full goodput."""
+    params, _ = models
+    prompt, n_new = [1, 2, 3, 4], 12
+    led = ChipTimeLedger()
+    engine = _engine(
+        params, slots=1, ledger=led,
+        fault_injector=FaultInjector({"decode_dispatch": [3]}),
+        max_retries=3,
+    )
+    rid = engine.submit(prompt, n_new)
+    while engine.steps_quarantined == 0:
+        engine.step()
+    # The faulted dispatch emitted nothing, so everything generated so
+    # far is exactly what the replay re-prefills on top of the prompt.
+    expected = len(prompt) + engine.generated_tokens
+    assert engine.tokens_replayed == expected
+    out = engine.run()
+    assert led.waste_tokens["replay"] == expected
+    assert list(out[rid]) == _oracle(params, prompt, n_new)
+    verdict = led.reconcile(expect_quiescent=True)
+    assert verdict["ok"], verdict
+    assert led.goodput_tokens == len(out[rid])
+
+
+def test_preempt_resume_charges_only_recompute(models):
+    """Preemption-via-offload parks the prompt's full pages; the
+    resume's re-prefill reloads them, so only the tail past the last
+    full page plus the emitted tokens recompute — the exact charge
+    pinned here, with the resumed stream an exact continuation."""
+    params, _ = models
+    page = 4
+    prompt = list(range(1, 10))  # 9 tokens -> 2 full pages parked
+    led = ChipTimeLedger()
+    engine = _engine(
+        params, slots=1, page_size=page, ledger=led,
+        prefix_cache=True, kv_offload=True,
+    )
+    rid = engine.submit(prompt, 40)
+    for _ in range(3):
+        engine.step()
+    ereq = engine.preempt(rid)
+    assert ereq is not None
+    emitted = list(ereq.tokens)
+    assert emitted  # work was actually displaced
+    covered = (len(prompt) // page) * page
+    expected = len(prompt) + len(emitted) - covered
+    assert engine.preempt_recompute_tokens == expected
+    assert led.waste_tokens["preempt_recompute"] == 0  # not yet stepped
+    # Resume exactly as the fleet would: prompt + emitted, remaining
+    # budget; the continuation must be bit-identical to the oracle.
+    resumed = engine.submit(prompt + emitted, 40 - len(emitted))
+    out = engine.run()
+    assert emitted + list(out[resumed]) == _oracle(params, prompt, 40)
+    assert led.waste_tokens["preempt_recompute"] == expected
+    # The preempted first segment is STATUSLESS at engine scope (the
+    # fleet owns its terminal status), so exactly its emissions stay
+    # pending here — the FleetLedger test covers full quiescence.
+    verdict = led.reconcile()
+    assert verdict["ok"], verdict
+    assert verdict["pending"] == len(emitted)
+    assert led.goodput_tokens == len(out[resumed])
+
+
+def test_midprefill_preempt_excludes_prefix_hit_region(models):
+    """A budget-parked admission that BEGAN at a prefix-cache hit only
+    redoes the buckets it actually swept: the cached region was never
+    prefilled here and the resume's lookup re-serves it, so the
+    preempt_recompute charge must exclude it."""
+    params, _ = models
+    page, bucket = 4, 8
+    shared = list(range(1, 17))  # 16 tokens = 4 full pages = 2 buckets
+    engine = _engine(
+        params, slots=1, page_size=page, prompt_bucket=bucket,
+        prefix_cache=True, prefill_budget=bucket,
+        ledger=ChipTimeLedger(),
+    )
+    warm = engine.submit(shared, 4)
+    engine.run()  # the shared prefix is now cached
+    tail = shared + list(range(30, 46))  # +16 fresh -> 4 buckets total
+    rid = engine.submit(tail, 8)
+    engine.step()  # budget sweeps ONE fresh bucket; the rest parks
+    parked = [p for p in engine._inflight_prefill
+              if p["req"].rid == rid]
+    assert parked, "the admission must be parked mid-prefill"
+    cursor = int(parked[0]["cursor"])
+    start_page = int(parked[0]["start_page"])
+    assert start_page * page == len(shared)  # the hit covered 2 buckets
+    assert cursor > start_page * page // bucket  # and one bucket swept
+    before = engine.preempt_recompute_tokens
+    assert engine.preempt(rid) is not None
+    charged = engine.preempt_recompute_tokens - before
+    # Exactly the swept-beyond-the-hit tokens — NOT the cached region.
+    assert charged == cursor * bucket - start_page * page
+    engine.close()
+
+
+def test_cancelled_stream_tokens_classify_as_waste(models):
+    params, _ = models
+    led = ChipTimeLedger()
+    engine = _engine(params, slots=1, ledger=led)
+    keep = engine.submit([1, 2], 4)
+    doomed = engine.submit([3, 4, 5], 40)
+    while not engine._occupied.any():
+        engine.step()
+    # Let the doomed stream emit, then cancel it mid-flight.
+    for _ in range(3):
+        engine.step()
+    assert engine.cancel(doomed)
+    engine.run()
+    by_rid = {r.rid: r for r in engine.completed}
+    assert by_rid[doomed].status == "cancelled"
+    n_doomed = len(by_rid[doomed].tokens)
+    assert led.waste_tokens["cancelled"] == n_doomed
+    assert led.goodput_tokens == len(by_rid[keep].tokens)
+    assert led.reconcile(expect_quiescent=True)["ok"]
+
+
+def test_spec_engine_charges_rejects_and_reconciles(models):
+    """Speculative serving: drafted-but-unaccepted tokens land in
+    spec_rejected, chained supersteps' dead rounds in overdecode, and
+    the books still balance — with spec phase time attributed across
+    draft/verify/commit."""
+    params, draft = models
+    for kw in (
+        dict(gamma=3),
+        dict(gamma=2, spec_superstep_k=2),
+    ):
+        led = ChipTimeLedger()
+        engine = _engine(
+            params, draft_params=draft, draft_config=DRAFT_CONFIG,
+            ledger=led, **kw,
+        )
+        _run_stream(engine)
+        assert led.waste_tokens["spec_rejected"] == (
+            engine.spec_tokens_rejected
+        )
+        assert led.waste_tokens["overdecode"] == engine.tokens_overdecoded
+        assert led.reconcile(expect_quiescent=True)["ok"], kw
+        spec_s = (
+            led.phase_s["spec_draft"] + led.phase_s["spec_verify"]
+            + led.phase_s["spec_commit"]
+        )
+        assert spec_s > 0, kw
+
+
+def test_totals_reconcile_across_engine_modes(models):
+    """goodput + waste == tokens accounted (pending 0) at quiescence
+    for serial / pipelined / budgeted / superstep runs, with goodput
+    cross-checked against the completed ok streams."""
+    params, _ = models
+    for kw in (
+        dict(),
+        dict(pipelined=True),
+        dict(prefill_budget=8),
+        dict(superstep_k=4),
+    ):
+        led = ChipTimeLedger()
+        engine = _engine(params, ledger=led, **kw)
+        _run_stream(engine)
+        verdict = led.reconcile(expect_quiescent=True)
+        assert verdict["ok"], (kw, verdict)
+        ok_tokens = sum(
+            len(r.tokens) for r in engine.completed if r.status == "ok"
+        )
+        assert led.goodput_tokens == ok_tokens, kw
+        assert verdict["goodput"] + verdict["waste"] == (
+            verdict["accounted"]
+        ), kw
+        # Time identity: every charged second landed in exactly one
+        # phase, and a serving run is mostly busy.
+        assert abs(sum(led.phase_s.values()) - led.wall_s) < 1e-6, kw
+        assert 0.0 < led.busy_fraction <= 1.0, kw
+
+
+def test_warmup_phase_classifies_whole_request_offbook(models):
+    params, _ = models
+    led = ChipTimeLedger()
+    engine = _engine(params, ledger=led)
+    engine.ledger_phase = "warmup"
+    engine.submit([1], 3)
+    engine.run()
+    engine.ledger_phase = "serve"
+    assert led.waste_tokens["probe_warmup"] == 3
+    assert led.goodput_tokens == 0
+    assert led.phase_s["warmup"] > 0
+    assert led.reconcile(expect_quiescent=True)["ok"]
+    # Back on the books: later traffic is ordinary goodput.
+    out = engine.run() if engine.idle else None
+    rid = engine.submit([2, 3], 4)
+    out = engine.run()
+    assert led.goodput_tokens == len(out[rid])
+    assert led.reconcile(expect_quiescent=True)["ok"]
+
+
+def test_engine_close_classifies_inflight_as_waste(models):
+    params, _ = models
+    led = ChipTimeLedger()
+    engine = _engine(params, slots=1, ledger=led)
+    engine.submit([1, 2, 3], 40)
+    for _ in range(4):
+        engine.step()
+    emitted = engine.generated_tokens
+    assert emitted > 0
+    engine.close()
+    assert led.waste_tokens["cancelled"] == emitted
+    assert led.reconcile(expect_quiescent=True)["ok"]
+
+
+# ---- fleet roll-up ------------------------------------------------------
+
+
+def test_fleet_failover_ledger_reconciles(models):
+    """A replica crash mid-stream: the fleet ledger charges the
+    failover's re-prefill to `replay`, classifies the survivors'
+    terminal tokens per class, and the fleet-wide books balance."""
+    params, _ = models
+    n = 2
+    engines = [
+        _engine(params, ledger=ChipTimeLedger(name=str(i)))
+        for i in range(n)
+    ]
+    fled = FleetLedger()
+    fleet = Fleet(
+        engines, chip_ids=[f"chip-{i}" for i in range(n)],
+        hang_timeout_s=None, ledger=fled,
+        fault_injector=FaultInjector({"replica_crash": 2 * n + 1}),
+    )
+    rids = [
+        fleet.submit(p, n_new, slo_class="interactive" if i % 2 else "bulk")
+        for i, (p, n_new) in enumerate(STREAM)
+    ]
+    out = fleet.run()
+    assert fleet.replica_crashes == 1
+    for (p, n_new), rid in zip(STREAM, rids):
+        assert list(out[rid]) == _oracle(params, p, n_new)
+    snap = fled.snapshot()
+    assert snap["waste_tokens"]["replay"] > 0
+    assert snap["goodput_tokens"] == sum(
+        len(r.tokens) for r in fleet.completed if r.status == "ok"
+    )
+    assert set(snap["per_class"]) == {"interactive", "bulk"}
+    verdict = fled.reconcile(expect_quiescent=True)
+    assert verdict["ok"], (verdict, snap)
+    # The healthz block carries the fractions + per-waste-class views.
+    hz = fled.healthz()
+    assert set(hz["waste_tokens"]) == set(WASTE_CLASSES)
+    assert 0.0 < hz["goodput_fraction"] <= 1.0
+    fleet.close()
+
+
+# ---- flight recorder / postmortem --------------------------------------
+
+
+def test_ledger_check_smoke(models, tmp_path):
+    """The `make ledger-check` smoke: a seeded fault run with ledger +
+    recorder armed — streams bit-identical to the unledgered oracle,
+    the scripted quarantine triggers a postmortem bundle that
+    tools/postmortem.py validates, and the totals reconcile."""
+    from postmortem import validate_file
+
+    params, _ = models
+    bare = _run_stream(_engine(
+        params, fault_injector=FaultInjector({"decode_dispatch": [3]}),
+        max_retries=3,
+    ))
+    led = ChipTimeLedger()
+    engine = _engine(
+        params, ledger=led,
+        fault_injector=FaultInjector({"decode_dispatch": [3]}),
+        max_retries=3,
+    )
+    recorder = FlightRecorder(out_dir=str(tmp_path))
+    recorder.attach_engine("0", engine)
+    rids = [engine.submit(p, n) for p, n in STREAM]
+    while not engine.idle:
+        engine.step()
+        recorder.poll()
+    by_rid = {r.rid: list(r.tokens) for r in engine.completed}
+    assert [by_rid[r] for r in rids] == bare
+    assert engine.steps_quarantined >= 1
+    assert led.waste_tokens["replay"] > 0
+    assert led.reconcile(expect_quiescent=True)["ok"]
+    assert recorder.dumped, "the quarantine must have triggered a bundle"
+    assert [k for k, _ in recorder.triggers][0] == "quarantine"
+    for path in recorder.dumped:
+        assert validate_file(path) == [], path
+    # The bundle names the replay waste the incident cost.
+    import json
+
+    with open(recorder.dumped[0]) as f:
+        bundle = json.load(f)
+    assert bundle["replicas"]["0"]["ledger"]["waste_tokens"]["replay"] > 0
+    assert bundle["replicas"]["0"]["counters"]["steps_quarantined"] >= 1

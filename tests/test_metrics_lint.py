@@ -12,6 +12,8 @@ import os
 import re
 from types import SimpleNamespace
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Call-site patterns for the emission APIs.  \s* spans newlines, so
@@ -53,6 +55,7 @@ def _described_names() -> set[str]:
         AUTOSCALER_METRICS,
         ENGINE_METRICS,
         FLEET_METRICS,
+        LEDGER_METRICS,
         SUPERVISOR_METRICS,
     )
 
@@ -62,6 +65,7 @@ def _described_names() -> set[str]:
         | {m.name for m in FLEET_METRICS}
         | {m.name for m in SUPERVISOR_METRICS}
         | {m.name for m in AUTOSCALER_METRICS}
+        | {m.name for m in LEDGER_METRICS}
     )
 
 
@@ -112,6 +116,39 @@ def test_fleet_gauge_readers_match_the_catalog():
 
     catalog_gauges = {m.name for m in FLEET_METRICS if m.type == "gauge"}
     assert catalog_gauges == set(FleetObserver._FLEET_GAUGE_READERS)
+
+
+def test_ledger_gauge_readers_match_the_catalog():
+    """Drift pin for the chip-time-ledger gauge families: the
+    engine-labeled ones ride EngineObserver._LEDGER_GAUGE_READERS, the
+    fleet-labeled one FleetObserver._FLEET_LEDGER_GAUGE_READERS —
+    nothing documented can fail to register, nothing registered can
+    leak past unbind."""
+    from workloads.obs import LEDGER_METRICS, EngineObserver, FleetObserver
+
+    engine_gauges = {
+        m.name for m in LEDGER_METRICS
+        if m.type == "gauge" and m.labels[0] == "engine"
+    }
+    assert engine_gauges == set(EngineObserver._LEDGER_GAUGE_READERS)
+    fleet_gauges = {
+        m.name for m in LEDGER_METRICS
+        if m.type == "gauge" and m.labels[0] == "fleet"
+    }
+    assert fleet_gauges == set(FleetObserver._FLEET_LEDGER_GAUGE_READERS)
+
+
+def test_ledger_catalog_is_fully_described_on_bind():
+    """Both bridges together must describe every LEDGER_METRICS family
+    (the rendered docs table promises them all)."""
+    from tpu_device_plugin.metrics import Registry
+    from workloads.obs import LEDGER_METRICS, EngineObserver, FleetObserver
+
+    reg = Registry()
+    EngineObserver().bind_registry(reg)
+    FleetObserver().bind_registry(reg)
+    missing = {m.name for m in LEDGER_METRICS} - set(reg._help)
+    assert not missing, missing
 
 
 def test_fleet_catalog_is_fully_described_on_bind():
@@ -653,3 +690,154 @@ def test_autoscaler_bridge_render_is_valid_exposition():
     assert f"{PREFIX}_autoscaler_ladder_level" not in _parse_exposition(
         reg.render()
     )
+
+
+def test_ring_overflow_counters_are_scrapeable():
+    """Satellite contract: observer ring evictions (dropped_steps /
+    dropped_spans / dropped_events) land on the registry as counters,
+    so silent history loss is a scrapeable signal."""
+    from tpu_device_plugin.metrics import PREFIX, Registry
+    from workloads.obs import EngineObserver, FleetObserver, SupervisorObserver
+
+    reg = Registry()
+    obs = EngineObserver(name="tiny", step_limit=1, span_limit=1)
+    obs.bind_registry(reg)
+    _drive_fake_engine(obs, steps=4)  # 4 steps into a 1-deep ring
+    families = _parse_exposition(reg.render())
+    drops = families[f"{PREFIX}_engine_observer_dropped_steps_total"]
+    assert drops["samples"][0][2] == 3.0
+    assert f"{PREFIX}_engine_observer_dropped_spans_total" not in families
+
+    fobs = FleetObserver(name="f0", span_limit=1)
+    fobs.bind_registry(reg)
+    fleet = SimpleNamespace(
+        queue=[], replicas=[], requests_submitted=0, generated_tokens=0,
+        failover_requeues=0, drain_requeues=0, queue_rejections=0,
+        replica_crashes=0, replica_hangs=0,
+        slo_burn_rates=lambda: {},
+    )
+    fobs._bind(fleet)
+    fobs._fleet_step_end(
+        fleet, [_fake_fleet_request(f"fr-{i}") for i in range(3)]
+    )
+    families = _parse_exposition(reg.render())
+    fdrops = families[f"{PREFIX}_fleet_observer_dropped_spans_total"]
+    assert fdrops["samples"][0][2] == 2.0
+
+    sobs = SupervisorObserver(name="s0")
+    sobs.bind_registry(reg)
+    sup = SimpleNamespace(
+        slots=[], restarts_total=0, restart_failures=0, crash_loops=0,
+        health_deferrals=0, restore_s=[], dropped_events=5,
+    )
+    sobs._bind(sup)
+    sobs._supervisor_poll_end(sup)
+    sobs._supervisor_poll_end(sup)  # unchanged total pushes no delta
+    families = _parse_exposition(reg.render())
+    sdrops = families[f"{PREFIX}_supervisor_dropped_events_total"]
+    assert sdrops["samples"][0][2] == 5.0
+
+
+def test_ledger_families_render_as_valid_exposition():
+    """Drive the engine bridge over a fake engine carrying a REAL
+    ChipTimeLedger (still no jax): the phase/token counter families
+    push as deltas, the fraction/pending gauges and the per-class
+    waste-seconds gauge scrape, and a ledger-less engine emits no
+    ledger series at all."""
+    import numpy as np
+
+    from tpu_device_plugin.metrics import PREFIX, Registry
+    from workloads.ledger import ChipTimeLedger, WASTE_CLASSES
+    from workloads.obs import EngineObserver
+
+    reg = Registry()
+    obs = EngineObserver(name="led")
+    obs.bind_registry(reg)
+    led = ChipTimeLedger()
+    eng = SimpleNamespace(
+        generated_tokens=0, requests_admitted=0, requests_retired=0,
+        prefill_dispatches=0, prefill_sweeps=0, chunks_run=0, spec_rounds=0,
+        mode_switches=0, admission_readbacks=0, spec_lookahead=1,
+        prefill_deferred_tokens=0, _inflight_prefill=[],
+        pending=[], _occupied=np.zeros(2, bool), slots=2,
+        ctrl=SimpleNamespace(used_pages=0), paused=False,
+        tokens_overdecoded=0, spec_tokens_rejected=0, tokens_replayed=0,
+        preempt_recompute_tokens=0, kv_spill_s=0.0, kv_reload_s=0.0,
+        kv_handoff_s=0.0, prefill_tokens=0, superstep_k=1,
+        spec_superstep_k=1, host_sync_s=0.0, ledger_phase="serve",
+        ledger=led,
+    )
+    obs._bind(eng)
+    finished = SimpleNamespace(
+        rid="req-0", t_submit=1.0, t_admit=1.1, t_first=1.5, t_done=3.0,
+        tokens=[7] * 6, status="ok",
+    )
+    for i in range(2):
+        lsnap = led.step_begin(eng)
+        snap = obs._step_begin(eng)
+        eng.generated_tokens += 3
+        eng.chunks_run += 1
+        if i == 1:
+            eng.tokens_replayed += 4
+        done = [finished] if i == 1 else []
+        led.step_end(eng, lsnap, done)
+        obs._step_end(eng, snap, done)
+    families = _parse_exposition(reg.render())
+    tokens = families[f"{PREFIX}_ledger_tokens_total"]["samples"]
+    by_class = {labels["class"]: v for _, labels, v in tokens}
+    assert by_class["goodput"] == 6.0
+    assert by_class["replay"] == 4.0
+    chip = families[f"{PREFIX}_ledger_chip_seconds_total"]["samples"]
+    assert {labels["phase"] for _, labels, _ in chip} >= {"decode"}
+    assert families[f"{PREFIX}_ledger_pending_tokens"]["samples"][0][2] == 0.0
+    frac = families[f"{PREFIX}_ledger_goodput_fraction"]["samples"][0][2]
+    assert frac == pytest.approx(6.0 / 10.0)
+    waste_s = families[f"{PREFIX}_ledger_waste_chip_seconds"]["samples"]
+    assert {labels["class"] for _, labels, _ in waste_s} == set(WASTE_CLASSES)
+    # A ledger-less engine emits no ledger SAMPLES (described help
+    # text is fine; series are not).
+    reg2 = Registry()
+    obs2 = EngineObserver(name="bare")
+    obs2.bind_registry(reg2)
+    _drive_fake_engine(obs2)
+    samples = [
+        ln for ln in reg2.render().splitlines()
+        if not ln.startswith("#") and ln.startswith(f"{PREFIX}_ledger_")
+    ]
+    assert samples == []
+
+
+def test_fleet_ledger_families_render_as_valid_exposition():
+    from tpu_device_plugin.metrics import PREFIX, Registry
+    from workloads.ledger import ChipTimeLedger, FleetLedger
+    from workloads.obs import FleetObserver
+
+    reg = Registry()
+    obs = FleetObserver(name="fl")
+    obs.bind_registry(reg)
+    fled = FleetLedger()
+    fled.attach("0", ChipTimeLedger())
+    fleet = SimpleNamespace(
+        queue=[], replicas=[], requests_submitted=2, generated_tokens=9,
+        failover_requeues=0, drain_requeues=0, queue_rejections=0,
+        replica_crashes=0, replica_hangs=0, tokens_replayed=0,
+        slo_burn_rates=lambda: {}, ledger=fled,
+    )
+    obs._bind(fleet)
+    finished = [
+        _fake_fleet_request("fr-0", slo_class="interactive",
+                            slo_attained=True, n_tokens=6),
+        _fake_fleet_request("fr-1", status="failed", slo_class="bulk",
+                            n_tokens=3),
+    ]
+    fled.step_end(fleet, finished)
+    obs._fleet_step_end(fleet, finished)
+    obs._fleet_step_end(fleet, [])  # unchanged totals push no deltas
+    families = _parse_exposition(reg.render())
+    tokens = families[f"{PREFIX}_fleet_ledger_tokens_total"]["samples"]
+    assert {
+        (labels["slo_class"], labels["kind"], v)
+        for _, labels, v in tokens
+    } == {("interactive", "goodput", 6.0), ("bulk", "waste", 3.0)}
+    frac = families[f"{PREFIX}_fleet_ledger_goodput_fraction"]
+    assert frac["samples"][0][2] == pytest.approx(6.0 / 9.0)

@@ -1,0 +1,329 @@
+"""Jax-free chip-time-ledger + flight-recorder units (workloads/
+ledger.py is importable without jax, like workloads/obs.py): the phase
+attribution rules on synthetic step data, the accounting identities,
+the recorder's trigger machinery (burn streaks, bundle budget, event
+cursors surviving ring eviction), and the postmortem validator's
+rejection of broken bundles.  Runs in the fast tier (conftest
+_FAST_DESPITE_JAX)."""
+
+import json
+import os
+import sys
+from types import SimpleNamespace
+
+import pytest
+
+from workloads.ledger import (
+    BUNDLE_SCHEMA,
+    ChipTimeLedger,
+    FleetLedger,
+    FlightRecorder,
+    PHASES,
+    WASTE_CLASSES,
+)
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"),
+)
+
+from postmortem import validate_bundle, validate_file  # noqa: E402
+
+
+def _fake_engine(**over):
+    base = dict(
+        generated_tokens=0, tokens_overdecoded=0, spec_tokens_rejected=0,
+        tokens_replayed=0, preempt_recompute_tokens=0, kv_spill_s=0.0,
+        kv_reload_s=0.0, kv_handoff_s=0.0, prefill_dispatches=0,
+        prefill_tokens=0, chunks_run=0, spec_rounds=0, superstep_k=1,
+        spec_lookahead=1, spec_superstep_k=1, gamma=4,
+        steps_quarantined=0, host_sync_s=0.0, ledger_phase="serve",
+        _obs=None,
+    )
+    base.update(over)
+    return SimpleNamespace(**base)
+
+
+def _step(led, eng, *, emit=0, chunks=0, prefill=0, spec_rounds=0,
+          finish=(), **bumps):
+    snap = led.step_begin(eng)
+    eng.generated_tokens += emit
+    eng.chunks_run += chunks
+    eng.prefill_dispatches += prefill
+    eng.prefill_tokens += prefill * 8
+    eng.spec_rounds += spec_rounds
+    for attr, delta in bumps.items():
+        setattr(eng, attr, getattr(eng, attr) + delta)
+    led.step_end(eng, snap, list(finish))
+
+
+# ---- attribution rules --------------------------------------------------
+
+
+def test_phase_catalog_and_time_identity():
+    led = ChipTimeLedger()
+    eng = _fake_engine()
+    _step(led, eng, emit=4, chunks=1)                   # decode step
+    _step(led, eng, prefill=2)                          # admission step
+    _step(led, eng)                                     # idle step
+    _step(led, eng, emit=2, chunks=1, prefill=1)        # mixed: splits
+    assert set(led.phase_s) == set(PHASES)
+    assert abs(sum(led.phase_s.values()) - led.wall_s) < 1e-9
+    assert led.phase_s["decode"] > 0
+    assert led.phase_s["prefill"] > 0
+    assert led.phase_s["idle"] > 0
+    assert 0 < led.busy_fraction < 1
+
+
+def test_kv_seconds_charge_their_phases_even_between_steps():
+    """KV work timed OUTSIDE step() (an export_kv park, a preempt
+    spill) still lands in its phase, and the per-step charge is
+    max(dur, kv) so the time identity survives."""
+    led = ChipTimeLedger()
+    eng = _fake_engine()
+    _step(led, eng, emit=4, chunks=1)
+    eng.kv_spill_s += 0.5     # between steps: a park's gathered spill
+    eng.kv_handoff_s += 0.25  # and its export packaging
+    _step(led, eng, emit=4, chunks=1, kv_reload_s=0.125)
+    assert led.phase_s["kv_spill"] == pytest.approx(0.5)
+    assert led.phase_s["kv_handoff"] == pytest.approx(0.25)
+    assert led.phase_s["kv_reload"] == pytest.approx(0.125)
+    assert abs(sum(led.phase_s.values()) - led.wall_s) < 1e-9
+
+
+def test_spec_split_subdivides_the_fused_window():
+    led = ChipTimeLedger(spec_split=(2, 1, 1))
+    eng = _fake_engine(spec_lookahead=2)
+    _step(led, eng, emit=6, spec_rounds=2)
+    draft, verify, commit = (
+        led.phase_s["spec_draft"], led.phase_s["spec_verify"],
+        led.phase_s["spec_commit"],
+    )
+    assert draft > 0 and verify > 0 and commit > 0
+    assert draft == pytest.approx(verify * 2, rel=1e-6)
+    assert verify == pytest.approx(commit, rel=1e-6)
+    with pytest.raises(ValueError):
+        ChipTimeLedger(spec_split=(0, 0, 0))
+
+
+def test_offbook_phase_classifies_emissions_immediately():
+    led = ChipTimeLedger()
+    eng = _fake_engine(ledger_phase="probe")
+    done = SimpleNamespace(rid="canary", tokens=[1, 2, 3], status="ok")
+    _step(led, eng, emit=3, chunks=1, finish=[done])
+    assert led.phase_s["probe"] > 0
+    assert led.waste_tokens["probe_warmup"] == 3
+    assert led.goodput_tokens == 0  # offbook terminals never classify
+    assert led.reconcile(expect_quiescent=True)["ok"]
+
+
+def test_token_identity_and_waste_classes():
+    led = ChipTimeLedger()
+    eng = _fake_engine()
+    ok = SimpleNamespace(rid="a", tokens=[1] * 6, status="ok")
+    bad = SimpleNamespace(rid="b", tokens=[1] * 2, status="expired")
+    _step(led, eng, emit=8, chunks=1, tokens_overdecoded=3,
+          spec_tokens_rejected=2, tokens_replayed=5,
+          preempt_recompute_tokens=1, finish=[ok, bad])
+    assert set(led.waste_tokens) == set(WASTE_CLASSES)
+    assert led.waste_tokens == {
+        "overdecode": 3, "spec_rejected": 2, "replay": 5,
+        "preempt_recompute": 1, "cancelled": 2, "probe_warmup": 0,
+    }
+    assert led.goodput_tokens == 6
+    assert led.tokens_accounted == 8 + 3 + 2 + 5 + 1
+    verdict = led.reconcile(expect_quiescent=True)
+    assert verdict["ok"], verdict
+    # Waste chip-second estimates cover every class and never exceed
+    # the phase budget they scale.
+    waste_s = led.waste_chip_s()
+    assert set(waste_s) == set(WASTE_CLASSES)
+    assert all(v >= 0 for v in waste_s.values())
+
+
+def test_pending_tracks_unterminated_emissions():
+    led = ChipTimeLedger()
+    eng = _fake_engine()
+    _step(led, eng, emit=5, chunks=1)
+    assert led.pending_tokens == 5
+    assert led.reconcile()["ok"]
+    assert not led.reconcile(expect_quiescent=True)["ok"]
+    done = SimpleNamespace(rid="a", tokens=[1] * 5, status="ok")
+    _step(led, eng, finish=[done])
+    assert led.pending_tokens == 0
+    assert led.reconcile(expect_quiescent=True)["ok"]
+
+
+def test_snapshot_round_trips_to_dict():
+    led = ChipTimeLedger(name="r7")
+    eng = _fake_engine()
+    _step(led, eng, emit=4, chunks=1)
+    snap = led.snapshot().to_dict()
+    assert snap["name"] == "r7"
+    assert json.loads(json.dumps(snap)) == snap
+    assert set(snap["phase_s"]) == set(PHASES)
+
+
+# ---- fleet roll-up ------------------------------------------------------
+
+
+def test_fleet_ledger_merges_replicas_and_classifies_per_class():
+    led0, led1 = ChipTimeLedger(name="0"), ChipTimeLedger(name="1")
+    e0, e1 = _fake_engine(), _fake_engine()
+    _step(led0, e0, emit=6, chunks=1, tokens_overdecoded=2)
+    _step(led1, e1, emit=4, chunks=1)
+    fled = FleetLedger()
+    fleet = SimpleNamespace(
+        replicas=[
+            SimpleNamespace(index=0, engine=SimpleNamespace(ledger=led0)),
+            SimpleNamespace(index=1, engine=SimpleNamespace(ledger=led1)),
+        ],
+        generated_tokens=10, tokens_replayed=7,
+    )
+    fled.step_end(fleet, [
+        SimpleNamespace(rid="a", tokens=[1] * 6, status="ok",
+                        slo_class="interactive"),
+        SimpleNamespace(rid="b", tokens=[1] * 4, status="failed",
+                        slo_class="bulk"),
+    ])
+    snap = fled.snapshot()
+    assert snap["waste_tokens"]["replay"] == 7     # fleet failover bill
+    assert snap["waste_tokens"]["overdecode"] == 2  # engine-local waste
+    assert snap["waste_tokens"]["cancelled"] == 4   # fleet-terminal
+    assert snap["goodput_tokens"] == 6
+    assert snap["tokens_accounted"] == 10 + 7 + 2
+    assert snap["pending_tokens"] == 0
+    assert snap["per_class"] == {
+        "interactive": {"goodput": 6, "waste": 0},
+        "bulk": {"goodput": 0, "waste": 4},
+    }
+    assert set(snap["per_replica"]) == {"0", "1"}
+    assert fled.reconcile(expect_quiescent=True)["ok"]
+    hz = fled.healthz()
+    assert set(hz["waste_chip_s"]) == set(WASTE_CLASSES)
+
+
+# ---- flight recorder ----------------------------------------------------
+
+
+def _recorder(tmp_path, **kw):
+    return FlightRecorder(out_dir=str(tmp_path), **kw)
+
+
+def test_burn_trigger_needs_a_sustained_streak(tmp_path):
+    rec = _recorder(tmp_path, burn_threshold=1.5, burn_polls=3)
+    burns = {"interactive": 0.0}
+    rec.attach_fleet(SimpleNamespace(
+        replicas=[], slo_burn_rates=lambda: burns,
+    ))
+    assert rec.poll() == []
+    burns["interactive"] = 9.0
+    assert rec.poll() == [] and rec.poll() == []  # streak 1, 2
+    written = rec.poll()                          # streak 3: fires once
+    assert len(written) == 1
+    assert rec.poll() == []                       # latched until clear
+    burns["interactive"] = 0.0
+    rec.poll()                                    # clears the latch
+    burns["interactive"] = 9.0
+    for _ in range(3):
+        out = rec.poll()
+    assert len(out) == 1                          # re-arms after clear
+    for path in rec.dumped:
+        assert validate_file(path) == []
+
+
+def test_bundle_budget_counts_skips(tmp_path):
+    rec = _recorder(tmp_path, bundle_limit=2)
+    assert rec.trigger("manual", "one") and rec.trigger("manual", "two")
+    assert rec.trigger("manual", "three") is None
+    assert rec.bundles_skipped == 1
+    assert len(rec.dumped) == 2
+    with pytest.raises(ValueError):
+        rec.trigger("not-a-kind")
+
+
+def test_event_cursor_survives_ring_eviction(tmp_path):
+    """The supervisor-event cursor is dropped_events + len(ring), so
+    evicted (or drained) events can never replay old triggers — and a
+    quarantine that arrives after eviction still fires."""
+    from collections import deque
+
+    rec = _recorder(tmp_path)
+    sup = SimpleNamespace(events=deque(maxlen=2), dropped_events=0)
+    rec.attach_supervisor(sup)
+
+    def push(kind, detail=""):
+        if len(sup.events) == sup.events.maxlen:
+            sup.dropped_events += 1
+        sup.events.append(SimpleNamespace(
+            t=1.0, kind=kind, chip_id="c0", detail=detail,
+        ))
+
+    push("death")
+    push("backoff")
+    push("probe")  # evicts "death"
+    assert rec.poll() == []  # nothing trigger-worthy
+    push("quarantine", "crash-loop: 3 failures")
+    push("restart_failed", "canary stream diverged from oracle")
+    written = rec.poll()
+    assert len(written) == 2
+    kinds = [k for k, _ in rec.triggers]
+    assert kinds == ["crash_loop", "probe_divergence"]
+    assert rec.poll() == []  # cursor advanced; no replay
+
+
+def test_bundle_embeds_rings_and_validates(tmp_path):
+    rec = _recorder(tmp_path, snapshot_limit=2)
+    led = ChipTimeLedger()
+    eng = _fake_engine(ledger=led)
+    rec.attach_engine("0", eng)
+    for _ in range(4):
+        _step(led, eng, emit=2, chunks=1,
+              finish=[SimpleNamespace(rid="r", tokens=[1, 1],
+                                      status="ok")])
+        rec.poll()
+    tap = rec._taps["0"]
+    assert len(tap.snapshots) == 2 and tap.dropped_snapshots == 2
+    path = rec.dump_bundle(trigger="manual", detail="unit")
+    assert validate_file(path) == []
+    with open(path) as f:
+        bundle = json.load(f)
+    assert bundle["schema"] == BUNDLE_SCHEMA
+    assert len(bundle["replicas"]["0"]["ledger_snapshots"]) == 2
+    assert bundle["replicas"]["0"]["reconcile"]["ok"]
+
+
+# ---- validator rejections -----------------------------------------------
+
+
+def _minimal_bundle():
+    return {
+        "schema": BUNDLE_SCHEMA,
+        "created_unix": 1.0,
+        "trigger": {"kind": "manual", "detail": ""},
+        "replicas": {},
+    }
+
+
+def test_validator_rejects_broken_bundles():
+    assert validate_bundle({"schema": "nope"})  # unknown schema
+    bad_trigger = _minimal_bundle()
+    bad_trigger["trigger"]["kind"] = "vibes"
+    assert any("trigger.kind" in e for e in validate_bundle(bad_trigger))
+    shuffled = _minimal_bundle()
+    shuffled["replicas"]["0"] = {
+        "steps": [{"index": 5}, {"index": 3}], "spans": [],
+    }
+    assert any("not increasing" in e for e in validate_bundle(shuffled))
+    cooked = _minimal_bundle()
+    cooked["replicas"]["0"] = {
+        "steps": [], "spans": [],
+        "ledger": {
+            "phase_s": {p: 0.0 for p in PHASES},
+            "waste_tokens": {c: 0 for c in WASTE_CLASSES},
+            "goodput_tokens": 5, "pending_tokens": 0,
+            "tokens_accounted": 9, "wall_s": 0.0,
+        },
+    }
+    assert any("reconcile" in e for e in validate_bundle(cooked))
+    assert validate_bundle(_minimal_bundle()) == []

@@ -258,6 +258,14 @@ def _run_chaos(seed: int, params, draft, adapters) -> None:
     injector = FaultInjector.random(
         seed=seed, rate=0.04, max_fires=int(rng.integers(1, 5))
     )
+    # Chip-time ledger under chaos (workloads/ledger.py): randomized on
+    # so quarantines/replays/cancels hit the waste taxonomy; inertness
+    # is implied by the oracle pins below and the books must still
+    # balance at the bottom.
+    if rng.integers(2):
+        from workloads.ledger import ChipTimeLedger
+
+        kw["ledger"] = ChipTimeLedger()
     engine = ServeEngine(
         params, CONFIG, adapters=adapters if use_adapters else None,
         fault_injector=injector, max_retries=2,
@@ -334,6 +342,16 @@ def _run_chaos(seed: int, params, draft, adapters) -> None:
     pinned = engine.prefix.cached_pages if engine.prefix is not None else 0
     assert engine.ctrl.used_pages == pinned, (seed, kw)
     _assert_kv_reclaimed(engine, seed, kw)
+    if engine.ledger is not None:
+        # Every rid reached exactly one terminal status above, so the
+        # ledger must be fully classified: goodput + waste == every
+        # token's worth of device work charged, nothing pending.
+        verdict = engine.ledger.reconcile(expect_quiescent=True)
+        assert verdict["ok"], (seed, kw, verdict)
+        ok_tokens = sum(
+            len(r.tokens) for r in engine.completed if r.status == "ok"
+        )
+        assert engine.ledger.goodput_tokens == ok_tokens, (seed, kw)
 
 
 def test_engine_fault_chaos_smoke():
@@ -437,6 +455,17 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
             ),
             max_retries=2, **kw,
         ))
+    # Fleet-scope chip-time ledger under chaos (workloads/ledger.py):
+    # per-replica ledgers + the fleet roll-up, randomized on — the
+    # failover/cancel/handoff taxonomy must still balance fleet-wide
+    # at the bottom (and the oracle pins below imply inertness).
+    fleet_ledger = None
+    if rng.integers(2):
+        from workloads.ledger import ChipTimeLedger, FleetLedger
+
+        fleet_ledger = FleetLedger()
+        for i, eng in enumerate(engines):
+            eng.ledger = ChipTimeLedger(name=str(i))
     # Disaggregated prefill/decode pools on half the seeds: random
     # per-replica roles (any combination is legal — a missing pool
     # degrades to mixed dispatch), so crashes/hangs/health drains land
@@ -457,6 +486,7 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
         hang_timeout_s=None,
         max_pending=int(rng.choice([4, 32])),
         roles=roles,
+        ledger=fleet_ledger,
     )
     names = [None] + (sorted(adapters) if use_adapters else [])
     expected = {}
@@ -553,6 +583,16 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
         pinned = e.prefix.cached_pages if e.prefix is not None else 0
         assert e.ctrl.used_pages == pinned, (seed, rep.index)
         assert not rep.rids, (seed, rep.index)
+    if fleet_ledger is not None:
+        # Every rid terminal fleet-wide, so the roll-up must be fully
+        # classified: goodput + waste == all charged device work, with
+        # goodput cross-checked against the ok streams.
+        verdict = fleet_ledger.reconcile(expect_quiescent=True)
+        assert verdict["ok"], (seed, verdict)
+        ok_tokens = sum(
+            len(r.tokens) for r in fleet.completed if r.status == "ok"
+        )
+        assert fleet_ledger.goodput_tokens == ok_tokens, (seed, verdict)
     fleet.close()
 
 

@@ -45,6 +45,11 @@ TRACKED_UP = [
     # superstep path or the link regressed.
     "superstep_tokens_per_sec",
     "obs_on_tokens_per_sec",
+    # Chip-time ledger: the goodput share of all charged device work
+    # under the seeded faulted spec stream — a drop means the serving
+    # stack started wasting more of the chip-second (more replays,
+    # more rejected drafts, more overdecode) for the same traffic.
+    "ledger_goodput_fraction",
     "admission_tokens_per_sec",
     "admission_speedup",
     "prefix_serve_speedup",
@@ -122,6 +127,10 @@ TRACKED_DOWN = [
     "autoscale_recover_slo_ms",
     "autoscale_overprovision_chip_s",
     "autoscale_preempt_resume_ms",
+    # Chip-time ledger: the always-on accounting tax (streams
+    # bit-identical on/off by construction, so a rise is pure
+    # bookkeeping cost creeping into the step loop).
+    "ledger_overhead_pct",
     # KV-cache hierarchy: per-page host-RAM reload cost — a rise means
     # offloaded conversations started paying more to come back.
     "kv_offload_reload_ms",

@@ -1,0 +1,409 @@
+"""Postmortem-bundle tooling for the flight recorder's dumps.
+
+The bundle building lives with the data (workloads/ledger.py
+``FlightRecorder.dump_bundle``); this tool is the validation and CLI
+side — the exact analog of tools/trace_export.py for the chrome-trace
+exporter:
+
+    python tools/postmortem.py --validate bundle.json  # schema-check
+    python tools/postmortem.py --summary bundle.json   # human headline
+    python tools/postmortem.py --selfcheck             # round-trip
+                                                       # (make ledger-check)
+
+The validator enforces what a diagnosable bundle actually needs:
+
+  * the ``tpu-serve-postmortem/1`` schema id and a legal trigger kind;
+  * per-replica blocks whose step records carry monotonically
+    increasing indices (a shuffled or double-drained ring is not a
+    timeline) and whose spans carry ordered stamps;
+  * **ledger reconciliation**: every embedded ledger must satisfy
+    ``goodput + waste + pending == tokens_accounted`` with no negative
+    class, and its phase seconds must sum to its charged wall clock —
+    a bundle whose books do not balance is evidence of a bug, not
+    evidence about the incident.
+
+``--selfcheck`` fabricates a recorder over fake engines (no jax —
+workloads/ledger.py is jax-free), drives a REAL ChipTimeLedger through
+a synthetic fault, dumps through the SAME code path the serve CLI uses,
+re-reads the file and validates it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_WASTE = (
+    "overdecode", "spec_rejected", "replay", "preempt_recompute",
+    "cancelled", "probe_warmup",
+)
+_TRIGGERS = (
+    "quarantine", "crash_loop", "probe_divergence", "slo_burn", "manual",
+)
+
+
+def _check_ledger(where: str, led: dict, errors: list[str]) -> None:
+    """One embedded ledger snapshot's accounting identities."""
+    for key in (
+        "phase_s", "waste_tokens", "goodput_tokens", "pending_tokens",
+        "tokens_accounted", "wall_s",
+    ):
+        if key not in led:
+            errors.append(f"{where}: ledger missing {key!r}")
+            return
+    waste = led["waste_tokens"]
+    if not isinstance(waste, dict) or not set(_WASTE) <= set(waste):
+        errors.append(
+            f"{where}: ledger waste_tokens must carry every class in "
+            f"{_WASTE}, got {sorted(waste) if isinstance(waste, dict) else waste!r}"
+        )
+        return
+    negatives = {k: v for k, v in waste.items() if v < 0}
+    if negatives or led["goodput_tokens"] < 0 or led["pending_tokens"] < 0:
+        errors.append(
+            f"{where}: negative ledger class "
+            f"(goodput={led['goodput_tokens']}, "
+            f"pending={led['pending_tokens']}, waste={negatives})"
+        )
+    lhs = led["goodput_tokens"] + sum(waste.values()) + led["pending_tokens"]
+    if lhs != led["tokens_accounted"]:
+        errors.append(
+            f"{where}: ledger does not reconcile — goodput + waste + "
+            f"pending = {lhs} != tokens_accounted = "
+            f"{led['tokens_accounted']}"
+        )
+    phases = led["phase_s"]
+    gap = abs(sum(phases.values()) - led["wall_s"])
+    if gap > max(1e-4, 1e-6 * led["wall_s"]):
+        errors.append(
+            f"{where}: phase seconds sum {sum(phases.values()):.6f} != "
+            f"charged wall {led['wall_s']:.6f} (gap {gap:.6f})"
+        )
+
+
+def _check_replica(label: str, block: dict, errors: list[str]) -> None:
+    where = f"replicas[{label}]"
+    if not isinstance(block, dict):
+        errors.append(f"{where}: not an object")
+        return
+    steps = block.get("steps", [])
+    if not isinstance(steps, list):
+        errors.append(f"{where}: steps must be a list")
+        steps = []
+    last = None
+    for i, rec in enumerate(steps):
+        idx = rec.get("index") if isinstance(rec, dict) else None
+        if not isinstance(idx, int):
+            errors.append(f"{where}.steps[{i}]: missing integer index")
+            continue
+        if last is not None and idx <= last:
+            errors.append(
+                f"{where}.steps[{i}]: index {idx} not increasing after "
+                f"{last} — the ring is not a timeline"
+            )
+        last = idx
+    for i, span in enumerate(block.get("spans", []) or []):
+        if not isinstance(span, dict):
+            errors.append(f"{where}.spans[{i}]: not an object")
+            continue
+        t_submit, t_done = span.get("t_submit"), span.get("t_done")
+        if (
+            isinstance(t_submit, (int, float))
+            and isinstance(t_done, (int, float))
+            and t_done < t_submit
+        ):
+            errors.append(
+                f"{where}.spans[{i}]: t_done {t_done} precedes "
+                f"t_submit {t_submit}"
+            )
+    if "ledger" in block:
+        _check_ledger(where, block["ledger"], errors)
+    for i, snap in enumerate(block.get("ledger_snapshots", []) or []):
+        _check_ledger(f"{where}.ledger_snapshots[{i}]", snap, errors)
+
+
+def validate_bundle(obj) -> list[str]:
+    """Return a list of schema/accounting violations (empty = valid)."""
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return ["top level must be an object"]
+    if obj.get("schema") != "tpu-serve-postmortem/1":
+        return [
+            f"unknown schema {obj.get('schema')!r} (want "
+            f"'tpu-serve-postmortem/1')"
+        ]
+    trigger = obj.get("trigger")
+    if not isinstance(trigger, dict) or trigger.get("kind") not in _TRIGGERS:
+        errors.append(
+            f"trigger.kind must be one of {_TRIGGERS}, got "
+            f"{trigger.get('kind') if isinstance(trigger, dict) else trigger!r}"
+        )
+    if not isinstance(obj.get("created_unix"), (int, float)):
+        errors.append("created_unix must be a number")
+    replicas = obj.get("replicas")
+    if not isinstance(replicas, dict):
+        errors.append("replicas must be a {label: block} object")
+        replicas = {}
+    for label, block in sorted(replicas.items()):
+        _check_replica(label, block, errors)
+    fleet = obj.get("fleet")
+    if fleet is not None:
+        if not isinstance(fleet, dict):
+            errors.append("fleet must be an object")
+        elif "ledger" in fleet:
+            led = fleet["ledger"]
+            # The fleet roll-up reuses the engine identities except the
+            # time one (its wall is a cross-replica sum of per-replica
+            # charges, already checked per replica above).
+            waste = led.get("waste_tokens", {})
+            lhs = (
+                led.get("goodput_tokens", 0) + sum(waste.values())
+                + led.get("pending_tokens", 0)
+            )
+            if lhs != led.get("tokens_accounted", -1):
+                errors.append(
+                    f"fleet: ledger does not reconcile — goodput + "
+                    f"waste + pending = {lhs} != tokens_accounted = "
+                    f"{led.get('tokens_accounted')}"
+                )
+            if led.get("pending_tokens", 0) < 0:
+                errors.append(
+                    f"fleet: negative pending_tokens "
+                    f"{led.get('pending_tokens')}"
+                )
+    for key in ("supervisor_events", "autoscaler_events"):
+        events = obj.get(key)
+        if events is None:
+            continue
+        if not isinstance(events, list):
+            errors.append(f"{key} must be a list")
+            continue
+        for i, ev in enumerate(events):
+            if not isinstance(ev, dict) or not isinstance(
+                ev.get("t"), (int, float)
+            ) or not ev.get("kind"):
+                errors.append(f"{key}[{i}]: wants numeric t and a kind")
+    return errors
+
+
+def validate_file(path: str) -> list[str]:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable or not JSON: {e}"]
+    return validate_bundle(obj)
+
+
+def summarize(path: str) -> str:
+    with open(path) as f:
+        obj = json.load(f)
+    trigger = obj.get("trigger", {})
+    lines = [
+        f"{os.path.basename(path)}: trigger={trigger.get('kind')} "
+        f"({trigger.get('detail', '')})"
+    ]
+    for label, block in sorted(obj.get("replicas", {}).items()):
+        led = block.get("ledger")
+        counters = block.get("counters", {})
+        bits = (
+            f"  replica {label}: {len(block.get('steps', []))} steps, "
+            f"{len(block.get('spans', []))} spans, "
+            f"quarantines={counters.get('steps_quarantined', 0)}"
+        )
+        if led:
+            bits += (
+                f", goodput={led['goodput_tokens']} "
+                f"waste={sum(led['waste_tokens'].values())} "
+                f"busy={led['busy_fraction']:.3f}"
+            )
+        lines.append(bits)
+    fleet = obj.get("fleet")
+    if fleet and fleet.get("ledger"):
+        led = fleet["ledger"]
+        lines.append(
+            f"  fleet: goodput={led['goodput_tokens']} "
+            f"waste={sum(led['waste_tokens'].values())} "
+            f"goodput_fraction={led['goodput_fraction']:.3f} "
+            f"per_class={led.get('per_class', {})}"
+        )
+    for key in ("supervisor_events", "autoscaler_events"):
+        if obj.get(key):
+            kinds: dict[str, int] = {}
+            for ev in obj[key]:
+                kinds[ev.get("kind", "?")] = kinds.get(ev.get("kind", "?"), 0) + 1
+            lines.append(f"  {key.split('_')[0]}: {kinds}")
+    return "\n".join(lines)
+
+
+def _fake_engine(label: str):
+    """A ChipTimeLedger-carrying fake engine (no jax) the REAL ledger
+    hooks can drive."""
+    from types import SimpleNamespace
+
+    from workloads.ledger import ChipTimeLedger
+
+    eng = SimpleNamespace(
+        generated_tokens=0, tokens_overdecoded=0, spec_tokens_rejected=0,
+        tokens_replayed=0, preempt_recompute_tokens=0, kv_spill_s=0.0,
+        kv_reload_s=0.0, kv_handoff_s=0.0, prefill_dispatches=0,
+        prefill_tokens=0, chunks_run=0, spec_rounds=0, superstep_k=1,
+        spec_lookahead=1, spec_superstep_k=1, steps_quarantined=0,
+        requests_retried=0, host_sync_s=0.0, ledger_phase="serve",
+        ledger=ChipTimeLedger(name=label), _obs=None,
+    )
+    return eng
+
+
+def _drive(eng, label: str, *, quarantine: bool) -> None:
+    """Advance the fake engine through synthetic steps — one of which
+    replays a request after a 'quarantine' — via the real hooks."""
+    from types import SimpleNamespace
+
+    led = eng.ledger
+
+    def step(emit=4, prefill=0, finish=None):
+        snap = led.step_begin(eng)
+        eng.generated_tokens += emit
+        eng.chunks_run += 1 if emit else 0
+        eng.prefill_dispatches += prefill
+        eng.prefill_tokens += prefill * 8
+        led.step_end(eng, snap, finish or [])
+
+    done = SimpleNamespace(rid=f"{label}-r0", tokens=[1] * 8, status="ok")
+    step(emit=4, prefill=1)
+    if quarantine:
+        eng.steps_quarantined += 1
+        eng.tokens_replayed += 10  # prompt 6 + emitted 4 re-prefilled
+        step(emit=0, prefill=0)
+    step(emit=4, prefill=0, finish=[done])
+
+
+def selfcheck() -> int:
+    from types import SimpleNamespace
+
+    from workloads.ledger import FleetLedger, FlightRecorder
+
+    eng0 = _fake_engine("0")
+    eng1 = _fake_engine("1")
+    fled = FleetLedger()
+    fleet = SimpleNamespace(
+        replicas=[], generated_tokens=16, tokens_replayed=10,
+        requests_submitted=2, ledger=fled, _obs=None,
+        slo_burn_rates=lambda: {"interactive": 0.4},
+    )
+    fled.attach("0", eng0.ledger)
+    fled.attach("1", eng1.ledger)
+    supervisor = SimpleNamespace(events=[], dropped_events=0)
+    out_dir = tempfile.mkdtemp(prefix="postmortem-selfcheck-")
+    rec = FlightRecorder(out_dir=out_dir, name="selfcheck")
+    # Attach BEFORE the faults happen — the recorder is always-on by
+    # contract, so the cursors must see the synthetic incident land.
+    rec.attach_engine("0", eng0)
+    rec.attach_engine("1", eng1)
+    rec.attach_fleet(fleet)
+    rec.attach_supervisor(supervisor)
+    _drive(eng0, "0", quarantine=True)
+    _drive(eng1, "1", quarantine=False)
+    fled.step_end(fleet, [
+        SimpleNamespace(
+            rid="fr-0", tokens=[1] * 8, status="ok",
+            slo_class="interactive",
+        ),
+        SimpleNamespace(
+            rid="fr-1", tokens=[1] * 4, status="cancelled", slo_class=None,
+        ),
+    ])
+    supervisor.events.append(SimpleNamespace(
+        t=1.0, kind="quarantine", chip_id="chip-0",
+        detail="crash-loop: 3 failures in 10.0s",
+    ))
+    try:
+        written = rec.poll()
+        errors: list[str] = []
+        # The synthetic quarantine AND the supervisor's crash-loop
+        # verdict must both have triggered real bundles.
+        kinds = [k for k, _ in rec.triggers]
+        if "quarantine" not in kinds or "crash_loop" not in kinds:
+            errors.append(
+                f"recorder triggers {kinds} missed the synthetic "
+                "quarantine/crash-loop"
+            )
+        if not written:
+            errors.append("recorder.poll() wrote no bundle")
+        for path in rec.dumped:
+            errors += validate_file(path)
+        manual = rec.dump_bundle(trigger="manual", detail="selfcheck")
+        errors += validate_file(manual)
+        with open(manual) as f:
+            bundle = json.load(f)
+        if set(bundle["replicas"]) != {"0", "1"}:
+            errors.append(
+                f"bundle covers replicas {sorted(bundle['replicas'])}, "
+                "want ['0', '1']"
+            )
+        if bundle["replicas"]["0"]["ledger"]["waste_tokens"]["replay"] != 10:
+            errors.append("replica 0's replay waste did not survive")
+        if bundle.get("fleet", {}).get("ledger") is None:
+            errors.append("fleet ledger block missing")
+    finally:
+        for fn in os.listdir(out_dir):
+            os.unlink(os.path.join(out_dir, fn))
+        os.rmdir(out_dir)
+    if errors:
+        for e in errors:
+            print(f"postmortem selfcheck: {e}", file=sys.stderr)
+        return 1
+    print(
+        f"postmortem selfcheck OK ({len(rec.dumped)} bundles "
+        f"round-tripped: {[k for k, _ in rec.triggers] + ['manual']})"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    group = parser.add_mutually_exclusive_group(required=True)
+    group.add_argument("--validate", metavar="PATH",
+                       help="schema- and accounting-check a postmortem "
+                       "bundle JSON file")
+    group.add_argument("--summary", metavar="PATH",
+                       help="print a human-readable headline of a bundle")
+    group.add_argument("--selfcheck", action="store_true",
+                       help="dump a synthetic bundle through the real "
+                       "recorder and validate it (the make ledger-check "
+                       "round trip)")
+    args = parser.parse_args(argv)
+    if args.selfcheck:
+        return selfcheck()
+    if args.summary:
+        errors = validate_file(args.summary)
+        if errors:
+            for e in errors:
+                print(f"postmortem: {e}", file=sys.stderr)
+            return 1
+        print(summarize(args.summary))
+        return 0
+    errors = validate_file(args.validate)
+    if errors:
+        for e in errors:
+            print(f"postmortem: {e}", file=sys.stderr)
+        return 1
+    with open(args.validate) as f:
+        bundle = json.load(f)
+    print(
+        f"postmortem: {args.validate} OK "
+        f"(trigger={bundle['trigger']['kind']}, "
+        f"{len(bundle.get('replicas', {}))} replica blocks)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -427,6 +427,12 @@ class FleetAutoscaler:
         from .supervisor import run_canary
 
         self._probes += 1
+        # Ledger-armed engines classify the canary's chip time and
+        # tokens as probe_warmup waste, not goodput — the supervisor
+        # probe's discipline (workloads/ledger.py OFFBOOK_PHASES).
+        had_phase = getattr(engine, "ledger_phase", None)
+        if had_phase is not None:
+            engine.ledger_phase = "probe"
         try:
             tokens, status = run_canary(
                 engine, self.probe_prompt, self.probe_new,
@@ -436,6 +442,9 @@ class FleetAutoscaler:
         except Exception as exc:  # noqa: BLE001 — a probe blowing up IS
             # the signal probes exist for.
             return False, f"{type(exc).__name__}: {exc}"
+        finally:
+            if had_phase is not None:
+                engine.ledger_phase = had_phase
         if tokens is None:
             return False, (
                 f"canary did not finish within {self.probe_max_steps} "

@@ -476,6 +476,7 @@ class Fleet:
         slo_window_s: float = 60.0,
         roles=None,
         wfq_weights=None,
+        ledger=None,
     ):
         engines = list(engines)
         if not engines:
@@ -618,6 +619,11 @@ class Fleet:
         self.requests_failed = 0
         self.failover_requeues = 0  # charged (true-fault) failovers
         self.drain_requeues = 0  # uncharged (health/operator) failovers
+        # Chip-time ledger waste class "replay" at FLEET scope: prompt
+        # + emitted tokens requeued for re-prefill on a survivor (a
+        # failover's or drain's recompute bill — the replica-local
+        # pendant is engine.tokens_replayed; workloads/ledger.py).
+        self.tokens_replayed = 0
         # Preemption-via-offload (degradation ladder step 2): streams
         # parked by preempt() and requeued uncharged, plus the
         # preempt -> next-resumed-token windows the bench publishes as
@@ -669,6 +675,12 @@ class Fleet:
         self._obs = observer
         if observer is not None:
             observer._bind(self)
+        # Fleet-scope chip-time ledger (workloads/ledger.py
+        # FleetLedger): per-replica engine ledgers roll up through it
+        # and the fleet classifies terminal tokens per SLO class.
+        # Inert like the observer; /healthz and the FleetObserver's
+        # LEDGER_METRICS families read it.
+        self.ledger = ledger
 
     # ---- introspection ---------------------------------------------------
 
@@ -1239,6 +1251,12 @@ class Fleet:
                     self._recovery_rids.add(fr.rid)
             else:
                 self.drain_requeues += 1
+            # Ledger waste class "replay": the failover re-prefills
+            # prompt + everything the dead/drained replica already
+            # emitted (workloads/ledger.py — charged whether or not
+            # the fault was the request's fault: the chip recomputes
+            # either way).
+            self.tokens_replayed += len(fr.prompt) + len(fr.tokens)
             fr.status = "queued"
             self.queue.appendleft(fr)
         return finished
@@ -1537,6 +1555,15 @@ class Fleet:
                 )
             except Exception:  # noqa: BLE001 — a graft failure must
                 pass  # degrade to plain re-prefill, never block dispatch
+        if (
+            ticket is not None
+            and pages_in == 0
+            and rep.index != ticket.src_replica
+        ):
+            # The handoff degraded to a re-prefill (empty ticket,
+            # incompatible blobs, or a failed graft): the decode pool
+            # recomputes the prompt — ledger waste class "replay".
+            self.tokens_replayed += len(prompt)
         deadline = None
         if fr.t_deadline is not None:
             deadline = max(fr.t_deadline - time.perf_counter(), 1e-6)
@@ -1839,6 +1866,8 @@ class Fleet:
             self.generated_tokens += (
                 sum(e.generated_tokens for e in engines) - tokens0
             )
+            if self.ledger is not None:
+                self.ledger.step_end(self, finished)
             if self._obs is not None:
                 self._obs._fleet_step_end(self, finished)
             return finished
@@ -1910,6 +1939,7 @@ class Fleet:
                 return
             self._closed = True
             err = "EngineClosed: fleet closed with the request in flight"
+            closed_now: list[FleetRequest] = []
             for rep in self.replicas:
                 if rep.state == DEAD:
                     continue
@@ -1918,7 +1948,9 @@ class Fleet:
                     if fr is not None and not fr.done:
                         self._close_attempt(fr, ereq, "closed")
                         fr.tokens.extend(int(t) for t in ereq.tokens)
-                        self._finish_terminal(fr, "failed", error=err)
+                        closed_now.append(
+                            self._finish_terminal(fr, "failed", error=err)
+                        )
                 rep.rids.clear()
                 try:
                     rep.engine.close()
@@ -1928,8 +1960,15 @@ class Fleet:
             while self.queue:
                 fr = self.queue.popleft()
                 if not fr.done:
-                    self._finish_terminal(fr, "failed", error=err)
+                    closed_now.append(
+                        self._finish_terminal(fr, "failed", error=err)
+                    )
             self._finished_buffer.clear()
+            if self.ledger is not None:
+                # A shutdown that failed N streams must not read as 0
+                # waste: the last counter deltas and close-failed
+                # classification land before the books freeze.
+                self.ledger.step_end(self, closed_now)
             self.unbind_health()
 
     def __enter__(self) -> "Fleet":
@@ -2338,6 +2377,12 @@ class FleetServer:
                     health["supervisor"] = supervisor.states()
                 if autoscaler is not None:
                     health["autoscaler"] = autoscaler.states()
+                if getattr(fleet, "ledger", None) is not None:
+                    # Chip-time accounting on the liveness endpoint:
+                    # busy/goodput fractions + the per-waste-class
+                    # token and estimated chip-second totals
+                    # (docs/OBSERVABILITY.md "Chip-time ledger").
+                    health["ledger"] = fleet.ledger.healthz()
                 self._json(200, health)
 
             def _operator(self, verb: str, arg: str) -> None:

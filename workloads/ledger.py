@@ -1,0 +1,910 @@
+"""Chip-time ledger + always-on flight recorder: where did the
+chip-second go, and how much of it was wasted?
+
+The plugin's whole value proposition is making a shared accelerator
+*accountable* — it advertises fractional replicas and health, and its
+``replicas = -1`` mode turns device memory into the schedulable unit
+(PAPER.md §0.5) — yet nine PRs of fleets, supersteps and autoscaling
+could not answer the operator's first question after an incident.  This
+module closes that gap with two always-cheap, always-inert host-side
+instruments:
+
+  1. **``ChipTimeLedger``** — a per-engine running attribution of every
+     ``step()``'s wall window to a *phase* (prefill sweep, plain decode,
+     spec draft/verify/commit, KV spill/reload/handoff, canary probe,
+     warmup, idle) using the seams the engine already times
+     (``host_sync_s``, ``kv_spill_s``/``kv_reload_s``/``kv_handoff_s``,
+     dispatch counters), and a classification of every token the chip
+     computed into **goodput vs a named waste taxonomy**:
+
+       * ``overdecode``       — device decode steps past a row's
+         retirement point (``engine.tokens_overdecoded``);
+       * ``spec_rejected``    — drafted-but-unaccepted speculative
+         tokens (``engine.spec_tokens_rejected``);
+       * ``replay``           — prompt + emitted tokens RE-prefilled
+         after a quarantine or fleet failover
+         (``engine.tokens_replayed`` / ``Fleet.tokens_replayed``);
+       * ``preempt_recompute``— the recompute a preemption-via-offload
+         resume pays beyond its parked pages
+         (``engine.preempt_recompute_tokens``);
+       * ``cancelled``        — tokens streamed to a request whose
+         terminal status is non-ok (cancelled/expired/failed);
+       * ``probe_warmup``     — tokens emitted while the engine's
+         ``ledger_phase`` marks a canary probe or warmup pass.
+
+     The ledger is a PURE counter-delta reader: it never touches device
+     state, RNG keys, scheduling or page accounting, so token streams
+     are bit-identical with it on or off (pinned by
+     tests/test_ledger.py) and its cost is priced by the perf bench
+     (``ledger_overhead_pct``).  ``FleetLedger`` rolls replicas up
+     fleet-wide with per-SLO-class goodput/waste accounting.
+
+  2. **``FlightRecorder``** — the always-on black box: it watches the
+     observers' existing bounded rings (step records, lifecycle spans,
+     supervisor/autoscaler events) plus a ring of periodic ledger
+     snapshots, and dumps a self-contained JSON **postmortem bundle**
+     (validated by ``tools/postmortem.py --validate``) when triggered
+     by a quarantine, a crash-loop verdict, a canary-probe divergence,
+     or a sustained SLO burn-rate breach — so the FIRST fault on the
+     tunnelled chip produces a diagnosable artifact instead of a dead
+     replica and a counter.
+
+Accounting identities (checked by ``reconcile()`` and the postmortem
+validator):
+
+  * ``goodput + waste + pending == tokens_accounted`` — where
+    ``tokens_accounted`` is every token's worth of device work the
+    ledger ever charged (delivered emissions + the overdecode /
+    spec-rejected / replay / preempt-recompute extras) and ``pending``
+    is the not-yet-terminal remainder, 0 at quiescence;
+  * ``sum(phase_s.values()) == wall_s`` — every charged second lands in
+    exactly one phase.
+
+This module is importable WITHOUT jax — it reads host counters only —
+so the postmortem tooling and the metrics lint stay fast.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field, is_dataclass
+
+# Every phase one charged chip-second can land in.  ``spec_draft`` /
+# ``spec_verify`` / ``spec_commit`` subdivide the fused speculative
+# window by the ``spec_split`` attribution model (the scan is one
+# dispatch; per-phase device timers do not exist inside it) — the SUM
+# across the three is exact, the split is the documented model.
+PHASES = (
+    "prefill", "decode", "spec_draft", "spec_verify", "spec_commit",
+    "kv_spill", "kv_reload", "kv_handoff", "probe", "warmup", "idle",
+)
+
+# The named waste taxonomy every non-goodput token falls into.
+WASTE_CLASSES = (
+    "overdecode", "spec_rejected", "replay", "preempt_recompute",
+    "cancelled", "probe_warmup",
+)
+
+# Engine ``ledger_phase`` values that take a step OFF the books: its
+# wall time charges to that phase and its emissions classify as
+# ``probe_warmup`` waste immediately (such passes should bracket whole
+# requests — the supervisor's canary and the CLI's warmup both do).
+OFFBOOK_PHASES = ("probe", "warmup")
+
+# Postmortem bundle schema id (tools/postmortem.py validates it).
+BUNDLE_SCHEMA = "tpu-serve-postmortem/1"
+
+# Flight-recorder trigger kinds (tools/postmortem.py pins the set).
+TRIGGER_KINDS = (
+    "quarantine", "crash_loop", "probe_divergence", "slo_burn", "manual",
+)
+
+
+@dataclass
+class LedgerSnapshot:
+    """One point-in-time copy of a ledger's totals — the unit the
+    flight recorder rings and the postmortem bundle embed."""
+
+    name: str
+    t: float
+    wall_s: float
+    steps: int
+    phase_s: dict
+    goodput_tokens: int
+    waste_tokens: dict
+    pending_tokens: int
+    tokens_emitted: int
+    tokens_accounted: int
+    busy_fraction: float
+    goodput_fraction: float
+    waste_chip_s: dict
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+class ChipTimeLedger:
+    """Continuously-maintained chip-time and token accounting for one
+    ``ServeEngine`` (``ServeEngine(ledger=ChipTimeLedger())``).
+
+    The engine drives ``step_begin`` / ``step_end`` around every
+    ``step()`` (and ``engine_closed`` at ``close()``); everything the
+    ledger learns comes from counter DELTAS against the engine's own
+    running totals, so increments that land between steps (a cancel, a
+    preempt, an ``export_kv`` spill) are never lost.
+
+    **Phase attribution rule** (documented, deterministic): a step's
+    wall window first pays its measured KV tax (``kv_spill_s`` /
+    ``kv_reload_s`` / ``kv_handoff_s`` deltas); the remainder splits
+    across prefill / decode / spec phases proportional to the step's
+    dispatch counts (a step that only admitted charges prefill, a step
+    that only decoded charges decode, a mixed budgeted step splits), or
+    lands in ``idle`` when nothing dispatched.  The fused speculative
+    window subdivides draft/verify/commit by ``spec_split`` (default
+    0.45/0.45/0.10 — roughly the measured per-phase economics of the
+    bench's ``spec_draft/verify/commit_ms`` probes; pass the artifact's
+    own ratios to recalibrate).  The per-step charge is
+    ``max(dur, kv)`` so the time identity ``sum(phase_s) == wall_s``
+    holds exactly even when KV work ran BETWEEN steps (an export_kv or
+    preempt park outside ``step()``)."""
+
+    # Engine counters read as running-total deltas each step_end.
+    _COUNTERS = (
+        "generated_tokens", "tokens_overdecoded", "spec_tokens_rejected",
+        "tokens_replayed", "preempt_recompute_tokens", "kv_spill_s",
+        "kv_reload_s", "kv_handoff_s", "prefill_dispatches",
+        "prefill_tokens", "chunks_run", "spec_rounds",
+    )
+
+    def __init__(
+        self,
+        *,
+        name: str = "0",
+        spec_split: tuple[float, float, float] = (0.45, 0.45, 0.10),
+    ):
+        if len(spec_split) != 3 or any(s < 0 for s in spec_split) or (
+            sum(spec_split) <= 0
+        ):
+            raise ValueError(
+                f"spec_split wants three non-negative weights with a "
+                f"positive sum (draft, verify, commit), got {spec_split}"
+            )
+        total = float(sum(spec_split))
+        self.name = name
+        self.spec_split = tuple(s / total for s in spec_split)
+        self.phase_s: dict[str, float] = {p: 0.0 for p in PHASES}
+        self.waste_tokens: dict[str, int] = {c: 0 for c in WASTE_CLASSES}
+        self.goodput_tokens = 0
+        self.tokens_emitted = 0
+        self.tokens_accounted = 0
+        self.wall_s = 0.0
+        self.steps = 0
+        # Attribution denominators for waste_chip_s(): on-book tokens
+        # emitted by the plain vs spec decode programs, and the prompt
+        # tokens the prefill programs actually forwarded.
+        self._emitted_plain = 0
+        self._emitted_spec = 0
+        self._prefill_tokens = 0
+        self._seen: dict[str, float] = {}
+
+    # ---- engine-facing hooks --------------------------------------------
+
+    def _delta(self, engine, attr: str) -> float:
+        total = float(getattr(engine, attr, 0) or 0)
+        delta = total - self._seen.get(attr, 0.0)
+        self._seen[attr] = total
+        return delta if delta > 0 else 0.0
+
+    def step_begin(self, engine) -> float:
+        return time.perf_counter()
+
+    def step_end(self, engine, t0: float, finished) -> None:
+        dur = max(time.perf_counter() - t0, 0.0)
+        emitted = int(self._delta(engine, "generated_tokens"))
+        overdecode = int(self._delta(engine, "tokens_overdecoded"))
+        spec_rej = int(self._delta(engine, "spec_tokens_rejected"))
+        replay = int(self._delta(engine, "tokens_replayed"))
+        preempt = int(self._delta(engine, "preempt_recompute_tokens"))
+        kv_spill = self._delta(engine, "kv_spill_s")
+        kv_reload = self._delta(engine, "kv_reload_s")
+        kv_handoff = self._delta(engine, "kv_handoff_s")
+        prefill_d = int(self._delta(engine, "prefill_dispatches"))
+        self._prefill_tokens += int(self._delta(engine, "prefill_tokens"))
+        chunk_d = int(self._delta(engine, "chunks_run")) // max(
+            int(getattr(engine, "superstep_k", 1) or 1), 1
+        )
+        spec_d = int(self._delta(engine, "spec_rounds")) // max(
+            int(getattr(engine, "spec_lookahead", 1) or 1),
+            int(getattr(engine, "spec_superstep_k", 1) or 1), 1,
+        )
+        kv = kv_spill + kv_reload + kv_handoff
+        self.phase_s["kv_spill"] += kv_spill
+        self.phase_s["kv_reload"] += kv_reload
+        self.phase_s["kv_handoff"] += kv_handoff
+        rest = max(dur - kv, 0.0)
+        phase = getattr(engine, "ledger_phase", "serve")
+        offbook = phase in OFFBOOK_PHASES
+        if offbook:
+            self.phase_s[phase] += rest
+            if emitted:
+                self.waste_tokens["probe_warmup"] += emitted
+        else:
+            weights = (
+                ("prefill", prefill_d), ("decode", chunk_d),
+                ("spec", spec_d),
+            )
+            total_w = prefill_d + chunk_d + spec_d
+            if total_w == 0:
+                self.phase_s["idle"] += rest
+            else:
+                for key, w in weights:
+                    if not w:
+                        continue
+                    share = rest * w / total_w
+                    if key == "spec":
+                        d, v, c = self.spec_split
+                        self.phase_s["spec_draft"] += share * d
+                        self.phase_s["spec_verify"] += share * v
+                        self.phase_s["spec_commit"] += share * c
+                    else:
+                        self.phase_s[key] += share
+            if emitted:
+                if spec_d:
+                    self._emitted_spec += emitted
+                else:
+                    self._emitted_plain += emitted
+        self.tokens_emitted += emitted
+        self.tokens_accounted += (
+            emitted + overdecode + spec_rej + replay + preempt
+        )
+        self.waste_tokens["overdecode"] += overdecode
+        self.waste_tokens["spec_rejected"] += spec_rej
+        self.waste_tokens["replay"] += replay
+        self.waste_tokens["preempt_recompute"] += preempt
+        for req in finished or ():
+            if offbook:
+                # The pass's emissions already classified as
+                # probe_warmup above — terminal classification on top
+                # would double-charge (offbook passes bracket whole
+                # requests by contract).
+                continue
+            n = len(getattr(req, "tokens", ()) or ())
+            status = getattr(req, "status", "ok") or "ok"
+            if status == "ok":
+                self.goodput_tokens += n
+            else:
+                self.waste_tokens["cancelled"] += n
+        self.wall_s += max(dur, kv)
+        self.steps += 1
+
+    def engine_closed(self, engine, finished) -> None:
+        """Final flush at ``engine.close()``: the last counter deltas
+        land and the close-failed requests classify (a shutdown that
+        failed N streams must not read as 0 waste)."""
+        self.step_end(engine, time.perf_counter(), finished)
+
+    # ---- derived accounting ---------------------------------------------
+
+    @property
+    def waste_total(self) -> int:
+        return sum(self.waste_tokens.values())
+
+    @property
+    def pending_tokens(self) -> int:
+        """Tokens charged but not yet classified: emissions whose
+        request has not reached a terminal status.  0 at quiescence on
+        a standalone engine; a fleet replica whose in-flight work was
+        HARVESTED for failover legitimately keeps the harvested
+        emissions pending forever — the FleetLedger classifies them at
+        the fleet-terminal transition instead."""
+        return self.tokens_accounted - self.goodput_tokens - self.waste_total
+
+    @property
+    def busy_fraction(self) -> float:
+        if self.wall_s <= 0:
+            return 0.0
+        return max(0.0, min(
+            1.0, (self.wall_s - self.phase_s["idle"]) / self.wall_s
+        ))
+
+    @property
+    def goodput_fraction(self) -> float:
+        if self.tokens_accounted <= 0:
+            return 0.0
+        return self.goodput_tokens / self.tokens_accounted
+
+    def waste_chip_s(self) -> dict[str, float]:
+        """Estimated chip-SECONDS behind each waste class — the phase
+        times scaled by that class's share of the work the phase
+        processed (decode-shaped waste scales the decode/spec window by
+        its token share; replay/preempt scale the prefill window by
+        their re-prefilled share; probe/warmup ARE their phases).  An
+        attribution model, documented and deterministic — the exact
+        quantity is the token taxonomy; this maps it onto seconds for
+        the scrape endpoint."""
+        w = self.waste_tokens
+        out = {c: 0.0 for c in WASTE_CLASSES}
+        decode_like = (
+            self.phase_s["decode"] + self.phase_s["spec_draft"]
+            + self.phase_s["spec_verify"] + self.phase_s["spec_commit"]
+        )
+        emitted_onbook = self._emitted_plain + self._emitted_spec
+        denom = emitted_onbook + w["overdecode"] + w["spec_rejected"]
+        if denom > 0:
+            out["overdecode"] = decode_like * w["overdecode"] / denom
+            out["spec_rejected"] = decode_like * w["spec_rejected"] / denom
+            out["cancelled"] = decode_like * min(
+                w["cancelled"], emitted_onbook
+            ) / denom
+        if self._prefill_tokens > 0:
+            pre = self.phase_s["prefill"]
+            out["replay"] = pre * min(
+                w["replay"] / self._prefill_tokens, 1.0
+            )
+            out["preempt_recompute"] = pre * min(
+                w["preempt_recompute"] / self._prefill_tokens, 1.0
+            )
+        out["probe_warmup"] = self.phase_s["probe"] + self.phase_s["warmup"]
+        return out
+
+    def reconcile(self, *, expect_quiescent: bool = False) -> dict:
+        """Check the ledger's invariants; returns a verdict dict with
+        ``ok`` plus the numbers behind it.  ``expect_quiescent=True``
+        additionally requires every charged token to be CLASSIFIED
+        (``pending == 0`` — the post-run contract the tests and `make
+        ledger-check` pin)."""
+        time_gap = abs(sum(self.phase_s.values()) - self.wall_s)
+        ok = (
+            self.pending_tokens >= 0
+            and all(v >= 0 for v in self.waste_tokens.values())
+            and self.goodput_tokens >= 0
+            and time_gap <= max(1e-6, 1e-9 * self.wall_s)
+        )
+        if expect_quiescent:
+            ok = ok and self.pending_tokens == 0
+        return {
+            "ok": ok,
+            "goodput": self.goodput_tokens,
+            "waste": self.waste_total,
+            "pending": self.pending_tokens,
+            "accounted": self.tokens_accounted,
+            "emitted": self.tokens_emitted,
+            "time_gap_s": time_gap,
+        }
+
+    def snapshot(self) -> LedgerSnapshot:
+        return LedgerSnapshot(
+            name=self.name, t=time.time(), wall_s=self.wall_s,
+            steps=self.steps, phase_s=dict(self.phase_s),
+            goodput_tokens=self.goodput_tokens,
+            waste_tokens=dict(self.waste_tokens),
+            pending_tokens=self.pending_tokens,
+            tokens_emitted=self.tokens_emitted,
+            tokens_accounted=self.tokens_accounted,
+            busy_fraction=round(self.busy_fraction, 6),
+            goodput_fraction=round(self.goodput_fraction, 6),
+            waste_chip_s={
+                k: round(v, 6) for k, v in self.waste_chip_s().items()
+            },
+        )
+
+
+class FleetLedger:
+    """Fleet-wide roll-up: per-replica ``ChipTimeLedger``s supply the
+    phase times and the engine-local waste classes; the FLEET supplies
+    the token classification (goodput / cancelled, per SLO class) and
+    the failover-replay charges — because a failed-over stream's
+    emissions span replicas and only the fleet sees its one terminal
+    status.  ``Fleet(ledger=FleetLedger())`` drives ``step_end`` per
+    fleet step; replica ledgers self-register from the live replica
+    set (resurrected and scaled-up members included), and a retired
+    replica's history stays in the roll-up."""
+
+    def __init__(self, *, name: str = "0"):
+        self.name = name
+        self.goodput_tokens = 0
+        self.waste_cancelled = 0
+        self.tokens_emitted = 0
+        self.fleet_replay_tokens = 0
+        # slo_class -> {"goodput": n, "waste": n} (terminal-classified
+        # tokens only; "untagged" carries unclassed traffic).
+        self.class_tokens: dict[str, dict[str, int]] = {}
+        self._seen: dict[str, float] = {}
+        self._ledgers: dict[int, tuple[str, ChipTimeLedger]] = {}
+
+    def attach(self, label: str, ledger: ChipTimeLedger) -> None:
+        """Adopt one replica ledger into the roll-up (idempotent; the
+        fleet hook auto-registers live replicas, this is the seam for
+        pre-registration or out-of-fleet engines)."""
+        self._ledgers.setdefault(id(ledger), (str(label), ledger))
+
+    @property
+    def engine_ledgers(self) -> list[tuple[str, ChipTimeLedger]]:
+        return list(self._ledgers.values())
+
+    def _delta(self, obj, attr: str) -> float:
+        total = float(getattr(obj, attr, 0) or 0)
+        delta = total - self._seen.get(attr, 0.0)
+        self._seen[attr] = total
+        return delta if delta > 0 else 0.0
+
+    @property
+    def tokens_accounted(self) -> int:
+        """Every token's worth of device work charged fleet-wide —
+        computed from the running counters alone (no snapshot
+        materialization: this sits on the scrape path)."""
+        extras = self.fleet_replay_tokens
+        for _, led in self._ledgers.values():
+            w = led.waste_tokens
+            extras += (
+                w["overdecode"] + w["spec_rejected"] + w["replay"]
+                + w["preempt_recompute"] + w["probe_warmup"]
+            )
+        return self.tokens_emitted + extras
+
+    @property
+    def goodput_fraction(self) -> float:
+        accounted = self.tokens_accounted
+        if accounted <= 0:
+            return 0.0
+        return self.goodput_tokens / accounted
+
+    def step_end(self, fleet, finished) -> None:
+        for rep in getattr(fleet, "replicas", ()):
+            led = getattr(rep.engine, "ledger", None)
+            if led is not None:
+                self.attach(str(rep.index), led)
+        self.tokens_emitted += int(self._delta(fleet, "generated_tokens"))
+        self.fleet_replay_tokens += int(
+            self._delta(fleet, "tokens_replayed")
+        )
+        for fr in finished or ():
+            n = len(getattr(fr, "tokens", ()) or ())
+            cls = getattr(fr, "slo_class", None) or "untagged"
+            bucket = self.class_tokens.setdefault(
+                cls, {"goodput": 0, "waste": 0}
+            )
+            if getattr(fr, "status", "ok") == "ok":
+                self.goodput_tokens += n
+                bucket["goodput"] += n
+            else:
+                self.waste_cancelled += n
+                bucket["waste"] += n
+
+    def snapshot(self) -> dict:
+        """The merged fleet-scope accounting: phase seconds and
+        engine-local waste summed over every registered replica ledger,
+        goodput/cancelled from the fleet's own terminal classification,
+        failover replays added to the ``replay`` class."""
+        phase_s = {p: 0.0 for p in PHASES}
+        waste = {c: 0 for c in WASTE_CLASSES}
+        wall = 0.0
+        per_replica = {}
+        for label, led in self._ledgers.values():
+            for p, secs in led.phase_s.items():
+                phase_s[p] += secs
+            wall += led.wall_s
+            for c in ("overdecode", "spec_rejected", "replay",
+                      "preempt_recompute", "probe_warmup"):
+                waste[c] += led.waste_tokens[c]
+            snap = led.snapshot()
+            per_replica[label] = {
+                "busy_fraction": snap.busy_fraction,
+                "goodput_fraction": snap.goodput_fraction,
+                "wall_s": round(led.wall_s, 6),
+                "waste_tokens": dict(led.waste_tokens),
+            }
+        waste["cancelled"] = self.waste_cancelled
+        waste["replay"] += self.fleet_replay_tokens
+        extras = (
+            waste["overdecode"] + waste["spec_rejected"] + waste["replay"]
+            + waste["preempt_recompute"] + waste["probe_warmup"]
+        )
+        accounted = self.tokens_emitted + extras
+        waste_total = sum(waste.values())
+        pending = accounted - self.goodput_tokens - waste_total
+        idle = phase_s["idle"]
+        return {
+            "name": self.name,
+            "t": time.time(),
+            "wall_s": round(wall, 6),
+            "phase_s": {p: round(s, 6) for p, s in phase_s.items()},
+            "goodput_tokens": self.goodput_tokens,
+            "waste_tokens": waste,
+            "pending_tokens": pending,
+            "tokens_emitted": self.tokens_emitted,
+            "tokens_accounted": accounted,
+            "busy_fraction": round(
+                max(0.0, min(1.0, (wall - idle) / wall)) if wall > 0
+                else 0.0, 6,
+            ),
+            "goodput_fraction": round(
+                self.goodput_tokens / accounted if accounted > 0 else 0.0,
+                6,
+            ),
+            "per_class": {
+                cls: dict(counts)
+                for cls, counts in sorted(self.class_tokens.items())
+            },
+            "per_replica": per_replica,
+        }
+
+    def healthz(self) -> dict:
+        """The /healthz-sized summary: fractions + per-waste-class
+        token and estimated chip-second totals."""
+        snap = self.snapshot()
+        waste_s = {c: 0.0 for c in WASTE_CLASSES}
+        for _, led in self._ledgers.values():
+            for c, secs in led.waste_chip_s().items():
+                waste_s[c] += secs
+        return {
+            "busy_fraction": snap["busy_fraction"],
+            "goodput_fraction": snap["goodput_fraction"],
+            "goodput_tokens": snap["goodput_tokens"],
+            "waste_tokens": snap["waste_tokens"],
+            "waste_chip_s": {c: round(s, 6) for c, s in waste_s.items()},
+            "per_class": snap["per_class"],
+        }
+
+    def reconcile(self, *, expect_quiescent: bool = False) -> dict:
+        snap = self.snapshot()
+        ok = (
+            snap["pending_tokens"] >= 0
+            and all(v >= 0 for v in snap["waste_tokens"].values())
+        )
+        if expect_quiescent:
+            ok = ok and snap["pending_tokens"] == 0
+        return {
+            "ok": ok,
+            "goodput": snap["goodput_tokens"],
+            "waste": sum(snap["waste_tokens"].values()),
+            "pending": snap["pending_tokens"],
+            "accounted": snap["tokens_accounted"],
+            "emitted": snap["tokens_emitted"],
+        }
+
+
+def _plain(obj):
+    """JSON-serialisable copy of a span/record/event (dataclasses via
+    asdict, SimpleNamespace-likes via __dict__, dicts verbatim)."""
+    if is_dataclass(obj) and not isinstance(obj, type):
+        return asdict(obj)
+    if isinstance(obj, dict):
+        return dict(obj)
+    return dict(vars(obj))
+
+
+@dataclass
+class _EngineTap:
+    """One watched engine: its label, the engine itself (counters +
+    optional ``._obs`` rings + optional ``.ledger``), the recorder's
+    trigger cursors, and its bounded ledger-snapshot ring."""
+
+    label: str
+    engine: object
+    quarantines_seen: int = 0
+    cooldown: int = 0
+    snapshots: deque = field(default_factory=lambda: deque(maxlen=64))
+    dropped_snapshots: int = 0
+
+
+class FlightRecorder:
+    """Always-on black box over the serving stack's existing bounded
+    rings.  Attach what exists — engines (with or without observers /
+    ledgers), the fleet (observer + ledger), the supervisor, the
+    autoscaler — then ``poll()`` wherever the serve loop already polls
+    its controllers.  Each poll records a ledger snapshot per engine
+    into a bounded ring and checks the trigger conditions:
+
+      * a replica-engine **quarantine** (``steps_quarantined`` moved);
+      * a supervisor **crash-loop** or operator quarantine verdict
+        (``quarantine`` events);
+      * a half-open **probe divergence** (``restart_failed`` events
+        whose detail names the canary/oracle);
+      * a **sustained SLO burn** (any class's
+        ``Fleet.slo_burn_rates()`` above ``burn_threshold`` for
+        ``burn_polls`` consecutive polls — the multi-window idea at
+        poll cadence).
+
+    A trigger dumps a self-contained JSON postmortem bundle
+    (``BUNDLE_SCHEMA``; ``tools/postmortem.py --validate`` accepts it)
+    into ``out_dir``, bounded by ``bundle_limit`` (further triggers
+    count ``bundles_skipped`` instead of filling the disk).  Dumps are
+    non-destructive — rings keep filling, drains stay the caller's.
+
+    Like the ledger it is INERT: reads counters and rings, writes only
+    bundle files — token streams are bit-identical with it armed or
+    absent (pinned)."""
+
+    def __init__(
+        self,
+        *,
+        out_dir: str = ".",
+        name: str = "0",
+        snapshot_limit: int = 64,
+        bundle_limit: int = 16,
+        burn_threshold: float = 2.0,
+        burn_polls: int = 3,
+        quarantine_cooldown_polls: int = 8,
+    ):
+        if snapshot_limit < 1 or bundle_limit < 1:
+            raise ValueError(
+                f"snapshot_limit/bundle_limit must be >= 1, got "
+                f"{snapshot_limit}/{bundle_limit}"
+            )
+        if burn_threshold <= 0 or burn_polls < 1:
+            raise ValueError(
+                f"burn_threshold must be > 0 and burn_polls >= 1, got "
+                f"{burn_threshold}/{burn_polls}"
+            )
+        self.out_dir = out_dir
+        self.name = name
+        self.snapshot_limit = snapshot_limit
+        self.bundle_limit = bundle_limit
+        self.burn_threshold = float(burn_threshold)
+        self.burn_polls = int(burn_polls)
+        self.quarantine_cooldown_polls = int(quarantine_cooldown_polls)
+        self.dumped: list[str] = []
+        self.bundles_skipped = 0
+        self.triggers: list[tuple[str, str]] = []
+        self._taps: dict[str, _EngineTap] = {}
+        self._fleet = None
+        self._supervisor = None
+        self._autoscaler = None
+        self._sup_cursor = 0
+        self._asc_cursor = 0
+        self._burn_streak = 0
+        self._burn_fired = False
+        self._seq = 0
+
+    # ---- attachment ------------------------------------------------------
+
+    def attach_engine(self, label: str, engine) -> None:
+        self._taps[str(label)] = _EngineTap(
+            label=str(label), engine=engine,
+            quarantines_seen=int(
+                getattr(engine, "steps_quarantined", 0) or 0
+            ),
+            snapshots=deque(maxlen=self.snapshot_limit),
+        )
+
+    def attach_fleet(self, fleet) -> None:
+        self._fleet = fleet
+
+    def attach_supervisor(self, supervisor) -> None:
+        self._supervisor = supervisor
+        self._sup_cursor = self._event_total(supervisor)
+
+    def attach_autoscaler(self, autoscaler) -> None:
+        self._autoscaler = autoscaler
+        self._asc_cursor = self._event_total(autoscaler)
+
+    @staticmethod
+    def _event_total(src) -> int:
+        """Monotonic count of events ever appended to a bounded event
+        ring (survives both ring eviction and drain_events())."""
+        if src is None:
+            return 0
+        return int(getattr(src, "dropped_events", 0) or 0) + len(
+            getattr(src, "events", ()) or ()
+        )
+
+    def _fresh_events(self, src, cursor: int) -> tuple[list, int]:
+        total = self._event_total(src)
+        events = list(getattr(src, "events", ()) or ())
+        fresh = events[max(len(events) - max(total - cursor, 0), 0):]
+        return fresh, total
+
+    # ---- polling / triggers ----------------------------------------------
+
+    def poll(self) -> list[str]:
+        """Record a ledger snapshot per engine, evaluate every trigger
+        condition, dump bundles for the ones that fired.  Returns the
+        paths written this poll."""
+        written: list[str] = []
+        for tap in self._taps.values():
+            led = getattr(tap.engine, "ledger", None)
+            if led is not None:
+                if len(tap.snapshots) == tap.snapshots.maxlen:
+                    tap.dropped_snapshots += 1
+                tap.snapshots.append(led.snapshot().to_dict())
+            if tap.cooldown > 0:
+                tap.cooldown -= 1
+            q = int(getattr(tap.engine, "steps_quarantined", 0) or 0)
+            if q > tap.quarantines_seen:
+                delta = q - tap.quarantines_seen
+                tap.quarantines_seen = q
+                if tap.cooldown == 0:
+                    tap.cooldown = self.quarantine_cooldown_polls
+                    path = self.trigger(
+                        "quarantine",
+                        f"engine {tap.label}: {delta} quarantined "
+                        f"step(s), {q} total",
+                    )
+                    if path:
+                        written.append(path)
+        if self._supervisor is not None:
+            fresh, self._sup_cursor = self._fresh_events(
+                self._supervisor, self._sup_cursor
+            )
+            for ev in fresh:
+                kind = getattr(ev, "kind", "")
+                detail = getattr(ev, "detail", "") or ""
+                chip = getattr(ev, "chip_id", "") or ""
+                if kind == "quarantine":
+                    trig = (
+                        "crash_loop" if "crash" in detail.lower()
+                        else "quarantine"
+                    )
+                    path = self.trigger(trig, f"slot {chip}: {detail}")
+                elif kind == "restart_failed" and (
+                    "diverg" in detail.lower() or "oracle" in detail.lower()
+                    or "canary" in detail.lower()
+                ):
+                    path = self.trigger(
+                        "probe_divergence", f"slot {chip}: {detail}"
+                    )
+                else:
+                    continue
+                if path:
+                    written.append(path)
+        if self._autoscaler is not None:
+            # Keep the cursor moving so a later trigger's bundle embeds
+            # only what the ring still holds, honestly counted.
+            _, self._asc_cursor = self._fresh_events(
+                self._autoscaler, self._asc_cursor
+            )
+        fleet = self._fleet
+        if fleet is not None and hasattr(fleet, "slo_burn_rates"):
+            try:
+                burns = fleet.slo_burn_rates()
+            except Exception:  # noqa: BLE001 — a recorder poll must
+                burns = {}  # never take the serving loop down
+            worst = max(burns.values(), default=0.0)
+            if worst > self.burn_threshold:
+                self._burn_streak += 1
+                if self._burn_streak >= self.burn_polls and (
+                    not self._burn_fired
+                ):
+                    self._burn_fired = True
+                    path = self.trigger(
+                        "slo_burn",
+                        f"burn rates {burns} above "
+                        f"{self.burn_threshold} for "
+                        f"{self._burn_streak} polls",
+                    )
+                    if path:
+                        written.append(path)
+            else:
+                self._burn_streak = 0
+                self._burn_fired = False
+        return written
+
+    def trigger(self, kind: str, detail: str = "") -> str | None:
+        """Dump one postmortem bundle for an (external or internal)
+        trigger.  Returns the path, or None when the bundle budget is
+        spent (counted in ``bundles_skipped`` — the recorder never
+        fills the disk)."""
+        if kind not in TRIGGER_KINDS:
+            raise ValueError(
+                f"unknown trigger kind {kind!r} (one of {TRIGGER_KINDS})"
+            )
+        self.triggers.append((kind, detail))
+        if len(self.dumped) >= self.bundle_limit:
+            self.bundles_skipped += 1
+            return None
+        return self.dump_bundle(trigger=kind, detail=detail)
+
+    # ---- bundle ----------------------------------------------------------
+
+    def _engine_block(self, tap: _EngineTap) -> dict:
+        eng = tap.engine
+        obs = getattr(eng, "_obs", None)
+        led = getattr(eng, "ledger", None)
+        counters = {}
+        for attr in (
+            "generated_tokens", "requests_admitted", "requests_retired",
+            "requests_cancelled", "requests_expired", "requests_failed",
+            "requests_retried", "requests_preempted", "queue_rejections",
+            "steps_quarantined", "tokens_overdecoded",
+            "tokens_replayed", "spec_tokens_rejected",
+            "preempt_recompute_tokens", "host_sync_s", "kv_spill_s",
+            "kv_reload_s", "kv_handoff_s",
+        ):
+            value = getattr(eng, attr, None)
+            if isinstance(value, (int, float)):
+                counters[attr] = value
+        block = {
+            "counters": counters,
+            "steps": [
+                _plain(r) for r in (getattr(obs, "steps", ()) or ())
+            ],
+            "spans": [
+                _plain(s) for s in (getattr(obs, "spans", ()) or ())
+            ],
+            "dropped_steps": int(getattr(obs, "dropped_steps", 0) or 0),
+            "dropped_spans": int(getattr(obs, "dropped_spans", 0) or 0),
+            "ledger_snapshots": list(tap.snapshots),
+            "dropped_snapshots": tap.dropped_snapshots,
+        }
+        if led is not None:
+            block["ledger"] = led.snapshot().to_dict()
+            block["reconcile"] = led.reconcile()
+        return block
+
+    def dump_bundle(
+        self, path: str | None = None, *, trigger: str = "manual",
+        detail: str = "",
+    ) -> str:
+        """Write the current state of every attached ring as ONE
+        self-contained postmortem JSON file and return its path."""
+        self._seq += 1
+        if path is None:
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(
+                self.out_dir,
+                f"postmortem-{self.name}-{self._seq:03d}-{trigger}.json",
+            )
+        bundle: dict = {
+            "schema": BUNDLE_SCHEMA,
+            "created_unix": time.time(),
+            "recorder": self.name,
+            "trigger": {"kind": trigger, "detail": detail},
+            "replicas": {
+                label: self._engine_block(tap)
+                for label, tap in sorted(self._taps.items())
+            },
+        }
+        fleet = self._fleet
+        if fleet is not None:
+            fobs = getattr(fleet, "_obs", None)
+            fled = getattr(fleet, "ledger", None)
+            counters = {}
+            for attr in (
+                "requests_submitted", "generated_tokens",
+                "failover_requeues", "drain_requeues", "queue_rejections",
+                "replica_crashes", "replica_hangs", "tokens_replayed",
+                "kv_handoffs", "handoff_pages", "preemptions",
+            ):
+                value = getattr(fleet, attr, None)
+                if isinstance(value, (int, float)):
+                    counters[attr] = value
+            block = {
+                "counters": counters,
+                "spans": [
+                    _plain(s) for s in (getattr(fobs, "spans", ()) or ())
+                ],
+                "dropped_spans": int(
+                    getattr(fobs, "dropped_spans", 0) or 0
+                ),
+            }
+            if hasattr(fleet, "slo_burn_rates"):
+                try:
+                    block["slo_burn_rates"] = dict(fleet.slo_burn_rates())
+                except Exception:  # noqa: BLE001 — stats, not steering
+                    pass
+            if fled is not None:
+                block["ledger"] = fled.snapshot()
+                block["reconcile"] = fled.reconcile()
+            bundle["fleet"] = block
+        if self._supervisor is not None:
+            bundle["supervisor_events"] = [
+                _plain(ev)
+                for ev in (getattr(self._supervisor, "events", ()) or ())
+            ]
+            bundle["supervisor_dropped_events"] = int(
+                getattr(self._supervisor, "dropped_events", 0) or 0
+            )
+        if self._autoscaler is not None:
+            bundle["autoscaler_events"] = [
+                _plain(ev)
+                for ev in (getattr(self._autoscaler, "events", ()) or ())
+            ]
+        with open(path, "w") as f:
+            json.dump(bundle, f)
+            f.write("\n")
+        self.dumped.append(path)
+        return path

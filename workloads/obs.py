@@ -167,6 +167,17 @@ ENGINE_METRICS: tuple[MetricSpec, ...] = (
         "readback)",
     ),
     MetricSpec(
+        "engine_observer_dropped_steps_total", "counter", ("engine",),
+        "step records the observer's bounded ring evicted UNREAD "
+        "(drain_steps too rarely) — non-zero means the scraped "
+        "timeline is silently truncated",
+    ),
+    MetricSpec(
+        "engine_observer_dropped_spans_total", "counter", ("engine",),
+        "lifecycle spans the observer's bounded ring evicted unread — "
+        "silent span loss made visible",
+    ),
+    MetricSpec(
         "engine_ttft_seconds", "histogram", ("engine",),
         "submission -> first observed token (queue wait included)",
     ),
@@ -315,6 +326,12 @@ FLEET_METRICS: tuple[MetricSpec, ...] = (
         "1 for each live replica's disaggregation role "
         "(prefill/decode/mixed; scrape-time)",
     ),
+    MetricSpec(
+        "fleet_observer_dropped_spans_total", "counter", ("fleet",),
+        "fleet-request spans the observer's bounded ring evicted "
+        "unread — the merged trace and postmortem bundles are "
+        "silently missing exactly this many requests",
+    ),
 )
 
 # Supervisor-level metric families (workloads/supervisor.py;
@@ -353,6 +370,12 @@ SUPERVISOR_METRICS: tuple[MetricSpec, ...] = (
         "supervisor_restore_seconds", "histogram", ("supervisor",),
         "replica death detection -> probed replacement rejoined the "
         "router (the bench's selfheal_restore_ms window)",
+    ),
+    MetricSpec(
+        "supervisor_dropped_events_total", "counter", ("supervisor",),
+        "supervision-timeline events the bounded ring evicted unread "
+        "— the merged trace's supervisor lane and postmortem bundles "
+        "are silently missing exactly this many transitions",
     ),
 )
 
@@ -415,6 +438,69 @@ AUTOSCALER_METRICS: tuple[MetricSpec, ...] = (
         "replicas actually alive in the fleet right now (target vs "
         "live is the convergence lag the step-load bench measures; "
         "scrape-time)",
+    ),
+)
+
+# Chip-time ledger families (workloads/ledger.py; docs/OBSERVABILITY.md
+# "Chip-time ledger, goodput & postmortems").  Same three-consumer
+# contract as the other catalogs: the engine/fleet bridges push them
+# when a ledger is armed, the lint test cross-checks, the docs render
+# from this spec.  The engine families ride the EngineObserver (per
+# replica in fleet mode); the fleet families ride the FleetObserver.
+LEDGER_METRICS: tuple[MetricSpec, ...] = (
+    MetricSpec(
+        "ledger_chip_seconds_total", "counter", ("engine", "phase"),
+        "chip-time attribution: wall seconds of engine work by phase "
+        "(prefill / decode / spec_draft / spec_verify / spec_commit / "
+        "kv_spill / kv_reload / kv_handoff / probe / warmup / idle) — "
+        "sum(phases) == total observed wall, every second lands in "
+        "exactly one phase",
+    ),
+    MetricSpec(
+        "ledger_tokens_total", "counter", ("engine", "class"),
+        "token accounting by class: goodput (delivered to an "
+        "ok-terminal stream) vs the named waste taxonomy (overdecode, "
+        "spec_rejected, replay, preempt_recompute, cancelled, "
+        "probe_warmup); goodput + waste + pending == every token's "
+        "worth of device work the ledger charged",
+    ),
+    MetricSpec(
+        "ledger_busy_fraction", "gauge", ("engine",),
+        "fraction of the engine's observed wall time in any non-idle "
+        "phase (scrape-time — the serving-side pendant of the "
+        "plugin's aggregate_chip_busy_fraction north star)",
+    ),
+    MetricSpec(
+        "ledger_goodput_fraction", "gauge", ("engine",),
+        "goodput tokens over every token's worth of device work "
+        "charged (scrape-time; 1.0 = zero waste)",
+    ),
+    MetricSpec(
+        "ledger_pending_tokens", "gauge", ("engine",),
+        "tokens charged but not yet classified (their request has no "
+        "terminal status yet; scrape-time — drains to 0 at quiescence "
+        "on a standalone engine)",
+    ),
+    MetricSpec(
+        "ledger_waste_chip_seconds", "gauge", ("engine", "class"),
+        "estimated chip-SECONDS behind each waste class (the phase "
+        "times scaled by the class's token share of its phase — an "
+        "attribution model, documented in workloads/ledger.py; "
+        "scrape-time)",
+    ),
+    MetricSpec(
+        "fleet_ledger_tokens_total", "counter",
+        ("fleet", "slo_class", "kind"),
+        "fleet-scope terminal token classification per SLO class: "
+        "kind=goodput (ok streams) vs kind=waste (cancelled/expired/"
+        "failed streams) — the per-class goodput split the scheduler "
+        "reads",
+    ),
+    MetricSpec(
+        "fleet_ledger_goodput_fraction", "gauge", ("fleet",),
+        "fleet-wide goodput tokens over all charged device work, "
+        "failover replays and engine-local waste included "
+        "(scrape-time)",
     ),
 )
 
@@ -692,10 +778,21 @@ class EngineObserver:
                 reg.describe(m.name, m.help, buckets=SERVE_SECONDS_BUCKETS)
             else:
                 reg.describe(m.name, m.help)
+        # Ledger families describe unconditionally (the engine may not
+        # exist yet at bind time); their gauges read empty until an
+        # armed ledger appears, and the counter pushes are delta-gated.
+        for m in LEDGER_METRICS:
+            if m.labels[0] == "engine":
+                reg.describe(m.name, m.help)
         key = f"replica:{self.replica}" if self.replica else None
         for name, reader in self._GAUGE_READERS.items():
             reg.register_gauge(
                 name, lambda reader=reader: self._gauge(reader), key=key
+            )
+        for name, reader in self._LEDGER_GAUGE_READERS.items():
+            reg.register_gauge(
+                name, lambda reader=reader: self._ledger_gauge(reader),
+                key=key,
             )
 
     # One engine reader per gauge family in ENGINE_METRICS — bind and
@@ -718,6 +815,20 @@ class EngineObserver:
                 getattr(e, "prefix", None), "offloaded_pages", 0
             ) or 0
         ),
+    }
+
+    # Chip-time ledger gauges (LEDGER_METRICS): ``e`` is the bound
+    # engine's ChipTimeLedger; a reader may return a scalar or a
+    # [(labels, value), ...] list.  Registered alongside the engine
+    # gauges (replica-keyed in fleet mode) and read empty until a
+    # ledger is armed.
+    _LEDGER_GAUGE_READERS = {
+        "ledger_busy_fraction": lambda e: e.busy_fraction,
+        "ledger_goodput_fraction": lambda e: e.goodput_fraction,
+        "ledger_pending_tokens": lambda e: e.pending_tokens,
+        "ledger_waste_chip_seconds": lambda e: [
+            ({"class": c}, s) for c, s in sorted(e.waste_chip_s().items())
+        ],
     }
 
     # Lifecycle counter families -> the ServeEngine attribute carrying
@@ -750,6 +861,8 @@ class EngineObserver:
         key = f"replica:{self.replica}" if self.replica else None
         for name in self._GAUGE_READERS:
             reg.unregister_gauge(name, key=key)
+        for name in self._LEDGER_GAUGE_READERS:
+            reg.unregister_gauge(name, key=key)
         self._engine = None
 
     def _gauge(self, value_fn) -> list[tuple[dict, float]]:
@@ -762,6 +875,21 @@ class EngineObserver:
             # A gauge must never fail a scrape mid-teardown; the
             # Registry logs collector failures, an empty read is honest.
             return []
+
+    def _ledger_gauge(self, value_fn) -> list[tuple[dict, float]]:
+        led = getattr(self._engine, "ledger", None)
+        if led is None:
+            return []
+        try:
+            out = value_fn(led)
+            if isinstance(out, list):
+                return [
+                    ({**self._labels, **labels}, float(v))
+                    for labels, v in out
+                ]
+            return [(dict(self._labels), float(out))]
+        except Exception:
+            return []  # a gauge must never fail a scrape mid-teardown
 
     # ---- engine-facing hooks --------------------------------------------
 
@@ -883,6 +1011,8 @@ class EngineObserver:
             if host_sync > 0:
                 reg.observe_seconds("engine_host_sync", host_sync, labels)
             self._push_lifecycle(engine, reg, labels)
+            self._push_ring_drops(reg, labels)
+            self._push_ledger(engine, reg, labels)
             if mode != "idle":
                 reg.inc(
                     "engine_decode_steps_total", {**labels, "mode": mode}
@@ -918,6 +1048,46 @@ class EngineObserver:
                 reg.inc(metric, labels, delta)
                 self._pushed[metric] = total
 
+    def _push_ring_drops(self, reg, labels) -> None:
+        """Ring-overflow visibility: evictions the bounded step/span
+        rings made unread land as counters, so silent history loss is
+        a scrapeable signal instead of a surprise during a postmortem."""
+        for metric, total in (
+            ("engine_observer_dropped_steps_total", self.dropped_steps),
+            ("engine_observer_dropped_spans_total", self.dropped_spans),
+        ):
+            delta = float(total) - self._pushed.get(metric, 0.0)
+            if delta:
+                reg.inc(metric, labels, delta)
+                self._pushed[metric] = float(total)
+
+    def _push_ledger(self, engine, reg, labels) -> None:
+        """Chip-time ledger counter families, pushed as deltas against
+        the armed ledger's running totals (phase seconds and the
+        goodput/waste token taxonomy — LEDGER_METRICS)."""
+        led = getattr(engine, "ledger", None)
+        if led is None:
+            return
+        for phase, secs in led.phase_s.items():
+            key = f"ledger_chip_seconds_total:{phase}"
+            delta = float(secs) - self._pushed.get(key, 0.0)
+            if delta > 0:
+                reg.inc(
+                    "ledger_chip_seconds_total",
+                    {**labels, "phase": phase}, delta,
+                )
+                self._pushed[key] = float(secs)
+        classes = [("goodput", led.goodput_tokens)]
+        classes += sorted(led.waste_tokens.items())
+        for cls, total in classes:
+            key = f"ledger_tokens_total:{cls}"
+            delta = float(total) - self._pushed.get(key, 0.0)
+            if delta > 0:
+                reg.inc(
+                    "ledger_tokens_total", {**labels, "class": cls}, delta
+                )
+                self._pushed[key] = float(total)
+
     def _engine_closed(self, engine, finished) -> None:
         """Final flush at ``engine.close()``: counters are pushed and
         spans recorded at step boundaries, but close() fails in-flight
@@ -931,6 +1101,8 @@ class EngineObserver:
             return
         labels = self._labels
         self._push_lifecycle(engine, reg, labels)
+        self._push_ring_drops(reg, labels)
+        self._push_ledger(engine, reg, labels)
         for span in new_spans:
             if span.ttft_secs is not None:
                 reg.observe_seconds("engine_ttft", span.ttft_secs, labels)
@@ -1022,6 +1194,17 @@ class FleetObserver:
         ],
     }
 
+    # Fleet-scope chip-time ledger gauge (LEDGER_METRICS): reads the
+    # armed FleetLedger off the bound fleet; empty until one exists.
+    # The counter-derived property, NOT a full snapshot — this runs on
+    # every scrape.
+    _FLEET_LEDGER_GAUGE_READERS = {
+        "fleet_ledger_goodput_fraction": lambda e: (
+            [({}, float(e.ledger.goodput_fraction))]
+            if getattr(e, "ledger", None) is not None else []
+        ),
+    }
+
     # Counter family -> Fleet attribute carrying the running total.
     _FLEET_COUNTERS = {
         "fleet_requests_total": "requests_submitted",
@@ -1042,7 +1225,13 @@ class FleetObserver:
                 reg.describe(m.name, m.help, buckets=SERVE_SECONDS_BUCKETS)
             else:
                 reg.describe(m.name, m.help)
-        for name, reader in self._FLEET_GAUGE_READERS.items():
+        for m in LEDGER_METRICS:
+            if m.labels[0] == "fleet":
+                reg.describe(m.name, m.help)
+        for name, reader in {
+            **self._FLEET_GAUGE_READERS,
+            **self._FLEET_LEDGER_GAUGE_READERS,
+        }.items():
             reg.register_gauge(
                 name, lambda reader=reader: self._gauge(reader),
                 key=f"fleet:{self.name}",
@@ -1053,6 +1242,8 @@ class FleetObserver:
         if reg is None:
             return
         for name in self._FLEET_GAUGE_READERS:
+            reg.unregister_gauge(name, key=f"fleet:{self.name}")
+        for name in self._FLEET_LEDGER_GAUGE_READERS:
             reg.unregister_gauge(name, key=f"fleet:{self.name}")
         self._fleet = None
 
@@ -1124,6 +1315,34 @@ class FleetObserver:
                     {**labels, "slo_class": cls or "untagged"}, delta,
                 )
                 self._pushed[metric] = float(total)
+        # Ring-overflow visibility (the engine bridge's contract).
+        drops = float(self.dropped_spans)
+        drop_delta = drops - self._pushed.get(
+            "fleet_observer_dropped_spans_total", 0.0
+        )
+        if drop_delta:
+            reg.inc(
+                "fleet_observer_dropped_spans_total", labels, drop_delta
+            )
+            self._pushed["fleet_observer_dropped_spans_total"] = drops
+        # Fleet-scope ledger: per-SLO-class terminal token
+        # classification, pushed as running-total deltas.
+        led = getattr(fleet, "ledger", None)
+        if led is not None:
+            for cls, counts in sorted(
+                getattr(led, "class_tokens", {}).items()
+            ):
+                for kind in ("goodput", "waste"):
+                    key = f"fleet_ledger_tokens_total:{cls}:{kind}"
+                    total = float(counts.get(kind, 0))
+                    delta = total - self._pushed.get(key, 0.0)
+                    if delta > 0:
+                        reg.inc(
+                            "fleet_ledger_tokens_total",
+                            {**labels, "slo_class": cls, "kind": kind},
+                            delta,
+                        )
+                        self._pushed[key] = total
         # Handoff windows closed since the last step (the list only
         # appends, so the pushed length is the delta cursor).
         windows = getattr(fleet, "handoff_s", ())
@@ -1251,6 +1470,13 @@ class SupervisorObserver:
             if delta:
                 reg.inc(metric, labels, delta)
                 self._pushed[metric] = total
+        drops = float(getattr(supervisor, "dropped_events", 0) or 0)
+        drop_delta = drops - self._pushed.get(
+            "supervisor_dropped_events_total", 0.0
+        )
+        if drop_delta:
+            reg.inc("supervisor_dropped_events_total", labels, drop_delta)
+            self._pushed["supervisor_dropped_events_total"] = drops
         fresh = supervisor.restore_s[self._restores_pushed:]
         for secs in fresh:
             reg.observe_seconds("supervisor_restore", secs, labels)

@@ -1024,6 +1024,122 @@ def measure_obs_overhead(scale: BenchScale) -> dict:
     }
 
 
+def measure_ledger(scale: BenchScale) -> dict:
+    """The chip-time ledger must be provably cheap AND its books must
+    describe a messy run exactly: a seeded mixed-length greedy stream
+    with SPECULATION on and two scheduled seam faults (a spec dispatch
+    and a prefill dispatch quarantine -> replay) runs ledger-OFF vs
+    ledger-ON in interleaved repeats, every pair's token streams
+    asserted bit-identical (the inertness pin at bench scale).  The
+    published numbers: ``ledger_overhead_pct`` (median per-pair
+    throughput loss, min/max spread — the always-on accounting tax),
+    ``ledger_goodput_fraction`` and the replay / spec-rejected waste
+    shares of all charged device work — the fleet-accountability
+    headline ROADMAP item 2's occupancy-scored scheduler reads.
+    Reconciliation (goodput + waste == tokens accounted, nothing
+    pending) is asserted on every armed run."""
+    import statistics
+
+    from .faults import FaultInjector
+    from .ledger import ChipTimeLedger
+    from .quant import quantize_params
+    from .serve import ServeEngine
+
+    batch, ps = scale.batch, scale.page_size
+    chunk = ps
+    hi = scale.serve_chunks[1]
+    prompt_len = scale.decode_prompt
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
+        n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=prompt_len + 1 + hi * chunk,
+    )
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype),
+        init_params(config, jax.random.PRNGKey(0)),
+    )
+    draft = quantize_params(params)
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(1), (prompt_len,), 0, config.vocab_size, jnp.int32
+    )]
+    n_req = 3 * batch
+
+    def serve(ledgered: bool):
+        led = ChipTimeLedger() if ledgered else None
+        # Identical schedules both arms: the quarantine/replay path is
+        # part of the measured stream, not a difference between arms.
+        injector = FaultInjector(
+            {"spec_dispatch": [4], "prefill_dispatch": [3]}
+        )
+        engine = ServeEngine(
+            params, config, slots=batch, page_size=ps, chunk=chunk,
+            prompt_bucket=-(-prompt_len // ps) * ps,
+            draft_params=draft, draft_config=config, gamma=4,
+            rng=jax.random.PRNGKey(3), pipelined=True,
+            fault_injector=injector, max_retries=4, ledger=led,
+        )
+        engine.submit(prompt, 1 + hi * chunk)  # warm every compile
+        engine.run()
+        before = engine.generated_tokens
+        rids = []
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            rids.append(
+                engine.submit(prompt, 1 + chunk * (1 + i % hi))
+            )
+        out = engine.run()
+        rate = (engine.generated_tokens - before) / (
+            time.perf_counter() - t0
+        )
+        streams = [list(out[r]) for r in rids]
+        assert engine.steps_quarantined >= 1, (
+            "the scheduled faults must actually exercise the replay "
+            "accounting"
+        )
+        if led is not None:
+            verdict = led.reconcile(expect_quiescent=True)
+            assert verdict["ok"], verdict
+        return rate, streams, led, engine.steps_quarantined
+
+    off_runs, on_runs = _interleaved_repeats(
+        lambda: serve(False), lambda: serve(True)
+    )
+    for (_, off_stream, *_), (_, on_stream, *_) in zip(off_runs, on_runs):
+        assert off_stream == on_stream, (
+            "token streams diverged ledger on/off"
+        )
+    overheads = [
+        (off - on) / max(off, 1e-9) * 100.0
+        for (off, *_), (on, *_) in zip(off_runs, on_runs)
+    ]
+    led = on_runs[-1][2]
+    accounted = max(led.tokens_accounted, 1)
+    return {
+        "ledger_overhead_pct": round(statistics.median(overheads), 2),
+        "ledger_overhead_pct_min": round(min(overheads), 2),
+        "ledger_overhead_pct_max": round(max(overheads), 2),
+        "ledger_on_tokens_per_sec": round(
+            statistics.median(r for r, *_ in on_runs), 1
+        ),
+        "ledger_off_tokens_per_sec": round(
+            statistics.median(r for r, *_ in off_runs), 1
+        ),
+        "ledger_goodput_fraction": round(led.goodput_fraction, 4),
+        "ledger_busy_fraction": round(led.busy_fraction, 4),
+        "ledger_waste_replay_pct": round(
+            led.waste_tokens["replay"] / accounted * 100.0, 2
+        ),
+        "ledger_waste_spec_rejected_pct": round(
+            led.waste_tokens["spec_rejected"] / accounted * 100.0, 2
+        ),
+        "ledger_waste_overdecode_pct": round(
+            led.waste_tokens["overdecode"] / accounted * 100.0, 2
+        ),
+        "ledger_requests": n_req,
+        "ledger_quarantines": on_runs[-1][3],
+    }
+
+
 def measure_fault_recovery(scale: BenchScale) -> dict:
     """Fault tolerance must be provably cheap AND provably fast: the
     composed serve stream (int8 base, pipelined stepping, greedy so
@@ -3440,6 +3556,7 @@ def run(scale_name: str = "full", pool_with: dict | None = None) -> dict:
         sup["superstep_tokens_per_sec_samples"], pool_with,
     )
     out.update(measure_obs_overhead(scale))
+    out.update(measure_ledger(scale))
     out.update(measure_fault_recovery(scale))
     out.update(measure_fleet(scale))
     out.update(measure_disagg(scale))

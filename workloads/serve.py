@@ -211,6 +211,7 @@ class ServeEngine:
         completed_limit: int | None = None,
         mode_trace_limit: int | None = 256,
         observer=None,
+        ledger=None,
         max_pending: int | None = None,
         fault_injector=None,
         max_retries: int = 2,
@@ -595,6 +596,28 @@ class ServeEngine:
         self._obs = observer
         if observer is not None:
             observer._bind(self)
+        # Chip-time ledger (workloads/ledger.py): opt-in goodput/waste
+        # accounting over the counters above.  Inert like the observer
+        # — a pure delta reader, streams bit-identical on/off (pinned
+        # by tests/test_ledger.py, priced as ledger_overhead_pct).
+        self.ledger = ledger
+        # Waste-taxonomy counters the ledger classifies from:
+        # speculative drafts the verify pass rejected, prompt+emitted
+        # tokens requeued for re-prefill after a quarantine, and the
+        # recompute a preemption-via-offload resume will pay beyond its
+        # parked pages.  Maintained unconditionally (cheap ints) so the
+        # lifecycle summary and tests can read them ledger or not.
+        self.spec_tokens_rejected = 0
+        self.tokens_replayed = 0
+        self.preempt_recompute_tokens = 0
+        # Wall seconds spent packaging/adopting KV handoff tickets
+        # (export_kv/import_kv), NET of the inner spill time already on
+        # kv_spill_s — the ledger's kv_handoff phase.
+        self.kv_handoff_s = 0.0
+        # Ledger phase override: "probe"/"warmup" passes charge their
+        # wall time to that phase and classify their emissions as
+        # probe_warmup waste (workloads/ledger.py OFFBOOK_PHASES).
+        self.ledger_phase = "serve"
         # Finished Request objects, in retirement order, carrying their
         # t_submit/t_first/t_done latency stamps — the TTFT/e2e source
         # for the bench and tests.  Tiny host objects, but unbounded for
@@ -1168,6 +1191,10 @@ class ServeEngine:
                 return req
         req.status = "queued"
         self.requests_retried += 1
+        # Ledger waste class "replay": the replay will RE-prefill the
+        # prompt plus everything already emitted — chip work the stream
+        # already paid for once (workloads/ledger.py).
+        self.tokens_replayed += len(req.prompt) + len(req.tokens)
         self.pending.appendleft(req)
         return None
 
@@ -1357,6 +1384,22 @@ class ServeEngine:
             if plan["req"].rid == rid:
                 if plan["req"].group is not None:
                     return None
+                # Prefix inserts are DEFERRED to prefill-finish, so a
+                # mid-prefill park redoes every chunk actually SWEPT —
+                # the resume's recompute, charged to the ledger's
+                # preempt_recompute class at the moment the work is
+                # discarded.  The cursor starts at the prefix-hit
+                # offset (start_page), so the cached region it covers
+                # was never swept and the resume's lookup re-serves it
+                # — subtract it or a cache-hit admission overbills.
+                self.preempt_recompute_tokens += max(
+                    min(
+                        int(plan.get("cursor", 0)) * self.prompt_bucket,
+                        int(plan.get("n", 0)),
+                    )
+                    - int(plan.get("start_page", 0)) * self.page_size,
+                    0,
+                )
                 req = self._reclaim_partial(plan)
                 req.group = None
                 self.requests_preempted += 1
@@ -1397,6 +1440,19 @@ class ServeEngine:
             self.pages_parked += self.prefix.park(
                 req.prompt, salt=salt, spill_many=self._spill_pages
             )
+        # The resume re-prefills prompt + emitted; the prefix index
+        # serves the prompt's FULL pages back (parked or resident), so
+        # only the tail past the last full page plus the emitted tokens
+        # recompute — the ledger's preempt_recompute class, charged
+        # exactly (assuming the parked pages survive to the resume;
+        # an eviction in between shows up as ordinary prefix misses).
+        covered = (
+            (len(req.prompt) // self.page_size) * self.page_size
+            if self.prefix is not None else 0
+        )
+        self.preempt_recompute_tokens += max(
+            len(req.prompt) + len(req.tokens) - covered, 0
+        )
         req.group = None
         self.requests_preempted += 1
         return req
@@ -1427,6 +1483,7 @@ class ServeEngine:
         if park is None or export is None:
             return None  # no index, or the flat baseline: nothing to ship
         salt = self._handoff_salt(adapter)
+        t0, spill0 = time.perf_counter(), self.kv_spill_s
         if self._kv_offload:
             # Free this replica's HBM the moment the prompt is done —
             # the disaggregation dividend: a prefill pool holds pages
@@ -1438,6 +1495,11 @@ class ServeEngine:
             )
         blobs = export(prompt, salt=salt, copy_many=self._spill_pages)
         self.kv_handoff_pages_out += len(blobs)
+        # Handoff phase time NET of the inner spill (already billed to
+        # kv_spill_s) — the ledger charges each second exactly once.
+        self.kv_handoff_s += max(
+            time.perf_counter() - t0 - (self.kv_spill_s - spill0), 0.0
+        )
         return blobs or None
 
     def _blob_compatible(self, blob) -> bool:
@@ -1494,11 +1556,13 @@ class ServeEngine:
             return 0
         if not blobs or not self._blob_compatible(blobs[0]):
             return 0
+        t0 = time.perf_counter()
         n = graft(
             [int(t) for t in prompt], blobs,
             salt=self._handoff_salt(adapter),
         )
         self.kv_handoff_pages_in += n
+        self.kv_handoff_s += time.perf_counter() - t0
         return n
 
     def _drain_all_pending(self) -> list[Request]:
@@ -1683,6 +1747,10 @@ class ServeEngine:
         self._dissolve_groups()
         if self.prefix is not None:
             self.prefix.clear()
+        if self.ledger is not None:
+            # Last counter deltas + close-failed classification land
+            # before the observer's final registry push reads them.
+            self.ledger.engine_closed(self, closed_now)
         if self._obs is not None:
             self._obs._engine_closed(self, closed_now)
             self._obs.unbind_registry()
@@ -2608,14 +2676,20 @@ class ServeEngine:
         identical.
 
         With an observer attached the step is bracketed by its
-        begin/end hooks (one StepRecord per call); without one this is
-        a zero-cost passthrough."""
+        begin/end hooks (one StepRecord per call); a chip-time ledger
+        (``ledger=``) brackets the same window for phase/goodput
+        accounting; without either this is a zero-cost passthrough."""
         obs = self._obs
-        if obs is None:
+        led = self.ledger
+        if obs is None and led is None:
             return self._step_impl()
-        snap = obs._step_begin(self)
+        lsnap = led.step_begin(self) if led is not None else None
+        snap = obs._step_begin(self) if obs is not None else None
         finished = self._step_impl()
-        obs._step_end(self, snap, finished)
+        if led is not None:
+            led.step_end(self, lsnap, finished)
+        if obs is not None:
+            obs._step_end(self, snap, finished)
         return finished
 
     def _step_impl(self) -> list[Request]:
@@ -3499,6 +3573,10 @@ class ServeEngine:
                 k = int(n_acc[j, slot]) + 1
                 if not req.done:
                     self._emit(req, committed[j, slot, :k])
+                    # Drafted-but-unaccepted tokens: the draft proposed
+                    # gamma, verify kept k-1 of them — the ledger's
+                    # spec_rejected waste class.
+                    self.spec_tokens_rejected += self.gamma - (k - 1)
                 advance += k
             self._positions[slot] += advance
             self._tokens[slot] = committed[-1, slot, int(n_acc[-1, slot])]
@@ -3533,6 +3611,7 @@ class ServeEngine:
                     continue
                 k = int(n_acc[j, slot]) + 1
                 self._emit(req, committed[j, slot, :k])
+                self.spec_tokens_rejected += self.gamma - (k - 1)
                 advance += k
                 last_live = j
             if last_live is None:
@@ -3637,6 +3716,28 @@ def serve_batch(
             if ("serve", b) in ctrl.tables:
                 ctrl.release(("serve", b))
     return jnp.stack(out, axis=1), pools
+
+
+class _RecorderDriver:
+    """Duck-typed fleet-driver shim for the flight recorder: delegates
+    the Fleet loop API (submit/cancel/idle/... via __getattr__) and
+    polls the recorder after EVERY step — the sustained-SLO-burn
+    trigger needs consecutive polls to distinguish a spike from a
+    burn, and a quarantine bundle must capture the incident's ring
+    state before the bounded rings evict it, neither of which a
+    single end-of-run poll can do."""
+
+    def __init__(self, inner, recorder):
+        self._inner = inner
+        self._recorder = recorder
+
+    def step(self):
+        finished = self._inner.step()
+        self._recorder.poll()
+        return finished
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
 
 
 def _run_fleet_cli(
@@ -3751,11 +3852,15 @@ def _run_fleet_cli(
     observers = [None] * args.fleet
     fleet_obs = None
     sup_obs = None
-    if args.metrics_port is not None or args.trace_out:
-        # Any active sink (a metrics scrape OR a trace file) gets the
-        # FULL observer set — a --trace-out --supervise run without
-        # --metrics-port must still see supervisor events on the very
-        # trace it asked for; only registry BINDING is port-gated.
+    if (
+        args.metrics_port is not None or args.trace_out
+        or args.postmortem_dir is not None
+    ):
+        # Any active sink (a metrics scrape, a trace file OR the flight
+        # recorder's postmortem bundles) gets the FULL observer set — a
+        # --trace-out --supervise run without --metrics-port must still
+        # see supervisor events on the very trace it asked for; only
+        # registry BINDING is port-gated.
         from .obs import EngineObserver, FleetObserver
 
         observers = [
@@ -3775,6 +3880,14 @@ def _run_fleet_cli(
             fleet_obs.bind_registry(registry)
             if sup_obs is not None:
                 sup_obs.bind_registry(registry)
+    fleet_ledger = None
+    recorder = None
+    if args.ledger:
+        from .ledger import ChipTimeLedger, FleetLedger, FlightRecorder
+
+        fleet_ledger = FleetLedger()
+        if args.postmortem_dir is not None:
+            recorder = FlightRecorder(out_dir=args.postmortem_dir)
     engines = []
     for i in range(args.fleet):
         engines.append(ServeEngine(
@@ -3787,6 +3900,9 @@ def _run_fleet_cli(
             prefix_cache=args.prefix_cache, kv_offload=args.kv_offload,
             kv_host_pages=args.kv_host_pages, adapters=adapters,
             observer=observers[i],
+            ledger=(
+                ChipTimeLedger(name=str(i)) if args.ledger else None
+            ),
             fault_injector=(
                 FaultInjector(replica_schedules[i])
                 if replica_schedules.get(i) else None
@@ -3794,6 +3910,8 @@ def _run_fleet_cli(
             max_retries=args.max_retries,
             retry_backoff_s=args.retry_backoff_s, **spec_kw,
         ))
+        if recorder is not None:
+            recorder.attach_engine(str(i), engines[-1])
     fleet = Fleet(
         engines,
         chip_ids=[f"chip-{i}" for i in range(args.fleet)],
@@ -3806,7 +3924,10 @@ def _run_fleet_cli(
         hang_timeout_s=60.0,
         observer=fleet_obs,
         roles=roles, wfq_weights=wfq_weights,
+        ledger=fleet_ledger,
     )
+    if recorder is not None:
+        recorder.attach_fleet(fleet)
     if roles is not None:
         print(f"disaggregated pools: roles={fleet.roles()}" + (
             f", wfq={wfq_weights}" if wfq_weights else ""
@@ -3847,7 +3968,17 @@ def _run_fleet_cli(
 
                     obs.bind_registry(registry)
                 respawn_observers.append(obs)
-            return ServeEngine(
+            led = None
+            if args.ledger and slot is not None:
+                from .ledger import ChipTimeLedger
+
+                # The resurrected replica keeps its own books; the
+                # fleet ledger adopts them when it rejoins (probe
+                # tokens classify as probe_warmup pre-join).
+                led = ChipTimeLedger(
+                    name=f"respawn-{slot.chip_id}-{slot.restarts}"
+                )
+            eng = ServeEngine(
                 params, config, slots=args.slots, page_size=page_size,
                 prompt_bucket=bucket, temperature=args.temperature,
                 top_k=args.top_k, top_p=args.top_p,
@@ -3857,9 +3988,18 @@ def _run_fleet_cli(
                 prefix_cache=args.prefix_cache,
                 kv_offload=args.kv_offload,
                 kv_host_pages=args.kv_host_pages, adapters=adapters,
-                max_retries=args.max_retries, observer=obs,
+                max_retries=args.max_retries, observer=obs, ledger=led,
                 retry_backoff_s=args.retry_backoff_s, **spec_kw,
             )
+            if recorder is not None and slot is not None:
+                # The black box must watch the REPLACEMENT, not keep
+                # reading the dead predecessor's frozen counters — a
+                # quarantine on a resurrected replica is exactly what
+                # a postmortem is for.
+                recorder.attach_engine(
+                    f"respawn-{slot.chip_id}-{slot.restarts}", eng
+                )
+            return eng
 
         supervisor = FleetSupervisor(
             fleet, respawn_factory,
@@ -3879,6 +4019,8 @@ def _run_fleet_cli(
         # from a scratch respawn now, so the FIRST real resurrection is
         # already held to bit-identity.
         supervisor.calibrate_probe()
+        if recorder is not None:
+            recorder.attach_supervisor(supervisor)
         print(
             f"supervisor armed: backoff {args.restart_backoff_s}s base "
             f"/ {args.restart_backoff_max_s}s cap, max_restarts="
@@ -3923,9 +4065,14 @@ def _run_fleet_cli(
 
                     obs.bind_registry(registry)
                 respawn_observers.append(obs)
-            return ServeEngine(
+            led = None
+            if args.ledger and slot is not None:
+                from .ledger import ChipTimeLedger
+
+                led = ChipTimeLedger(name=f"scaleup-{slot.chip_id}")
+            eng = ServeEngine(
                 params, config, slots=args.slots, page_size=page_size,
-                observer=obs,
+                observer=obs, ledger=led,
                 prompt_bucket=bucket, temperature=args.temperature,
                 top_k=args.top_k, top_p=args.top_p,
                 rng=jax.random.PRNGKey(4242), pipelined=args.pipelined,
@@ -3937,6 +4084,9 @@ def _run_fleet_cli(
                 max_retries=args.max_retries,
                 retry_backoff_s=args.retry_backoff_s, **spec_kw,
             )
+            if recorder is not None and slot is not None:
+                recorder.attach_engine(f"scaleup-{slot.chip_id}", eng)
+            return eng
 
         autoscaler = FleetAutoscaler(
             fleet,
@@ -3953,6 +4103,8 @@ def _run_fleet_cli(
             observer=asc_obs,
         )
         autoscaler.calibrate_probe()
+        if recorder is not None:
+            recorder.attach_autoscaler(autoscaler)
         print(
             f"autoscaler armed: replicas in [{a_min}, {a_max}] "
             f"(starting at {args.fleet}), brownout factor "
@@ -4054,7 +4206,11 @@ def _run_fleet_cli(
             driver = autoscaler
         elif supervisor is not None:
             driver = supervisor
+        if recorder is not None:
+            driver = _RecorderDriver(driver, recorder)
         drive_open_loop(driver, sched)
+    if recorder is not None:
+        recorder.poll()
     if supervisor is not None:
         supervisor.wait_healed(timeout_s=30.0)
     if autoscaler is not None:
@@ -4121,6 +4277,30 @@ def _run_fleet_cli(
             f"overprovision_chip_s="
             f"{round(autoscaler.overprovision_chip_s, 3)}"
         )
+    if fleet_ledger is not None:
+        if recorder is not None:
+            recorder.poll()  # final trigger sweep before the summary
+        fsnap = fleet_ledger.snapshot()
+        waste = {
+            k: v for k, v in sorted(fsnap["waste_tokens"].items()) if v
+        }
+        print(
+            f"ledger: goodput={fsnap['goodput_tokens']} "
+            f"waste={sum(fsnap['waste_tokens'].values())} {waste} "
+            f"goodput_fraction={fsnap['goodput_fraction']:.3f} "
+            f"busy_fraction={fsnap['busy_fraction']:.3f} "
+            f"per_class={fsnap['per_class']} "
+            f"reconcile_ok={fleet_ledger.reconcile()['ok']}"
+        )
+        if recorder is not None:
+            import os
+
+            print(
+                f"postmortem: {len(recorder.dumped)} bundle(s) "
+                f"{[os.path.basename(p) for p in recorder.dumped]} "
+                f"-> {args.postmortem_dir} "
+                f"(validate: python tools/postmortem.py --validate)"
+            )
     attainment = fleet.slo_attainment()
     if any(v is not None for v in attainment.values()):
         burn = fleet.slo_burn_rates()
@@ -4275,6 +4455,28 @@ def main(argv=None) -> int:
                         help="write the run's chrome://tracing timeline "
                         "(request spans + step records) to PATH at exit; "
                         "enables the observer")
+    parser.add_argument("--ledger", action="store_true",
+                        help="arm the chip-time ledger (workloads/"
+                        "ledger.py): every step's wall window is "
+                        "attributed to a phase (prefill/decode/spec/"
+                        "KV/probe/warmup/idle) and every token "
+                        "classified goodput vs the named waste "
+                        "taxonomy (overdecode, spec_rejected, replay, "
+                        "preempt_recompute, cancelled, probe_warmup); "
+                        "goodput/waste land on the lifecycle summary "
+                        "and — with --metrics-port — the LEDGER_METRICS "
+                        "scrape families (docs/OBSERVABILITY.md "
+                        "'Chip-time ledger'); streams are bit-identical "
+                        "on/off")
+    parser.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                        help="arm the always-on flight recorder "
+                        "(implies --ledger): quarantines, crash-loop "
+                        "verdicts, canary-probe divergence and "
+                        "sustained SLO burn dump a self-contained JSON "
+                        "postmortem bundle (step records + spans + "
+                        "ledger snapshots + supervisor/autoscaler "
+                        "events) into DIR — validate with "
+                        "tools/postmortem.py --validate")
     parser.add_argument("--max-pending", type=int, default=None,
                         help="bounded admission: reject (typed QueueFull) "
                         "instead of queueing more than N pending requests "
@@ -4405,6 +4607,8 @@ def main(argv=None) -> int:
     if args.spec_superstep_k > 1 and args.spec_lookahead > 1:
         parser.error("--spec-superstep-k supersedes --spec-lookahead; "
                      "use one round-chaining knob, not both")
+    if args.postmortem_dir is not None:
+        args.ledger = True  # a bundle without its ledger is half a story
     if args.kv_offload:
         args.prefix_cache = True  # the offload tier lives on the cache
     if args.kv_host_pages is not None and not args.kv_offload:
@@ -4496,7 +4700,13 @@ def main(argv=None) -> int:
     # series this process carries).
     observer = None
     metrics_server = None
-    if args.fleet is None and (args.metrics_port is not None or args.trace_out):
+    if args.fleet is None and (
+        args.metrics_port is not None or args.trace_out
+        or args.postmortem_dir is not None
+    ):
+        # --postmortem-dir arms the observer too: the flight recorder's
+        # bundles embed its step/span rings (counters alone make a thin
+        # black box).
         from .obs import EngineObserver
 
         observer = EngineObserver()
@@ -4576,6 +4786,14 @@ def main(argv=None) -> int:
             injector = FaultInjector(schedule)
         except ValueError as e:
             parser.error(str(e))
+    ledger = None
+    recorder = None
+    if args.ledger:
+        from .ledger import ChipTimeLedger, FlightRecorder
+
+        ledger = ChipTimeLedger()
+        if args.postmortem_dir is not None:
+            recorder = FlightRecorder(out_dir=args.postmortem_dir)
     engine = ServeEngine(
         params, config, slots=args.slots, page_size=page_size,
         prompt_bucket=bucket,
@@ -4585,11 +4803,13 @@ def main(argv=None) -> int:
         prefill_budget=args.prefill_budget,
         prefix_cache=args.prefix_cache, kv_offload=args.kv_offload,
         kv_host_pages=args.kv_host_pages,
-        adapters=adapters, observer=observer,
+        adapters=adapters, observer=observer, ledger=ledger,
         max_pending=args.max_pending, fault_injector=injector,
         max_retries=args.max_retries,
         retry_backoff_s=args.retry_backoff_s, **spec_kw,
     )
+    if recorder is not None:
+        recorder.attach_engine("0", engine)
     key = jax.random.PRNGKey(7)
     rejected = 0
     for i in range(args.requests):
@@ -4615,6 +4835,10 @@ def main(argv=None) -> int:
     # is already a readback, not block_until_ready).  Each step runs
     # under the cooperative chip lease so a time-sliced sibling pod gets
     # the chip between chunks (no granted chips -> the lease is a no-op).
+    # (The warm step serves REAL stream requests that continue past it,
+    # so it stays on the books — the ledger's warmup/probe phases are
+    # for passes that bracket whole requests, like the supervisor's
+    # canary or a dedicated warm request.)
     with lease.chip_lease():
         engine.step()
     tokens_before = engine.generated_tokens
@@ -4622,6 +4846,8 @@ def main(argv=None) -> int:
     while not engine.idle:
         with lease.chip_lease():
             engine.step()
+        if recorder is not None:
+            recorder.poll()
     elapsed = time.perf_counter() - t0
     generated = engine.generated_tokens - tokens_before
     rate = generated / elapsed if elapsed > 0 and generated else 0.0
@@ -4662,6 +4888,29 @@ def main(argv=None) -> int:
             f"host_sync_ms={round(engine.host_sync_s * 1000, 1)} "
             f"recoveries_ms={[round(s * 1000, 1) for s in engine.fault_recovery_s]}"
         )
+    if ledger is not None:
+        if recorder is not None:
+            recorder.poll()  # final trigger sweep before the summary
+        snap = ledger.snapshot()
+        waste = {
+            k: v for k, v in sorted(snap.waste_tokens.items()) if v
+        }
+        print(
+            f"ledger: goodput={snap.goodput_tokens} "
+            f"waste={sum(snap.waste_tokens.values())} {waste} "
+            f"goodput_fraction={snap.goodput_fraction:.3f} "
+            f"busy_fraction={snap.busy_fraction:.3f} "
+            f"reconcile_ok={ledger.reconcile()['ok']}"
+        )
+        if recorder is not None:
+            import os
+
+            print(
+                f"postmortem: {len(recorder.dumped)} bundle(s) "
+                f"{[os.path.basename(p) for p in recorder.dumped]} "
+                f"-> {args.postmortem_dir} "
+                f"(validate: python tools/postmortem.py --validate)"
+            )
     if args.trace_out:
         n_events = engine.export_trace(args.trace_out)
         print(

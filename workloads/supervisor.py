@@ -581,6 +581,13 @@ class FleetSupervisor:
         oracle.  Greedy canaries make that a real equivalence check;
         the first success seeds the oracle when none was injected."""
         self._probes += 1
+        # Ledger-armed engines classify the canary's chip time and
+        # tokens as probe_warmup waste, not goodput (workloads/
+        # ledger.py OFFBOOK_PHASES) — the probe brackets one whole
+        # request, exactly the offbook contract.
+        had_phase = getattr(engine, "ledger_phase", None)
+        if had_phase is not None:
+            engine.ledger_phase = "probe"
         try:
             tokens, status = run_canary(
                 engine, self.probe_prompt, self.probe_new,
@@ -590,6 +597,9 @@ class FleetSupervisor:
         except Exception as exc:  # noqa: BLE001 — a probe blowing up IS
             # the signal the half-open state exists for.
             return False, f"{type(exc).__name__}: {exc}"
+        finally:
+            if had_phase is not None:
+                engine.ledger_phase = had_phase
         if tokens is None:
             return False, (
                 f"canary did not finish within {self.probe_max_steps} steps"
