@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check disagg-check ledger-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check disagg-check ledger-check faststart-check fmt-check
 
 all: native
 
@@ -51,7 +51,7 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check disagg-check ledger-check test
+check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check disagg-check ledger-check faststart-check test
 
 # Chip-time-ledger tripwires (docs/OBSERVABILITY.md "Chip-time ledger,
 # goodput & postmortems"): one seeded fault run with the ledger and
@@ -149,6 +149,18 @@ autoscale-check:
 # rides tests/test_serve_fuzz.py with the slow suite's multi-seed arms.
 selfheal-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_supervisor.py::test_selfheal_smoke" -q -o addopts=
+
+# Fast-replica-start tripwires (docs/SERVING.md "Fast replica start"):
+# one seeded crash under supervision with the warm-state snapshot
+# armed — the supervisor seeds its canary oracle from the snapshot
+# (no scratch calibration build), the respawned replica skips the
+# spec-breakeven dead dispatches (calibration_reused ticks) and ok
+# streams stay bit-identical to the dense oracle through the failover
+# (tests/test_faststart.py).  The snapshot on/off randomization rides
+# the serve-fuzz chaos arms; the measured spawn economics ride
+# `make perf-bench` (faststart_* keys, bench_diff-guarded).
+faststart-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_faststart.py::test_smoke" -q -o addopts=
 
 # Fleet-serving tripwires (docs/SERVING.md "Fleet serving & failover"):
 # one seeded router-chaos round — randomized replica crashes/hangs (the

@@ -610,6 +610,13 @@ COMPACT_KEYS = [
     "spec_engine_best_k",
     "busy_serve_fraction", "busy_serve_tokens_per_sec",
     "multi_lora_relative_throughput",
+    # Fast replica start: the spawn ladder (cold / warm / snapshot-
+    # primed), the calibration skips observed, and the supervised +
+    # autoscaled integration windows with the snapshot armed.
+    "faststart_cold_ms", "faststart_warm_ms",
+    "faststart_cache_hit_spawn_ms", "faststart_calibration_skipped",
+    "faststart_selfheal_restore_ms",
+    "faststart_scaleup_cold_ms", "faststart_scaleup_hot_ms",
 ]
 
 

@@ -455,6 +455,17 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
             ),
             max_retries=2, **kw,
         ))
+    # Fast-start snapshots under chaos (workloads/faststart.py): on
+    # half the seeds every engine is primed with a snapshot captured
+    # from replica 0 — heterogeneous per-replica configs mean some
+    # primes legitimately REJECT (fingerprint mismatch → cold path);
+    # either way the oracle pins below assert streams are unchanged.
+    if rng.integers(2):
+        from workloads.faststart import EngineSnapshot
+
+        snap = EngineSnapshot.capture(engines[0])
+        for eng in engines:
+            snap.prime(eng)
     # Fleet-scope chip-time ledger under chaos (workloads/ledger.py):
     # per-replica ledgers + the fleet roll-up, randomized on — the
     # failover/cancel/handoff taxonomy must still balance fleet-wide
@@ -659,14 +670,27 @@ def _run_supervised_chaos(seed: int, params, adapters) -> None:
         hang_timeout_s=None,
         max_pending_per_replica=int(rng.choice([3, 16])),
     )
+    # Fast-start snapshot on half the seeds: the factory primes every
+    # resurrection with warmed state captured from replica 0 (same
+    # engine_kw, so the fingerprint matches) and the supervisor carries
+    # it — respawn streams must stay bit-identical snapshot on/off.
+    snapshot = None
+    if rng.integers(2):
+        from workloads.faststart import EngineSnapshot
+
+        snapshot = EngineSnapshot.capture(
+            engines[0], probe=([1, 2, 3], 4),
+        )
     factory, oracle = make_engine_factory(
-        params, CONFIG, engine_kw=engine_kw, probe=([1, 2, 3], 4)
+        params, CONFIG, engine_kw=engine_kw, probe=([1, 2, 3], 4),
+        snapshot=snapshot,
     )
     crash_loop = bool(rng.integers(2))
     sup = FleetSupervisor(
         fleet, factory,
         backoff=Backoff(base_s=1e-3, max_s=8e-3, jitter=0.0),
         probe=([1, 2, 3], 4), probe_oracle=oracle,
+        snapshot=snapshot,
         crash_loop_k=3, crash_loop_window_s=60.0,
         fault_injector=(
             FaultInjector(crash_loop_schedule(2)) if crash_loop else None

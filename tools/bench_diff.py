@@ -138,6 +138,12 @@ TRACKED_DOWN = [
     # chained scan exists to divide by k — a rise means the spec
     # scheduler started serializing host syncs behind the device again.
     "spec_round_readback_ms",
+    # Fast replica start: snapshot-primed spawn + canary on a warm
+    # process (what every supervised respawn and autoscaler scale-up
+    # pays once faststart is armed) — a rise means spawns started
+    # re-running calibration or re-compiling what the caches should
+    # replay.
+    "faststart_cache_hit_spawn_ms",
 ]
 
 # The serving keys whose thresholds derive from the artifact's own

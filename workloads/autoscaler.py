@@ -159,6 +159,7 @@ class FleetAutoscaler:
         probe_max_steps: int = 400,
         fault_injector=None,
         observer=None,
+        snapshot=None,
         clock=time.perf_counter,
     ):
         if min_replicas < 1:
@@ -247,6 +248,20 @@ class FleetAutoscaler:
             [int(t) for t in probe_oracle]
             if probe_oracle is not None else None
         )
+        # Fast start (workloads/faststart.py): a snapshot captured
+        # against the SAME probe seeds the canary oracle, so arming the
+        # autoscaler needs no scratch build — the first scale-up is the
+        # first engine built.
+        self.snapshot = snapshot
+        if (
+            self._probe_oracle is None
+            and snapshot is not None
+            and getattr(snapshot, "probe_oracle", None) is not None
+            and getattr(snapshot, "probe", None) is not None
+            and list(snapshot.probe[0]) == self.probe_prompt
+            and int(snapshot.probe[1]) == self.probe_new
+        ):
+            self._probe_oracle = [int(t) for t in snapshot.probe_oracle]
         self._faults = fault_injector
         self._clock = clock
         self._serial = itertools.count()
@@ -523,6 +538,10 @@ class FleetAutoscaler:
                 now, f"spawn died: {type(exc).__name__}: {exc}"
             )
             return False
+        if self.snapshot is not None:
+            # Idempotent when the factory already primed: injection
+            # only lands on an engine with no calibration yet.
+            self.snapshot.prime(engine)
         ok, detail = self._probe(engine)
         if not ok:
             try:

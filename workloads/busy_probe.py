@@ -365,24 +365,40 @@ def main(argv=None) -> int:
     # different backend: config.update wins as long as no backend has
     # initialised yet in this process (same pattern as __graft_entry__).
     platforms = os.environ.get("JAX_PLATFORMS")
+    prior_platforms = _sentinel = object()
     if platforms:
+        try:
+            prior_platforms = jax.config.read("jax_platforms")
+        except (AttributeError, RuntimeError):
+            prior_platforms = _sentinel
         try:
             jax.config.update("jax_platforms", platforms)
         except (AttributeError, RuntimeError) as e:
+            prior_platforms = _sentinel  # nothing changed; nothing to undo
             print(
                 f"busy_probe: could not force JAX_PLATFORMS={platforms} "
                 f"({e}); measuring on the already-initialised backend",
                 file=sys.stderr,
             )
-    if args.aggregate:
-        print(json.dumps(aggregate(args.report)))
+    # jax.config is process-global: restore the prior value even when the
+    # probe raises, so a failed probe can't poison engine spawns that a
+    # library caller runs in this same process afterwards.
+    try:
+        if args.aggregate:
+            print(json.dumps(aggregate(args.report)))
+            return 0
+        stats = run_probe(
+            args.duration, args.report or None, args.matrix_dim, args.workload,
+            args.barrier_dir or None, args.barrier_count,
+        )
+        print(json.dumps(stats))
         return 0
-    stats = run_probe(
-        args.duration, args.report or None, args.matrix_dim, args.workload,
-        args.barrier_dir or None, args.barrier_count,
-    )
-    print(json.dumps(stats))
-    return 0
+    finally:
+        if prior_platforms is not _sentinel:
+            try:
+                jax.config.update("jax_platforms", prior_platforms)
+            except (AttributeError, RuntimeError):
+                pass
 
 
 if __name__ == "__main__":

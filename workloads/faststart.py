@@ -1,0 +1,374 @@
+"""Fast replica start: persistent compile cache + warm-state snapshots.
+
+The reference plugin's headline ``replicas = -1`` mode only works
+because advertising another replica is nearly free.  Our fleet's
+replicas are NOT free: every respawn pays full XLA compilation, warmup,
+and — for ``spec="auto"`` engines — the spec-breakeven calibration's
+dead dispatches, chip-seconds the PR-15 ledger prices as probe_warmup
+waste.  Until a replica is cheap to start, the supervisor (PR 7) and
+autoscaler (PR 13) cannot treat capacity as fluid — ROADMAP item 1
+names exactly this as the enabling refactor for page-granular
+scheduling.  This module collapses cold restore toward warm restore
+with two independent layers:
+
+**1. The persistent compilation cache** (``enable_compile_cache``).
+JAX's disk-backed executable cache, wired behind one idempotent call:
+every jitted program the serve path compiles — prefill chunks, decode
+supersteps, spec superstep chains, TP variants, the per-engine
+first-token samplers — lands in ``cache_dir`` keyed by HLO fingerprint,
+and every LATER compile of the same program (next engine, next replica,
+next PROCESS) is a disk read instead of an XLA run.  Hit/miss counts
+flow through ``jax.monitoring`` into ``cache_stats()``; the engine
+surfaces per-engine deltas as ``engine_compile_cache_{hits,misses}_total``
+(workloads/obs.py).  The cache changes WHERE executables come from,
+never what they compute — streams are bit-identical cache on/off.
+
+**2. The post-warmup engine snapshot** (``EngineSnapshot``).  After an
+engine's first warmup + ``_calibrate_breakeven``, ``capture()`` records
+the host-side warmed state the cache cannot replay: the calibrated
+``spec_breakeven`` verdict with its full ``spec_calibration`` evidence,
+the kernel-select dispatch table (workloads/ops/kernel_select.py), and
+the canary probe + oracle stream.  ``prime(engine)`` injects that state
+into a freshly built engine so its first decode step REUSES the
+calibration instead of re-running the dead timing dispatches
+(``engine.calibration_reused`` counts the skips), and
+``make_engine_factory(..., snapshot=...)`` (workloads/supervisor.py)
+applies it on every supervisor resurrection and autoscaler scale-up.
+Snapshots are versioned and config-fingerprinted: a snapshot from a
+different model/engine shape, jax version, or device kind is REJECTED
+(``prime`` returns False, the cold path runs) — a stale snapshot can
+degrade nothing but speed, never numerics.
+
+The measured economics live in ``measure_faststart``
+(workloads/perfbench.py): ``faststart_cold_ms`` vs
+``faststart_cache_hit_spawn_ms`` with every measured pair's token
+streams asserted bit-identical snapshot on/off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+SNAPSHOT_VERSION = 1
+
+# Process-global compile-cache state: one persistent cache per process
+# (jax.config is global), one monitoring listener, monotonic counters.
+_cache_dir: str | None = None
+_listener_installed = False
+_stats = {"hits": 0, "misses": 0}
+
+
+def _on_event(event: str, *args, **kwargs) -> None:
+    # jax.monitoring fires one event per compilation-cache lookup; the
+    # names are stable public monitoring keys ("/jax/compilation_cache/
+    # cache_hits" / "cache_misses").  Extra positional/keyword payloads
+    # vary across jax versions — accept and ignore them.
+    if not isinstance(event, str):
+        return
+    if event.endswith("/cache_hits"):
+        _stats["hits"] += 1
+    elif event.endswith("/cache_misses"):
+        _stats["misses"] += 1
+
+
+def enable_compile_cache(cache_dir: str) -> str:
+    """Point JAX's persistent compilation cache at ``cache_dir``
+    (created if missing) and start counting hits/misses.  Idempotent:
+    repeated calls with the same directory are no-ops; a DIFFERENT
+    directory re-points the cache (jax.config is process-global — the
+    last caller wins, so fleets should share one directory).
+
+    The entry-size and compile-time floors are disabled so even the
+    tiny CPU test programs persist — on a serving host every skipped
+    compile counts, and the cache's own key check (HLO + jax version +
+    backend) already prevents wrong reuse."""
+    global _cache_dir, _listener_installed
+    cache_dir = os.path.abspath(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+
+    if _cache_dir != cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        try:
+            jax.config.update(
+                "jax_persistent_cache_enable_xla_caches", "all"
+            )
+        except AttributeError:
+            pass  # older jax: executable cache only, still a win
+        # jax latches cache-enabled per process at the FIRST compile
+        # (compilation_cache._cache_checked): enabling after any jit has
+        # run would otherwise be a silent no-op.  reset_cache() clears
+        # the latch so late enables (a CLI that builds params before
+        # parsing --compile-cache-dir, a test that warms first) still
+        # take effect.  Private API — guarded, and worst case is the
+        # documented pre-initialization requirement.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001 — best-effort unlatch
+            pass
+        _cache_dir = cache_dir
+    if not _listener_installed:
+        try:
+            jax.monitoring.register_event_listener(_on_event)
+            _listener_installed = True
+        except Exception:  # noqa: BLE001 — counters are telemetry, not
+            # correctness; a jax without monitoring still gets the cache.
+            pass
+    return cache_dir
+
+
+def compile_cache_dir() -> str | None:
+    """The directory the persistent cache currently writes to (None
+    while disabled)."""
+    return _cache_dir
+
+
+def cache_stats() -> dict[str, int]:
+    """Monotonic process-wide persistent-cache counters: ``hits`` are
+    compiles served from disk, ``misses`` are compiles that ran XLA
+    (and then populated the cache).  Engines read per-engine deltas
+    off these (ServeEngine.compile_cache_hits/misses)."""
+    return dict(_stats)
+
+
+def _scalar(v):
+    return v if isinstance(v, (int, float, str, bool, type(None))) else None
+
+
+def _config_dict(config) -> dict:
+    """A ModelConfig (or any config object) as a scalars-only dict —
+    the model half of the fingerprint."""
+    import dataclasses
+
+    if dataclasses.is_dataclass(config):
+        raw = dataclasses.asdict(config)
+    else:
+        raw = dict(vars(config))
+    return {k: _scalar(v) if _scalar(v) is not None else str(v)
+            for k, v in sorted(raw.items())}
+
+
+def fingerprint_engine(engine) -> str:
+    """The compatibility key for one live engine: every knob that
+    shapes its compile set or the calibration verdict — model + draft
+    configs, batch/page geometry, decode-mode knobs, sampling, LoRA
+    census — plus the jax version and device kind (a threshold
+    measured on one chip generation says nothing about another).
+    Params VALUES are deliberately excluded: the snapshot carries no
+    tensors, and timing verdicts depend on shapes, not weights."""
+    import hashlib
+
+    import jax
+
+    try:
+        device = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — no backend yet; still fingerprintable
+        device = "unknown"
+    payload = {
+        "version": SNAPSHOT_VERSION,
+        "jax": jax.__version__,
+        "device": device,
+        "config": _config_dict(engine.config),
+        "draft_config": (
+            _config_dict(engine.draft_config)
+            if engine.draft_config is not None else None
+        ),
+        "engine": {
+            "slots": engine.slots,
+            "page_size": engine.page_size,
+            "chunk": engine.chunk,
+            "prompt_bucket": engine.prompt_bucket,
+            "temperature": engine.temperature,
+            "top_k": engine.top_k,
+            "top_p": engine.top_p,
+            "gamma": engine.gamma,
+            "spec": engine.spec,
+            "spec_lookahead": engine.spec_lookahead,
+            "spec_superstep_k": engine.spec_superstep_k,
+            "superstep_k": engine.superstep_k,
+            "pipelined": engine.pipelined,
+            "adapters": sorted(engine._adapter_ids),
+            "lora_alpha": engine.lora_alpha,
+            "tp": engine._mesh is not None,
+        },
+    }
+    blob = json.dumps(payload, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+@dataclass
+class EngineSnapshot:
+    """The host-side warmed state of one served engine, captured after
+    warmup + calibration so later spawns of the SAME shape skip both.
+    Versioned + config-fingerprinted; ``prime``/``compatible`` reject
+    mismatches (fall back to the cold path) rather than ever serving a
+    wrong table or threshold.  JSON round-trippable — small enough to
+    ship next to the weights."""
+
+    config_key: str
+    version: int = SNAPSHOT_VERSION
+    spec_breakeven: float | None = None
+    spec_calibration: dict | None = None
+    kernel_table: dict[int, str] | None = None
+    probe: tuple[list[int], int] | None = None
+    probe_oracle: list[int] | None = None
+    meta: dict = field(default_factory=dict)
+
+    @classmethod
+    def capture(
+        cls, engine, *, probe=None, probe_oracle=None,
+    ) -> "EngineSnapshot":
+        """Snapshot a WARMED engine: its calibration verdict (when the
+        first decode step has run one — ``spec="auto"`` engines), the
+        process-wide kernel-select table, and the canary contract the
+        supervisor/autoscaler held it to."""
+        import jax
+
+        from .ops.kernel_select import kernel_table
+
+        table = kernel_table()
+        try:
+            device = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 — capture works backend-less
+            device = "unknown"
+        return cls(
+            config_key=fingerprint_engine(engine),
+            spec_breakeven=(
+                float(engine.spec_breakeven)
+                if engine.spec_breakeven is not None else None
+            ),
+            spec_calibration=(
+                dict(engine.spec_calibration)
+                if engine.spec_calibration is not None else None
+            ),
+            kernel_table=(
+                {int(b): impl for b, impl in table}
+                if table is not None else None
+            ),
+            probe=(
+                ([int(t) for t in probe[0]], int(probe[1]))
+                if probe is not None else None
+            ),
+            probe_oracle=(
+                [int(t) for t in probe_oracle]
+                if probe_oracle is not None else None
+            ),
+            meta={
+                "jax": jax.__version__,
+                "device": device,
+                "created_unix": time.time(),
+                "compile_cache_dir": _cache_dir,
+            },
+        )
+
+    # ---- compatibility ---------------------------------------------------
+
+    def compatible(self, engine) -> bool:
+        """True iff this snapshot was captured from an engine of the
+        SAME shape as ``engine`` (version + full config fingerprint) —
+        the stale-snapshot gate every consumer checks before reuse."""
+        return (
+            self.version == SNAPSHOT_VERSION
+            and self.config_key == fingerprint_engine(engine)
+        )
+
+    def prime(self, engine) -> bool:
+        """Inject the warmed state into a freshly built engine.
+        Returns True iff the snapshot applied; an incompatible
+        (stale/foreign) snapshot is a no-op False — the engine keeps
+        its cold path and calibrates itself.  Calibration injection
+        rides the engine's lazy ``_calibrate_breakeven`` seam, so the
+        skip lands (and ``calibration_reused`` ticks) at the first
+        decode step, exactly where the dead dispatches would have
+        run."""
+        if not self.compatible(engine):
+            return False
+        if self.kernel_table is not None:
+            from .ops.kernel_select import set_kernel_table
+
+            set_kernel_table(self.kernel_table)
+        if (
+            self.spec_calibration is not None
+            and engine.spec == "auto"
+            and engine.spec_breakeven is None
+            and engine._injected_calibration is None
+        ):
+            engine._injected_calibration = dict(self.spec_calibration)
+        elif (
+            self.spec_breakeven is not None
+            and engine.spec == "auto"
+            and engine.spec_breakeven is None
+            and engine._injected_calibration is None
+        ):
+            # A snapshot carrying only the verdict (no evidence dict)
+            # still skips the dead dispatches.
+            engine._injected_calibration = {
+                "threshold": float(self.spec_breakeven)
+            }
+        return True
+
+    def engine_kw(self) -> dict:
+        """Constructor-time injection kwargs for ``ServeEngine`` — the
+        factory path (`make_engine_factory(snapshot=...)`) prefers
+        ``prime`` post-build (which can fingerprint-check), but callers
+        composing their own kwargs can merge these."""
+        kw: dict = {}
+        if self.spec_calibration is not None:
+            kw["spec_calibration"] = dict(self.spec_calibration)
+        return kw
+
+    # ---- (de)serialization ----------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": self.version,
+            "config_key": self.config_key,
+            "spec_breakeven": self.spec_breakeven,
+            "spec_calibration": self.spec_calibration,
+            "kernel_table": self.kernel_table,
+            "probe": (
+                [self.probe[0], self.probe[1]]
+                if self.probe is not None else None
+            ),
+            "probe_oracle": self.probe_oracle,
+            "meta": self.meta,
+        }, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, blob: str) -> "EngineSnapshot":
+        d = json.loads(blob)
+        probe = d.get("probe")
+        return cls(
+            config_key=d["config_key"],
+            version=int(d.get("version", -1)),
+            spec_breakeven=d.get("spec_breakeven"),
+            spec_calibration=d.get("spec_calibration"),
+            kernel_table=(
+                {int(b): impl for b, impl in d["kernel_table"].items()}
+                if d.get("kernel_table") is not None else None
+            ),
+            probe=(
+                ([int(t) for t in probe[0]], int(probe[1]))
+                if probe is not None else None
+            ),
+            probe_oracle=d.get("probe_oracle"),
+            meta=dict(d.get("meta") or {}),
+        )
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+            f.write("\n")
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "EngineSnapshot":
+        with open(path) as f:
+            return cls.from_json(f.read())

@@ -1105,12 +1105,18 @@ class Fleet:
 
     def add_replica(
         self, engine, chip_id: str = "", role: str = "mixed",
+        *, snapshot=None,
     ) -> int:
         """Join a fresh engine live; the router dispatches to it from
         the next step.  ``role`` places it in a disaggregated fleet's
         prefill/decode pools (the supervisor passes the dead slot's
         original role back so a resurrected pool member rejoins its
-        pool).  Returns the new replica index."""
+        pool).  ``snapshot`` (workloads/faststart.py) primes the joiner
+        with captured warm state before it takes traffic — incompatible
+        snapshots no-op and the engine warms cold.  Returns the new
+        replica index."""
+        if snapshot is not None:
+            snapshot.prime(engine)
         with self._lock:
             if self._closed:
                 raise EngineClosed("fleet is closed")

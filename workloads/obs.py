@@ -124,6 +124,24 @@ ENGINE_METRICS: tuple[MetricSpec, ...] = (
         "submissions rejected by bounded admission (max_pending)",
     ),
     MetricSpec(
+        "engine_calibration_reused_total", "counter", ("engine",),
+        'spec="auto" break-even calibrations adopted from an injected '
+        "warm-state snapshot instead of re-running the dead timing "
+        "dispatches (workloads/faststart.py EngineSnapshot)",
+    ),
+    MetricSpec(
+        "engine_compile_cache_hits_total", "counter", ("engine",),
+        "persistent-compile-cache hits during this engine's lifetime "
+        "(executables replayed from disk instead of recompiled — "
+        "faststart.enable_compile_cache / --compile-cache-dir)",
+    ),
+    MetricSpec(
+        "engine_compile_cache_misses_total", "counter", ("engine",),
+        "persistent-compile-cache misses during this engine's lifetime "
+        "(compiles that ran XLA and then populated the cache — the "
+        "cold-spawn signature)",
+    ),
+    MetricSpec(
         "engine_queue_depth", "gauge", ("engine",),
         "requests waiting in the pending queue (scrape-time)",
     ),
@@ -840,6 +858,12 @@ class EngineObserver:
         "engine_requests_failed_total": "requests_failed",
         "engine_requests_retried_total": "requests_retried",
         "engine_queue_rejections_total": "queue_rejections",
+        # Fast-start telemetry (workloads/faststart.py): snapshot
+        # calibration skips and the per-engine persistent-compile-cache
+        # deltas (properties over the process-global counters).
+        "engine_calibration_reused_total": "calibration_reused",
+        "engine_compile_cache_hits_total": "compile_cache_hits",
+        "engine_compile_cache_misses_total": "compile_cache_misses",
     }
 
     def unbind_registry(self) -> None:

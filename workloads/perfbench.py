@@ -3510,6 +3510,243 @@ def _publish_ratio_spread(
     )
 
 
+def measure_faststart(scale: BenchScale) -> dict:
+    """Fast replica start economics (workloads/faststart.py;
+    docs/SERVING.md "Fast replica start"), on a spec="auto" engine so
+    the spawn path carries everything fast start removes: XLA compiles
+    (both decode programs + prefill), warmup, and the spec-breakeven
+    calibration's dead timing dispatches.  Greedy, so every stream
+    bit-compares.
+
+      1. **Spawn ladder** — ``faststart_cold_ms`` is the arm's FIRST
+         build + canary probe with the persistent compile cache enabled
+         but empty for this process (full XLA bill + calibration);
+         ``faststart_warm_ms`` is the same spawn with in-process caches
+         hot but NO snapshot (re-runs calibration — what respawns paid
+         before this subsystem); ``faststart_cache_hit_spawn_ms`` is
+         the snapshot-primed spawn (calibration skipped, kernel table
+         injected — what every supervised respawn and autoscaler
+         scale-up pays with faststart armed).  Every repeat's streams
+         are ASSERTED bit-identical snapshot on/off and to the cold
+         oracle; ``faststart_calibration_skipped`` counts the skips the
+         arm observed (must be > 0 or the subsystem is dead).
+      2. **Supervised selfheal integration** — a 2-replica fleet with a
+         scheduled mid-stream crash and a snapshot-armed
+         ``make_engine_factory``: the death -> probed-rejoin window is
+         ``faststart_selfheal_restore_ms``, and the respawned engine
+         must have CONSUMED the snapshot (calibration-skip counter > 0
+         during the heal, hard-fail otherwise).
+      3. **Autoscaler integration** — one probed ``_try_scale_up`` on a
+         warm process, snapshot hot (``faststart_scaleup_hot_ms``) vs
+         cold (``faststart_scaleup_cold_ms``); the gap is the pure
+         calibration + oracle-seeding tax scale-ups no longer pay."""
+    import statistics
+    import tempfile
+
+    from .backoff import Backoff
+    from .faststart import EngineSnapshot, cache_stats, enable_compile_cache
+    from .faults import FaultInjector
+    from .fleet import Fleet
+    from .serve import ServeEngine
+    from .supervisor import FleetSupervisor, make_engine_factory
+
+    batch, ps = scale.batch, scale.page_size
+    chunk = ps
+    hi = scale.serve_chunks[1]
+    prompt_len = scale.decode_prompt
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model, n_heads=scale.n_heads,
+        n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=prompt_len + 1 + hi * chunk,
+    )
+    draft_config = ModelConfig(
+        vocab_size=scale.vocab, d_model=max(16, scale.d_model // 2),
+        n_heads=max(2, scale.n_heads // 2), n_layers=1,
+        d_ff=max(32, scale.d_ff // 2),
+        max_seq_len=config.max_seq_len,
+    )
+    params = init_params(config, jax.random.PRNGKey(0))
+    draft = init_params(draft_config, jax.random.PRNGKey(7))
+    engine_kw = dict(
+        slots=batch, page_size=ps, chunk=chunk,
+        prompt_bucket=-(-prompt_len // ps) * ps,
+        draft_params=draft, draft_config=draft_config, gamma=3,
+        spec="auto",
+    )
+    probe = ([1, 2, 3], 1 + chunk)
+    # The persistent compile cache is process-global; enabling it here
+    # (fresh directory) starts the hit/miss meters for the whole arm.
+    enable_compile_cache(tempfile.mkdtemp(prefix="faststart-bench-"))
+    cc0 = cache_stats()
+
+    def timed_spawn(snapshot):
+        """Build + inline-canary one engine (the supervisor's probe
+        contract); returns (secs, tokens, calibration_reused)."""
+        t0 = time.perf_counter()
+        engine = ServeEngine(params, config, **engine_kw)
+        if snapshot is not None and not snapshot.prime(engine):
+            raise RuntimeError("faststart bench: snapshot failed to prime")
+        rid = engine.submit(probe[0], probe[1])
+        tokens = None
+        while tokens is None and not engine.idle:
+            for req in engine.step():
+                if req.rid == rid:
+                    tokens = [int(t) for t in req.tokens]
+        secs = time.perf_counter() - t0
+        reused = engine.calibration_reused
+        snap = EngineSnapshot.capture(
+            engine, probe=probe, probe_oracle=tokens,
+        ) if snapshot is None else None
+        engine.close()
+        if tokens is None:
+            raise RuntimeError("faststart bench: canary never finished")
+        return secs, tokens, reused, snap
+
+    # 1. Spawn ladder.  Cold carries the empty-persistent-cache compile
+    # bill and the calibration dispatches; its verdict becomes THE
+    # snapshot for everything below.
+    cold_s, oracle, _, snap = timed_spawn(None)
+    skipped = 0
+    warm_samples: list[float] = []
+    hot_samples: list[float] = []
+    for _ in range(3):
+        warm_s, warm_tokens, warm_reused, _ = timed_spawn(None)
+        hot_s, hot_tokens, hot_reused, _ = timed_spawn(snap)
+        if warm_tokens != oracle or hot_tokens != oracle:
+            raise RuntimeError(
+                "faststart bench: spawn streams diverged snapshot "
+                "on/off — the snapshot must never change tokens"
+            )
+        if warm_reused != 0 or hot_reused != 1:
+            raise RuntimeError(
+                f"faststart bench: calibration reuse miscounted "
+                f"(warm={warm_reused}, primed={hot_reused})"
+            )
+        skipped += hot_reused
+        warm_samples.append(warm_s)
+        hot_samples.append(hot_s)
+
+    # 2. Supervised selfheal with the snapshot armed.  Replicas start
+    # COLD-built so any calibration reuse observed after the heal is
+    # attributable to the respawn alone.
+    n_rep = 2
+    factory, fac_oracle = make_engine_factory(
+        params, config, engine_kw=engine_kw, snapshot=snap,
+    )
+    if fac_oracle != oracle:
+        raise RuntimeError(
+            "faststart bench: factory oracle != snapshot oracle"
+        )
+    injector = FaultInjector()
+    engines = [ServeEngine(params, config, **engine_kw)
+               for _ in range(n_rep)]
+    fleet = Fleet(
+        engines, chip_ids=[f"chip-{i}" for i in range(n_rep)],
+        fault_injector=injector, hang_timeout_s=60.0,
+    )
+    for i in range(n_rep):  # warm (and calibrate) off the clock
+        fleet.submit([1 + i], 1 + chunk)
+    fleet.run()
+    fleet.drain_completed()
+    sup = FleetSupervisor(
+        fleet, factory,
+        backoff=Backoff(base_s=1e-3, max_s=5e-3, jitter=0.0),
+        probe=probe, snapshot=snap,
+        crash_loop_k=3, crash_loop_window_s=60.0,
+    )
+    injector.reset()
+    injector.arm({"replica_crash": 2 * n_rep + 1})
+    n_req = 2 * batch
+    for i in range(n_req):
+        fleet.submit([1 + (i % 7)], 1 + (i % hi) * chunk)
+    sup.run()
+    done = fleet.drain_completed()
+    statuses = {fr.status for fr in done}
+    if len(done) != n_req or statuses != {"ok"}:
+        raise RuntimeError(
+            f"faststart bench: {len(done)} finished with statuses "
+            f"{statuses}, expected {n_req} ok"
+        )
+    if not sup.wait_healed(timeout_s=30.0) or len(sup.restore_s) != 1:
+        raise RuntimeError(
+            f"faststart bench: supervised heal failed "
+            f"(restore windows: {len(sup.restore_s)})"
+        )
+    selfheal_skipped = sum(
+        r.engine.calibration_reused for r in fleet.replicas
+        if r.engine is not None
+    )
+    if selfheal_skipped < 1:
+        raise RuntimeError(
+            "faststart bench: respawned replica did not consume the "
+            "snapshot (calibration-skip counter is 0 after the heal)"
+        )
+    skipped += selfheal_skipped
+    selfheal_restore_s = sup.restore_s[0]
+    fleet.close()
+
+    # 3. Autoscaler scale-up, snapshot hot vs cold.
+    from .autoscaler import FleetAutoscaler
+
+    def timed_scaleup(snapshot):
+        base = ServeEngine(params, config, **engine_kw)
+        fl = Fleet([base], chip_ids=["chip-0"], hang_timeout_s=None)
+        fl.submit([1], 1 + chunk)
+        fl.run()
+        fl.drain_completed()
+        fac, _ = make_engine_factory(
+            params, config, engine_kw=engine_kw, snapshot=snapshot,
+        )
+        asc = FleetAutoscaler(
+            fl, fac, min_replicas=1, max_replicas=2,
+            probe=probe, snapshot=snapshot,
+            probe_oracle=None if snapshot is not None else list(oracle),
+            up_backoff=Backoff(base_s=1e-3, max_s=5e-3, jitter=0.0),
+        )
+        t0 = time.perf_counter()
+        if not asc._try_scale_up(time.perf_counter()):
+            raise RuntimeError("faststart bench: scale-up refused")
+        secs = time.perf_counter() - t0
+        reused = sum(
+            r.engine.calibration_reused for r in fl.replicas
+            if r.engine is not None
+        )
+        fl.close()
+        return secs, reused
+
+    scaleup_cold_s, _ = timed_scaleup(None)
+    scaleup_hot_s, hot_scale_reused = timed_scaleup(snap)
+    if hot_scale_reused < 1:
+        raise RuntimeError(
+            "faststart bench: hot scale-up did not consume the snapshot"
+        )
+    skipped += hot_scale_reused
+
+    cc1 = cache_stats()
+    warm_ms = [s * 1000 for s in warm_samples]
+    hot_ms = [s * 1000 for s in hot_samples]
+    return {
+        "faststart_cold_ms": round(cold_s * 1000, 2),
+        "faststart_warm_ms": round(statistics.median(warm_ms), 2),
+        "faststart_cache_hit_spawn_ms": round(
+            statistics.median(hot_ms), 2
+        ),
+        "faststart_cache_hit_spawn_ms_min": round(min(hot_ms), 2),
+        "faststart_cache_hit_spawn_ms_max": round(max(hot_ms), 2),
+        "faststart_cache_hit_spawn_ms_samples": [
+            round(s, 2) for s in hot_ms
+        ],
+        "faststart_calibration_skipped": skipped,
+        "faststart_selfheal_restore_ms": round(
+            selfheal_restore_s * 1000, 2
+        ),
+        "faststart_scaleup_cold_ms": round(scaleup_cold_s * 1000, 2),
+        "faststart_scaleup_hot_ms": round(scaleup_hot_s * 1000, 2),
+        "faststart_compile_cache_hits": cc1["hits"] - cc0["hits"],
+        "faststart_compile_cache_misses": cc1["misses"] - cc0["misses"],
+    }
+
+
 def run(scale_name: str = "full", pool_with: dict | None = None) -> dict:
     """The full perf suite as one flat dict (bench.py merges it into the
     JSON line).  ``pool_with`` is the previous committed artifact (when
@@ -3588,6 +3825,10 @@ def run(scale_name: str = "full", pool_with: dict | None = None) -> dict:
         sps["spec_superstep_tokens_per_sec_samples"], pool_with,
     )
     out.update(measure_multi_lora(scale))
+    # LAST: measure_faststart enables the process-global persistent
+    # compile cache — every arm before it measures the un-cached
+    # baseline it always did.
+    out.update(measure_faststart(scale))
     for key, samples in (
         ("flash_vs_xla_speedup", attn[top_seq]["speedup_samples"]),
         ("flash_window_speedup", out["flash_window_speedup_samples"]),

@@ -199,6 +199,8 @@ class ServeEngine:
         spec_superstep_k: int = 1,
         spec: str = "on",
         spec_breakeven: float | None = None,
+        spec_calibration: dict | None = None,
+        compile_cache_dir: str | None = None,
         pipelined: bool = False,
         superstep_k: int = 1,
         prefix_cache: bool | str = False,
@@ -297,6 +299,31 @@ class ServeEngine:
                 'spec_breakeven is the spec="auto" occupancy threshold; '
                 'it has no effect with spec="on"'
             )
+        if spec_calibration is not None:
+            if spec != "auto":
+                raise ValueError(
+                    'spec_calibration injects the spec="auto" break-even '
+                    'calibration; it has no effect with spec="on"'
+                )
+            if "threshold" not in spec_calibration:
+                raise ValueError(
+                    "spec_calibration must carry the calibrated "
+                    '"threshold" (the _calibrate_breakeven dict shape)'
+                )
+        # Persistent compilation cache (workloads/faststart.py): every
+        # jitted serve-path program this engine compiles is keyed to
+        # disk and replayed by later engines/replicas/processes of the
+        # same shape.  Wired BEFORE any program builds so even the
+        # first-token samplers below land in the cache; inert for
+        # numerics (the cache changes where executables come from,
+        # never what they compute).
+        if compile_cache_dir is not None:
+            from .faststart import enable_compile_cache
+
+            enable_compile_cache(compile_cache_dir)
+        from .faststart import cache_stats
+
+        self._cc_base = cache_stats()
         self.params, self.config = params, config
         self.draft_params, self.draft_config = draft_params, draft_config
         self.gamma = gamma
@@ -335,6 +362,15 @@ class ServeEngine:
         self.spec = spec
         self.spec_breakeven = spec_breakeven
         self.spec_calibration: dict | None = None
+        # A calibration injected from a warm-state snapshot (workloads/
+        # faststart.py EngineSnapshot.prime, or the spec_calibration=
+        # kwarg): _calibrate_breakeven adopts it instead of re-running
+        # the dead timing dispatches, and calibration_reused counts the
+        # skips (engine_calibration_reused_total on the registry).
+        self._injected_calibration = (
+            dict(spec_calibration) if spec_calibration is not None else None
+        )
+        self.calibration_reused = 0
         # Auto-mode telemetry: per-decode-step mode counts, switch count,
         # and a bounded (occupancy, mode) trace for tests and debugging.
         # The trace bound is a constructor knob (None = unbounded), and
@@ -3072,6 +3108,26 @@ class ServeEngine:
         self._super_chained = None
         return finished
 
+    # ---- fast start (workloads/faststart.py) ----------------------------
+
+    @property
+    def compile_cache_hits(self) -> int:
+        """Persistent-compile-cache hits since THIS engine was built
+        (a delta over the process-global faststart counters — per-
+        engine attribution of which spawn rode the disk cache; 0 while
+        the cache is disabled)."""
+        from .faststart import cache_stats
+
+        return cache_stats()["hits"] - self._cc_base["hits"]
+
+    @property
+    def compile_cache_misses(self) -> int:
+        """Persistent-compile-cache misses (compiles that ran XLA)
+        since this engine was built — the cold-spawn signature."""
+        from .faststart import cache_stats
+
+        return cache_stats()["misses"] - self._cc_base["misses"]
+
     # ---- adaptive speculation (spec="auto") -----------------------------
 
     def _decide_spec(self) -> bool:
@@ -3166,7 +3222,17 @@ class ServeEngine:
         unknowable before real traffic; the spec side assumes 0.75 (the
         conservative middle of the measured int8-self-draft range).
         Uses a private RNG key so the served sampling stream's key
-        schedule is untouched (parity with injected-threshold engines)."""
+        schedule is untouched (parity with injected-threshold engines).
+
+        An INJECTED calibration (a warm-state snapshot's, via
+        ``spec_calibration=`` or ``EngineSnapshot.prime``) short-
+        circuits the whole probe: the verdict was measured seconds ago
+        on an identical shape, so the dead dispatches (and the compiles
+        they force) are pure waste — adopt it, count the skip."""
+        if self._injected_calibration is not None:
+            self.spec_calibration = dict(self._injected_calibration)
+            self.calibration_reused += 1
+            return float(self.spec_calibration["threshold"])
         k = max(self.spec_lookahead, self.spec_superstep_k)
         u = (self.gamma + 1) * k
         # The superstep's verify gather is O(cover), and production's
@@ -4442,6 +4508,18 @@ def main(argv=None) -> int:
                         help="occupancy threshold for --spec-auto (e.g. "
                         "the bench artifact's spec_breakeven_batch); "
                         "omit to calibrate at the first decode step")
+    parser.add_argument("--compile-cache-dir", default=None, metavar="DIR",
+                        help="persistent XLA compilation cache "
+                        "(workloads/faststart.py): every jitted "
+                        "serve-path program is keyed into DIR and "
+                        "replayed by later engines, replicas and "
+                        "PROCESSES of the same shape — respawns and "
+                        "scale-ups read executables off disk instead "
+                        "of recompiling (docs/SERVING.md 'Fast "
+                        "replica start'); hit/miss counters land on "
+                        "--metrics-port as engine_compile_cache_"
+                        "{hits,misses}_total; streams are "
+                        "bit-identical cache on/off")
     parser.add_argument("--lora-adapters", type=int, default=0,
                         help="serve N synthetic LoRA adapters multi-tenant "
                         "(requests round-robin across them + the base)")
@@ -4645,6 +4723,19 @@ def main(argv=None) -> int:
     from . import lease
 
     lease.hold_claim_leases()  # mixed-strategy lifetime declaration
+
+    if args.compile_cache_dir is not None:
+        # Process-global (jax.config), enabled BEFORE any engine builds
+        # so every program — founders, respawns, scale-ups — lands in
+        # (or replays from) the persistent cache.  Engine constructions
+        # below inherit it; the per-engine kwarg exists for library
+        # callers.
+        from .faststart import enable_compile_cache
+
+        print(
+            f"compile cache: "
+            f"{enable_compile_cache(args.compile_cache_dir)}"
+        )
 
     config = ModelConfig(
         d_model=512, n_heads=8, n_layers=4, d_ff=2048, vocab_size=8192,

@@ -132,6 +132,7 @@ class FleetSupervisor:
         capacity_aware: bool = True,
         fault_injector=None,
         observer=None,
+        snapshot=None,
         clock=time.perf_counter,
     ):
         if crash_loop_k < 1:
@@ -171,6 +172,23 @@ class FleetSupervisor:
             [int(t) for t in probe_oracle]
             if probe_oracle is not None else None
         )
+        # A warm-state snapshot (workloads/faststart.py) can carry the
+        # probe oracle from the capture-time engine; seeding it here
+        # makes ``calibrate_probe`` a no-op, so arming the supervisor
+        # skips the scratch build-probe-close round entirely.  Only a
+        # snapshot captured against the SAME probe may seed — a
+        # different (prompt, max_new) would pin a stream no respawn
+        # can reproduce.
+        self.snapshot = snapshot
+        if (
+            self._probe_oracle is None
+            and snapshot is not None
+            and getattr(snapshot, "probe_oracle", None) is not None
+            and getattr(snapshot, "probe", None) is not None
+            and list(snapshot.probe[0]) == self.probe_prompt
+            and int(snapshot.probe[1]) == self.probe_new
+        ):
+            self._probe_oracle = [int(t) for t in snapshot.probe_oracle]
         self._faults = fault_injector
         self._clock = clock
         self._probes = 0
@@ -740,21 +758,34 @@ def run_canary(
     return tokens, status
 
 
-def make_engine_factory(params, config, *, engine_kw=None, probe=None):
+def make_engine_factory(
+    params, config, *, engine_kw=None, probe=None, snapshot=None,
+):
     """The standard ``engine_factory`` for homogeneous fleets: respawn
     a ``ServeEngine`` over the SHARED params (warm restarts — weights
     and in-process compile caches are reused; only the first build in a
     process pays cold XLA compiles).  Returns ``(factory, oracle)``
     where ``oracle`` is the canary's greedy reference stream from the
     dense model (``None`` when no ``probe`` is given — the supervisor
-    then seeds trust-on-first-use)."""
+    then seeds trust-on-first-use).
+
+    ``snapshot`` (an ``EngineSnapshot`` from ``workloads/faststart.py``)
+    arms fast start: every engine the factory builds is primed with the
+    captured calibration + kernel table (incompatible snapshots are a
+    silent no-op — the engine just takes the cold path), and when no
+    dense ``probe`` reference is requested the snapshot's own captured
+    ``probe_oracle`` is returned so the supervisor can skip its scratch
+    calibration build."""
     from .serve import ServeEngine
 
     engine_kw = dict(engine_kw or {})
     engine_kw.pop("observer", None)  # observers are per-replica identity
 
     def factory(slot):
-        return ServeEngine(params, config, **engine_kw)
+        engine = ServeEngine(params, config, **engine_kw)
+        if snapshot is not None:
+            snapshot.prime(engine)
+        return engine
 
     oracle = None
     if probe is not None:
@@ -768,4 +799,9 @@ def make_engine_factory(params, config, *, engine_kw=None, probe=None):
             params, jnp.asarray([prompt], jnp.int32), config,
             max_new_tokens=new,
         )[0])]
+    elif (
+        snapshot is not None
+        and getattr(snapshot, "probe_oracle", None) is not None
+    ):
+        oracle = [int(t) for t in snapshot.probe_oracle]
     return factory, oracle
