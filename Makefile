@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check disagg-check ledger-check faststart-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check fmt-check
 
 all: native
 
@@ -51,7 +51,7 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check slo-check disagg-check ledger-check faststart-check test
+check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check test
 
 # Chip-time-ledger tripwires (docs/OBSERVABILITY.md "Chip-time ledger,
 # goodput & postmortems"): one seeded fault run with the ledger and
@@ -107,6 +107,16 @@ spec-superstep-check:
 # tests/test_fleet_trace.py with the slow suite.
 slo-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_fleet_trace.py::test_slo_check_smoke" -q -o addopts=
+
+# KV-page-scheduling tripwires (docs/SERVING.md "Memory as the
+# schedulable unit"): a seeded oversubscribed page-scheduled fleet must
+# spill to the host tier at least once, leak no pages or slots at
+# drain, keep the fleet-ledger busy fraction above the floor, and the
+# published stats snapshot must round-trip into the plugin's scorer.
+# The page_scheduling-randomized fuzz arms ride the slow suite
+# (tests/test_serve_fuzz.py).
+kvsched-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_kvsched.py -q -o addopts=
 
 # KV-cache-hierarchy tripwires (docs/SERVING.md "KV-cache hierarchy"):
 # radix-tree parity vs the flat chain cache on one repeated-prefix

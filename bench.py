@@ -594,6 +594,13 @@ COMPACT_KEYS = [
     # the offload tier's reload tax and the HBM pages it frees.
     "kv_multiturn_speedup", "kv_radix_vs_flat_hit_ratio",
     "kv_offload_reload_ms", "kv_resident_pages_saved",
+    # KV pages as the schedulable unit: page-scheduled vs
+    # replica-scheduled throughput on the oversubscribed multi-tenant
+    # stream (bit-identical tokens), the page arm's busy/goodput
+    # verdict, and the free-page waste it leaves on the table.
+    "kvsched_vs_replica_tokens_per_sec", "kvsched_busy_fraction",
+    "kvsched_goodput_fraction", "kvsched_page_waste_pct",
+    "kvsched_page_dispatches", "kvsched_offload_spills",
     # spec_round_readback_ms travels NEXT TO the spec-serve tok/s in the
     # headline so the link-tax-bound absolute number cannot be misread
     # as the design's ceiling (VERDICT r5 weak #3).

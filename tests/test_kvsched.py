@@ -1,0 +1,386 @@
+"""KV pages as the schedulable unit (tpu_device_plugin/kvsched.py +
+Fleet(page_scheduling=True)): the live-signal snapshot protocol and the
+GetPreferredAllocation scorer built on it.
+
+The pinned contracts: the snapshot is atomic (write-then-rename — a
+reader never sees a torn file) with a monotonically increasing epoch
+that survives publisher restarts; the reader's fallback taxonomy is
+exactly absent/stale/corrupt/ok; and the scorer degrades
+BIT-IDENTICALLY to the static least-shared spread on every fallback —
+the serving fleet is advisory icing on the allocation path, never a
+dependency.  The unit tier here is jax-free; the `make kvsched-check`
+smoke at the bottom drives a real oversubscribed page-scheduled fleet.
+"""
+
+import json
+import os
+
+import pytest
+
+from tpu_device_plugin import kvsched
+from tpu_device_plugin.replica import (
+    AllocationError,
+    prioritize_devices,
+    replica_id,
+)
+
+
+def _snap(tmp_path, name="fleet-stats.json"):
+    return str(tmp_path / name)
+
+
+# ---- snapshot hygiene ----------------------------------------------------
+
+
+def test_write_read_round_trip_filters_to_known_signals(tmp_path):
+    path = _snap(tmp_path)
+    epoch = kvsched.write_stats_snapshot(
+        path,
+        {
+            "tpu-0": {
+                "free_pages": 7,
+                "total_pages": 16,
+                "busy_fraction": 0.25,
+                "future_signal_v9": 42,  # unknown keys must be dropped
+                "not_a_number": "nan-ish",
+            }
+        },
+        now=1000.0,
+    )
+    assert epoch == 0
+    stats, reason = kvsched.read_stats_snapshot(path, now=1000.0)
+    assert reason == "ok"
+    assert stats["__epoch__"] == 0
+    assert stats["tpu-0"] == {
+        "free_pages": 7.0,
+        "total_pages": 16.0,
+        "busy_fraction": 0.25,
+    }
+    # No temp debris left behind by the write-then-rename.
+    assert os.listdir(tmp_path) == ["fleet-stats.json"]
+
+
+def test_epoch_is_monotonic_even_across_publisher_restart(tmp_path):
+    path = _snap(tmp_path)
+    assert kvsched.write_stats_snapshot(path, {}, epoch=5) == 5
+    # A respawned fleet restarts its own counter at zero; the stamped
+    # epoch must still advance past what is on disk.
+    assert kvsched.write_stats_snapshot(path, {}, epoch=0) == 6
+    assert kvsched.write_stats_snapshot(path, {}) == 7
+    with open(path, encoding="utf-8") as f:
+        assert json.load(f)["epoch"] == 7
+
+
+def test_reader_reason_taxonomy(tmp_path):
+    absent = _snap(tmp_path, "never-written.json")
+    assert kvsched.read_stats_snapshot(absent) == (None, "absent")
+    assert kvsched.read_stats_snapshot(None) == (None, "absent")
+
+    path = _snap(tmp_path)
+    for garbage in [
+        "{truncated",
+        json.dumps({"written_at": 1.0, "chips": {}}),  # no epoch
+        json.dumps({"epoch": -3, "written_at": 1.0, "chips": {}}),
+        json.dumps({"epoch": 1, "written_at": 1.0, "chips": [1, 2]}),
+        json.dumps([1, 2, 3]),
+    ]:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(garbage)
+        assert kvsched.read_stats_snapshot(path, now=1.0) == (
+            None,
+            "corrupt",
+        ), garbage
+
+    kvsched.write_stats_snapshot(path, {"tpu-0": {"free_pages": 1}}, now=100.0)
+    ok, reason = kvsched.read_stats_snapshot(path, ttl_secs=10.0, now=109.0)
+    assert reason == "ok" and ok is not None
+    assert kvsched.read_stats_snapshot(path, ttl_secs=10.0, now=110.5) == (
+        None,
+        "stale",
+    )
+    # A clock that runs BACKWARD past the write is also stale-shaped
+    # garbage, not a fresh snapshot.
+    assert kvsched.read_stats_snapshot(
+        path, ttl_secs=10.0, now=float("nan")
+    ) == (None, "stale")
+    # min_epoch: a reader that accepted epoch N refuses a rollback.
+    assert kvsched.read_stats_snapshot(
+        path, now=100.0, min_epoch=0
+    ) == (None, "stale")
+    ok, reason = kvsched.read_stats_snapshot(path, now=100.0, min_epoch=-1)
+    assert reason == "ok" and ok["__epoch__"] == 0
+
+
+def test_reader_never_sees_a_torn_write(tmp_path):
+    """The rename is the commit point: a concurrent reader gets either
+    the previous complete snapshot or the new one."""
+    path = _snap(tmp_path)
+    kvsched.write_stats_snapshot(path, {"tpu-0": {"free_pages": 1}})
+    before = kvsched.load_stats_snapshot(path, ttl_secs=None)
+    kvsched.write_stats_snapshot(path, {"tpu-0": {"free_pages": 2}})
+    after = kvsched.load_stats_snapshot(path, ttl_secs=None)
+    assert before["tpu-0"]["free_pages"] == 1.0
+    assert after["tpu-0"]["free_pages"] == 2.0
+    assert after["__epoch__"] == before["__epoch__"] + 1
+
+
+# ---- the degrade contract ------------------------------------------------
+
+
+def _expand(chips, replicas):
+    return [replica_id(c, i) for c in chips for i in range(replicas)]
+
+
+def test_fallback_is_bit_identical_to_the_static_spread():
+    """score_devices(..., stats=None) IS prioritize_devices — same
+    devices, same uniqueness verdict, same errors, over randomized
+    availability/must-include shapes."""
+    import random
+
+    rng = random.Random(1234)
+    for case in range(300):
+        chips = [f"tpu-{i}" for i in range(rng.randint(1, 5))]
+        pool = _expand(chips, rng.randint(1, 4))
+        available = sorted(rng.sample(pool, rng.randint(1, len(pool))))
+        rng.shuffle(available)
+        must = rng.sample(available, rng.randint(0, min(2, len(available))))
+        if rng.random() < 0.15:
+            must = must + [replica_id("tpu-99", 0)]  # not offered
+        size = rng.randint(max(1, len(must)), len(available) + 2)
+
+        try:
+            want = prioritize_devices(list(available), list(must), size)
+            want_err = None
+        except AllocationError as e:
+            want, want_err = None, str(e)
+        try:
+            got = kvsched.score_devices(list(available), list(must), size, None)
+            got_err = None
+        except AllocationError as e:
+            got, got_err = None, str(e)
+        assert (want, want_err) == (got, got_err), (case, available, must, size)
+
+
+def test_plugin_preferred_for_degrades_bit_identically(tmp_path):
+    """The plugin path pins the same contract one layer up: with the
+    stats file absent, stale, or corrupt, _preferred_for returns
+    exactly the static spread and labels the fallback reason."""
+    from tpu_device_plugin.backend.fake import FakeChipManager
+    from tpu_device_plugin.config import Config, Flags
+    from tpu_device_plugin.device import Unit
+    from tpu_device_plugin.plugin import TpuDevicePlugin
+
+    mgr = FakeChipManager(n_chips=3, chips_per_tray=4)
+    mgr.init()
+    path = _snap(tmp_path)
+    plugin = TpuDevicePlugin(
+        config=Config(flags=Flags(backend="fake", driver_root="/")),
+        resource_name="google.com/shared-tpu",
+        units_fn=lambda: [Unit(id=c.id, chips=[c]) for c in mgr.devices()],
+        chip_manager=mgr,
+        socket_path=str(tmp_path / "shared.sock"),
+        replicas=2,
+        lease_dir=str(tmp_path / "leases"),
+        stats_path=path,
+    )
+    available = _expand(["tpu-0", "tpu-1", "tpu-2"], 2)
+
+    static = prioritize_devices(list(available), [], 2).devices
+    assert plugin._preferred_for(list(available), [], 2) == static  # absent
+
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("{not json")
+    assert plugin._preferred_for(list(available), [], 2) == static  # corrupt
+
+    kvsched.write_stats_snapshot(
+        path, {"tpu-2": {"free_pages": 99, "total_pages": 99}}, now=0.0
+    )
+    assert plugin._preferred_for(list(available), [], 2) == static  # stale
+
+    # Fresh snapshot: the scorer now steers toward the signalled chip.
+    kvsched.write_stats_snapshot(
+        path,
+        {
+            "tpu-0": {"free_pages": 0, "total_pages": 16, "busy_fraction": 1.0},
+            "tpu-1": {"free_pages": 2, "total_pages": 16, "busy_fraction": 0.9},
+            "tpu-2": {"free_pages": 15, "total_pages": 16, "busy_fraction": 0.1},
+        },
+    )
+    scored = plugin._preferred_for(list(available), [], 2)
+    assert replica_id("tpu-2", 0) in scored
+    assert len({d.split("-replica-")[0] for d in scored}) == 2
+
+
+def test_non_shared_no_policy_returns_kubelet_legal_prefix():
+    """S1: a plain exclusive resource with no topology policy answers
+    GetPreferredAllocation with the identity prefix of the offer (the
+    reference returns an empty response) — never an error that would
+    fail pod admission."""
+    from tpu_device_plugin.backend.fake import FakeChipManager
+    from tpu_device_plugin.config import Config, Flags
+    from tpu_device_plugin.device import Unit
+    from tpu_device_plugin.plugin import TpuDevicePlugin
+
+    mgr = FakeChipManager(n_chips=4, chips_per_tray=4)
+    mgr.init()
+    plugin = TpuDevicePlugin(
+        config=Config(flags=Flags(backend="fake", driver_root="/")),
+        resource_name="google.com/tpu",
+        units_fn=lambda: [Unit(id=c.id, chips=[c]) for c in mgr.devices()],
+        chip_manager=mgr,
+        socket_path="/tmp/unused.sock",
+        lease_dir="/tmp/unused-leases",
+    )
+    assert not plugin.shared and plugin._policy is None
+    got = plugin._preferred_for(
+        ["tpu-0", "tpu-1", "tpu-2", "tpu-3"], ["tpu-2"], 2
+    )
+    assert got == ["tpu-2", "tpu-0"]
+    assert plugin._preferred_for(["tpu-0"], [], 3) == ["tpu-0"]
+
+
+# ---- live-signal ranking -------------------------------------------------
+
+
+def test_scorer_prefers_free_idle_goodput_chips():
+    available = _expand(["tpu-0", "tpu-1", "tpu-2"], 2)
+    stats = {
+        "tpu-0": {
+            "free_pages": 1, "total_pages": 16,
+            "busy_fraction": 1.0, "goodput_fraction": 0.2,
+        },
+        "tpu-1": {
+            "free_pages": 14, "total_pages": 16,
+            "busy_fraction": 0.2, "goodput_fraction": 0.9,
+        },
+        "tpu-2": {
+            "free_pages": 8, "total_pages": 16,
+            "busy_fraction": 0.5, "goodput_fraction": 0.9,
+        },
+    }
+    got = kvsched.score_devices(list(available), [], 2, stats)
+    assert got.unique
+    chips = [d.split("-replica-")[0] for d in got.devices]
+    assert set(chips) == {"tpu-1", "tpu-2"}  # the freest two, not tpu-0
+
+
+def test_scorer_keeps_the_static_spread_structure():
+    available = _expand(["tpu-0", "tpu-1"], 2)
+    stats = {"tpu-1": {"free_pages": 9, "total_pages": 9}}
+    # must_include honoured first; a missing must-include raises the
+    # SAME error text as the static path.
+    got = kvsched.score_devices(
+        list(available), [replica_id("tpu-0", 1)], 2, stats
+    )
+    assert replica_id("tpu-0", 1) in got.devices and got.unique
+    with pytest.raises(AllocationError, match="mustIncludeDeviceIDs"):
+        kvsched.score_devices(
+            list(available), [replica_id("tpu-9", 0)], 2, stats
+        )
+    with pytest.raises(AllocationError, match="no devices left"):
+        kvsched.score_devices(list(available), [], 5, stats)
+    # Requesting more than the unique chips marks non-unique, like the
+    # static spread does.
+    assert not kvsched.score_devices(list(available), [], 3, stats).unique
+
+
+def test_chips_absent_from_snapshot_score_zero_not_crash():
+    available = _expand(["tpu-0", "tpu-1"], 1)
+    stats = {"tpu-1": {"free_pages": 1, "total_pages": 4}}
+    got = kvsched.score_devices(list(available), [], 1, stats)
+    assert got.devices == [replica_id("tpu-1", 0)]
+
+
+# ---- the `make kvsched-check` smoke --------------------------------------
+
+
+def test_kvsched_check_smoke(tmp_path):
+    """Seeded oversubscribed multi-tenant stream on a page-scheduled
+    fleet: every request served, at least one host-tier offload spill,
+    no page/slot leak at drain, the fleet-ledger busy fraction above
+    the floor, and the published stats snapshot round-trips into the
+    device plugin's live-signal scorer."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from workloads.fleet import DEAD, Fleet
+    from workloads.ledger import ChipTimeLedger, FleetLedger
+    from workloads.model import ModelConfig, init_params
+    from workloads.serve import ServeEngine
+
+    config = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+    params = init_params(config, jax.random.PRNGKey(0))
+    ps, batch = 4, 2
+    # A pool tight enough that tenant prefixes must spill to the host
+    # tier under the oversubscribed stream (the kvcache-check recipe).
+    n_pages = 12
+
+    def engine():
+        return ServeEngine(
+            params, config, slots=batch, page_size=ps, prompt_bucket=8,
+            n_pages=n_pages, prefix_cache=True, kv_offload=True,
+            kv_host_pages=8 * n_pages, ledger=ChipTimeLedger(),
+        )
+
+    stats_path = str(tmp_path / "fleet-stats.json")
+    fleet_ledger = FleetLedger()
+    fleet = Fleet(
+        [engine(), engine()],
+        chip_ids=["chip-0", "chip-1"],
+        hang_timeout_s=None,
+        page_scheduling=True,
+        stats_path=stats_path,
+        ledger=fleet_ledger,
+    )
+    rng = np.random.default_rng(7)
+    prefixes = {
+        t: [int(x) for x in rng.integers(0, config.vocab_size, 2 * ps)]
+        for t in range(3)
+    }
+    reqs = []
+    for i in range(12):
+        tenant = i % 3
+        tail = [int(x) for x in rng.integers(0, config.vocab_size, 1 + i % 5)]
+        reqs.append((prefixes[tenant] + tail, 2 + i % 6, tenant))
+    rids = [
+        fleet.submit(p, n, session=f"tenant-{t}") for p, n, t in reqs
+    ]
+    served = fleet.run()
+    assert sorted(served) == sorted(rids)
+    assert fleet.requests_ok == len(reqs)
+    assert fleet.page_dispatches > 0
+
+    # The oversubscription actually bit: the radix tier spilled.
+    spills = sum(int(r.engine.prefix.spills) for r in fleet.replicas)
+    assert spills >= 1, "pool was not tight enough to force an offload"
+
+    # Chip time was spent working, not idling the oversubscribed queue.
+    assert fleet_ledger.snapshot()["busy_fraction"] >= 0.5
+    assert fleet_ledger.goodput_fraction >= 0.99
+
+    # No page/slot leaks at drain (prefix-pinned pages are not leaks).
+    for rep in fleet.replicas:
+        if rep.state == DEAD:
+            continue
+        e = rep.engine
+        assert not e._occupied.any(), rep.index
+        assert e._committed_pages == 0, rep.index
+        pinned = e.prefix.cached_pages if e.prefix is not None else 0
+        assert e.ctrl.used_pages == pinned, rep.index
+        assert not rep.rids, rep.index
+
+    # publish -> plugin scorer round trip: the snapshot the fleet just
+    # wrote is fresh, epoch-stamped, and steers score_devices.
+    assert fleet.publish_stats() == stats_path
+    assert fleet.stats_published >= 1
+    stats, reason = kvsched.read_stats_snapshot(stats_path)
+    assert reason == "ok"
+    assert set(stats) >= {"chip-0", "chip-1"}
+    for cid in ("chip-0", "chip-1"):
+        assert stats[cid]["total_pages"] == float(n_pages)
+        assert 0.0 <= stats[cid]["busy_fraction"] <= 1.0
+    available = _expand(["chip-0", "chip-1"], 2)
+    got = kvsched.score_devices(list(available), [], 2, stats)
+    assert len({d.split("-replica-")[0] for d in got.devices}) == 2
+    fleet.close()

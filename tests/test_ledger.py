@@ -362,6 +362,45 @@ def test_fleet_failover_ledger_reconciles(models):
     fleet.close()
 
 
+def test_fleet_out_of_step_cancel_keeps_token_identity(models):
+    """Regression: cancelling a running rid between fleet steps drains
+    the engine's pipelined in-flight chunks, emitting tokens (for
+    co-batched rows too) OUTSIDE step()'s delta window — the fleet must
+    fold that emission into `generated_tokens` or the ledger's
+    emitted-token base undercounts and quiescent reconciliation goes
+    negative-pending."""
+    params, _ = models
+    led = FleetLedger()
+    engine = _engine(
+        params, pipelined=True, ledger=ChipTimeLedger(name="0"),
+    )
+    fleet = Fleet(
+        [engine], chip_ids=["chip-0"], hang_timeout_s=None, ledger=led,
+    )
+    keep = fleet.submit([1, 2, 3], 12)
+    drop = fleet.submit([4, 5], 12)
+    while len(fleet._reqs[keep].tokens) + sum(
+        len(r.tokens) for r in engine._slot_req.values()
+    ) < 2:
+        fleet.step()
+    g0 = engine.generated_tokens
+    assert fleet.cancel(drop)
+    # The pipelined drain inside cancel() emitted for the co-batched
+    # row — exactly the out-of-window emission this test pins.
+    assert engine.generated_tokens > g0
+    out = fleet.run()
+    assert list(out[keep]) == _oracle(params, [1, 2, 3], 12)
+    assert list(out[drop]) == _oracle(params, [4, 5], 12)[: len(out[drop])]
+    assert fleet.generated_tokens == engine.generated_tokens
+    verdict = led.reconcile(expect_quiescent=True)
+    assert verdict["ok"], verdict
+    snap = led.snapshot()
+    assert snap["goodput_tokens"] == sum(
+        len(r.tokens) for r in fleet.completed if r.status == "ok"
+    )
+    fleet.close()
+
+
 # ---- flight recorder / postmortem --------------------------------------
 
 

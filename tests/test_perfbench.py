@@ -231,6 +231,16 @@ def test_perfbench_tiny_end_to_end():
         "kv_offload_spills",
         "kv_offload_reloads",
         "kv_resident_pages_saved",
+        # KV-page scheduling arm (docs/SERVING.md "Memory as the
+        # schedulable unit").
+        "kvsched_vs_replica_tokens_per_sec",
+        "kvsched_vs_replica_tokens_per_sec_min",
+        "kvsched_vs_replica_tokens_per_sec_max",
+        "kvsched_busy_fraction",
+        "kvsched_goodput_fraction",
+        "kvsched_page_waste_pct",
+        "kvsched_page_dispatches",
+        "kvsched_offload_spills",
         # Cross-run-poolable ratio spreads.
         "paged_vs_contiguous_decode_samples",
         "paged_vs_contiguous_decode_min",
@@ -249,6 +259,15 @@ def test_perfbench_tiny_end_to_end():
     assert out["kv_offload_reloads"] >= 1
     assert out["kv_offload_reload_ms"] > 0
     assert out["kv_radix_hit_pages"] >= out["kv_flat_hit_pages"]
+    # KV-page scheduling: the page arm stayed busy on useful work,
+    # costed its dispatches in pages, and the tight pools spilled
+    # (streams asserted bit-identical to the replica arm inside the
+    # arm itself).
+    assert 0.0 < out["kvsched_busy_fraction"] <= 1.0
+    assert 0.0 < out["kvsched_goodput_fraction"] <= 1.0
+    assert out["kvsched_page_dispatches"] > 0
+    assert out["kvsched_offload_spills"] >= 1
+    assert 0.0 <= out["kvsched_page_waste_pct"] <= 100.0
     assert out["fleet_replicas"] == 4
     assert out["fleet_tokens_per_sec"] > 0
     assert out["failover_recovery_ms"] > 0

@@ -233,6 +233,27 @@ def test_auto_replicas_one_per_gib(kubelet, tmp_path):
         plugin.stop()
 
 
+def test_auto_replicas_kv_pages_per_chip(kubelet, tmp_path):
+    mgr = FakeChipManager(n_chips=1, chips_per_tray=4, hbm_gib=16)
+    mgr.init()
+    plugin = make_plugin(
+        kubelet,
+        mgr,
+        str(tmp_path / "leases"),
+        replicas=1,
+        auto_replicas=True,
+        kv_page_bytes=4 << 30,
+    )
+    plugin.start()
+    try:
+        stub = kubelet.plugin_client("tpu.sock")
+        resp = first_response(stub.ListAndWatch(pb.Empty()))
+        # 16 GiB HBM / 4 GiB per KV page -> 4 replicas, not 16.
+        assert len(resp.devices) == 4
+    finally:
+        plugin.stop()
+
+
 def test_policy_path_preferred_allocation(kubelet, backend, tmp_path):
     plugin = make_plugin(
         kubelet,

@@ -2,7 +2,11 @@
 
 import pytest
 
-from tpu_device_plugin.resource_config import Variant, parse_resource_config
+from tpu_device_plugin.resource_config import (
+    Variant,
+    parse_resource_config,
+    parse_size_bytes,
+)
 
 
 def test_basic_entry():
@@ -44,3 +48,61 @@ def test_rename_without_sharing():
     rc = parse_resource_config("tpu:renamed:1")
     v = rc.get("tpu")
     assert v.name == "renamed" and not v.shared
+
+
+# ---- KV-page units (the optional fourth field) ---------------------------
+
+
+def test_auto_replicas_with_kv_page_size():
+    rc = parse_resource_config("tpu:tpu-kv-pages:-1:16Mi")
+    v = rc.get("tpu")
+    assert v == Variant(
+        name="tpu-kv-pages",
+        replicas=1,
+        auto_replicas=True,
+        kv_page_bytes=16 << 20,
+    )
+    assert v.shared
+
+
+def test_kv_page_size_defaults_to_none_in_plain_auto_mode():
+    assert parse_resource_config("tpu:x:-1").get("tpu").kv_page_bytes is None
+
+
+@pytest.mark.parametrize(
+    ("text", "expect"),
+    [
+        ("512", 512),
+        ("4Ki", 4 << 10),
+        ("16Mi", 16 << 20),
+        ("2Gi", 2 << 30),
+        (" 1Gi ", 1 << 30),
+    ],
+)
+def test_parse_size_bytes(text, expect):
+    assert parse_size_bytes(text) == expect
+
+
+@pytest.mark.parametrize("bad", ["", "Mi", "1.5Gi", "16MB", "0", "-4Ki"])
+def test_parse_size_bytes_rejects(bad):
+    with pytest.raises(ValueError, match="size"):
+        parse_size_bytes(bad)
+
+
+def test_page_size_requires_auto_mode():
+    with pytest.raises(ValueError, match="only .*valid with replicas = -1"):
+        parse_resource_config("tpu:x:4:16Mi")
+
+
+def test_bad_page_size_names_the_entry():
+    with pytest.raises(
+        ValueError, match="resource-config entry 'tpu:x:-1:huge'"
+    ):
+        parse_resource_config("tpu:x:-1:huge")
+
+
+def test_kv_page_entry_round_trips_next_to_legacy_entries():
+    rc = parse_resource_config("tpu:legacy:-1, tray:paged:-1:4Ki, t2:shared:2")
+    assert rc.get("tpu").kv_page_bytes is None
+    assert rc.get("tray").kv_page_bytes == 4 << 10
+    assert rc.get("t2") == Variant(name="shared", replicas=2)

@@ -498,6 +498,9 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
         max_pending=int(rng.choice([4, 32])),
         roles=roles,
         ledger=fleet_ledger,
+        # Page-granular dispatch on half the seeds: placement may move,
+        # tokens must not (the kvsched degrade contract under chaos).
+        page_scheduling=bool(rng.integers(2)),
     )
     names = [None] + (sorted(adapters) if use_adapters else [])
     expected = {}
@@ -669,6 +672,9 @@ def _run_supervised_chaos(seed: int, params, adapters) -> None:
         fault_injector=fleet_inj, max_failovers=2,
         hang_timeout_s=None,
         max_pending_per_replica=int(rng.choice([3, 16])),
+        # Page-granular dispatch on half the seeds: supervised
+        # resurrection must stay stream-invariant either way.
+        page_scheduling=bool(rng.integers(2)),
     )
     # Fast-start snapshot on half the seeds: the factory primes every
     # resurrection with warmed state captured from replica 0 (same

@@ -80,6 +80,15 @@ TRACKED_UP = [
     "selfheal_capacity_recovered",
     "aggregate_chip_busy_fraction",
     "aggregate_tokens_per_sec",
+    # KV pages as the schedulable unit: the page-scheduled /
+    # replica-scheduled throughput ratio on the oversubscribed
+    # multi-tenant stream (streams bit-identical by construction, so a
+    # drop is pure scheduling regression), and the page arm's
+    # fleet-ledger busy/goodput verdict (the ROADMAP's >= 0.99 busy
+    # target under oversubscription).
+    "kvsched_vs_replica_tokens_per_sec",
+    "kvsched_busy_fraction",
+    "kvsched_goodput_fraction",
 ]
 
 # Lower-is-better serving guardrails (the chunked-prefill PR's SLO
@@ -144,6 +153,11 @@ TRACKED_DOWN = [
     # re-running calibration or re-compiling what the caches should
     # replay.
     "faststart_cache_hit_spawn_ms",
+    # KV pages as the schedulable unit: HBM pages sitting free while
+    # work was pending under page scheduling — a rise means the
+    # page-granular dispatcher started stranding the capacity it
+    # exists to spend.
+    "kvsched_page_waste_pct",
 ]
 
 # The serving keys whose thresholds derive from the artifact's own

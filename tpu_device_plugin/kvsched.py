@@ -1,0 +1,255 @@
+"""Live-signal KV-page allocation scoring for ``GetPreferredAllocation``.
+
+The static spread in :mod:`tpu_device_plugin.replica` sees only replica
+counts; the serving fleet meanwhile knows exactly how busy each chip's
+time-slice really is — per-replica goodput/busy fractions from the
+chip-time ledger (workloads/ledger.py), radix-tree occupancy and
+free-page headroom from the paged KV cache (workloads/paged.py), and
+host-tier offload headroom.  This module is the bridge: the fleet
+publishes those signals to a host-local JSON snapshot (same host-dir
+pattern as the claim-lease machinery in ``sharing.py``), and the plugin's
+preferred-allocation path ranks candidate replicas by them.
+
+Contracts, in order of importance:
+
+  * **Bit-identical degrade** — with no snapshot, a stale snapshot, or a
+    corrupt one, :func:`score_devices` returns EXACTLY what
+    ``prioritize_devices`` returns.  The scorer is advisory icing; the
+    admission path must never depend on the fleet having run.
+  * **Atomic + monotonic** — the writer writes a temp file in the same
+    directory and ``os.replace``s it (readers never observe a torn
+    write), and stamps a monotonically increasing ``epoch`` (the
+    claim-epoch discipline of ``sharing.CLAIM_EPOCH_ENV``): a reader
+    that has seen epoch N treats any snapshot with epoch <= its last
+    seen as stale, so a crashed-and-restarted publisher cannot roll the
+    scorer back onto old signals.
+  * **Pure in-memory scoring** — one ``open()`` + ``json.loads`` per
+    call, no RPCs, no directory walks: ``GetPreferredAllocation`` p50
+    stays on the Allocate path's latency budget.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .replica import Prioritized, prioritize_devices, strip_replica
+
+# The snapshot lives next to the cooperative lease files — one host dir
+# that shared pods and the daemon already bind-mount.
+STATS_FILENAME = "fleet-stats.json"
+# A snapshot older than this is ignored (the fleet republishes every few
+# steps; a dead fleet must not steer allocations forever).
+STATS_TTL_SECS = 10.0
+# Signals the scorer understands; unknown keys are ignored so publisher
+# and scorer can rev independently.
+SIGNAL_KEYS = (
+    "goodput_fraction",
+    "busy_fraction",
+    "free_pages",
+    "total_pages",
+    "host_free_pages",
+    "radix_resident_pages",
+)
+
+
+def default_stats_path(lease_dir: str) -> str:
+    return os.path.join(lease_dir, STATS_FILENAME)
+
+
+def write_stats_snapshot(
+    path: str,
+    chips: dict,
+    *,
+    epoch: int | None = None,
+    now: float | None = None,
+) -> int:
+    """Atomically publish per-chip live signals to ``path``.
+
+    ``chips`` maps chip id -> {signal: number}.  Returns the epoch
+    actually stamped: max(previous epoch + 1, ``epoch``) — monotonic
+    even when the caller's own counter restarted from zero (fleet
+    respawn), mirroring the per-allocation claim-epoch discipline.
+    Write-then-rename in the snapshot's own directory, so a reader
+    either sees the old complete file or the new complete file, never
+    a prefix.
+    """
+    prev = -1
+    try:
+        with open(path, encoding="utf-8") as f:
+            prev_doc = json.load(f)
+        prev = int(prev_doc.get("epoch", -1))
+    except (OSError, ValueError, TypeError, AttributeError):
+        prev = -1
+    stamped = max(prev + 1, int(epoch) if epoch is not None else 0)
+    doc = {
+        "epoch": stamped,
+        "written_at": float(time.time() if now is None else now),
+        "chips": {
+            str(cid): {
+                k: float(v)
+                for k, v in signals.items()
+                if isinstance(v, (int, float))
+            }
+            for cid, signals in chips.items()
+        },
+    }
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    tmp = os.path.join(directory, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, separators=(",", ":"))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return stamped
+
+
+def read_stats_snapshot(
+    path: str | None,
+    *,
+    ttl_secs: float = STATS_TTL_SECS,
+    now: float | None = None,
+    min_epoch: int | None = None,
+) -> tuple[dict | None, str]:
+    """One file read -> (per-chip signals, reason).
+
+    Reason is ``"ok"`` with a dict, else one of ``"absent"`` /
+    ``"stale"`` / ``"corrupt"`` with None — the fallback taxonomy the
+    plugin's ``preferred_fallback_total`` counter labels.  ``min_epoch``
+    rejects (as stale) any snapshot not strictly newer than the last
+    epoch the caller accepted.
+    """
+    if not path:
+        return None, "absent"
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = f.read()
+    except OSError:
+        return None, "absent"
+    try:
+        doc = json.loads(raw)
+        epoch = int(doc["epoch"])
+        written = float(doc["written_at"])
+        chips = doc["chips"]
+        if epoch < 0 or not isinstance(chips, dict):
+            raise ValueError("malformed snapshot")
+        parsed = {
+            str(cid): {
+                k: float(v)
+                for k, v in sig.items()
+                if k in SIGNAL_KEYS and isinstance(v, (int, float))
+            }
+            for cid, sig in chips.items()
+            if isinstance(sig, dict)
+        }
+    except (ValueError, TypeError, KeyError):
+        return None, "corrupt"
+    t = time.time() if now is None else now
+    if ttl_secs is not None and not (t - written <= ttl_secs):
+        return None, "stale"
+    if min_epoch is not None and epoch <= min_epoch:
+        return None, "stale"
+    parsed["__epoch__"] = epoch  # type: ignore[assignment]
+    return parsed, "ok"
+
+
+def load_stats_snapshot(
+    path: str | None,
+    *,
+    ttl_secs: float = STATS_TTL_SECS,
+    now: float | None = None,
+) -> dict | None:
+    """Convenience wrapper: the signals dict, or None on any fallback."""
+    return read_stats_snapshot(path, ttl_secs=ttl_secs, now=now)[0]
+
+
+def _chip_score(signals: dict) -> float:
+    """Higher = a better home for a new replica.  Free-page headroom is
+    the primary currency (the unit the engine actually allocates);
+    goodput and idle fraction break capacity ties toward chips whose
+    time-slices are doing useful work with room to spare; host-tier
+    headroom is the oversubscription relief valve.  Weights are
+    deliberately coarse — ORDERING is what GetPreferredAllocation
+    ships, and every input is already a [0, 1] fraction."""
+    total = max(signals.get("total_pages", 0.0), 1.0)
+    free_frac = max(0.0, min(1.0, signals.get("free_pages", 0.0) / total))
+    host_frac = max(
+        0.0, min(1.0, signals.get("host_free_pages", 0.0) / total)
+    )
+    goodput = max(0.0, min(1.0, signals.get("goodput_fraction", 0.0)))
+    idle = 1.0 - max(0.0, min(1.0, signals.get("busy_fraction", 1.0)))
+    return 4.0 * free_frac + 2.0 * idle + 1.0 * goodput + 0.5 * host_frac
+
+
+def score_devices(
+    available: list[str],
+    must_include: list[str],
+    allocation_size: int,
+    stats: dict | None,
+) -> Prioritized:
+    """Pick ``allocation_size`` replica IDs, live-signal ranked.
+
+    With ``stats`` None the result is bit-identical to
+    ``prioritize_devices`` (the pinned degrade contract).  With signals,
+    the selection keeps the static spread's structure — must_include
+    honoured first, unique physical chips preferred, deterministic
+    lexicographic tie-breaks, ``AllocationError`` on infeasible — but
+    ranks candidate chips by :func:`_chip_score` before the
+    least-shared replica count.  Chips absent from the snapshot score
+    0.0, so a partially-covered fleet degrades per-chip, not
+    wholesale.
+    """
+    if stats is None:
+        return prioritize_devices(available, must_include, allocation_size)
+
+    free: dict[str, list[str]] = {}
+    for rid in available:
+        free.setdefault(strip_replica(rid), []).append(rid)
+    for replicas in free.values():
+        replicas.sort()
+    used_chips: set[str] = set()
+    allocated: list[str] = []
+    unique = True
+
+    for rid in must_include:
+        chip = strip_replica(rid)
+        replicas = free.get(chip)
+        if replicas is None or rid not in replicas:
+            # Same failure text as the static path: the kubelet sees
+            # one error contract regardless of which brain answered.
+            from .replica import AllocationError
+
+            raise AllocationError(
+                f"device '{rid}' in mustIncludeDeviceIDs is missing "
+                f"from availableDeviceIDs"
+            )
+        if chip in used_chips:
+            unique = False
+        replicas.remove(rid)
+        used_chips.add(chip)
+        allocated.append(rid)
+
+    def rank(chip: str) -> tuple:
+        # max() keeps the FIRST maximum over sorted chips, so equal
+        # scores AND equal free-replica counts break lexicographically
+        # — the same determinism contract as the static spread.
+        return (_chip_score(stats.get(chip, {})), len(free[chip]))
+
+    for _ in range(len(allocated), allocation_size):
+        candidates = [
+            c for c in sorted(free) if free[c] and c not in used_chips
+        ]
+        if not candidates:
+            candidates = [c for c in sorted(free) if free[c]]
+            if not candidates:
+                from .replica import AllocationError
+
+                raise AllocationError("no devices left to allocate")
+            unique = False
+        chip = max(candidates, key=rank)
+        allocated.append(free[chip].pop(0))
+        used_chips.add(chip)
+
+    return Prioritized(devices=sorted(allocated), unique=unique)

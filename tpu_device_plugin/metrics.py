@@ -222,6 +222,14 @@ registry = Registry()
 registry.describe("allocations_total", "Allocate container requests served")
 registry.describe("allocation_errors_total", "Allocate requests rejected")
 registry.describe("preferred_allocations_total", "GetPreferredAllocation container requests served")
+registry.describe(
+    "preferred_scored_total",
+    "preferred allocations ranked by a fresh live-signal fleet snapshot",
+)
+registry.describe(
+    "preferred_fallback_total",
+    "preferred allocations that fell back to the static spread, by reason",
+)
 registry.describe("health_events_total", "chip health transitions observed")
 registry.describe("plugin_restarts_total", "plugin serve-cycle restarts")
 registry.describe("allocate_seconds", "Allocate handler latency histogram")

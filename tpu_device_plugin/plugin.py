@@ -24,7 +24,7 @@ from typing import Callable
 
 import grpc
 
-from . import sharing
+from . import kvsched, sharing
 from .allocator import Policy, PolicyError
 from .api import constants, pb, rpc
 from .backend import ChipManager
@@ -37,7 +37,7 @@ from .config import (
 from .device import Chip, HealthEvent, Unit
 from .metrics import registry as metrics_registry
 from .metrics import timed as metrics_timed
-from .replica import AllocationError, prioritize_devices, replica_id, strip_replicas
+from .replica import AllocationError, replica_id, strip_replicas
 
 log = logging.getLogger(__name__)
 
@@ -267,6 +267,9 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
         on_fatal: Callable[[str], None] | None = None,
         lease_dir: str = sharing.DEFAULT_LEASE_DIR,
         health_fanout=None,
+        kv_page_bytes: int | None = None,
+        stats_path: str | None = None,
+        stats_ttl_secs: float = kvsched.STATS_TTL_SECS,
     ):
         self.config = config
         self.resource_name = resource_name
@@ -277,10 +280,20 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
         self._policy = allocate_policy
         self.replicas = replicas
         self.auto_replicas = auto_replicas
+        self.kv_page_bytes = kv_page_bytes
         self._kubelet_socket = kubelet_socket or constants.KUBELET_SOCKET
         self._claims = claims
         self._on_fatal = on_fatal or (lambda msg: None)
         self._lease_dir = lease_dir
+        # Live-signal scorer inputs: where the fleet publishes its stats
+        # snapshot, and how old a snapshot may be before the scorer falls
+        # back to the static spread.
+        self._stats_path = (
+            stats_path
+            if stats_path is not None
+            else kvsched.default_stats_path(lease_dir)
+        )
+        self._stats_ttl_secs = stats_ttl_secs
         if health_fanout is None:
             from .health import HealthFanout
 
@@ -320,9 +333,15 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
             if self.shared:
                 n = self.replicas
                 if self.auto_replicas:
-                    # One replica per GiB of HBM: memory as the schedulable
-                    # unit (reference: server.go:100-103, 1 per ~GB).
-                    n = max(unit.hbm_bytes >> 30, 1)
+                    if self.kv_page_bytes:
+                        # KV pages per chip: the unit the serving engine
+                        # actually allocates (PagedAttention lineage).
+                        n = max(unit.hbm_bytes // self.kv_page_bytes, 1)
+                    else:
+                        # One replica per GiB of HBM: memory as the
+                        # schedulable unit (reference: server.go:100-103,
+                        # 1 per ~GB).
+                        n = max(unit.hbm_bytes >> 30, 1)
                 log.info(
                     "replicating unit %s of %s %d times", unit.id, self.resource_name, n
                 )
@@ -590,7 +609,21 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
         self, available: list[str], must_include: list[str], size: int
     ) -> list[str]:
         if self.shared:
-            result = prioritize_devices(available, must_include, size)
+            # One file read, no RPCs: the fleet's host-local stats snapshot
+            # (when fresh) ranks chips by live free-page / goodput signals;
+            # absent, stale, or corrupt degrades BIT-IDENTICALLY to the
+            # static least-shared spread.
+            stats, reason = kvsched.read_stats_snapshot(
+                self._stats_path, ttl_secs=self._stats_ttl_secs
+            )
+            labels = {"resource": self.resource_name}
+            if stats is not None:
+                metrics_registry.inc("preferred_scored_total", labels)
+            else:
+                metrics_registry.inc(
+                    "preferred_fallback_total", {**labels, "reason": reason}
+                )
+            result = kvsched.score_devices(available, must_include, size, stats)
             if not result.unique:
                 # Non-unique is sub-optimal but legal (reference: server.go:288-295).
                 log.warning(
@@ -603,9 +636,17 @@ class TpuDevicePlugin(rpc.DevicePluginServicer):
             return self._policy.allocate(
                 strip_replicas(available), strip_replicas(must_include), size
             )
-        raise NotImplementedError(
-            "GetPreferredAllocation() not implemented for this resource"
-        )
+        # No spreading brain and no topology policy: return the kubelet-legal
+        # empty-intersection preference (the identity prefix of what the
+        # kubelet offered) instead of erroring the admission path
+        # (reference: server.go:268-271 returns an empty response).
+        preferred = list(must_include)
+        for device in available:
+            if len(preferred) >= size:
+                break
+            if device not in preferred:
+                preferred.append(device)
+        return preferred[:size]
 
     def Allocate(self, request, context):  # noqa: N802
         """Pure in-memory response construction — no backend calls, keeping

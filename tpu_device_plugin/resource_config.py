@@ -2,8 +2,11 @@
 
 Format: comma-separated entries ``<orig-name>:<new-name>:<replicas>``, e.g.
 ``tpu:shared-tpu:4`` advertises every physical chip 4 times under the renamed
-resource ``google.com/shared-tpu``.  ``replicas = -1`` means *auto*: one
-replica per GiB of chip HBM, exposing TPU memory as the schedulable unit.
+resource ``google.com/shared-tpu``.  ``replicas = -1`` means *auto*: the chip's
+HBM is advertised as the schedulable unit.  Auto mode accepts an optional
+fourth field giving the KV-page size (``tpu:tpu-kv-pages:-1:16Mi``): replicas
+are then derived as *KV pages per chip* — the unit the serving engine actually
+allocates — instead of the legacy one-replica-per-GiB heuristic.
 
 Reference semantics: cmd/nvidia-device-plugin/main.go:171-203 (parsing) and
 mig-strategy.go:58-76 (per-resource lookup with identity fallback).
@@ -13,6 +16,28 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+_SIZE_SUFFIXES = {"Ki": 1 << 10, "Mi": 1 << 20, "Gi": 1 << 30}
+
+
+def parse_size_bytes(text: str) -> int:
+    """``"16Mi"`` -> 16777216.  Accepts Ki/Mi/Gi suffixes or raw bytes."""
+    text = text.strip()
+    for suffix, scale in _SIZE_SUFFIXES.items():
+        if text.endswith(suffix):
+            number = text[: -len(suffix)]
+            break
+    else:
+        number, scale = text, 1
+    try:
+        value = int(number)
+    except ValueError:
+        raise ValueError(
+            f"size {text!r} must be an integer with an optional Ki/Mi/Gi suffix"
+        ) from None
+    if value <= 0:
+        raise ValueError(f"size {text!r} must be positive")
+    return value * scale
+
 
 @dataclass(frozen=True)
 class Variant:
@@ -21,6 +46,9 @@ class Variant:
     name: str
     replicas: int = 0
     auto_replicas: bool = False
+    # Auto mode only: bytes of HBM backing one advertised replica (one KV
+    # page).  None keeps the legacy one-replica-per-GiB derivation.
+    kv_page_bytes: int | None = None
 
     @property
     def shared(self) -> bool:
@@ -43,7 +71,7 @@ class ResourceConfig(dict):
 
 
 def parse_resource_config(text: str) -> ResourceConfig:
-    """Parse ``orig:new:replicas[,orig:new:replicas...]``.
+    """Parse ``orig:new:replicas[:page-size][,...]``.
 
     Raises ValueError on malformed entries.
     """
@@ -53,19 +81,37 @@ def parse_resource_config(text: str) -> ResourceConfig:
         if not entry:
             continue
         parts = entry.split(":")
-        if len(parts) != 3:
+        if len(parts) not in (3, 4):
             raise ValueError(
                 f"resource-config entry {entry!r} must have three ':'-separated parts"
             )
-        orig, new, replicas_text = parts
+        orig, new, replicas_text = parts[:3]
         try:
             replicas = int(replicas_text)
         except ValueError:
             raise ValueError(
                 f"resource-config entry {entry!r}: replicas must be an integer"
             ) from None
+        kv_page_bytes = None
+        if len(parts) == 4:
+            if replicas != -1:
+                raise ValueError(
+                    f"resource-config entry {entry!r}: a page size is only "
+                    f"valid with replicas = -1 (auto mode)"
+                )
+            try:
+                kv_page_bytes = parse_size_bytes(parts[3])
+            except ValueError as exc:
+                raise ValueError(
+                    f"resource-config entry {entry!r}: {exc}"
+                ) from None
         if replicas == -1:
-            config[orig] = Variant(name=new, replicas=1, auto_replicas=True)
+            config[orig] = Variant(
+                name=new,
+                replicas=1,
+                auto_replicas=True,
+                kv_page_bytes=kv_page_bytes,
+            )
         elif replicas < 0:
             raise ValueError(
                 f"resource-config entry {entry!r}: replicas must be >= -1"

@@ -187,6 +187,7 @@ class TopologyStrategy(ABC):
             on_fatal=self.on_fatal,
             lease_dir=self.lease_dir,
             health_fanout=self.health_fanout,
+            kv_page_bytes=rc.kv_page_bytes,
         )
 
 

@@ -118,6 +118,12 @@ class AutoscaleSignals:
     breach: bool  # scale-up territory
     clear: bool  # scale-down territory (strictly below the breach band)
     severe: bool  # ladder step-2 territory
+    # Aggregate free KV pages / total pages across live replicas (None
+    # when the fleet exposes no page pools or the watermark is off) —
+    # page capacity as a FLUID autoscale input: snapshot-primed fast
+    # start makes adding a replica cheap, so running low on pages is
+    # itself scale-up territory (page_low_watermark=).
+    free_page_fraction: float | None = None
 
 
 class FleetAutoscaler:
@@ -154,6 +160,7 @@ class FleetAutoscaler:
         brownout_factor: float = 0.5,
         preempt_class: str = "bulk",
         preempt_batch: int = 2,
+        page_low_watermark: float | None = None,
         probe: tuple[list[int], int] = ([1, 2, 3], 4),
         probe_oracle: list[int] | None = None,
         probe_max_steps: int = 400,
@@ -205,6 +212,13 @@ class FleetAutoscaler:
             raise ValueError(
                 f"preempt_batch must be >= 1, got {preempt_batch}"
             )
+        if page_low_watermark is not None and not (
+            0.0 < page_low_watermark < 1.0
+        ):
+            raise ValueError(
+                f"page_low_watermark must be in (0, 1) or None (off), "
+                f"got {page_low_watermark}"
+            )
         prompt, new = probe
         if not prompt or new < 1:
             raise ValueError(
@@ -226,6 +240,10 @@ class FleetAutoscaler:
         self.clear_fraction = float(clear_fraction)
         self.severe_factor = float(severe_factor)
         self.window_s = float(window_s)
+        self.page_low_watermark = (
+            None if page_low_watermark is None
+            else float(page_low_watermark)
+        )
         # Separate up/down hysteresis from the shared backoff policy:
         # derive() decorrelates the jitter per direction, consecutive
         # spawn failures escalate the up-gate, repeated downs space out.
@@ -403,6 +421,29 @@ class FleetAutoscaler:
                         r.load_requests() - getattr(r.engine, "slots", 0),
                     )
             dispatchable = max(1, fleet.dispatchable_count)
+            # Page capacity as a fluid signal (page_low_watermark=):
+            # the fraction of the fleet's KV pages still free, host
+            # tier included.  Low headroom means admission is about to
+            # tighten (the page-aware bound) — with snapshot-primed
+            # fast start a new replica is cheap page capacity, so the
+            # watermark opens the breach before queue wait does.
+            page_frac = None
+            if self.page_low_watermark is not None:
+                free = total = 0
+                for r in fleet.replicas:
+                    if not r.dispatchable:
+                        continue
+                    rep_free = r.free_pages()
+                    if rep_free is None:
+                        continue
+                    # Host-tier headroom counts toward FREE (spilling
+                    # cold pages relieves HBM pressure) but not toward
+                    # the denominator — clamped, so an oversized host
+                    # tier reads as "fully free", never more.
+                    free += rep_free + r.host_free_pages()
+                    total += r.total_pages() or 0
+                if total > 0:
+                    page_frac = min(1.0, free / total)
         depth_per = depth / dispatchable
         burn = 0.0
         for name, rate in fleet.slo_burn_rates().items():
@@ -410,10 +451,15 @@ class FleetAutoscaler:
                 continue  # the class the ladder sacrifices is not input
             burn = max(burn, rate)
         target = self.queue_wait_p99_target_s
+        wm = self.page_low_watermark
+        page_low = (
+            wm is not None and page_frac is not None and page_frac < wm
+        )
         breach = (
             (qw_p99 is not None and qw_p99 > target)
             or depth_per > self.depth_high
             or burn > self.burn_high
+            or page_low
         )
         frac = self.clear_fraction
         clear = (
@@ -421,16 +467,26 @@ class FleetAutoscaler:
             and (qw_p99 is None or qw_p99 <= target * frac)
             and depth_per <= self.depth_high * frac
             and burn <= self.burn_high * frac
+            # Scale-down only with COMFORTABLE page headroom: the same
+            # hysteresis ratio the other signals use, inverted because
+            # free fraction clears HIGH (breach below wm, clear at or
+            # above wm / frac).
+            and (
+                wm is None or page_frac is None
+                or page_frac >= min(1.0, wm / frac)
+            )
         )
         sev = self.severe_factor
         severe = (
             (qw_p99 is not None and qw_p99 > sev * target)
             or depth_per > sev * self.depth_high
             or burn > sev * self.burn_high
+            or (page_low and page_frac < wm / sev)
         )
         return AutoscaleSignals(
             qw_p99_s=qw_p99, depth_per_replica=depth_per, burn=burn,
             breach=breach, clear=clear, severe=severe,
+            free_page_fraction=page_frac,
         )
 
     # ---- actuation: scale up --------------------------------------------

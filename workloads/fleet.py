@@ -323,6 +323,61 @@ class Replica:
             + int(e._occupied.sum())
         )
 
+    # ---- KV-page accounting (Fleet(page_scheduling=True)) ----------------
+
+    def total_pages(self) -> int | None:
+        """HBM KV pages this replica's engine owns, or None when the
+        engine runs no page pool (page scheduling degrades to the
+        request-count load for it)."""
+        ctrl = getattr(self.engine, "ctrl", None)
+        n = getattr(ctrl, "n_pages", None)
+        return None if n is None else int(n)
+
+    def free_pages(self) -> int | None:
+        """Unallocated HBM KV pages right now, or None without a pool."""
+        ctrl = getattr(self.engine, "ctrl", None)
+        if ctrl is None or not hasattr(ctrl, "used_pages"):
+            return None
+        return max(0, int(ctrl.n_pages) - int(ctrl.used_pages))
+
+    def host_free_pages(self) -> int:
+        """Host-tier offload headroom in pages: how much HBM pressure
+        this replica can relieve by spilling cold radix pages (0 when
+        the engine runs no radix cache or the host tier is off; an
+        unbounded tier reports one HBM pool's worth — the most the
+        relief valve can matter to one scheduling decision)."""
+        prefix = getattr(self.engine, "prefix", None)
+        budget = getattr(prefix, "host_pages", 0)
+        if prefix is None or budget == 0:
+            return 0
+        if budget is None:
+            return self.total_pages() or 0
+        return max(0, int(budget) - int(prefix.offloaded_pages))
+
+    def page_load(self) -> int:
+        """The page-granular router scalar: KV pages held plus pages
+        the queued/mid-prefill work will claim — memory as the unit
+        the fleet schedules, mirroring the device plugin's
+        pages-per-chip advertisement.  Engines without a page pool
+        fall back to the bucket-weighted request load so heterogeneous
+        fleets keep a comparable (if mixed-unit) view."""
+        e = self.engine
+        ctrl = getattr(e, "ctrl", None)
+        if ctrl is None or not hasattr(ctrl, "pages_needed"):
+            return self.load()
+        demand = 0
+        for req in e.pending:
+            n = len(getattr(req, "prompt", ()) or ())
+            demand += max(1, int(ctrl.pages_needed(n)))
+        bucket = max(1, getattr(e, "prompt_bucket", 1))
+        for plan in getattr(e, "_inflight_prefill", ()):
+            if not plan.get("prefill", False):
+                demand += 1
+                continue
+            remaining = max(0, plan["n"] - plan["cursor"] * bucket)
+            demand += max(1, int(ctrl.pages_needed(remaining)))
+        return int(ctrl.used_pages) + demand
+
     @property
     def idle(self) -> bool:
         return self.engine.idle
@@ -477,6 +532,8 @@ class Fleet:
         roles=None,
         wfq_weights=None,
         ledger=None,
+        page_scheduling: bool = False,
+        stats_path: str | None = None,
     ):
         engines = list(engines)
         if not engines:
@@ -681,6 +738,25 @@ class Fleet:
         # Inert like the observer; /healthz and the FleetObserver's
         # LEDGER_METRICS families read it.
         self.ledger = ledger
+        # KV pages as the schedulable unit (docs/SERVING.md "Memory as
+        # the schedulable unit"): dispatch ranks replicas by a
+        # page-granular load view (pages held + pages the queued work
+        # will claim, goodput-penalized) instead of request counts, and
+        # an unbounded/per-replica admission bound additionally caps at
+        # the fleet's aggregate free pages (HBM + host-tier headroom —
+        # oversubscription stays safe because cold pages spill to the
+        # PR-9 host tier instead of evicting).  Off by default: False
+        # keeps every dispatch decision bit-identical to the
+        # request-count router.
+        self.page_scheduling = bool(page_scheduling)
+        # Where publish_stats() drops the live-signal snapshot the
+        # device plugin's GetPreferredAllocation scorer reads
+        # (tpu_device_plugin/kvsched.py); None publishes nowhere until
+        # a path is passed explicitly.
+        self.stats_path = stats_path
+        self._stats_epoch = 0
+        self.page_dispatches = 0
+        self.stats_published = 0
 
     # ---- introspection ---------------------------------------------------
 
@@ -757,9 +833,115 @@ class Fleet:
                 self.max_pending_per_replica
                 * max(1, self.dispatchable_count)
             ))
+        if self.page_scheduling and self.max_pending is None:
+            pages = self.aggregate_free_pages()
+            if pages is not None:
+                # Admission scales with what the memory can actually
+                # hold: one queued request per aggregate free page
+                # (HBM + host-tier headroom).  An operator's static
+                # max_pending stays authoritative; the page bound only
+                # CAPS the per-replica/unbounded modes — admitting past
+                # the pages would just park work in the queue anyway.
+                page_bound = max(1, pages)
+                bound = (
+                    page_bound if bound is None
+                    else min(bound, page_bound)
+                )
         if bound is not None and self.admission_factor < 1.0:
             bound = max(1, int(bound * self.admission_factor))
         return bound
+
+    def aggregate_free_pages(self) -> int | None:
+        """Free KV pages the dispatchable replicas can absorb right
+        now, host-tier offload headroom included; None when no
+        dispatchable replica exposes a page pool (page-granular
+        admission degrades to the configured bound)."""
+        total = None
+        for rep in self.replicas:
+            if not rep.dispatchable:
+                continue
+            free = rep.free_pages()
+            if free is None:
+                continue
+            total = (total or 0) + free + rep.host_free_pages()
+        return total
+
+    # Pages of handicap a fully-wasteful replica carries in the
+    # page-granular load view — enough to steer marginal dispatches
+    # off a replica burning its chip-time, small enough that real
+    # free-page deltas still dominate.
+    _GOODPUT_PENALTY_PAGES = 4
+
+    def _goodput_penalty(self, rep: Replica) -> int:
+        """Ledger-informed handicap: (1 - goodput_fraction) scaled to
+        pages.  0 without an armed per-engine chip-time ledger, and 0
+        until the ledger has accounted any tokens — an idle fleet must
+        not dispatch differently just because a ledger is attached."""
+        led = getattr(rep.engine, "ledger", None)
+        if led is None or not getattr(led, "tokens_accounted", 0):
+            return 0
+        try:
+            goodput = float(led.goodput_fraction)
+        except Exception:
+            return 0
+        return int(round(
+            (1.0 - max(0.0, min(1.0, goodput)))
+            * self._GOODPUT_PENALTY_PAGES
+        ))
+
+    def publish_stats(self, path: str | None = None) -> str | None:
+        """Publish each replica's live signals — free/total KV pages,
+        host-tier headroom, radix-resident pages, ledger busy/goodput
+        fractions — to the host-local snapshot the device plugin's
+        GetPreferredAllocation scorer reads (atomic write-then-rename
+        with a monotonic epoch; tpu_device_plugin/kvsched.py).  Chips
+        are keyed by ``chip_id``, so only replicas pinned to an
+        advertised chip publish.  Returns the path written, or None
+        when no path is configured or no replica carries a chip id
+        (the scorer then falls back to the static spread — by
+        design)."""
+        from tpu_device_plugin import kvsched
+
+        path = path if path is not None else self.stats_path
+        if path is None:
+            return None
+        chips: dict[str, dict[str, float]] = {}
+        for rep in self.replicas:
+            if not rep.chip_id or rep.state == DEAD:
+                continue
+            signals = chips.setdefault(rep.chip_id, {
+                "free_pages": 0.0, "total_pages": 0.0,
+                "host_free_pages": 0.0, "radix_resident_pages": 0.0,
+                "busy_fraction": 0.0, "goodput_fraction": 0.0,
+            })
+            free = rep.free_pages()
+            if free is not None:
+                signals["free_pages"] += free
+                signals["total_pages"] += rep.total_pages() or 0
+            signals["host_free_pages"] += rep.host_free_pages()
+            prefix = getattr(rep.engine, "prefix", None)
+            signals["radix_resident_pages"] += float(
+                getattr(prefix, "cached_pages", 0) or 0
+            )
+            led = getattr(rep.engine, "ledger", None)
+            if led is not None:
+                # Chips backing several replicas publish the WORST
+                # busy and goodput: the scorer is placing NEW load,
+                # and the most contended time-slice is what it hits.
+                signals["busy_fraction"] = max(
+                    signals["busy_fraction"], float(led.busy_fraction)
+                )
+                signals["goodput_fraction"] = max(
+                    signals["goodput_fraction"],
+                    float(led.goodput_fraction),
+                )
+        if not chips:
+            return None
+        self._stats_epoch = kvsched.write_stats_snapshot(
+            path, chips, epoch=self._stats_epoch + 1,
+        )
+        self.stats_published += 1
+        return path
 
     def _revival_pending(self) -> bool:
         hook = self.revival_hook
@@ -896,7 +1078,14 @@ class Fleet:
                 else None
             )
             if rep is not None and rid in rep.rids and rep.state != DEAD:
-                return bool(rep.engine.cancel(rid))
+                # The engine-side cancel drains pipelined in-flight
+                # chunks first, emitting tokens (for co-batched rows
+                # too) OUTSIDE step()'s capture window — fold the delta
+                # in here or the ledger's emitted base undercounts.
+                g0 = rep.engine.generated_tokens
+                got = bool(rep.engine.cancel(rid))
+                self.generated_tokens += rep.engine.generated_tokens - g0
+                return got
             return False
 
     def preempt(self, rid: str) -> bool:
@@ -929,7 +1118,13 @@ class Fleet:
             if rep is None or rid not in rep.rids or rep.state == DEAD:
                 return False
             try:
+                # Like cancel(): the engine-side preempt drains
+                # pipelined in-flight chunks, emitting tokens outside
+                # step()'s capture window — fold the delta in so the
+                # ledger's emitted base keeps the token identity.
+                g0 = rep.engine.generated_tokens
                 ereq = rep.engine.preempt(rid)
+                self.generated_tokens += rep.engine.generated_tokens - g0
             except EngineClosed:
                 return False
             if ereq is None:
@@ -1443,7 +1638,18 @@ class Fleet:
         t0 = time.perf_counter()
         now = t0
         dispatchable = [r for r in self.replicas if r.dispatchable]
-        loads = {r.index: r.load() for r in dispatchable}
+        if self.page_scheduling:
+            # Page-granular view: pages held + pages the queued work
+            # will claim, with a small penalty on replicas whose
+            # chip-time ledger shows wasted work — free pages, radix
+            # match depth (the Router's measured affinity) and goodput
+            # replace the request count as the dispatch currency.
+            loads = {
+                r.index: r.page_load() + self._goodput_penalty(r)
+                for r in dispatchable
+            }
+        else:
+            loads = {r.index: r.load() for r in dispatchable}
         entries = [fr for fr in self.queue if not fr.done]
         self.queue.clear()
         order = (
@@ -1490,12 +1696,18 @@ class Fleet:
             # replica that just took a 4k-token prefill.  The CHOSEN
             # replica's own bucket, not the fleet norm: heterogeneous
             # fleets are legal and load() reports per-engine units.
-            rep_bucket = max(1, getattr(
-                self.replicas[pick].engine, "prompt_bucket", 1
-            ))
-            loads[pick] += max(1, -(-(
-                len(fr.prompt) + len(fr.tokens)
-            ) // rep_bucket))
+            n_request = len(fr.prompt) + len(fr.tokens)
+            ctrl = getattr(self.replicas[pick].engine, "ctrl", None)
+            if self.page_scheduling and hasattr(ctrl, "pages_needed"):
+                # Same currency as the page-load view: the pages this
+                # request's KV will claim on the chosen replica.
+                self.page_dispatches += 1
+                loads[pick] += max(1, int(ctrl.pages_needed(n_request)))
+            else:
+                rep_bucket = max(1, getattr(
+                    self.replicas[pick].engine, "prompt_bucket", 1
+                ))
+                loads[pick] += max(1, -(-n_request // rep_bucket))
             removed.add(id(fr))
         if charged:
             # The fleet's virtual time after the batch: the LEAST

@@ -344,6 +344,29 @@ FLEET_METRICS: tuple[MetricSpec, ...] = (
         "1 for each live replica's disaggregation role "
         "(prefill/decode/mixed; scrape-time)",
     ),
+    # KV pages as the schedulable unit (Fleet(page_scheduling=True),
+    # docs/SERVING.md "Memory as the schedulable unit"): page-granular
+    # dispatch volume, live-signal snapshot publications for the device
+    # plugin's GetPreferredAllocation scorer, and the free-page headroom
+    # the page-aware admission bound scales with.
+    MetricSpec(
+        "fleet_page_dispatches_total", "counter", ("fleet",),
+        "dispatches routed by the page-granular load view (pages held "
+        "+ pages the queued work will claim, goodput-penalized) "
+        "instead of request counts (page_scheduling=True)",
+    ),
+    MetricSpec(
+        "fleet_stats_published_total", "counter", ("fleet",),
+        "live-signal snapshots atomically published to the host-local "
+        "stats file the device plugin's preferred-allocation scorer "
+        "reads (Fleet.publish_stats; tpu_device_plugin/kvsched.py)",
+    ),
+    MetricSpec(
+        "fleet_free_pages", "gauge", ("fleet", "tier"),
+        "aggregate free KV pages across live replicas, by tier (hbm = "
+        "unallocated pool pages, host = offload-tier headroom; "
+        "scrape-time — the page-aware admission bound's inputs)",
+    ),
     MetricSpec(
         "fleet_observer_dropped_spans_total", "counter", ("fleet",),
         "fleet-request spans the observer's bounded ring evicted "
@@ -1216,6 +1239,16 @@ class FleetObserver:
             ({"replica": str(r.index), "role": r.role}, 1.0)
             for r in e.replicas if r.state != "dead"
         ],
+        "fleet_free_pages": lambda e: [
+            ({"tier": "hbm"}, float(sum(
+                r.free_pages() or 0 for r in e.replicas
+                if r.state != "dead" and hasattr(r, "free_pages")
+            ))),
+            ({"tier": "host"}, float(sum(
+                r.host_free_pages() for r in e.replicas
+                if r.state != "dead" and hasattr(r, "host_free_pages")
+            ))),
+        ],
     }
 
     # Fleet-scope chip-time ledger gauge (LEDGER_METRICS): reads the
@@ -1238,6 +1271,8 @@ class FleetObserver:
         "fleet_queue_rejections_total": "queue_rejections",
         "fleet_kv_handoffs_total": "kv_handoffs",
         "fleet_handoff_pages_total": "handoff_pages",
+        "fleet_page_dispatches_total": "page_dispatches",
+        "fleet_stats_published_total": "stats_published",
     }
 
     def bind_registry(self, reg, labels: dict | None = None) -> None:
