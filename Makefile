@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check profile-check fmt-check
 
 all: native
 
@@ -51,7 +51,7 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check test
+check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check profile-check test
 
 # Chip-time-ledger tripwires (docs/OBSERVABILITY.md "Chip-time ledger,
 # goodput & postmortems"): one seeded fault run with the ledger and
@@ -67,6 +67,19 @@ check: check-compat obs-check faults-check prefill-check fleet-check selfheal-ch
 ledger-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_ledger.py::test_ledger_check_smoke" -q -o addopts=
 	JAX_PLATFORMS=cpu $(PYTHON) tools/postmortem.py --selfcheck
+
+# Device-time-profiling tripwires (docs/OBSERVABILITY.md "Device-time
+# profiling & regression sentry"): one seeded serve loop captured
+# inside a bounded ProfileSession — the jax.profiler dump must land on
+# disk, and the single-engine + merged 2-replica chrome traces (device
+# lanes included) must pass tools/trace_export.py --validate — plus
+# the jax-free units: EWMA/z-score sentry firing EXACTLY ONE validating
+# perf_regression bundle per incident and re-arming on recovery, quiet
+# under baseline noise at the committed artifact's own spread, and the
+# validator's empty-trace / lane-collision regressions.
+profile-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_profile_capture.py::test_profile_capture_smoke" -q -o addopts=
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_profiler.py -q -o addopts=
 
 # Disaggregated prefill/decode tripwires (docs/SERVING.md
 # "Disaggregated prefill/decode"): one seeded two-pool smoke — a

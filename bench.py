@@ -555,6 +555,12 @@ COMPACT_KEYS = [
     "decode_host_sync_ms", "superstep_speedup",
     "superstep_overdecode_pct",
     "obs_overhead_pct", "obs_on_tokens_per_sec",
+    # Device-time profiling layer: the device-busy share of every
+    # charged wall window, its host-stall complement, and the full
+    # treatment's tax (observer + device table + registry + sentry
+    # feed; streams asserted bit-identical profiler on/off).
+    "device_busy_fraction", "host_stall_fraction",
+    "profiler_overhead_pct", "profiler_on_tokens_per_sec",
     # Chip-time ledger: fleet-wide goodput/waste accounting — the
     # goodput share of all charged device work under a faulted spec
     # stream, the replay/spec-rejected waste shares, and the always-on

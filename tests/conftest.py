@@ -47,6 +47,11 @@ _FAST_DESPITE_JAX = {
     # imports workloads.ledger (deliberately jax-free) and drives it
     # with fake engines; never traces a jax program.
     "test_postmortem",
+    # Device-time table + regression-sentry units and the trace-lane
+    # validator regressions: imports workloads.profiler (deliberately
+    # jax-free) and drives fake engines; the real jax.profiler capture
+    # smoke lives in test_profile_capture.py (slow / profile-check).
+    "test_profiler",
 }
 _JAX_IMPORT_RE = re.compile(r"^\s*(?:import|from)\s+(?:jax|workloads)\b", re.MULTILINE)
 _slow_file_cache: dict[str, bool] = {}

@@ -841,3 +841,41 @@ def test_fleet_ledger_families_render_as_valid_exposition():
     } == {("interactive", "goodput", 6.0), ("bulk", "waste", 3.0)}
     frac = families[f"{PREFIX}_fleet_ledger_goodput_fraction"]
     assert frac["samples"][0][2] == pytest.approx(6.0 / 9.0)
+
+
+def test_metrics_http_scrape_sets_prometheus_content_type():
+    """HTTP scrape contract: /metrics serves the standard Prometheus
+    text exposition content type (``text/plain; version=0.0.4``) — the
+    version parameter is what lets scrapers negotiate the format — and
+    the body it ships is valid exposition of the bound registry."""
+    import urllib.request
+
+    from tpu_device_plugin.metrics import PREFIX, MetricsServer, Registry
+    from workloads.obs import EngineObserver
+
+    reg = Registry()
+    obs = EngineObserver()
+    obs.bind_registry(reg)
+    _drive_fake_engine(obs)
+
+    server = MetricsServer(0, reg)  # ephemeral port
+    port = server.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as resp:
+            assert resp.headers["Content-Type"] == "text/plain; version=0.0.4"
+            body = resp.read().decode()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as resp:
+            # /healthz is NOT exposition; it must not claim the format.
+            assert resp.headers["Content-Type"] == "text/plain"
+    finally:
+        server.stop()
+
+    families = _parse_exposition(body)
+    assert f"{PREFIX}_engine_decode_steps_total" in families
+    for fam, info in families.items():
+        if info["type"] == "histogram":
+            _assert_histogram_sound(fam, info)

@@ -1,0 +1,125 @@
+"""Real jax.profiler capture smoke (`make profile-check`): a seeded
+serve loop runs inside a bounded ProfileSession and the dump lands on
+disk; the single-engine chrome trace AND the 2-replica merged fleet
+trace carry device lanes and pass tools/trace_export.py --validate
+(docs/OBSERVABILITY.md "Device-time profiling & regression sentry").
+
+The jax-free profiler units (sentry semantics, table round-trips, the
+validator's collision regressions) live in tests/test_profiler.py;
+this module exists to prove the one thing those cannot — that
+ProfileSession drives the REAL jax.profiler and the observer's device
+attribution survives a real engine's dispatch cadence.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"),
+)
+
+from workloads.model import ModelConfig, init_params
+from workloads.obs import EngineObserver, fleet_trace_events, trace_events
+from workloads.profiler import DeviceTimeTable, ProfileSession, device_report
+from workloads.serve import ServeEngine
+
+from trace_export import validate_trace  # noqa: E402
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+
+STREAM = (([1, 2, 3], 6), ([4, 5], 4), ([7, 8, 9], 3))
+
+
+def _run_observed(obs):
+    engine = ServeEngine(
+        params=_PARAMS, config=CONFIG, slots=2, page_size=4,
+        prompt_bucket=8, observer=obs,
+    )
+    rids = [engine.submit(p, n) for p, n in STREAM]
+    out = engine.run()
+    return [list(out[r]) for r in rids]
+
+
+_PARAMS = None
+
+
+def setup_module(module):
+    global _PARAMS
+    _PARAMS = init_params(CONFIG, jax.random.PRNGKey(0))
+
+
+def test_profile_capture_smoke(tmp_path):
+    out_dir = str(tmp_path / "profiles")
+    profiler = ProfileSession(out_dir, max_secs=60.0)
+    obs0 = EngineObserver(
+        name="r0", replica="0", device_table=DeviceTimeTable()
+    )
+    started = profiler.start()
+    assert profiler.active
+    streams0 = _run_observed(obs0)
+    capture = profiler.stop()
+    assert not profiler.active
+
+    # The dump exists on disk and the session accounted its bytes.
+    assert capture is not None and capture["dir"] == started["dir"]
+    dumped = [
+        os.path.join(root, fn)
+        for root, _, fns in os.walk(capture["dir"]) for fn in fns
+    ]
+    assert dumped, "jax.profiler capture must leave files on disk"
+    assert capture["bytes"] > 0
+    assert profiler.bytes_spent == capture["bytes"]
+    assert profiler.state()["captures"] == [capture]
+
+    # The profiled run still served its tokens, and the device table
+    # calibrated from the real dispatch cadence.
+    assert all(streams0)
+    assert len(obs0.device_table) > 0
+    assert 0.0 < obs0.device_busy_fraction <= 1.0
+    report = device_report([obs0])
+    assert 0.0 < report["device_busy_fraction"] <= 1.0
+
+    # Single-engine trace: device lane declared and populated.
+    trace = trace_events(obs0)
+    assert validate_trace(trace) == []
+    device_events = [
+        ev for ev in trace["traceEvents"]
+        if ev["ph"] == "X" and ev["name"].startswith("device[")
+    ]
+    assert device_events, "attributed steps must land on the device lane"
+    assert all(ev["pid"] == 2 and ev["tid"] == 2 for ev in device_events)
+
+    # Merged 2-replica fleet trace: each replica keeps its own device
+    # lane after the pid rebase, and the merge validates end to end
+    # through the SAME file path the serve CLI writes.
+    obs1 = EngineObserver(
+        name="r1", replica="1", device_table=DeviceTimeTable()
+    )
+    streams1 = _run_observed(obs1)
+    assert streams1 == streams0  # same seeded stream on both replicas
+    merged = fleet_trace_events(None, [obs0, obs1])
+    path = str(tmp_path / "merged-trace.json")
+    with open(path, "w") as f:
+        json.dump(merged, f)
+    from trace_export import validate_file
+
+    assert validate_file(path) == []
+    device_lanes = {
+        ev["pid"] for ev in merged["traceEvents"]
+        if ev["ph"] == "X" and ev["name"].startswith("device[")
+    }
+    assert len(device_lanes) == 2, (
+        "both replicas must keep a device lane after the merge"
+    )
+
+    # A second capture into the same session stacks its budget.
+    profiler.start(secs=5.0)
+    second = profiler.stop()
+    assert second is not None and len(profiler.captures) == 2
+    assert profiler.bytes_spent >= capture["bytes"]

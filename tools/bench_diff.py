@@ -89,6 +89,10 @@ TRACKED_UP = [
     "kvsched_vs_replica_tokens_per_sec",
     "kvsched_busy_fraction",
     "kvsched_goodput_fraction",
+    # Device-time profiling: the device-busy share of every charged
+    # wall window under the profiled serve stream — a drop means host
+    # stalls started eating the chip-seconds the ledger charges.
+    "device_busy_fraction",
 ]
 
 # Lower-is-better serving guardrails (the chunked-prefill PR's SLO
@@ -158,6 +162,11 @@ TRACKED_DOWN = [
     # page-granular dispatcher started stranding the capacity it
     # exists to spend.
     "kvsched_page_waste_pct",
+    # Device-time profiling layer: the full treatment's tax (observer
+    # + device table + registry push + sentry feed; streams
+    # bit-identical on/off by construction, so a rise is pure
+    # attribution cost creeping into the step loop).
+    "profiler_overhead_pct",
 ]
 
 # The serving keys whose thresholds derive from the artifact's own

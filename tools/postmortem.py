@@ -44,7 +44,8 @@ _WASTE = (
     "cancelled", "probe_warmup",
 )
 _TRIGGERS = (
-    "quarantine", "crash_loop", "probe_divergence", "slo_burn", "manual",
+    "quarantine", "crash_loop", "probe_divergence", "slo_burn",
+    "perf_regression", "manual",
 )
 
 
@@ -189,6 +190,17 @@ def validate_bundle(obj) -> list[str]:
                 ev.get("t"), (int, float)
             ) or not ev.get("kind"):
                 errors.append(f"{key}[{i}]: wants numeric t and a kind")
+    # A perf_regression bundle without the detector state that fired it
+    # is not diagnosable — the whole point of the sentry embed.
+    if (
+        isinstance(trigger, dict)
+        and trigger.get("kind") == "perf_regression"
+        and not isinstance(obj.get("sentry"), dict)
+    ):
+        errors.append(
+            "perf_regression bundle must embed the sentry detector "
+            "state under 'sentry'"
+        )
     return errors
 
 
@@ -352,6 +364,30 @@ def selfcheck() -> int:
             errors.append("replica 0's replay waste did not survive")
         if bundle.get("fleet", {}).get("ledger") is None:
             errors.append("fleet ledger block missing")
+        # Round-trip the sentry path too: a scripted throughput collapse
+        # must fire exactly one perf_regression bundle that embeds the
+        # detector state this validator demands.
+        from workloads.profiler import RegressionSentry
+
+        sentry = RegressionSentry(z_threshold=3.0, confirm=2)
+        rec.attach_sentry(sentry)
+        sentry.watch("tokens_per_sec", 100.0, 5.0, direction="down_bad")
+        for value in (101.0, 99.0, 100.5, 20.0, 18.0, 19.0):
+            sentry.observe("tokens_per_sec", value)
+        perf = [p for p in rec.dumped if "perf_regression" in p]
+        if len(perf) != 1:
+            errors.append(
+                f"scripted regression fired {len(perf)} perf_regression "
+                "bundles, want exactly 1"
+            )
+        for path in perf:
+            errors += validate_file(path)
+            with open(path) as f:
+                pbundle = json.load(f)
+            if not isinstance(pbundle.get("sentry"), dict):
+                errors.append(
+                    "perf_regression bundle lacks embedded sentry state"
+                )
     finally:
         for fn in os.listdir(out_dir):
             os.unlink(os.path.join(out_dir, fn))

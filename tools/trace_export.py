@@ -55,6 +55,18 @@ def validate_trace(obj) -> list[str]:
     events = obj["traceEvents"]
     if not isinstance(events, list):
         return ["'traceEvents' must be an array"]
+    if not events:
+        return [
+            "'traceEvents' is empty — an exported trace with no events "
+            "means the observer rings were never filled (or drained "
+            "twice); nothing to load"
+        ]
+    # Lane registry: pid/tid names are declared via "M" metadata events.
+    # Two replicas claiming the same lane (same pid named twice, or the
+    # same (pid, tid) thread named twice with different names) silently
+    # interleave their timelines in the viewer — reject the collision.
+    pid_names: dict[int, tuple[int, str]] = {}
+    tid_names: dict[tuple[int, int], tuple[int, str]] = {}
     for i, ev in enumerate(events):
         where = f"traceEvents[{i}]"
         if not isinstance(ev, dict):
@@ -92,6 +104,33 @@ def validate_trace(obj) -> list[str]:
                 json.dumps(ev["args"])
             except (TypeError, ValueError) as e:
                 errors.append(f"{where}: args not JSON-serialisable: {e}")
+        if (
+            ph == "M"
+            and ev.get("name") in ("process_name", "thread_name")
+            and isinstance(ev.get("pid"), int)
+            and isinstance(ev.get("tid"), int)
+            and isinstance(ev.get("args"), dict)
+        ):
+            label = str(ev["args"].get("name", ""))
+            if ev["name"] == "process_name":
+                prev = pid_names.get(ev["pid"])
+                if prev is not None and prev[1] != label:
+                    errors.append(
+                        f"{where}: pid {ev['pid']} lane collision — "
+                        f"named {label!r} here but {prev[1]!r} at "
+                        f"traceEvents[{prev[0]}]"
+                    )
+                pid_names.setdefault(ev["pid"], (i, label))
+            else:
+                key = (ev["pid"], ev["tid"])
+                prev = tid_names.get(key)
+                if prev is not None and prev[1] != label:
+                    errors.append(
+                        f"{where}: pid/tid {key} lane collision — "
+                        f"named {label!r} here but {prev[1]!r} at "
+                        f"traceEvents[{prev[0]}]"
+                    )
+                tid_names.setdefault(key, (i, label))
     return errors
 
 

@@ -203,6 +203,19 @@ def fingerprint_engine(engine) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
+def _device_table_dict(engine) -> dict | None:
+    """The engine observer's device-time calibration table
+    (workloads/profiler.py ``DeviceTimeTable``) as a JSON-able dict,
+    or ``None`` when the engine carries no observer/table — snapshots
+    persist the warmup calibration so a primed replica attributes
+    device time from its first served request."""
+    obs = getattr(engine, "_obs", None)
+    table = getattr(obs, "device_table", None)
+    if table is None or not len(table):
+        return None
+    return table.to_dict()
+
+
 @dataclass
 class EngineSnapshot:
     """The host-side warmed state of one served engine, captured after
@@ -219,6 +232,7 @@ class EngineSnapshot:
     kernel_table: dict[int, str] | None = None
     probe: tuple[list[int], int] | None = None
     probe_oracle: list[int] | None = None
+    device_time_table: dict | None = None
     meta: dict = field(default_factory=dict)
 
     @classmethod
@@ -260,6 +274,7 @@ class EngineSnapshot:
                 [int(t) for t in probe_oracle]
                 if probe_oracle is not None else None
             ),
+            device_time_table=_device_table_dict(engine),
             meta={
                 "jax": jax.__version__,
                 "device": device,
@@ -312,6 +327,14 @@ class EngineSnapshot:
             engine._injected_calibration = {
                 "threshold": float(self.spec_breakeven)
             }
+        if self.device_time_table:
+            obs = getattr(engine, "_obs", None)
+            table = getattr(obs, "device_table", None)
+            if table is not None:
+                # Live entries win inside load() — a snapshot seeds the
+                # device-time attribution, it never overwrites fresher
+                # measurements.
+                table.load(self.device_time_table)
         return True
 
     def engine_kw(self) -> dict:
@@ -338,6 +361,7 @@ class EngineSnapshot:
                 if self.probe is not None else None
             ),
             "probe_oracle": self.probe_oracle,
+            "device_time_table": self.device_time_table,
             "meta": self.meta,
         }, sort_keys=True)
 
@@ -359,6 +383,7 @@ class EngineSnapshot:
                 if probe is not None else None
             ),
             probe_oracle=d.get("probe_oracle"),
+            device_time_table=d.get("device_time_table"),
             meta=dict(d.get("meta") or {}),
         )
 

@@ -2533,6 +2533,13 @@ class FleetServer:
         one chip slot (409 when no supervisor is armed, 404 for an
         unknown slot): the remote pendant of
         ``FleetSupervisor.clear()``, which was in-process only.
+      * ``POST /profile?secs=N`` / ``POST /profile/stop`` /
+        ``GET /profile`` — on-demand device-trace capture against the
+        armed ``ProfileSession`` (workloads/profiler.py; the serve
+        CLI's ``--profile-dir``): start a bounded ``jax.profiler``
+        capture on the live fleet, stop it early, read session state.
+        409 when no session is armed, a capture is already active, or
+        the disk budget is spent.
 
     ``start()`` binds the port (0 = ephemeral; the bound port lands
     back on ``.port``) and spins the fleet's driver thread; handlers
@@ -2540,7 +2547,7 @@ class FleetServer:
 
     def __init__(
         self, fleet: Fleet, port: int = 0, poll_s: float = 0.002,
-        supervisor=None, autoscaler=None,
+        supervisor=None, autoscaler=None, profiler=None,
     ):
         self.fleet = fleet
         self.port = port
@@ -2553,6 +2560,9 @@ class FleetServer:
         # fleet's) and /healthz reports the control-loop state too.
         self.supervisor = supervisor
         self.autoscaler = autoscaler
+        # Optional ProfileSession (workloads/profiler.py): arms the
+        # /profile endpoints for live device-trace capture.
+        self.profiler = profiler
         self._httpd = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -2563,6 +2573,7 @@ class FleetServer:
         fleet, poll_s, stop = self.fleet, self.poll_s, self._stop
         supervisor = self.supervisor
         autoscaler = self.autoscaler
+        profiler = self.profiler
 
         class Handler(http.server.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -2575,7 +2586,52 @@ class FleetServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _profile(self, action: str, query: str) -> None:
+                """Live device-trace capture: start (bounded by the
+                session's duration/disk budgets), stop early, or read
+                state.  The capture itself is the profiler's business —
+                this handler only translates its refusals to 409s."""
+                if profiler is None:
+                    self._json(409, {
+                        "error": "no profile session is armed; start the "
+                                 "serve CLI with --profile-dir",
+                    })
+                    return
+                if action == "state":
+                    self._json(200, profiler.state())
+                    return
+                if action == "stop":
+                    rec = profiler.stop()
+                    if rec is None:
+                        self._json(409, {"error": "no capture is active"})
+                    else:
+                        self._json(200, {"ok": True, "capture": rec})
+                    return
+                secs = None
+                for pair in query.split("&"):
+                    if pair.startswith("secs="):
+                        try:
+                            secs = float(pair[len("secs="):])
+                        except ValueError:
+                            self._json(400, {
+                                "error": f"secs wants a number, got "
+                                         f"{pair[len('secs='):]!r}",
+                            })
+                            return
+                try:
+                    started = profiler.start(secs)
+                except RuntimeError as e:
+                    self._json(409, {"error": str(e)})
+                    return
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                self._json(200, {"ok": True, **started})
+
             def do_GET(self):  # noqa: N802
+                if self.path.split("?")[0] == "/profile":
+                    self._profile("state", "")
+                    return
                 if self.path != "/healthz":
                     self.send_error(404)
                     return
@@ -2671,7 +2727,15 @@ class FleetServer:
                     self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
             def do_POST(self):  # noqa: N802
-                parts = self.path.strip("/").split("/")
+                route, _, query = self.path.partition("?")
+                parts = route.strip("/").split("/")
+                if parts[0] == "profile":
+                    action = parts[1] if len(parts) == 2 else "start"
+                    if len(parts) > 2 or action not in ("start", "stop"):
+                        self.send_error(404)
+                        return
+                    self._profile(action, query)
+                    return
                 if len(parts) == 2 and parts[0] in (
                     "drain", "undrain", "clear",
                 ):

@@ -99,7 +99,8 @@ BUNDLE_SCHEMA = "tpu-serve-postmortem/1"
 
 # Flight-recorder trigger kinds (tools/postmortem.py pins the set).
 TRIGGER_KINDS = (
-    "quarantine", "crash_loop", "probe_divergence", "slo_burn", "manual",
+    "quarantine", "crash_loop", "probe_divergence", "slo_burn",
+    "perf_regression", "manual",
 )
 
 
@@ -654,6 +655,7 @@ class FlightRecorder:
         self._fleet = None
         self._supervisor = None
         self._autoscaler = None
+        self._sentry = None
         self._sup_cursor = 0
         self._asc_cursor = 0
         self._burn_streak = 0
@@ -681,6 +683,13 @@ class FlightRecorder:
     def attach_autoscaler(self, autoscaler) -> None:
         self._autoscaler = autoscaler
         self._asc_cursor = self._event_total(autoscaler)
+
+    def attach_sentry(self, sentry) -> None:
+        """Attach a regression sentry (workloads/profiler.py).  The
+        sentry fires ``perf_regression`` triggers through this recorder
+        and its detector state is embedded in every bundle."""
+        self._sentry = sentry
+        sentry.recorder = self
 
     @staticmethod
     def _event_total(src) -> int:
@@ -903,6 +912,11 @@ class FlightRecorder:
                 _plain(ev)
                 for ev in (getattr(self._autoscaler, "events", ()) or ())
             ]
+        if self._sentry is not None:
+            try:
+                bundle["sentry"] = self._sentry.state()
+            except Exception:  # noqa: BLE001 — a bundle dump must land
+                bundle["sentry"] = {"error": "sentry state unavailable"}
         with open(path, "w") as f:
             json.dump(bundle, f)
             f.write("\n")

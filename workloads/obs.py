@@ -47,6 +47,15 @@ SERVE_SECONDS_BUCKETS = (
     60.0, 120.0,
 )
 
+# Device-time-scale ladder (workloads/profiler.py): per-dispatch device
+# windows sit well under a millisecond on real chips, where the serving
+# ladder's 5 ms floor would flatten every observation into one bucket —
+# so `engine_device_seconds` gets its own sub-millisecond floor.
+DEVICE_SECONDS_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
 
 @dataclass(frozen=True)
 class MetricSpec:
@@ -212,6 +221,25 @@ ENGINE_METRICS: tuple[MetricSpec, ...] = (
     MetricSpec(
         "engine_step_seconds", "histogram", ("engine",),
         "wall time of one engine step() (admit + dispatch + consume)",
+    ),
+    MetricSpec(
+        "engine_device_seconds", "histogram", ("engine",),
+        "estimated DEVICE time of one dispatching step (step wall "
+        "minus the engine-measured host-sync stall, smoothed through "
+        "the per-(program, seq-bucket, batch-bucket) calibration "
+        "table when one is attached — workloads/profiler.py); "
+        "sub-millisecond DEVICE_SECONDS_BUCKETS ladder",
+    ),
+    MetricSpec(
+        "engine_device_busy_fraction", "gauge", ("engine",),
+        "fraction of observed step wall the device was busy "
+        "(scrape-time, cumulative over this observer's run — the "
+        "device-side split of the chip-second the ledger charges)",
+    ),
+    MetricSpec(
+        "engine_host_stall_fraction", "gauge", ("engine",),
+        "1 - engine_device_busy_fraction: observed step wall spent "
+        "host-stalled (readbacks, scheduling, idle admission polls)",
     ),
 )
 
@@ -642,6 +670,10 @@ class StepRecord:
     # fused readback reconciled).
     host_sync_ms: float = 0.0
     tokens_overdecoded: int = 0
+    # Device-time attribution (workloads/profiler.py): estimated DEVICE
+    # ms inside this step's wall window (0.0 for idle steps and for
+    # records from older tooling — the default keeps them identical).
+    device_ms: float = 0.0
 
 
 @dataclass
@@ -763,6 +795,7 @@ class EngineObserver:
         span_limit: int = 2048,
         name: str = "0",
         replica: str = "",
+        device_table=None,
     ):
         if step_limit < 1 or span_limit < 1:
             raise ValueError(
@@ -781,6 +814,14 @@ class EngineObserver:
         self.spans: deque[RequestSpan] = deque(maxlen=span_limit)
         self.dropped_steps = 0
         self.dropped_spans = 0
+        # Device-time attribution (workloads/profiler.py): an optional
+        # DeviceTimeTable smooths per-dispatch device estimates; the
+        # wall/device running sums back the busy/stall fraction gauges
+        # either way (pure host arithmetic over values the step hooks
+        # already computed — nothing here touches device state).
+        self.device_table = device_table
+        self._wall_ms = 0.0
+        self._device_ms = 0.0
         self._step_index = 0
         self._readback_secs = 0.0
         self._registry = None
@@ -816,7 +857,14 @@ class EngineObserver:
             self._labels.setdefault("replica", self.replica)
         for m in ENGINE_METRICS:
             if m.type == "histogram":
-                reg.describe(m.name, m.help, buckets=SERVE_SECONDS_BUCKETS)
+                # Per-dispatch device windows need the sub-millisecond
+                # ladder; every serving latency keeps the seconds scale.
+                buckets = (
+                    DEVICE_SECONDS_BUCKETS
+                    if m.name == "engine_device_seconds"
+                    else SERVE_SECONDS_BUCKETS
+                )
+                reg.describe(m.name, m.help, buckets=buckets)
             else:
                 reg.describe(m.name, m.help)
         # Ledger families describe unconditionally (the engine may not
@@ -855,6 +903,15 @@ class EngineObserver:
             lambda e: getattr(
                 getattr(e, "prefix", None), "offloaded_pages", 0
             ) or 0
+        ),
+        # Device-time split (workloads/profiler.py): read back through
+        # the engine's bound observer; engines without one (or before
+        # any step) read empty via _gauge's teardown guard.
+        "engine_device_busy_fraction": (
+            lambda e: e._obs.device_busy_fraction
+        ),
+        "engine_host_stall_fraction": (
+            lambda e: e._obs.host_stall_fraction
         ),
     }
 
@@ -938,6 +995,22 @@ class EngineObserver:
         except Exception:
             return []  # a gauge must never fail a scrape mid-teardown
 
+    # ---- device-time split (workloads/profiler.py) -----------------------
+
+    @property
+    def device_busy_fraction(self) -> float:
+        """Fraction of observed step wall the device was busy, over
+        this observer's whole run (0.0 before any step)."""
+        if self._wall_ms <= 0:
+            return 0.0
+        return min(self._device_ms / self._wall_ms, 1.0)
+
+    @property
+    def host_stall_fraction(self) -> float:
+        if self._wall_ms <= 0:
+            return 0.0
+        return 1.0 - self.device_busy_fraction
+
     # ---- engine-facing hooks --------------------------------------------
 
     def _bind(self, engine) -> None:
@@ -994,6 +1067,28 @@ class EngineObserver:
         # one decode program per step (drains only consume in-flight
         # work; they never dispatch).
         mode = "spec" if spec_d else ("plain" if chunk_d else "idle")
+        # Device-time attribution: the measured device window is the
+        # step wall minus the engine-measured host-sync stall; idle
+        # steps (pure admission/drain, no dispatch) attribute nothing.
+        # A prefill-only step dispatches too — count it as its own
+        # program so the calibration table keys don't mix phases.
+        program = mode
+        if mode == "idle" and engine.prefill_dispatches - pd0 > 0:
+            program = "prefill"
+        device_ms = 0.0
+        if program != "idle":
+            measured_ms = max((dur - host_sync) * 1000.0, 0.0)
+            device_ms = measured_ms
+            if self.device_table is not None:
+                batch = int(engine._occupied.sum())
+                self.device_table.observe(
+                    program, tokens, batch, measured_ms
+                )
+                est = self.device_table.estimate(program, tokens, batch)
+                if est is not None:
+                    device_ms = est
+        self._wall_ms += dur * 1000.0
+        self._device_ms += device_ms
         rec = StepRecord(
             index=self._step_index,
             t_start=t0,
@@ -1014,6 +1109,7 @@ class EngineObserver:
             ),
             host_sync_ms=round(host_sync * 1000, 3),
             tokens_overdecoded=overdecoded,
+            device_ms=round(device_ms, 3),
         )
         self._step_index += 1
         if len(self.steps) == self.steps.maxlen:
@@ -1057,6 +1153,10 @@ class EngineObserver:
                 reg.inc("engine_prefix_miss_total", labels, prefix_misses)
             if host_sync > 0:
                 reg.observe_seconds("engine_host_sync", host_sync, labels)
+            if rec.device_ms > 0:
+                reg.observe_seconds(
+                    "engine_device", rec.device_ms / 1000.0, labels
+                )
             self._push_lifecycle(engine, reg, labels)
             self._push_ring_drops(reg, labels)
             self._push_ledger(engine, reg, labels)
@@ -1671,6 +1771,8 @@ def trace_events(observer: EngineObserver, t0: float | None = None) -> dict:
          "args": {"name": f"engine {observer.name} steps"}},
         {"ph": "M", "pid": 2, "tid": 1, "name": "thread_name",
          "args": {"name": "step()"}},
+        {"ph": "M", "pid": 2, "tid": 2, "name": "thread_name",
+         "args": {"name": "device"}},
     ]
     for lane, span in enumerate(spans, start=1):
         events.append(
@@ -1708,6 +1810,25 @@ def trace_events(observer: EngineObserver, t0: float | None = None) -> dict:
                 for f in fields(rec) if f.name not in ("t_start", "index")
             },
         })
+        # Device lane (workloads/profiler.py): the step's attributed
+        # device window rendered directly under its step() span — in
+        # the merged fleet trace this lane rides each replica's
+        # process, aligned under that replica's attempt spans.
+        if getattr(rec, "device_ms", 0.0) > 0:
+            program = rec.mode
+            if program == "idle" and rec.prefill_dispatches > 0:
+                program = "prefill"
+            events.append({
+                "ph": "X", "pid": 2, "tid": 2, "cat": "device",
+                "name": f"device[{program}]",
+                "ts": _us(rec.t_start, t0),
+                "dur": max(round(rec.device_ms * 1000.0, 3), 0.0),
+                "args": {
+                    "device_ms": rec.device_ms,
+                    "host_sync_ms": rec.host_sync_ms,
+                    "mode": rec.mode,
+                },
+            })
         for counter, value in (
             ("occupancy", rec.occupancy),
             ("queue_depth", rec.queue_depth),

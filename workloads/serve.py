@@ -3793,12 +3793,19 @@ class _RecorderDriver:
     state before the bounded rings evict it, neither of which a
     single end-of-run poll can do."""
 
-    def __init__(self, inner, recorder):
+    def __init__(self, inner, recorder, feed=None):
         self._inner = inner
         self._recorder = recorder
+        # Optional SentryFeed (workloads/profiler.py): windowed live
+        # signals into the regression sentry, polled at the recorder's
+        # cadence so a perf_regression fires while the rings still hold
+        # the incident.
+        self._feed = feed
 
     def step(self):
         finished = self._inner.step()
+        if self._feed is not None:
+            self._feed.poll()
         self._recorder.poll()
         return finished
 
@@ -3928,9 +3935,13 @@ def _run_fleet_cli(
         # see supervisor events on the very trace it asked for; only
         # registry BINDING is port-gated.
         from .obs import EngineObserver, FleetObserver
+        from .profiler import DeviceTimeTable
 
         observers = [
-            EngineObserver(name=str(i), replica=str(i))
+            EngineObserver(
+                name=str(i), replica=str(i),
+                device_table=DeviceTimeTable(),
+            )
             for i in range(args.fleet)
         ]
         fleet_obs = FleetObserver()
@@ -3954,6 +3965,35 @@ def _run_fleet_cli(
         fleet_ledger = FleetLedger()
         if args.postmortem_dir is not None:
             recorder = FlightRecorder(out_dir=args.postmortem_dir)
+    sentry_feed = None
+    if recorder is not None:
+        # The live regression sentry rides the flight recorder: the
+        # committed bench artifact contributes the RELATIVE noise band,
+        # each detector self-baselines from its first live windows, and
+        # a confirmed breach fires exactly one perf_regression bundle.
+        from .profiler import (
+            SentryFeed,
+            load_committed_artifact,
+            sentry_from_artifact,
+        )
+
+        artifact = load_committed_artifact()
+        if artifact:
+            sentry = sentry_from_artifact(
+                artifact, live=True, recorder=recorder
+            )
+            if sentry.signals:
+                sentry_feed = SentryFeed(sentry)
+                print(
+                    "sentry armed: watching "
+                    f"{', '.join(sentry.signals)} at the committed "
+                    "artifact's noise band"
+                )
+    profiler = None
+    if args.profile_dir is not None:
+        from .profiler import ProfileSession
+
+        profiler = ProfileSession(args.profile_dir)
     engines = []
     for i in range(args.fleet):
         engines.append(ServeEngine(
@@ -3978,6 +4018,8 @@ def _run_fleet_cli(
         ))
         if recorder is not None:
             recorder.attach_engine(str(i), engines[-1])
+        if sentry_feed is not None:
+            sentry_feed.attach(engines[-1], observers[i])
     fleet = Fleet(
         engines,
         chip_ids=[f"chip-{i}" for i in range(args.fleet)],
@@ -4222,10 +4264,15 @@ def _run_fleet_cli(
 
         server = FleetServer(
             fleet, args.http_port, supervisor=supervisor,
-            autoscaler=autoscaler,
+            autoscaler=autoscaler, profiler=profiler,
         )
         port = server.start()
         print(f"fleet SSE front end: http://127.0.0.1:{port}/v1/generate")
+        if profiler is not None:
+            print(
+                f"profiler armed: POST http://127.0.0.1:{port}"
+                f"/profile?secs=N (dumps -> {args.profile_dir})"
+            )
         statuses: dict[str, int] = {}
         statuses_lock = threading.Lock()
 
@@ -4273,8 +4320,21 @@ def _run_fleet_cli(
         elif supervisor is not None:
             driver = supervisor
         if recorder is not None:
-            driver = _RecorderDriver(driver, recorder)
+            driver = _RecorderDriver(driver, recorder, sentry_feed)
+        if profiler is not None:
+            # No HTTP operator to trigger captures: deep-profile the
+            # whole timed fleet loop (still duration/disk bounded).
+            profiler.start()
         drive_open_loop(driver, sched)
+        if profiler is not None:
+            capture = profiler.stop() or (
+                profiler.captures[-1] if profiler.captures else None
+            )
+            if capture is not None:
+                print(
+                    f"profile: {capture['bytes']} bytes over "
+                    f"{capture['secs']}s -> {capture['dir']}"
+                )
     if recorder is not None:
         recorder.poll()
     if supervisor is not None:
@@ -4367,6 +4427,28 @@ def _run_fleet_cli(
                 f"-> {args.postmortem_dir} "
                 f"(validate: python tools/postmortem.py --validate)"
             )
+    armed_observers = [
+        o for o in list(observers) + respawn_observers if o is not None
+    ]
+    if any(getattr(o, "_wall_ms", 0.0) > 0 for o in armed_observers):
+        from .profiler import device_report
+
+        rep = device_report(armed_observers)
+        per_phase = {
+            ph: d["device_busy_fraction"]
+            for ph, d in rep["phases"].items()
+        }
+        print(
+            f"device: busy_fraction={rep['device_busy_fraction']:.3f} "
+            f"host_stall_fraction={rep['host_stall_fraction']:.3f} "
+            f"per_phase={per_phase}"
+        )
+    if sentry_feed is not None:
+        st = sentry_feed.sentry.state()
+        print(
+            f"sentry: armed={st['armed']} fired={st['fired']} "
+            f"incidents={[i['signal'] for i in st['incidents']]}"
+        )
     attainment = fleet.slo_attainment()
     if any(v is not None for v in attainment.values()):
         burn = fleet.slo_burn_rates()
@@ -4555,6 +4637,14 @@ def main(argv=None) -> int:
                         "ledger snapshots + supervisor/autoscaler "
                         "events) into DIR — validate with "
                         "tools/postmortem.py --validate")
+    parser.add_argument("--profile-dir", default=None, metavar="DIR",
+                        help="arm on-demand deep profiling: a bounded "
+                        "jax.profiler ProfileSession dumps device traces "
+                        "into DIR — a single-engine or non-HTTP fleet run "
+                        "captures its timed loop; with --http-port the "
+                        "capture is operator-triggered via POST "
+                        "/profile?secs=N (docs/OBSERVABILITY.md "
+                        "'Device-time profiling')")
     parser.add_argument("--max-pending", type=int, default=None,
                         help="bounded admission: reject (typed QueueFull) "
                         "instead of queueing more than N pending requests "
@@ -4797,10 +4887,13 @@ def main(argv=None) -> int:
     ):
         # --postmortem-dir arms the observer too: the flight recorder's
         # bundles embed its step/span rings (counters alone make a thin
-        # black box).
+        # black box).  The device-time table splits each step's wall
+        # into device-busy vs host-stall (StepRecord.device_ms, the
+        # engine_device_seconds family and the trace's device lane).
         from .obs import EngineObserver
+        from .profiler import DeviceTimeTable
 
-        observer = EngineObserver()
+        observer = EngineObserver(device_table=DeviceTimeTable())
     if args.metrics_port is not None:
         from tpu_device_plugin.metrics import MetricsServer, registry
 
@@ -4932,6 +5025,14 @@ def main(argv=None) -> int:
     # canary or a dedicated warm request.)
     with lease.chip_lease():
         engine.step()
+    profiler = None
+    if args.profile_dir is not None:
+        # Deep-profile the TIMED loop (warmup compiles excluded): the
+        # capture is duration- and disk-bounded by the session.
+        from .profiler import ProfileSession
+
+        profiler = ProfileSession(args.profile_dir)
+        profiler.start()
     tokens_before = engine.generated_tokens
     t0 = time.perf_counter()
     while not engine.idle:
@@ -4940,6 +5041,15 @@ def main(argv=None) -> int:
         if recorder is not None:
             recorder.poll()
     elapsed = time.perf_counter() - t0
+    if profiler is not None:
+        capture = profiler.stop() or (
+            profiler.captures[-1] if profiler.captures else None
+        )
+        if capture is not None:
+            print(
+                f"profile: {capture['bytes']} bytes over "
+                f"{capture['secs']}s -> {capture['dir']}"
+            )
     generated = engine.generated_tokens - tokens_before
     rate = generated / elapsed if elapsed > 0 and generated else 0.0
     print(
@@ -4978,6 +5088,16 @@ def main(argv=None) -> int:
             f"{kv}"
             f"host_sync_ms={round(engine.host_sync_s * 1000, 1)} "
             f"recoveries_ms={[round(s * 1000, 1) for s in engine.fault_recovery_s]}"
+        )
+    if observer is not None and getattr(observer, "_wall_ms", 0.0) > 0:
+        from .profiler import device_report
+
+        rep = device_report([observer])
+        print(
+            f"device: busy_fraction={rep['device_busy_fraction']:.3f} "
+            f"host_stall_fraction={rep['host_stall_fraction']:.3f} "
+            f"device_ms={rep['device_ms']} wall_ms={rep['wall_ms']} "
+            f"table_entries={len(observer.device_table or ())}"
         )
     if ledger is not None:
         if recorder is not None:
