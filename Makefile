@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check profile-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check profile-check durable-check fmt-check
 
 all: native
 
@@ -51,7 +51,7 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check profile-check test
+check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check profile-check durable-check test
 
 # Chip-time-ledger tripwires (docs/OBSERVABILITY.md "Chip-time ledger,
 # goodput & postmortems"): one seeded fault run with the ledger and
@@ -130,6 +130,17 @@ slo-check:
 # (tests/test_serve_fuzz.py).
 kvsched-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_kvsched.py -q -o addopts=
+
+# Durable-session tripwires (docs/SERVING.md "Durable sessions"): one
+# seeded kill-and-restore smoke — a journaled fleet with the KV disk
+# tier armed is killed mid-stream, a FRESH fleet restores from nothing
+# but the journal + per-page disk files, and every continuation is
+# asserted bit-identical to the uninterrupted oracle — plus the bf16
+# disk-page round-trip pin.  The full pinned suite and the
+# kv_disk/restart-randomized fuzz arms ride the slow suite
+# (tests/test_durable.py, tests/test_serve_fuzz.py).
+durable-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_durable.py::test_durable_check_smoke" "tests/test_durable.py::test_disk_page_roundtrip_preserves_bfloat16" -q -o addopts=
 
 # KV-cache-hierarchy tripwires (docs/SERVING.md "KV-cache hierarchy"):
 # radix-tree parity vs the flat chain cache on one repeated-prefix

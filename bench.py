@@ -607,6 +607,12 @@ COMPACT_KEYS = [
     "kvsched_vs_replica_tokens_per_sec", "kvsched_busy_fraction",
     "kvsched_goodput_fraction", "kvsched_page_waste_pct",
     "kvsched_page_dispatches", "kvsched_offload_spills",
+    # Durable sessions: the crash-recovery RTO (journal -> resurrected
+    # fleet, streams bit-identical to the uninterrupted oracle), the
+    # per-page disk->HBM reload tax, the hibernation fan-out over hot
+    # memory, and the durability-off rate pinned pay-for-what-you-use.
+    "durable_restore_ms", "kv_disk_reload_ms",
+    "durable_sessions_per_hbm_page", "durable_off_tokens_per_sec",
     # spec_round_readback_ms travels NEXT TO the spec-serve tok/s in the
     # headline so the link-tax-bound absolute number cannot be misread
     # as the design's ceiling (VERDICT r5 weak #3).

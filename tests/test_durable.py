@@ -1,0 +1,379 @@
+"""Durable sessions (docs/SERVING.md "Durable sessions"): the KV disk
+tier below host RAM, the crash-surviving session journal, and
+``Fleet.restore`` — exact continuation across full-process restarts.
+
+The contracts split in four bands:
+  * durable primitives (atomic writes never expose a torn file, disk
+    pages are checksummed + named by chain key so dedup is structural,
+    bfloat16 payloads round-trip bit-exactly — the npz void-degrade
+    regression, corrupt reads quarantine to a miss, the mtime-LRU
+    budget, journal rotation/epochs/torn-write fallback);
+  * fault seams (``kv_disk_write_fail`` / ``kv_disk_read_corrupt`` /
+    ``journal_torn_write`` drive exactly the production degrade paths);
+  * restart bit-identity (a journaled fleet killed mid-stream is
+    rebuilt in a FRESH fleet from nothing but the journal + per-page
+    disk files, and every continuation matches the uninterrupted
+    oracle — the restart moves time, never a token);
+  * degrade hardening rides along (EngineSnapshot.load and
+    DeviceTimeTable.refresh_from_artifact treat corrupt artifacts as
+    cold starts, never crashes).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+
+from workloads.durable import (
+    KVDiskTier,
+    SessionJournal,
+    _pack_blob,
+    _unpack_blob,
+    atomic_write_bytes,
+    atomic_write_json,
+)
+from workloads.faults import FaultInjector
+from workloads.fleet import Fleet
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+
+
+def _blob(seed=0, dtype=np.float32, draft=False):
+    rng = np.random.default_rng(seed)
+    def arr():
+        return rng.standard_normal((2, 4, 8)).astype(dtype)
+    return ((arr(), arr()), (arr(), arr()) if draft else None)
+
+
+def _blobs_equal(a, b):
+    (amk, amv), ad = a
+    (bmk, bmv), bd = b
+    if (ad is None) != (bd is None):
+        return False
+    pairs = [(amk, bmk), (amv, bmv)]
+    if ad is not None:
+        pairs += list(zip(ad, bd))
+    return all(
+        x.dtype == y.dtype and x.shape == y.shape and np.array_equal(x, y)
+        for x, y in pairs
+    )
+
+
+# ---- atomic writes -------------------------------------------------------
+
+
+def test_atomic_write_replaces_whole_file_and_cleans_tmp(tmp_path):
+    """Successive writes leave exactly the LAST payload and no temp
+    droppings — the invariant every durable artifact in the tree leans
+    on (snapshots, journals, disk pages, postmortem bundles)."""
+    path = str(tmp_path / "artifact.bin")
+    atomic_write_bytes(path, b"first generation")
+    atomic_write_bytes(path, b"second")
+    with open(path, "rb") as f:
+        assert f.read() == b"second"
+    assert os.listdir(tmp_path) == ["artifact.bin"]
+
+
+def test_atomic_write_json_round_trips(tmp_path):
+    path = str(tmp_path / "doc.json")
+    doc = {"b": [1, 2, 3], "a": {"nested": True}}
+    atomic_write_json(path, doc)
+    with open(path, encoding="utf-8") as f:
+        assert json.load(f) == doc
+
+
+# ---- disk pages ----------------------------------------------------------
+
+
+def test_disk_page_roundtrip_preserves_bfloat16():
+    """The regression pin: a plain np.savez/np.load round trip degrades
+    ml_dtypes arrays to raw void (``|V2``) — which jnp.asarray then
+    rejects at reload, killing every restored stream.  The raw-bytes +
+    dtype-sidecar format must hand back the exact dtype and bytes."""
+    blob = _blob(seed=3, dtype=ml_dtypes.bfloat16, draft=True)
+    out = _unpack_blob(_pack_blob(blob))
+    assert out[0][0].dtype == np.dtype(ml_dtypes.bfloat16)
+    assert _blobs_equal(out, blob)
+    # And the failure the format exists to prevent, so this pin fails
+    # loudly if numpy ever changes the hazard out from under us.
+    import io
+
+    bio = io.BytesIO()
+    np.savez(bio, mk=blob[0][0])
+    bio.seek(0)
+    with np.load(bio) as z:
+        degraded = z["mk"]
+    assert degraded.dtype != np.dtype(ml_dtypes.bfloat16)
+
+
+def test_unpack_rejects_damage():
+    data = _pack_blob(_blob())
+    with pytest.raises(ValueError):
+        _unpack_blob(b"NOTMAGIC" + data[8:])
+    flipped = bytearray(data)
+    flipped[-1] ^= 0xFF
+    with pytest.raises(ValueError):
+        _unpack_blob(bytes(flipped))
+    with pytest.raises(ValueError):
+        _unpack_blob(data[: len(data) // 2])
+
+
+def test_disk_tier_put_get_dedup_and_counters(tmp_path):
+    """Files are NAMED by chain key, so a second put of the same key —
+    from any engine, replica, or process — is a touch, not a write."""
+    tier = KVDiskTier(str(tmp_path))
+    blob = _blob(seed=1, draft=True)
+    assert tier.put("ab12", blob) and tier.writes == 1
+    assert tier.put("ab12", blob) and tier.writes == 1
+    assert tier.dedup_hits == 1 and tier.pages == 1
+    # A second tier over the same directory sees the same file: the
+    # directory IS the dedup namespace.
+    other = KVDiskTier(str(tmp_path))
+    assert other.contains("ab12")
+    got = other.get("ab12")
+    assert got is not None and _blobs_equal(got, blob)
+    assert other.reads == 1
+    with pytest.raises(ValueError):
+        tier.put("not-hex!", blob)
+    with pytest.raises(ValueError):
+        KVDiskTier(str(tmp_path), budget_pages=0)
+
+
+def test_disk_tier_budget_evicts_coldest_by_mtime(tmp_path):
+    tier = KVDiskTier(str(tmp_path), budget_pages=2)
+    for i, key in enumerate(("aa", "bb", "cc")):
+        tier.put(key, _blob(seed=i))
+        os.utime(tier._path(key), (i + 1, i + 1))  # deterministic ages
+    assert tier.pages == 2 and tier.evictions == 1
+    assert not tier.contains("aa")  # coldest went first
+    assert tier.contains("bb") and tier.contains("cc")
+
+
+def test_disk_tier_corrupt_read_quarantines_to_miss(tmp_path):
+    """A damaged file is counted, unlinked, and served as a miss — the
+    tier converges back to clean instead of re-reading the damage (and
+    a re-put can then land a good copy)."""
+    tier = KVDiskTier(str(tmp_path))
+    blob = _blob(seed=2)
+    tier.put("0f", blob)
+    with open(tier._path("0f"), "r+b") as f:
+        f.seek(30)
+        f.write(b"\xff\xff\xff\xff")
+    assert tier.get("0f") is None
+    assert tier.read_corrupt == 1 and not tier.contains("0f")
+    assert tier.put("0f", blob) and tier.writes == 2
+    assert tier.get("0f") is not None
+
+
+def test_disk_tier_fault_seams_degrade_not_raise(tmp_path):
+    """The injector seams take exactly the production degrade paths: a
+    failed write returns False (blob stays in host RAM), a corrupt
+    read quarantines to a miss — neither ever raises to the caller."""
+    inj = FaultInjector(
+        {"kv_disk_write_fail": 1, "kv_disk_read_corrupt": 1}
+    )
+    tier = KVDiskTier(str(tmp_path), injector=inj)
+    blob = _blob(seed=4)
+    assert tier.put("e0", blob) is False and tier.write_failures == 1
+    assert tier.put("e0", blob) is True  # crossing 2: lands
+    assert tier.get("e0") is None  # injected damage -> quarantined
+    assert tier.read_corrupt == 1 and not tier.contains("e0")
+
+
+# ---- session journal -----------------------------------------------------
+
+
+def test_journal_rotation_and_epochs_survive_restart(tmp_path):
+    """Epochs are monotonic ACROSS writers (the kvsched claim-epoch
+    discipline): a fresh-process journal over the same directory can
+    never stamp an epoch a reader has already seen."""
+    j1 = SessionJournal(str(tmp_path))
+    assert j1.write([{"rid": "a"}]) == 0
+    assert j1.write([{"rid": "a"}, {"rid": "b"}]) == 1
+    records, reason = j1.load()
+    assert reason == "ok" and [r["rid"] for r in records] == ["a", "b"]
+    # The previous generation is the FIRST write, kept beside it.
+    assert os.path.exists(j1.prev_path)
+    j2 = SessionJournal(str(tmp_path))  # "fresh process"
+    assert j2.write([{"rid": "c"}]) == 2
+    assert j2.load()[0] == [{"rid": "c"}]
+
+
+def test_journal_torn_write_falls_back_one_generation(tmp_path):
+    inj = FaultInjector({"journal_torn_write": 2})
+    j = SessionJournal(str(tmp_path), injector=inj)
+    j.write([{"rid": "good"}])
+    j.write([{"rid": "torn"}])  # crossing 2: dies mid-write
+    assert j.writes == 1 and j.torn_writes == 1
+    records, reason = j.load()
+    assert reason == "fallback" and records == [{"rid": "good"}]
+
+
+def test_journal_absent_and_doubly_corrupt(tmp_path):
+    j = SessionJournal(str(tmp_path))
+    assert j.load() == (None, "absent")
+    j.write([{"rid": "x"}])
+    j.write([{"rid": "y"}])
+    for path in (j.path, j.prev_path):
+        with open(path, "w", encoding="utf-8") as f:
+            f.write('{"version": 1, "records"')  # torn prefix
+    assert j.load() == (None, "corrupt")
+
+
+# ---- restart bit-identity ------------------------------------------------
+
+
+def _reqs():
+    """The bench arm's shape at test scale: a shared system template
+    (the disk tier dedups it) + per-request tails, budgets staggered so
+    a 3-step kill lands genuinely mid-stream."""
+    key = jax.random.PRNGKey(23)
+    prefix = [int(t) for t in jax.random.randint(
+        jax.random.fold_in(key, 0), (8,), 0, CONFIG.vocab_size, jnp.int32,
+    )]
+    reqs = []
+    for i in range(4):
+        tail = [int(t) for t in jax.random.randint(
+            jax.random.fold_in(key, 100 + i), (1 + i % 4,), 0,
+            CONFIG.vocab_size, jnp.int32,
+        )]
+        reqs.append((prefix + tail, 13 - (i * 4) % 8))
+    return reqs
+
+
+def _mk_fleet(params, root):
+    """Two-replica fleet; ``root=None`` builds the durability-off
+    oracle (no disk tier, no journal — the pay-for-what-you-use pin is
+    that its streams are the reference)."""
+    durable = root is not None
+    engines = [
+        ServeEngine(
+            params, CONFIG, slots=2, page_size=4, chunk=4,
+            prompt_bucket=4, pipelined=True, n_pages=14,
+            prefix_cache=True,
+            kv_offload=durable,
+            kv_host_pages=28 if durable else None,
+            kv_disk_dir=os.path.join(root, "kv") if durable else None,
+        )
+        for _ in range(2)
+    ]
+    return Fleet(
+        engines, chip_ids=["chip-0", "chip-1"], hang_timeout_s=60.0,
+        journal_dir=os.path.join(root, "journal") if durable else None,
+    )
+
+
+def test_durable_check_smoke(tmp_path):
+    """The acceptance pin, end to end: kill a journaled fleet
+    mid-stream, rebuild a FRESH fleet from nothing but the journal +
+    per-page disk files, and every restored stream must be
+    bit-identical to the uninterrupted durability-OFF oracle — then
+    fresh submissions keep working (the rid counter fast-forwarded
+    past every restored rid)."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    reqs = _reqs()
+
+    oracle = _mk_fleet(params, None)
+    rids = [oracle.submit(p, n) for p, n in reqs]
+    oracle.run()
+    done = {fr.rid: fr for fr in oracle.drain_completed()}
+    assert {done[r].status for r in rids} == {"ok"}
+    ref = [list(done[r].tokens) for r in rids]
+    oracle.close()
+
+    root = str(tmp_path)
+    fleet = _mk_fleet(params, root)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    with fleet._lock:
+        for _ in range(3):  # mid-stream, then the process "dies"
+            if not fleet.idle:
+                fleet.step()
+    fleet.close()  # journals live sessions before going dark
+    assert fleet.journal_writes >= 1
+    assert os.listdir(os.path.join(root, "kv"))  # pages parked on disk
+
+    fleet2 = _mk_fleet(params, root)
+    restored = fleet2.restore()
+    assert restored == len(reqs) and fleet2.sessions_restored == restored
+    # The kill must land genuinely mid-stream, or this test silently
+    # degrades to restoring completed sessions.
+    assert sum(1 for fr in fleet2.queue if fr.tokens) >= 1
+    assert fleet2.tokens_replayed > 0
+    fleet2.run()
+    done = {fr.rid: fr for fr in fleet2.drain_completed()}
+    assert [list(done[r].tokens) for r in rids] == ref
+
+    # Fresh work composes: no rid collision with the resurrected ones.
+    fresh = fleet2.submit(reqs[0][0], 2)
+    assert fresh not in rids
+    fleet2.run()
+    tokens, done, status = fleet2.poll(fresh)
+    assert done and status == "ok" and len(tokens) == 2
+    fleet2.close()
+
+
+def test_restore_is_boot_time_only_and_cold_start_is_zero(tmp_path):
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    fleet = _mk_fleet(params, str(tmp_path))
+    assert fleet.restore() == 0  # absent journal: cold start, no raise
+    fleet.submit([1, 2, 3], 2)
+    with pytest.raises(RuntimeError, match="boot-time"):
+        fleet.restore()
+    fleet.close()
+
+
+def test_completed_sessions_restore_as_history_without_redispatch(tmp_path):
+    """Terminal journal records come back pollable with their exact
+    tokens but move no terminal counters (they were the dead process's
+    work); a journaled-complete live stream finishes without a single
+    new dispatch."""
+    params = init_params(CONFIG, jax.random.PRNGKey(0))
+    reqs = _reqs()
+    root = str(tmp_path)
+    fleet = _mk_fleet(params, root)
+    rids = [fleet.submit(p, n) for p, n in reqs]
+    fleet.run()  # everything completes, THEN the process dies
+    ref = {r: fleet.poll(r)[0] for r in rids}
+    fleet.close()
+
+    fleet2 = _mk_fleet(params, root)
+    assert fleet2.restore() == len(reqs)
+    for r in rids:
+        tokens, done, status = fleet2.poll(r)
+        assert done and status == "ok" and tokens == ref[r]
+    assert not fleet2.queue  # nothing left to dispatch...
+    assert fleet2.generated_tokens == 0  # ...and nothing re-decoded
+    fleet2.close()
+
+
+# ---- degrade hardening (snapshot + device table) -------------------------
+
+
+def test_engine_snapshot_corrupt_artifact_degrades_to_cold(tmp_path):
+    from workloads.faststart import EngineSnapshot
+
+    path = str(tmp_path / "snap.json")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write('{"version": 1, "config_fingerprint"')  # torn
+    before = EngineSnapshot.load_errors
+    assert EngineSnapshot.load(path) is None
+    assert EngineSnapshot.load(str(tmp_path / "missing.json")) is None
+    assert EngineSnapshot.load_errors == before + 2
+
+
+def test_device_table_corrupt_artifact_adopts_nothing(tmp_path):
+    from workloads.profiler import DeviceTimeTable
+
+    path = str(tmp_path / "bench.json")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("{torn artifact")
+    table = DeviceTimeTable()
+    assert table.refresh_from_artifact(path) == 0
+    assert table.refresh_from_artifact(["not", "a", "dict"]) == 0
+    assert table.refresh_errors == 2

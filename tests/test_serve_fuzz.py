@@ -414,6 +414,19 @@ def test_injector_off_streams_bit_identical():
 
 
 def _run_fleet_chaos(seed: int, params, adapters) -> None:
+    import shutil
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="fuzz-durable-")
+    try:
+        _run_fleet_chaos_impl(seed, params, adapters, root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _run_fleet_chaos_impl(seed: int, params, adapters, root: str) -> None:
+    import os
+
     from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
     from tpu_device_plugin.device import HealthEvent
     from workloads.errors import QueueFull
@@ -423,11 +436,22 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
     rng = np.random.default_rng(seed + 77000)
     n = int(rng.integers(2, 5))
     use_adapters = bool(rng.integers(2))
+    # Durable sessions under chaos (workloads/durable.py): on half the
+    # seeds the fleet journals sessions (replicas with kv_offload also
+    # share one --kv-disk-dir, durable seams riding the per-engine
+    # injectors) and a SCHEDULED process restart lands mid-loop — the
+    # fleet is torn down, a FRESH one rebuilt from nothing but the
+    # journal + disk pages, and the same seeded stream continues.  All
+    # the oracle pins below then hold ACROSS process death.
+    durable = bool(rng.integers(2))
+    journal_dir = os.path.join(root, "journal") if durable else None
+    restart_at = int(rng.integers(3, 10)) if durable else None
     fleet_inj = FaultInjector.random(
         seed=seed, rate=0.03, seams=REPLICA_SEAMS,
         max_fires=int(rng.integers(1, n)),  # >= 1 replica always survives
     )
     engines = []
+    engine_kws = []  # the restart rebuilds the same replica shapes
     for i in range(n):
         kw = dict(
             slots=int(rng.integers(1, 3)),
@@ -440,15 +464,22 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
         # replay re-prefill when not — both must stay oracle-true).
         if kw["prefix_cache"] and rng.integers(2):
             kw["kv_offload"] = True
+            if durable:
+                # One shared directory — chain-key filenames make the
+                # sharing the dedup, including across the restart.
+                kw["kv_disk_dir"] = os.path.join(root, "kv")
         kw["prompt_bucket"] = int(kw["page_size"] * rng.choice([2, 3]))
         if rng.integers(2):
             kw["prefill_budget"] = int(
                 rng.choice([1, kw["prompt_bucket"]])
             )
+        engine_kws.append(kw)
         engines.append(ServeEngine(
             params, CONFIG,
             adapters=adapters if use_adapters else None,
             fault_injector=(
+                # Default seams, so kv_disk_write_fail /
+                # kv_disk_read_corrupt degrade paths fire under chaos.
                 FaultInjector.random(
                     seed=seed * 13 + i, rate=0.02, max_fires=2
                 ) if rng.integers(2) else None
@@ -469,9 +500,11 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
     # Fleet-scope chip-time ledger under chaos (workloads/ledger.py):
     # per-replica ledgers + the fleet roll-up, randomized on — the
     # failover/cancel/handoff taxonomy must still balance fleet-wide
-    # at the bottom (and the oracle pins below imply inertness).
+    # at the bottom (and the oracle pins below imply inertness).  Not
+    # under the scheduled restart: the ledger is per-process state, so
+    # a mid-run teardown legitimately splits its books.
     fleet_ledger = None
-    if rng.integers(2):
+    if rng.integers(2) and not durable:
         from workloads.ledger import ChipTimeLedger, FleetLedger
 
         fleet_ledger = FleetLedger()
@@ -488,6 +521,8 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
             str(rng.choice(["prefill", "decode", "mixed"]))
             for _ in range(n)
         ]
+    max_pending = int(rng.choice([4, 32]))
+    page_sched = bool(rng.integers(2))
     fleet = Fleet(
         engines, chip_ids=[f"chip-{i}" for i in range(n)],
         fault_injector=fleet_inj, max_failovers=2, slow_readback_s=0.0,
@@ -495,15 +530,18 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
         # wall-clock watchdog would turn host-load-dependent XLA compile
         # times into nondeterministic replica kills.
         hang_timeout_s=None,
-        max_pending=int(rng.choice([4, 32])),
+        max_pending=max_pending,
         roles=roles,
         ledger=fleet_ledger,
         # Page-granular dispatch on half the seeds: placement may move,
         # tokens must not (the kvsched degrade contract under chaos).
-        page_scheduling=bool(rng.integers(2)),
+        page_scheduling=page_sched,
+        journal_dir=journal_dir,
+        journal_every=int(rng.choice([2, 5])) if durable else None,
     )
     names = [None] + (sorted(adapters) if use_adapters else [])
     expected = {}
+    terminal_frs: dict = {}  # rid -> FleetRequest (survives the restart)
     pending_submits = []
     for _ in range(int(rng.integers(5, 10))):
         plen = int(rng.integers(1, 25))
@@ -571,11 +609,41 @@ def _run_fleet_chaos(seed: int, params, adapters) -> None:
             assert fr.rid not in terminal, (seed, fr.rid, "double terminal")
             assert fr.status in TERMINAL, (seed, fr.rid, fr.status)
             terminal[fr.rid] = fr.status
+            terminal_frs[fr.rid] = fr
+        if restart_at is not None and steps >= restart_at:
+            # The scheduled process death: close() journals live
+            # sessions, then a FRESH fleet (same replica shapes, empty
+            # pools, empty radix) is rebuilt from what survived on
+            # disk and the SAME seeded stream continues.  Terminal
+            # non-ok rids are deliberately absent from the journal
+            # (nothing to resume) — `terminal_frs` keeps their streams
+            # for the oracle pins below; every still-live rid must
+            # terminalize exactly once in the new process, or the
+            # one-terminal-per-rid / set-equality asserts fail.
+            restart_at = None
+            fleet.close()
+            engines = [
+                ServeEngine(
+                    params, CONFIG,
+                    adapters=adapters if use_adapters else None,
+                    max_retries=2, **kw,
+                )
+                for kw in engine_kws
+            ]
+            fleet = Fleet(
+                engines, chip_ids=[f"chip-{i}" for i in range(n)],
+                max_failovers=2, slow_readback_s=0.0,
+                hang_timeout_s=None, max_pending=max_pending,
+                roles=roles, page_scheduling=page_sched,
+                journal_dir=journal_dir,
+            )
+            fleet.restore()
+            added = True  # chip-n may exist; don't re-add post-restart
     assert set(terminal) == set(expected), (
         seed, set(expected) ^ set(terminal),
     )
     for rid, (prompt, new, adapter) in expected.items():
-        fr = fleet._reqs[rid]
+        fr = fleet._reqs.get(rid) or terminal_frs[rid]
         ref = [int(t) for t in np.asarray(generate(
             model_for(adapter), jnp.asarray([prompt], jnp.int32), CONFIG,
             max_new_tokens=new,
@@ -632,6 +700,19 @@ def test_fleet_chaos_fuzz():
 
 
 def _run_supervised_chaos(seed: int, params, adapters) -> None:
+    import shutil
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="fuzz-durable-sup-")
+    try:
+        _run_supervised_chaos_impl(seed, params, adapters, root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _run_supervised_chaos_impl(seed: int, params, adapters, root) -> None:
+    import os
+
     from tpu_device_plugin.api.constants import HEALTHY, UNHEALTHY
     from tpu_device_plugin.device import HealthEvent
     from workloads.backoff import Backoff
@@ -657,6 +738,18 @@ def _run_supervised_chaos(seed: int, params, adapters) -> None:
     engine_kw["prompt_bucket"] = int(
         engine_kw["page_size"] * rng.choice([2, 3])
     )
+    # Durable sessions under supervision: on half the seeds the fleet
+    # journals (the supervisor checkpoints on deaths + wall cadence),
+    # kv_offload replicas share one disk dir via the engine factory,
+    # and a SCHEDULED full-process restart (fresh fleet + fresh
+    # supervisor from the journal) lands mid-loop — convergence and
+    # every oracle pin below must hold across it.
+    durable = bool(rng.integers(2))
+    journal_dir = os.path.join(root, "journal") if durable else None
+    restart_at = int(rng.integers(3, 12)) if durable else None
+    if durable and engine_kw["prefix_cache"]:
+        engine_kw["kv_offload"] = True
+        engine_kw["kv_disk_dir"] = os.path.join(root, "kv")
     fleet_inj = FaultInjector.random(
         seed=seed, rate=0.03,
         seams=("replica_crash", "replica_hang"),
@@ -667,14 +760,17 @@ def _run_supervised_chaos(seed: int, params, adapters) -> None:
     engines = [
         ServeEngine(params, CONFIG, **engine_kw) for _ in range(n)
     ]
+    mppr = int(rng.choice([3, 16]))
+    page_sched = bool(rng.integers(2))
     fleet = Fleet(
         engines, chip_ids=[f"chip-{i}" for i in range(n)],
         fault_injector=fleet_inj, max_failovers=2,
         hang_timeout_s=None,
-        max_pending_per_replica=int(rng.choice([3, 16])),
+        max_pending_per_replica=mppr,
         # Page-granular dispatch on half the seeds: supervised
         # resurrection must stay stream-invariant either way.
-        page_scheduling=bool(rng.integers(2)),
+        page_scheduling=page_sched,
+        journal_dir=journal_dir,
     )
     # Fast-start snapshot on half the seeds: the factory primes every
     # resurrection with warmed state captured from replica 0 (same
@@ -692,15 +788,21 @@ def _run_supervised_chaos(seed: int, params, adapters) -> None:
         snapshot=snapshot,
     )
     crash_loop = bool(rng.integers(2))
-    sup = FleetSupervisor(
-        fleet, factory,
-        backoff=Backoff(base_s=1e-3, max_s=8e-3, jitter=0.0),
-        probe=([1, 2, 3], 4), probe_oracle=oracle,
-        snapshot=snapshot,
-        crash_loop_k=3, crash_loop_window_s=60.0,
-        fault_injector=(
-            FaultInjector(crash_loop_schedule(2)) if crash_loop else None
-        ),
+
+    def mk_sup(target, injector):
+        return FleetSupervisor(
+            target, factory,
+            backoff=Backoff(base_s=1e-3, max_s=8e-3, jitter=0.0),
+            probe=([1, 2, 3], 4), probe_oracle=oracle,
+            snapshot=snapshot,
+            crash_loop_k=3, crash_loop_window_s=60.0,
+            fault_injector=injector,
+            journal_every_s=1e-3 if durable else None,
+        )
+
+    sup = mk_sup(
+        fleet,
+        FaultInjector(crash_loop_schedule(2)) if crash_loop else None,
     )
     names = [None] + (sorted(adapters) if use_adapters else [])
     merged_cache: dict = {}
@@ -724,6 +826,7 @@ def _run_supervised_chaos(seed: int, params, adapters) -> None:
         pending_submits.append((prompt, new, adapter, deadline))
     expected = {}
     terminal: dict[str, str] = {}
+    terminal_frs: dict = {}  # rid -> FleetRequest (survives the restart)
     steps = 0
     while pending_submits or not fleet.idle:
         steps += 1
@@ -759,6 +862,28 @@ def _run_supervised_chaos(seed: int, params, adapters) -> None:
             assert fr.rid not in terminal, (seed, fr.rid, "double terminal")
             assert fr.status in TERMINAL, (seed, fr.rid, fr.status)
             terminal[fr.rid] = fr.status
+            terminal_frs[fr.rid] = fr
+        if restart_at is not None and steps >= restart_at:
+            # The scheduled full-process death: close() journals live
+            # sessions, then a FRESH fleet AND supervisor rebuild from
+            # the journal + disk pages and the same stream continues
+            # (the dead process's quarantines/backoffs are gone with
+            # it — slot history is process state, sessions are not).
+            restart_at = None
+            fleet.close()
+            engines = [
+                ServeEngine(params, CONFIG, **engine_kw)
+                for _ in range(n)
+            ]
+            fleet = Fleet(
+                engines, chip_ids=[f"chip-{i}" for i in range(n)],
+                max_failovers=2, hang_timeout_s=None,
+                max_pending_per_replica=mppr,
+                page_scheduling=page_sched,
+                journal_dir=journal_dir,
+            )
+            fleet.restore()
+            sup = mk_sup(fleet, None)
     # Lift any lingering health marks so deferred resurrections can
     # proceed, then the fleet must converge BACK to full capacity.
     ev = HealthEvent(chip_id="", health=HEALTHY)
@@ -780,7 +905,7 @@ def _run_supervised_chaos(seed: int, params, adapters) -> None:
         seed, set(expected) ^ set(terminal),
     )
     for rid, (prompt, new, adapter) in expected.items():
-        fr = fleet._reqs[rid]
+        fr = fleet._reqs.get(rid) or terminal_frs[rid]
         ref = [int(t) for t in np.asarray(generate(
             model_for(adapter), jnp.asarray([prompt], jnp.int32), CONFIG,
             max_new_tokens=new,

@@ -167,6 +167,13 @@ TRACKED_DOWN = [
     # bit-identical on/off by construction, so a rise is pure
     # attribution cost creeping into the step loop).
     "profiler_overhead_pct",
+    # Durable sessions: journal -> resurrected fleet wall time (the
+    # crash-recovery RTO; restored streams bit-identical to the
+    # uninterrupted oracle by construction, so a rise is pure restore
+    # cost), and the per-page disk->HBM reload latency (checksum
+    # verify + device put) hibernated sessions pay to come back.
+    "durable_restore_ms",
+    "kv_disk_reload_ms",
 ]
 
 # The serving keys whose thresholds derive from the artifact's own

@@ -388,10 +388,56 @@ def selfcheck() -> int:
                 errors.append(
                     "perf_regression bundle lacks embedded sentry state"
                 )
+        # Restart round-trip (durable sessions): checkpoint a session
+        # journal, re-open it as a FRESH process would, and demand (a)
+        # the records survive bit-exact, (b) epochs stay monotonic
+        # across the restart, (c) a post-restart ledger that charges
+        # the restored session's re-prefill as replay waste still
+        # reconciles — the --validate identity held across a process
+        # death, not just within one life.
+        from workloads.durable import SessionJournal
+
+        journal = SessionJournal(os.path.join(out_dir, "journal"))
+        records = [{
+            "rid": "fr-0", "prompt": [1, 2, 3], "tokens": [4, 5],
+            "max_new_tokens": 8, "eos_token": None, "adapter": None,
+            "session": None, "slo_class": None, "status": "live",
+        }]
+        journal.write(records)
+        pre_epoch = journal.write(records)  # rotates a .prev generation
+        reopened = SessionJournal(os.path.join(out_dir, "journal"))
+        got, reason = reopened.load()
+        if reason != "ok" or got != records:
+            errors.append(
+                f"journal restart round-trip: reason={reason!r}"
+            )
+        if reopened.write(records) <= pre_epoch:
+            errors.append("journal epochs rolled back across restart")
+        eng_r = _fake_engine("0-restarted")
+        rec_r = FlightRecorder(out_dir=out_dir, name="restarted")
+        rec_r.attach_engine("0-restarted", eng_r)
+        # The restored continuation re-prefills prompt + journaled
+        # tokens — the replay waste class, same as a failover's.
+        eng_r.tokens_replayed += len(records[0]["prompt"]) + len(
+            records[0]["tokens"]
+        )
+        _drive(eng_r, "0-restarted", quarantine=False)
+        restart_bundle = rec_r.dump_bundle(
+            trigger="manual", detail="post-restart"
+        )
+        errors += validate_file(restart_bundle)
+        with open(restart_bundle) as f:
+            rbundle = json.load(f)
+        rled = rbundle["replicas"]["0-restarted"]["ledger"]
+        if rled["waste_tokens"]["replay"] != 5:
+            errors.append(
+                "post-restart replay waste did not book (want 5, got "
+                f"{rled['waste_tokens']['replay']})"
+            )
     finally:
-        for fn in os.listdir(out_dir):
-            os.unlink(os.path.join(out_dir, fn))
-        os.rmdir(out_dir)
+        import shutil
+
+        shutil.rmtree(out_dir, ignore_errors=True)
     if errors:
         for e in errors:
             print(f"postmortem selfcheck: {e}", file=sys.stderr)

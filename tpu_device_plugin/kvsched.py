@@ -95,14 +95,13 @@ def write_stats_snapshot(
             for cid, signals in chips.items()
         },
     }
-    directory = os.path.dirname(os.path.abspath(path))
-    os.makedirs(directory, exist_ok=True)
-    tmp = os.path.join(directory, f".{os.path.basename(path)}.tmp.{os.getpid()}")
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(doc, f, separators=(",", ":"))
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    # The shared durable-write helper (workloads/durable.py) is this
+    # function's original temp+fsync+replace pattern, factored out so
+    # every saver (snapshots, journals, disk-tier pages, bundles)
+    # closes the same torn-write window the same way.
+    from workloads.durable import atomic_write_json
+
+    atomic_write_json(path, doc)
     return stamped
 
 

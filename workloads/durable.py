@@ -1,0 +1,492 @@
+"""Durable sessions: the disk tier below host RAM, and crash-surviving
+session journals (docs/SERVING.md "Durable sessions").
+
+The KV-cache hierarchy (workloads/paged.py) ends at host RAM, so a
+process death loses every parked page, handoff blob, and preempted
+stream.  This module is the layer below: per-page files on disk keyed by
+the same ``_chain_key`` chain hashes the radix tree and flat prefix
+cache already share, plus a bounded session journal the fleet
+checkpoints into — enough durable state that ``Fleet.restore`` in a
+FRESH process resurrects every in-flight and idle session as an exact
+continuation (greedy streams bit-identical to the uninterrupted oracle;
+interrupted streams true prefixes — the preempt/resume contract
+extended across process death).
+
+Contracts, in order of importance:
+
+  * **Atomic everywhere** — every durable write goes through ONE shared
+    temp + fsync + ``os.replace`` helper (:func:`atomic_write_bytes`,
+    factored out of ``tpu_device_plugin.kvsched.write_stats_snapshot``
+    and reused by the engine snapshot and FlightRecorder savers), so a
+    reader never observes a torn file.
+  * **Checksum-verified, degrade-to-miss** — every disk page carries a
+    sha256 over its payload and every journal generation a sha256 over
+    its records; a corrupt read is COUNTED and treated as a miss (a
+    shorter prefix hit, an older journal generation), never raised.
+    The injectable failure seams (``kv_disk_write_fail``,
+    ``kv_disk_read_corrupt``, ``journal_torn_write`` — workloads/
+    faults.py) drive exactly these degrade paths in the chaos arms.
+  * **Dedup by construction** — disk pages are NAMED by their chain key
+    (salt included in the chain), so the same system prompt written by
+    any replica, engine, or process maps to the same file: one copy per
+    tier, and ``put`` of a key that already exists is a touch, not a
+    write.
+  * **Jax-free, lazily numpy** — importable by host-only tooling and
+    the metrics lint; numpy loads only when a KV blob is actually
+    (de)serialized.
+
+Reference pendant: none — serving-era durability beyond the reference
+(its daemon checkpoints allocation state, never workload state).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+# ---- the one shared atomic-write helper --------------------------------
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` so a concurrent reader sees either the
+    old complete file or the new complete file, never a prefix: temp
+    file in the SAME directory (``os.replace`` must not cross
+    filesystems), flush + fsync before the rename.  The pattern every
+    durable artifact in the tree shares — kvsched stats snapshots,
+    engine warm-state snapshots, FlightRecorder bundles, disk-tier
+    pages, session journals."""
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    tmp = os.path.join(
+        directory, f".{os.path.basename(path)}.tmp.{os.getpid()}"
+    )
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def atomic_write_json(path: str, doc, *, indent: int | None = None) -> None:
+    """Atomic ``json.dump``: the compact separators match the existing
+    savers' wire format (indent is for human-read artifacts like the
+    FlightRecorder bundle)."""
+    if indent is None:
+        text = json.dumps(doc, separators=(",", ":"))
+    else:
+        text = json.dumps(doc, indent=indent)
+    atomic_write_text(path, text)
+
+
+# ---- KV disk tier -------------------------------------------------------
+
+# File format: magic + sha256(payload) + payload (an .npz archive of the
+# page's arrays).  The checksum is over the PAYLOAD so a torn or
+# bit-flipped file can never deserialize into wrong k/v bytes — streams
+# would silently diverge, the one failure mode durability must not have.
+_PAGE_MAGIC = b"KVDPAGE1"
+_PAGE_SUFFIX = ".kvpage"
+
+
+def _np_dtype(name: str):
+    """Resolve a dtype NAME back to a numpy dtype, reaching into
+    ml_dtypes for the accelerator dtypes numpy doesn't know natively
+    (bfloat16 & friends) — an npz round-trip degrades those to raw
+    void bytes, which is exactly the silent-divergence failure this
+    tier must not have."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _pack_blob(blob) -> bytes:
+    """Serialize one host-tier page blob — ``((mk, mv), draft_or_None)``
+    in the engine's spill format — to self-verifying bytes.  Arrays are
+    stored as raw bytes with a dtype/shape sidecar so non-native dtypes
+    (bfloat16) survive the trip bit-exactly."""
+    import io
+    import json
+
+    import numpy as np
+
+    (mk, mv), draft = blob
+    arrays = {"mk": np.asarray(mk), "mv": np.asarray(mv)}
+    if draft is not None:
+        arrays["dk"] = np.asarray(draft[0])
+        arrays["dv"] = np.asarray(draft[1])
+    raw = {}
+    meta = {}
+    for name, arr in arrays.items():
+        a = np.ascontiguousarray(arr)
+        raw[name] = np.frombuffer(a.tobytes(), dtype=np.uint8)
+        meta[name] = [a.dtype.name, list(a.shape)]
+    raw["__meta__"] = np.frombuffer(
+        json.dumps(meta, sort_keys=True).encode("utf-8"), dtype=np.uint8
+    )
+    bio = io.BytesIO()
+    np.savez(bio, **raw)
+    payload = bio.getvalue()
+    return _PAGE_MAGIC + hashlib.sha256(payload).digest() + payload
+
+
+def _unpack_blob(data: bytes):
+    """Inverse of :func:`_pack_blob`; raises ValueError on any damage
+    (bad magic, checksum mismatch, malformed archive)."""
+    import io
+    import json
+
+    import numpy as np
+
+    if data[: len(_PAGE_MAGIC)] != _PAGE_MAGIC:
+        raise ValueError("bad disk-page magic")
+    digest = data[len(_PAGE_MAGIC) : len(_PAGE_MAGIC) + 32]
+    payload = data[len(_PAGE_MAGIC) + 32 :]
+    if hashlib.sha256(payload).digest() != digest:
+        raise ValueError("disk-page checksum mismatch")
+    with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+        try:
+            meta = json.loads(bytes(z["__meta__"]).decode("utf-8"))
+        except KeyError as exc:
+            raise ValueError("disk-page meta missing") from exc
+
+        def _arr(name: str):
+            dtype_name, shape = meta[name]
+            return (
+                np.frombuffer(bytes(z[name]), dtype=_np_dtype(dtype_name))
+                .reshape(shape)
+                .copy()
+            )
+
+        mk, mv = _arr("mk"), _arr("mv")
+        draft = (_arr("dk"), _arr("dv")) if "dk" in z.files else None
+    return ((mk, mv), draft)
+
+
+class KVDiskTier:
+    """Per-page KV files under one directory: the tier below the radix
+    tree's host-RAM budget.
+
+    Keys are chain-key hex strings (``paged._chain_key`` digests, salt
+    included in the chain), so the file namespace IS the dedup: every
+    replica/engine/process sharing the directory stores a given prefix
+    page exactly once, and a restart finds yesterday's pages by
+    recomputing the same hashes.  ``budget_pages`` caps the file count
+    with mtime-LRU eviction (get/put touch); ``None`` is unbounded.
+
+    All failure modes degrade to a miss: a failed write keeps the blob
+    in host RAM (the caller checks the return), a corrupt read is
+    quarantined (file unlinked, counter bumped) and the lookup's prefix
+    hit just ends one page earlier.  The ``kv_disk_write_fail`` /
+    ``kv_disk_read_corrupt`` injector seams fire inside put/get so the
+    chaos arms drive exactly the production degrade paths.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        budget_pages: int | None = None,
+        injector=None,
+    ):
+        if budget_pages is not None and budget_pages < 1:
+            raise ValueError(
+                f"budget_pages must be >= 1 or None (unbounded), got "
+                f"{budget_pages}"
+            )
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.budget_pages = budget_pages
+        self._faults = injector
+        self.writes = 0  # pages newly written to disk
+        self.dedup_hits = 0  # puts satisfied by an existing file
+        self.reads = 0  # pages read back intact
+        self.read_corrupt = 0  # reads that failed verification
+        self.write_failures = 0  # puts that could not land
+        self.evictions = 0  # files dropped by the budget
+        # Wall seconds inside put/get — the engine folds these into its
+        # kv_spill_s / kv_reload_s so the chip-time ledger's kv_spill /
+        # kv_reload phases price the disk hops too.
+        self.put_s = 0.0
+        self.get_s = 0.0
+
+    def _path(self, key_hex: str) -> str:
+        if not key_hex or any(c not in "0123456789abcdef" for c in key_hex):
+            raise ValueError(f"disk-tier keys are hex digests, got {key_hex!r}")
+        return os.path.join(self.root, key_hex + _PAGE_SUFFIX)
+
+    def _files(self) -> list[str]:
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        return [
+            os.path.join(self.root, n)
+            for n in names if n.endswith(_PAGE_SUFFIX)
+        ]
+
+    @property
+    def pages(self) -> int:
+        """Files currently in the tier — directory truth, not a cached
+        counter, because the directory is SHARED across engines and
+        processes (that sharing is the dedup)."""
+        return len(self._files())
+
+    def contains(self, key_hex: str) -> bool:
+        return os.path.exists(self._path(key_hex))
+
+    def _evict_to_budget(self, incoming: int = 1) -> None:
+        if self.budget_pages is None:
+            return
+        files = self._files()
+        excess = len(files) + incoming - self.budget_pages
+        if excess <= 0:
+            return
+        # Coldest-first by mtime (get/put touch): same LRU discipline as
+        # the tiers above, at file granularity.
+        def mtime(p: str) -> float:
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return 0.0
+
+        for path in sorted(files, key=mtime)[:excess]:
+            try:
+                os.unlink(path)
+                self.evictions += 1
+            except OSError:
+                pass
+
+    def put(self, key_hex: str, blob) -> bool:
+        """Store one page blob under its chain key; True when a durable
+        copy exists afterwards (fresh write OR dedup hit).  False means
+        the write failed and the caller must keep its in-RAM copy."""
+        t0 = time.perf_counter()
+        try:
+            return self._put_impl(key_hex, blob)
+        finally:
+            self.put_s += time.perf_counter() - t0
+
+    def _put_impl(self, key_hex: str, blob) -> bool:
+        path = self._path(key_hex)
+        if self._faults is not None:
+            from .faults import InjectedFault
+
+            try:
+                self._faults.check("kv_disk_write_fail")
+            except InjectedFault:
+                self.write_failures += 1
+                return False
+        if os.path.exists(path):
+            self.dedup_hits += 1
+            try:
+                os.utime(path)
+            except OSError:
+                pass
+            return True
+        try:
+            self._evict_to_budget(incoming=1)
+            atomic_write_bytes(path, _pack_blob(blob))
+        except (OSError, ValueError):
+            self.write_failures += 1
+            return False
+        self.writes += 1
+        return True
+
+    def get(self, key_hex: str):
+        """The page blob for ``key_hex``, or None on absent/corrupt.  A
+        file that fails verification is quarantined (unlinked) so the
+        tier converges back to clean state instead of re-reading the
+        same damage forever."""
+        t0 = time.perf_counter()
+        try:
+            return self._get_impl(key_hex)
+        finally:
+            self.get_s += time.perf_counter() - t0
+
+    def _get_impl(self, key_hex: str):
+        path = self._path(key_hex)
+        corrupt = False
+        if self._faults is not None:
+            from .faults import InjectedFault
+
+            try:
+                self._faults.check("kv_disk_read_corrupt")
+            except InjectedFault:
+                corrupt = True
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        if corrupt:
+            # The injected seam models the read returning damaged bytes;
+            # verification would catch it, so take the same path.
+            data = data[: max(len(data) // 2, len(_PAGE_MAGIC))]
+        try:
+            blob = _unpack_blob(data)
+        except (ValueError, KeyError, OSError):
+            self.read_corrupt += 1
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        self.reads += 1
+        try:
+            os.utime(path)
+        except OSError:
+            pass
+        return blob
+
+    def clear(self) -> int:
+        """Drop every page file (tests / explicit operator reset — the
+        engine's ``close()`` intentionally does NOT call this: pages
+        outliving the process is the whole point)."""
+        n = 0
+        for path in self._files():
+            try:
+                os.unlink(path)
+                n += 1
+            except OSError:
+                pass
+        return n
+
+
+# ---- session journal ----------------------------------------------------
+
+JOURNAL_FILENAME = "journal.json"
+_JOURNAL_VERSION = 1
+
+
+def _records_digest(records: list) -> str:
+    payload = json.dumps(records, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()
+
+
+class SessionJournal:
+    """The fleet's crash checkpoint: one bounded JSON document of
+    session records (rid, prompt, emitted tokens, sampling state, LoRA
+    salt, parked-page manifest — ``Fleet.journal_now`` builds them),
+    written atomically with a PREVIOUS generation kept beside it.
+
+    Epochs are monotonic across process restarts (the kvsched
+    claim-epoch discipline: the stamp is max(on-disk epoch + 1, own
+    counter)), so a restarted writer can never roll a reader back onto
+    older state.  The loader's taxonomy mirrors
+    ``kvsched.read_stats_snapshot``: ``"ok"`` (current generation),
+    ``"fallback"`` (current torn/corrupt, previous generation intact —
+    at most one checkpoint interval of progress lost), ``"absent"``,
+    ``"corrupt"`` (both generations damaged).  The
+    ``journal_torn_write`` seam writes a half-length current file
+    OUTSIDE the atomic path — exactly the crash-mid-write the previous
+    generation exists for."""
+
+    def __init__(self, directory: str, injector=None):
+        self.dir = os.path.abspath(directory)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, JOURNAL_FILENAME)
+        self.prev_path = self.path + ".prev"
+        self._faults = injector
+        self.epoch = -1
+        self.writes = 0
+        self.torn_writes = 0
+
+    def _disk_epoch(self) -> int:
+        epoch = -1
+        for path in (self.path, self.prev_path):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    epoch = max(epoch, int(json.load(f).get("epoch", -1)))
+            except (OSError, ValueError, TypeError, AttributeError):
+                continue
+        return epoch
+
+    def write(self, records: list[dict], meta: dict | None = None) -> int:
+        """Checkpoint ``records``; returns the stamped epoch.  The
+        current generation rotates to ``.prev`` FIRST, so even a torn
+        write (injected or real) leaves one intact generation."""
+        stamped = max(self._disk_epoch(), self.epoch) + 1
+        doc = {
+            "version": _JOURNAL_VERSION,
+            "epoch": stamped,
+            "written_at": time.time(),
+            "checksum": _records_digest(records),
+            "meta": dict(meta or {}),
+            "records": records,
+        }
+        body = json.dumps(doc, separators=(",", ":"))
+        if os.path.exists(self.path):
+            os.replace(self.path, self.prev_path)
+        torn = False
+        if self._faults is not None:
+            from .faults import InjectedFault
+
+            try:
+                self._faults.check("journal_torn_write")
+            except InjectedFault:
+                torn = True
+        if torn:
+            # A crash mid-write: the current generation is a prefix.
+            # Deliberately NOT the atomic path — this is the failure the
+            # atomic path exists to prevent, surfaced so the loader's
+            # fallback generation is a tested path, not a comment.
+            with open(self.path, "w", encoding="utf-8") as f:
+                f.write(body[: len(body) // 2])
+            self.torn_writes += 1
+        else:
+            atomic_write_text(self.path, body)
+            self.writes += 1
+        self.epoch = stamped
+        return stamped
+
+    @staticmethod
+    def _parse(path: str) -> list | None:
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        try:
+            if int(doc["version"]) != _JOURNAL_VERSION:
+                return None
+            records = doc["records"]
+            if not isinstance(records, list):
+                return None
+            if doc["checksum"] != _records_digest(records):
+                return None
+        except (KeyError, TypeError, ValueError):
+            return None
+        return records
+
+    def load(self) -> tuple[list | None, str]:
+        """(records, reason) — reason in ``"ok"`` / ``"fallback"`` /
+        ``"absent"`` / ``"corrupt"`` (the restore path's counter
+        labels)."""
+        current_exists = os.path.exists(self.path)
+        prev_exists = os.path.exists(self.prev_path)
+        if not current_exists and not prev_exists:
+            return None, "absent"
+        records = self._parse(self.path)
+        if records is not None:
+            return records, "ok"
+        records = self._parse(self.prev_path)
+        if records is not None:
+            return records, "fallback"
+        return None, "corrupt"

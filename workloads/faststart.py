@@ -387,13 +387,26 @@ class EngineSnapshot:
             meta=dict(d.get("meta") or {}),
         )
 
+    # Snapshot artifacts that fail to parse (truncated by a crash
+    # mid-copy, bit-flipped, wrong schema) degrade to the cold path:
+    # ``load`` returns None and bumps this counter instead of raising —
+    # a warm-start artifact must never be able to stop a cold start.
+    load_errors = 0
+
     def save(self, path: str) -> str:
-        with open(path, "w") as f:
-            f.write(self.to_json())
-            f.write("\n")
+        from .durable import atomic_write_text
+
+        atomic_write_text(path, self.to_json() + "\n")
         return path
 
     @classmethod
-    def load(cls, path: str) -> "EngineSnapshot":
-        with open(path) as f:
-            return cls.from_json(f.read())
+    def load(cls, path: str) -> "EngineSnapshot | None":
+        """Parse a saved snapshot, or None (counted in
+        ``EngineSnapshot.load_errors``) when the artifact is absent,
+        truncated, or corrupt — the caller cold-starts."""
+        try:
+            with open(path) as f:
+                return cls.from_json(f.read())
+        except (OSError, ValueError, KeyError, TypeError):
+            cls.load_errors += 1
+            return None

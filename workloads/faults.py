@@ -103,7 +103,28 @@ REPLICA_SEAMS = (
     "scale_spawn_fail",
 )
 
-SEAMS = ENGINE_SEAMS + REPLICA_SEAMS
+# Durability seams (``DURABLE_SEAMS``; crossed by workloads/durable.py
+# inside the disk tier's put/get and the session journal's write):
+#
+#   * ``kv_disk_write_fail``   — a host-tier page's demotion to disk
+#     cannot land (ENOSPC, a dead volume): the blob STAYS in host RAM
+#     and ordinary pressure handles it — durability degrades, streams
+#     do not.
+#   * ``kv_disk_read_corrupt`` — a disk page reads back damaged: the
+#     checksum catches it, the file is quarantined, and the lookup's
+#     prefix hit ends one page earlier (a re-prefill, never a wrong
+#     byte).
+#   * ``journal_torn_write``   — the process dies mid-checkpoint: the
+#     current journal generation is a torn prefix and ``Fleet.restore``
+#     falls back to the previous generation (at most one checkpoint
+#     interval of progress re-paid as replay).
+DURABLE_SEAMS = (
+    "kv_disk_write_fail",
+    "kv_disk_read_corrupt",
+    "journal_torn_write",
+)
+
+SEAMS = ENGINE_SEAMS + REPLICA_SEAMS + DURABLE_SEAMS
 
 
 def crash_loop_schedule(
@@ -305,6 +326,32 @@ def self_check(verbose: bool = True) -> int:
     except InjectedFault:
         pass
 
+    # Durability seams are first-class: scheduled crossings fire (the
+    # disk tier / journal degrade paths), and a DURABLE_SEAMS-scoped
+    # Bernoulli injector leaves engine and replica seams alone — the
+    # kill-and-restart chaos arm relies on both.
+    dinj = FaultInjector({
+        "kv_disk_write_fail": 1, "kv_disk_read_corrupt": 2,
+        "journal_torn_write": 1,
+    })
+    for seam in DURABLE_SEAMS:
+        fired_now = 0
+        for _ in range(2):
+            try:
+                dinj.check(seam)
+            except InjectedFault as e:
+                assert e.seam == seam
+                fired_now += 1
+        assert fired_now == 1, (seam, fired_now)
+    dscoped = FaultInjector.random(seed=7, rate=1.0, seams=DURABLE_SEAMS)
+    dscoped.check("decode_dispatch")
+    dscoped.check("replica_crash")
+    try:
+        dscoped.check("kv_disk_write_fail")
+        raise AssertionError("rate=1.0 durable seam did not fire")
+    except InjectedFault:
+        pass
+
     # The supervisor's repeat-crash-on-restart shape: k consecutive
     # respawn crossings fire, the (k+1)th succeeds — the half-open
     # probe after a quarantine clear rides exactly that crossing.
@@ -386,9 +433,9 @@ def self_check(verbose: bool = True) -> int:
             if isinstance(e, AssertionError):
                 raise
     if verbose:
-        print("faults selfcheck OK: schedule, replica seams, crash-loop "
-              "schedules, spawn seam, seeded replay, reset, max_fires, "
-              "inert, validation")
+        print("faults selfcheck OK: schedule, replica seams, durable "
+              "seams, crash-loop schedules, spawn seam, seeded replay, "
+              "reset, max_fires, inert, validation")
     return 0
 
 

@@ -64,6 +64,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from .durable import SessionJournal
 from .errors import EngineClosed, InvalidRequest, QueueFull, RequestTooLarge
 from .faults import InjectedFault
 from .obs import AttemptSpan
@@ -534,6 +535,8 @@ class Fleet:
         ledger=None,
         page_scheduling: bool = False,
         stats_path: str | None = None,
+        journal_dir: str | None = None,
+        journal_every: int | None = None,
     ):
         engines = list(engines)
         if not engines:
@@ -757,6 +760,32 @@ class Fleet:
         self._stats_epoch = 0
         self.page_dispatches = 0
         self.stats_published = 0
+        # Durable sessions (docs/SERVING.md "Durable sessions"): with a
+        # journal directory set, ``journal_now()`` checkpoints every
+        # live session (and a bounded tail of finished ones) plus their
+        # prefix pages' disk-tier copies, and ``restore()`` on a
+        # freshly built fleet resurrects them after a FULL process
+        # restart — greedy continuations bit-identical to the
+        # uninterrupted stream (the failover-replay contract lifted
+        # across process death).  ``journal_every`` (steps) arms an
+        # automatic cadence inside ``step()``; None journals only on
+        # explicit calls (the supervisor's poll cadence, close()).
+        if journal_every is not None and journal_every < 1:
+            raise ValueError(
+                f"journal_every must be >= 1 or None, got {journal_every}"
+            )
+        if journal_every is not None and journal_dir is None:
+            raise ValueError(
+                "journal_every needs journal_dir= (nowhere to write)"
+            )
+        self._journal = (
+            SessionJournal(journal_dir, injector=fault_injector)
+            if journal_dir is not None else None
+        )
+        self.journal_every = journal_every
+        self._steps_since_journal = 0
+        self.journal_sessions = 0  # sessions in the last checkpoint
+        self.sessions_restored = 0
 
     # ---- introspection ---------------------------------------------------
 
@@ -942,6 +971,203 @@ class Fleet:
         )
         self.stats_published += 1
         return path
+
+    # ---- durable sessions ------------------------------------------------
+
+    # Finished-ok sessions kept in each checkpoint (newest first to
+    # go): enough for post-restart session continuation, bounded so the
+    # journal cannot grow with lifetime traffic.
+    _JOURNAL_IDLE_CAP = 256
+
+    @property
+    def journal_writes(self) -> int:
+        """Checkpoints durably written (fleet_journal_writes_total)."""
+        return self._journal.writes if self._journal is not None else 0
+
+    @property
+    def journal_torn(self) -> int:
+        """Checkpoints torn mid-write by the ``journal_torn_write``
+        seam — each one left the previous generation as the recovery
+        point (fleet_journal_torn_total)."""
+        return (
+            self._journal.torn_writes if self._journal is not None else 0
+        )
+
+    def journal_now(self) -> int:
+        """Checkpoint the fleet's sessions into the journal: every
+        live request (router-queued and dispatched — the live engine
+        segment's already-consumed tokens included) plus the most
+        recent finished-ok streams, each with its prefix pages flushed
+        to the disk tier first.  The parked-page manifest is implicit
+        by construction: pages are keyed by the prompt+tokens chain
+        keys, so ``restore()`` recomputes them from the record alone.
+        Returns sessions checkpointed; 0 without a journal.  A torn
+        write (injected crash-mid-write) is counted, never raised —
+        the previous generation remains the recovery point."""
+        if self._journal is None:
+            return 0
+        with self._lock:
+            live: list[dict] = []
+            idle: list[dict] = []
+            for fr in self._reqs.values():
+                if fr.done and fr.status != "ok":
+                    continue  # cancelled/expired/failed: nothing to resume
+                toks = list(fr.tokens)
+                if not fr.done and fr.replica is not None:
+                    rep = self.replicas[fr.replica]
+                    ereq = rep.rids.get(fr.rid)
+                    if ereq is not None:
+                        toks += [int(t) for t in ereq.tokens]
+                rec = {
+                    "rid": fr.rid,
+                    "prompt": [int(t) for t in fr.prompt],
+                    "tokens": toks,
+                    "max_new_tokens": int(fr.max_new_tokens),
+                    "eos_token": fr.eos_token,
+                    "adapter": fr.adapter,
+                    "session": fr.session,
+                    "slo_class": fr.slo_class,
+                    "status": fr.status if fr.done else "live",
+                }
+                (idle if fr.done else live).append(rec)
+            records = idle[-self._JOURNAL_IDLE_CAP:] + live
+            flushed = 0
+            for rec in records:
+                stitched = rec["prompt"] + rec["tokens"]
+                pages = 0
+                for rep in self.replicas:
+                    if rep.state == DEAD:
+                        continue
+                    try:
+                        pages = rep.engine.flush_kv_to_disk(
+                            stitched, adapter=rec["adapter"]
+                        )
+                    except Exception:  # noqa: BLE001 — a checkpoint
+                        pages = 0  # must never take the fleet down
+                    if pages:
+                        break  # files are shared: one durable copy is enough
+                rec["kv_pages"] = pages
+                flushed += pages
+            self._journal.write(records, meta={
+                "sessions": len(records), "kv_pages_flushed": flushed,
+            })
+            self.journal_sessions = len(records)
+            self._steps_since_journal = 0
+            return len(records)
+
+    def restore(self, journal_dir: str | None = None) -> int:
+        """Resurrect journaled sessions into THIS (freshly built, still
+        empty) fleet after a full process restart.  Finished sessions
+        re-register as history — their rids stay unique and pollable,
+        no terminal counter moves (they were the dead process's work).
+        Live sessions requeue with their journaled tokens stitched:
+        the next dispatch re-prefills prompt + emitted on whichever
+        replica the router picks, and ``attach_kv_disk`` first adopts
+        their parked pages from ``--kv-disk-dir`` so the re-prefill
+        reloads instead of recomputing.  A journaled-complete stream
+        (the process died between its last token and the terminal
+        transition) finishes terminally here without re-dispatch.
+        Greedy continuations are bit-identical to the uninterrupted
+        stream; sampled ones preserve the journaled prefix exactly.
+        The replayed prompt+token re-prefill is charged to
+        ``tokens_replayed`` (ledger waste class "replay").  Returns
+        sessions restored; a missing or doubly-corrupt journal
+        restores 0 (cold start), never raises."""
+        journal = self._journal
+        if journal_dir is not None:
+            journal = SessionJournal(journal_dir)
+        if journal is None:
+            raise ValueError(
+                "restore() needs journal_dir= here or on the Fleet"
+            )
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("fleet is closed")
+            if self._reqs:
+                raise RuntimeError(
+                    "restore() is a boot-time operation: this fleet "
+                    f"already tracks {len(self._reqs)} request(s)"
+                )
+            records, reason = journal.load()
+            if records is None:
+                return 0  # absent/corrupt: cold start, by design
+            restored = 0
+            for rec in records:
+                try:
+                    rid = str(rec["rid"])
+                    prompt = [int(t) for t in rec["prompt"]]
+                    toks = [int(t) for t in rec.get("tokens") or ()]
+                    budget = int(rec["max_new_tokens"])
+                    status = str(rec.get("status", "live"))
+                except (KeyError, TypeError, ValueError):
+                    continue  # one damaged record must not sink the rest
+                if not prompt or budget < 1 or rid in self._reqs:
+                    continue
+                eos = rec.get("eos_token")
+                fr = FleetRequest(
+                    rid, prompt, budget,
+                    int(eos) if eos is not None else None,
+                    adapter=rec.get("adapter"),
+                    session=rec.get("session"),
+                    slo_class=(
+                        rec.get("slo_class")
+                        if rec.get("slo_class") in self.slo_classes
+                        else None
+                    ),
+                    t_submit=time.perf_counter(),
+                )
+                fr.tokens = toks
+                self._reqs[rid] = fr
+                restored += 1
+                if status in TERMINAL:
+                    # History: visible to poll()/session continuation,
+                    # not this process's work.
+                    fr.status = status
+                    fr.t_submit = None
+                    self.completed.append(fr)
+                    continue
+                self.requests_submitted += 1
+                if len(toks) >= budget or (
+                    fr.eos_token is not None
+                    and toks
+                    and toks[-1] == fr.eos_token
+                ):
+                    # Bit-complete in the journal: the process died
+                    # between the last token and the terminal
+                    # transition (the _requeue_victims check, lifted
+                    # across process death).
+                    self._finished_buffer.append(
+                        self._finish_terminal(fr, "ok")
+                    )
+                    continue
+                # Adopt the parked pages everywhere live — the files
+                # are shared and attach costs stat calls, so the
+                # router's pick is free to land anywhere.
+                stitched = prompt + toks
+                for rep in self.replicas:
+                    if rep.state == DEAD:
+                        continue
+                    try:
+                        rep.engine.attach_kv_disk(
+                            stitched, adapter=fr.adapter
+                        )
+                    except Exception:  # noqa: BLE001 — degrade to
+                        pass  # plain re-prefill, bit-identical anyway
+                self.tokens_replayed += len(stitched)
+                fr.status = "queued"
+                self.queue.append(fr)
+            # Never mint a rid the journal already owns: a restored
+            # "fleet-3" colliding with this process's own counter would
+            # reject the new submission as already-in-flight.
+            taken = [
+                int(r[len("fleet-"):]) for r in self._reqs
+                if r.startswith("fleet-")
+                and r[len("fleet-"):].isdigit()
+            ]
+            if taken:
+                self._ids = itertools.count(max(taken) + 1)
+            self.sessions_restored += restored
+            return restored
 
     def _revival_pending(self) -> bool:
         hook = self.revival_hook
@@ -2084,6 +2310,10 @@ class Fleet:
             self.generated_tokens += (
                 sum(e.generated_tokens for e in engines) - tokens0
             )
+            if self._journal is not None and self.journal_every is not None:
+                self._steps_since_journal += 1
+                if self._steps_since_journal >= self.journal_every:
+                    self.journal_now()
             if self.ledger is not None:
                 self.ledger.step_end(self, finished)
             if self._obs is not None:
@@ -2155,6 +2385,15 @@ class Fleet:
         with self._lock:
             if self._closed:
                 return
+            # Checkpoint FIRST, while in-flight sessions still read as
+            # live: a graceful close's journal is what a successor
+            # process restores from (a crash's journal is whatever the
+            # last cadence wrote — the previous generation at worst).
+            if self._journal is not None:
+                try:
+                    self.journal_now()
+                except Exception:  # noqa: BLE001 — shutdown must not
+                    pass  # fail because the checkpoint did
             self._closed = True
             err = "EngineClosed: fleet closed with the request in flight"
             closed_now: list[FleetRequest] = []

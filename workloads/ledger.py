@@ -917,8 +917,11 @@ class FlightRecorder:
                 bundle["sentry"] = self._sentry.state()
             except Exception:  # noqa: BLE001 — a bundle dump must land
                 bundle["sentry"] = {"error": "sentry state unavailable"}
-        with open(path, "w") as f:
-            json.dump(bundle, f)
-            f.write("\n")
+        # Atomic via the shared durable-write helper: a postmortem
+        # bundle is read EXACTLY when things are going wrong — the one
+        # moment a half-written artifact would hurt most.
+        from .durable import atomic_write_text
+
+        atomic_write_text(path, json.dumps(bundle) + "\n")
         self.dumped.append(path)
         return path

@@ -182,6 +182,12 @@ ENGINE_METRICS: tuple[MetricSpec, ...] = (
         "(kv_offload; scrape-time — state held without holding HBM)",
     ),
     MetricSpec(
+        "engine_kv_disk_pages", "gauge", ("engine",),
+        "KV pages durable in the disk tier below host RAM "
+        "(--kv-disk-dir; per-page files named by chain key, shared "
+        "across replicas and processes — scrape-time)",
+    ),
+    MetricSpec(
         "engine_paused", "gauge", ("engine",),
         "1 while the health bridge holds admission paused on an "
         "Unhealthy chip (scrape-time; fleet routers read this as the "
@@ -394,6 +400,30 @@ FLEET_METRICS: tuple[MetricSpec, ...] = (
         "aggregate free KV pages across live replicas, by tier (hbm = "
         "unallocated pool pages, host = offload-tier headroom; "
         "scrape-time — the page-aware admission bound's inputs)",
+    ),
+    # Durable sessions (Fleet(journal_dir=...), docs/SERVING.md
+    # "Durable sessions"): session-journal checkpoint volume, injected
+    # torn writes, and sessions resurrected by Fleet.restore after a
+    # full process restart.
+    MetricSpec(
+        "fleet_journal_writes_total", "counter", ("fleet",),
+        "session-journal checkpoints durably written (atomic, with "
+        "the previous generation kept beside the current one as the "
+        "torn-write recovery point)",
+    ),
+    MetricSpec(
+        "fleet_journal_torn_total", "counter", ("fleet",),
+        "journal checkpoints torn mid-write (the journal_torn_write "
+        "chaos seam) — each one left the previous generation as the "
+        "restore point, at most one checkpoint interval of progress "
+        "lost",
+    ),
+    MetricSpec(
+        "fleet_sessions_restored_total", "counter", ("fleet",),
+        "sessions resurrected from the journal + disk tier by "
+        "Fleet.restore after a full process restart (greedy "
+        "continuations bit-identical to the uninterrupted stream; "
+        "interrupted streams true prefixes)",
     ),
     MetricSpec(
         "fleet_observer_dropped_spans_total", "counter", ("fleet",),
@@ -904,6 +934,9 @@ class EngineObserver:
                 getattr(e, "prefix", None), "offloaded_pages", 0
             ) or 0
         ),
+        "engine_kv_disk_pages": (
+            lambda e: getattr(e, "kv_disk_pages", 0) or 0
+        ),
         # Device-time split (workloads/profiler.py): read back through
         # the engine's bound observer; engines without one (or before
         # any step) read empty via _gauge's teardown guard.
@@ -1373,6 +1406,9 @@ class FleetObserver:
         "fleet_handoff_pages_total": "handoff_pages",
         "fleet_page_dispatches_total": "page_dispatches",
         "fleet_stats_published_total": "stats_published",
+        "fleet_journal_writes_total": "journal_writes",
+        "fleet_journal_torn_total": "journal_torn",
+        "fleet_sessions_restored_total": "sessions_restored",
     }
 
     def bind_registry(self, reg, labels: dict | None = None) -> None:

@@ -342,11 +342,20 @@ class RadixNode:
     """One page-sized token block in the RadixKV tree.  Exactly one of
     ``page`` (resident: a pool page pinned through the pool refcounts)
     or ``host`` (offloaded: the page's k/v bytes in host RAM, engine-
-    provided blob) is set for a real node; the per-salt root has
-    neither.  ``last_use`` is the tree's LRU clock at the node's last
+    provided blob) is set for a real node — or neither, when ``disk``
+    alone holds the page's chain-key hex and the bytes live in the disk
+    tier's file (``disk`` may also coexist with either as a record that
+    a durable copy exists); the per-salt root has none of the three.
+    ``key`` is the node's chain hash (``_chain_key`` from the root's
+    salt), computed once at creation — it names the disk file, so the
+    same prefix page written by any tree maps to the same file.
+    ``last_use`` is the tree's LRU clock at the node's last
     hit/insert."""
 
-    __slots__ = ("block", "parent", "children", "page", "host", "last_use")
+    __slots__ = (
+        "block", "parent", "children", "page", "host", "disk", "key",
+        "last_use",
+    )
 
     def __init__(self, block, parent):
         self.block = block
@@ -354,6 +363,8 @@ class RadixNode:
         self.children: dict = {}
         self.page: int | None = None
         self.host = None
+        self.disk: str | None = None
+        self.key: bytes | None = None
         self.last_use = 0
 
 
@@ -384,7 +395,15 @@ class RadixKV:
         conversations hold state without holding HBM.  Spill/reload
         round-trips are bit-exact (device_get/device_put of the same
         dtype), so streams are bit-identical offload on/off (pinned by
-        tests/test_kv_hierarchy.py).
+        tests/test_kv_hierarchy.py);
+      * **disk tier** (docs/SERVING.md "Durable sessions"): with a
+        ``durable.KVDiskTier`` attached, a full host budget demotes its
+        coldest page to a chain-key-named, checksummed file instead of
+        forcing a leaf drop; lookups reload disk pages through the same
+        callback, files survive the process, and ``attach_disk`` /
+        ``flush_to_disk`` are the restart-rehydration and checkpoint
+        halves.  Same bit-exactness contract: a disk round trip is the
+        host round trip plus a verified file copy.
 
     Control-plane only: no jax imports run here; the engine owns the
     device copies (read_page/write_page below).
@@ -393,7 +412,9 @@ class RadixKV:
     design, rebuilt over this pool's refcounts.
     """
 
-    def __init__(self, ctrl: PagePool, host_pages: int | None = 0):
+    def __init__(
+        self, ctrl: PagePool, host_pages: int | None = 0, disk=None,
+    ):
         self.ctrl = ctrl
         self.page_size = ctrl.page_size
         # host_pages: 0 disables the offload tier (evictions drop),
@@ -404,6 +425,13 @@ class RadixKV:
                 f"{host_pages}"
             )
         self.host_pages = host_pages
+        # The tier below host RAM: a durable.KVDiskTier (or None).  When
+        # the host budget is exhausted, the COLDEST host-tier page
+        # demotes to its chain-key file instead of forcing a leaf drop;
+        # lookups reload disk pages through the same reload callback
+        # (file -> host blob -> write_page), and files survive the
+        # process — restart rehydration is ``attach_disk``.
+        self.disk = disk
         self._roots: dict[str, RadixNode] = {}
         self._clock = 0
         # Pages matched by an IN-PROGRESS lookup: a reload mid-walk may
@@ -417,14 +445,36 @@ class RadixKV:
         self.reloads = 0  # pages brought back from the host tier
         self.spills = 0  # pages pushed out to the host tier
         self.grafts = 0  # pages adopted from another index's handoff
+        self.demotions = 0  # host-tier pages pushed down to disk
+        self.disk_reloads = 0  # pages brought back from the disk tier
         self._resident = 0
         self._offloaded = 0
+        self._disked = 0  # nodes whose ONLY copy is the disk tier
 
     # ---- tree walks -----------------------------------------------------
 
     def _tick(self) -> int:
         self._clock += 1
         return self._clock
+
+    def _root(self, salt: str) -> RadixNode:
+        """The per-salt root, created on demand.  Its ``key`` is the
+        salt bytes — block 0's chain hash starts from it, matching
+        ``PrefixCache._keys`` so the two indexes (and every disk file)
+        share one key space."""
+        root = self._roots.get(salt)
+        if root is None:
+            root = RadixNode(None, None)
+            root.key = salt.encode()
+            self._roots[salt] = root
+        return root
+
+    def _child_of(self, node: RadixNode, block: tuple) -> RadixNode:
+        """Create (and key) a new child under ``node``."""
+        child = RadixNode(block, node)
+        child.key = _chain_key(node.key, list(block))
+        node.children[block] = child
+        return child
 
     def match_depth(self, tokens: list[int], salt: str = "") -> int:
         """Pages of ``tokens`` this index knows — resident OR offloaded
@@ -484,12 +534,28 @@ class RadixKV:
                     if child.page is None:
                         if reload is None:
                             break
-                        page = reload(child.host)
+                        blob, from_disk = child.host, False
+                        if blob is None:
+                            # Disk-only: pull the blob back through the
+                            # chain-key file.  A missing/corrupt file is
+                            # a shorter hit, never a failure — the walk
+                            # stops and prefill rebuilds the page.
+                            if self.disk is None or child.disk is None:
+                                break
+                            blob = self.disk.get(child.disk)
+                            if blob is None:
+                                break
+                            from_disk = True
+                        page = reload(blob)
                         if page is None:
                             break
                         child.page = page
                         child.host = None
-                        self._offloaded -= 1
+                        if from_disk:
+                            self._disked -= 1
+                            self.disk_reloads += 1
+                        else:
+                            self._offloaded -= 1
                         self._resident += 1
                         self.reloads += 1
                         child.last_use = self._tick()
@@ -519,17 +585,21 @@ class RadixKV:
         the freshly written page (same bytes by construction) and drops
         its host copy."""
         ps = self.page_size
-        node = self._roots.setdefault(salt, RadixNode(None, None))
+        node = self._root(salt)
         for i in range(len(tokens) // ps):
             block = tuple(tokens[i * ps : (i + 1) * ps])
             child = node.children.get(block)
             if child is None:
-                child = RadixNode(block, node)
-                node.children[block] = child
+                child = self._child_of(node, block)
             if child.page is None:
                 if child.host is not None:
                     child.host = None
                     self._offloaded -= 1
+                elif child.disk is not None:
+                    # Disk-only node re-anchors to the freshly written
+                    # page; the file stays (same bytes — it is still the
+                    # durable copy).
+                    self._disked -= 1
                 self.ctrl.retain_page(table[i])
                 child.page = table[i]
                 self._resident += 1
@@ -559,10 +629,40 @@ class RadixKV:
         elif node.host is not None:
             node.host = None
             self._offloaded -= 1
+        elif node.disk is not None:
+            # The node leaves the tree; its FILE stays — the disk tier's
+            # budget owns file lifetime, and a restart's attach_disk can
+            # still find the page.
+            self._disked -= 1
         del node.parent.children[node.block]
 
     def _host_budget_left(self) -> bool:
         return self.host_pages is None or self._offloaded < self.host_pages
+
+    def _demote_to_disk(self, n: int = 1) -> int:
+        """Push the coldest host-tier page(s) down to their chain-key
+        files — the host budget's relief valve, called when an eviction
+        wants to spill but host RAM is full.  A failed put (disk fault
+        seam, dead volume) leaves the blob in host RAM: durability
+        degrades, correctness does not."""
+        if self.disk is None:
+            return 0
+        demotable = sorted(
+            (nd for nd in self._nodes() if nd.host is not None),
+            key=lambda nd: nd.last_use,
+        )
+        moved = 0
+        for nd in demotable[:n]:
+            key = nd.key.hex()
+            if not self.disk.put(key, nd.host):
+                break
+            nd.host = None
+            nd.disk = key
+            self._offloaded -= 1
+            self._disked += 1
+            self.demotions += 1
+            moved += 1
+        return moved
 
     def evict(self, n_pages: int, spill=None) -> int:
         """Free up to ``n_pages`` POOL pages, coldest (LRU) first, from
@@ -587,6 +687,11 @@ class RadixKV:
             for node in victims:
                 if freed >= n_pages:
                     break
+                if spill is not None and not self._host_budget_left():
+                    # Host RAM is full: demote its coldest page to the
+                    # disk tier so this victim can still spill instead
+                    # of dropping — the hierarchy's third level.
+                    self._demote_to_disk(1)
                 if spill is not None and self._host_budget_left():
                     blob = spill(node.page)
                     if blob is not None:
@@ -727,7 +832,7 @@ class RadixKV:
                 f"graft got {len(blobs)} page blobs but tokens cover "
                 f"only {len(tokens) // ps} full pages"
             )
-        node = self._roots.setdefault(salt, RadixNode(None, None))
+        node = self._root(salt)
         grafted = 0
         for i, blob in enumerate(blobs):
             block = tuple(tokens[i * ps : (i + 1) * ps])
@@ -735,9 +840,8 @@ class RadixKV:
             if child is None:
                 if blob is None or not self._host_budget_left():
                     break
-                child = RadixNode(block, node)
+                child = self._child_of(node, block)
                 child.host = blob
-                node.children[block] = child
                 self._offloaded += 1
                 self.grafts += 1
                 grafted += 1
@@ -748,7 +852,10 @@ class RadixKV:
     def clear(self) -> None:
         """Drop the whole index: resident pages release back to the
         pool, host blobs free — the close/quarantine-flush path (an
-        offloaded page must not outlive the cache that owns it)."""
+        offloaded page must not outlive the cache that owns it).  DISK
+        files deliberately stay: they are the durable tier, and pages
+        outliving this index (and this process) is their whole point —
+        ``attach_disk`` finds them again."""
         for root in self._roots.values():
             stack = list(root.children.values())
             while stack:
@@ -759,6 +866,87 @@ class RadixKV:
         self._roots.clear()
         self._resident = 0
         self._offloaded = 0
+        self._disked = 0
+
+    # ---- durable (disk) tier --------------------------------------------
+
+    def attach_disk(self, tokens: list[int], salt: str = "") -> int:
+        """Restart rehydration: walk ``tokens``' page blocks, recompute
+        their chain keys, and adopt every block whose file exists in
+        the disk tier as a disk-backed node — the durable counterpart
+        of ``graft`` (files instead of host blobs, contains() instead
+        of payloads, so attaching a long path costs stat calls, not
+        reads).  The walk stops at the first unknown block (a disk page
+        behind a gap would never be reachable as a prefix).  The next
+        lookup reloads attached pages through the ordinary reload
+        callback.  Returns the nodes attached."""
+        if self.disk is None:
+            return 0
+        ps = self.page_size
+        node = self._root(salt)
+        attached = 0
+        for i in range(len(tokens) // ps):
+            block = tuple(tokens[i * ps : (i + 1) * ps])
+            child = node.children.get(block)
+            if child is None:
+                key = _chain_key(node.key, list(block))
+                if not self.disk.contains(key.hex()):
+                    break
+                child = self._child_of(node, block)
+                child.disk = key.hex()
+                self._disked += 1
+                attached += 1
+            child.last_use = self._tick()
+            node = child
+        return attached
+
+    def flush_to_disk(
+        self, tokens: list[int], salt: str = "", copy_many=None,
+    ) -> int:
+        """Persist the ``tokens`` path's pages to the disk tier WITHOUT
+        changing what this index holds — the session checkpoint's
+        parked-page-manifest half: after a flush, a process restart can
+        rebuild this prefix from files alone.  Host-tier nodes write
+        their blob (a key already on disk is a dedup touch, not a
+        write); resident nodes copy their bytes out through
+        ``copy_many(pages) -> blobs`` (the engine's gathered spill,
+        same seam as ``export_path``).  Returns how many of the path's
+        pages have a durable copy afterwards."""
+        if self.disk is None:
+            return 0
+        node = self._roots.get(salt)
+        if node is None:
+            return 0
+        ps = self.page_size
+        path_nodes: list[RadixNode] = []
+        for i in range(len(tokens) // ps):
+            node = node.children.get(tuple(tokens[i * ps : (i + 1) * ps]))
+            if node is None:
+                break
+            path_nodes.append(node)
+        resident = [
+            n for n in path_nodes
+            if n.page is not None and n.host is None
+        ]
+        copies: dict[int, object] = {}
+        if resident and copy_many is not None:
+            for n, blob in zip(
+                resident, copy_many([n.page for n in resident])
+            ):
+                copies[id(n)] = blob
+        durable = 0
+        for n in path_nodes:
+            if n.disk is not None and self.disk.contains(n.disk):
+                durable += 1
+                continue
+            blob = n.host if n.host is not None else copies.get(id(n))
+            if blob is None:
+                continue
+            key = n.key.hex()
+            if self.disk.put(key, blob):
+                n.disk = key
+                durable += 1
+        return durable
 
     # ---- accounting -----------------------------------------------------
 
@@ -771,6 +959,13 @@ class RadixKV:
     @property
     def offloaded_pages(self) -> int:
         return self._offloaded
+
+    @property
+    def disked_pages(self) -> int:
+        """Nodes whose ONLY copy is the disk tier (tree-local view; the
+        tier's file count is ``self.disk.pages`` — larger, because
+        files are shared across trees and survive ``clear()``)."""
+        return self._disked
 
     @property
     def node_count(self) -> int:

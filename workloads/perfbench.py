@@ -3843,6 +3843,223 @@ def measure_kv_sched(scale: BenchScale) -> dict:
 measure_kvsched = measure_kv_sched
 
 
+def measure_durability(scale: BenchScale) -> dict:
+    """Durable sessions (docs/SERVING.md "Durable sessions"): the SAME
+    seeded greedy stream run two ways as interleaved repeats — an
+    ORACLE arm on today's engine (no disk tier, no journal) and a
+    DURABLE arm (``--kv-disk-dir`` + ``Fleet(journal_dir=...)``) that
+    is KILLED mid-stream via ``close()`` and rebuilt in a fresh fleet
+    from nothing but the journal and the per-page disk files.
+
+    Every repeat ASSERTS the restored arm's streams bit-identical to
+    the uninterrupted oracle — the restart moves time, never a token —
+    and that the kill landed genuinely mid-stream (>= 1 session had
+    emitted tokens but not finished).  So the published numbers price
+    pure durability:
+
+      * ``durable_restore_ms`` — wall time for ``Fleet.restore()`` to
+        resurrect every journaled session into a cold fleet (median
+        with min/max; the crash-recovery RTO).
+      * ``kv_disk_reload_ms`` — per-page disk→HBM reload latency
+        (checksum verify + device put) during the restored run.
+      * ``durable_sessions_per_hbm_page`` — journaled sessions carried
+        per HBM page in the pool: the fan-out the disk tier buys over
+        hot memory alone.
+      * ``durable_off_tokens_per_sec`` — the oracle arm's rate, pinned
+        so durability stays pay-for-what-you-use when disabled.
+    """
+    import os
+    import shutil
+    import statistics
+    import tempfile
+
+    from .fleet import Fleet
+    from .serve import ServeEngine
+
+    batch, ps = scale.batch, scale.page_size
+    chunk = ps
+    hi = scale.serve_chunks[1]
+    prefix_len = 2 * ps  # shared system template: the disk tier dedups it
+    tail_max = ps
+    max_new = 1 + hi * chunk
+    longest = prefix_len + tail_max + max_new
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model,
+        n_heads=scale.n_heads, n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=longest + chunk,
+    )
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype),
+        init_params(config, jax.random.PRNGKey(0)),
+    )
+    n_rep = 2
+    n_req = 2 * batch
+    key = jax.random.PRNGKey(23)
+    sys_prefix = [int(t) for t in jax.random.randint(
+        jax.random.fold_in(key, 0), (prefix_len,), 0,
+        config.vocab_size, jnp.int32,
+    )]
+    reqs = []
+    for i in range(n_req):
+        tail = [int(t) for t in jax.random.randint(
+            jax.random.fold_in(key, 100 + i), (1 + i % tail_max,), 0,
+            config.vocab_size, jnp.int32,
+        )]
+        # Budgets long enough that a 3-step kill is mid-stream for most.
+        new = max_new - (i * chunk) % (2 * chunk)
+        reqs.append((sys_prefix + tail, new))
+    pages_req = -(-longest // ps)
+    n_pages = pages_req * batch
+    host_pages = 4 * pages_req
+    fleet_hbm_pages = n_rep * n_pages
+
+    def build_fleet(root: str | None, warm: bool) -> Fleet:
+        durable = root is not None
+        engines = [
+            ServeEngine(
+                params, config, slots=batch, page_size=ps, chunk=chunk,
+                prompt_bucket=ps, pipelined=True, n_pages=n_pages,
+                prefix_cache=True,
+                kv_offload=durable,
+                kv_host_pages=host_pages if durable else None,
+                kv_disk_dir=os.path.join(root, "kv") if durable else None,
+            )
+            for _ in range(n_rep)
+        ]
+        fleet = Fleet(
+            engines, chip_ids=[f"chip-{i}" for i in range(n_rep)],
+            hang_timeout_s=60.0,
+            journal_dir=os.path.join(root, "journal") if durable else None,
+        )
+        if warm:  # land the compiles off-clock
+            for i in range(n_rep):
+                fleet.submit([1 + i], 1 + chunk)
+            fleet.run()
+            fleet.drain_completed()
+        return fleet
+
+    def run_oracle() -> tuple[float, list[list[int]]]:
+        fleet = build_fleet(None, warm=True)
+        rids = [fleet.submit(p, n) for p, n in reqs]
+        tokens0 = fleet.generated_tokens
+        t0 = time.perf_counter()
+        fleet.run()
+        secs = time.perf_counter() - t0
+        rate = (fleet.generated_tokens - tokens0) / secs
+        done = {fr.rid: fr for fr in fleet.drain_completed()}
+        statuses = {done[r].status for r in rids}
+        if statuses != {"ok"}:
+            raise RuntimeError(
+                f"durability bench oracle: statuses {statuses}, "
+                "expected all ok"
+            )
+        fleet.close()
+        return rate, [list(done[r].tokens) for r in rids]
+
+    def run_durable(
+        oracle_streams: list[list[int]],
+    ) -> tuple[float, float, float]:
+        root = tempfile.mkdtemp(prefix="bench-durable-")
+        try:
+            fleet = build_fleet(root, warm=True)
+            rids = [fleet.submit(p, n) for p, n in reqs]
+            with fleet._lock:
+                for _ in range(3):  # mid-stream, then the process "dies"
+                    if not fleet.idle:
+                        fleet.step()
+            fleet.close()  # journals live sessions before going dark
+            # A FRESH fleet — new engines, empty pools, empty radix —
+            # rebuilt from nothing but what survived on disk.  No warm
+            # pass: restore must work into a cold boot (compiles are
+            # already process-cached, so the clock prices restore).
+            fleet2 = build_fleet(root, warm=False)
+            t0 = time.perf_counter()
+            restored = fleet2.restore(os.path.join(root, "journal"))
+            restore_s = time.perf_counter() - t0
+            mid = sum(
+                1 for fr in fleet2.queue if fr.tokens
+            )
+            if restored < n_req or mid < 1:
+                raise RuntimeError(
+                    f"durability bench: restored {restored} sessions "
+                    f"({mid} mid-stream) — the kill must land with "
+                    "every session journaled and >= 1 mid-stream"
+                )
+            fleet2.run()
+            done = {fr.rid: fr for fr in fleet2.drain_completed()}
+            streams = [list(done[r].tokens) for r in rids]
+            if streams != oracle_streams:
+                raise RuntimeError(
+                    "durability bench: restored streams diverged from "
+                    "the uninterrupted oracle — restart is supposed to "
+                    "move time, never a token"
+                )
+            reads = sum(
+                r.engine._kv_disk.reads for r in fleet2.replicas
+            )
+            get_s = sum(
+                r.engine._kv_disk.get_s for r in fleet2.replicas
+            )
+            reload_ms = (get_s / reads) * 1000 if reads else 0.0
+            fleet2.close()
+            return restore_s * 1000, reload_ms, restored
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    # Throwaway pass: land the measured shapes' compiles (and the
+    # restore path's) so the first interleaved pair prices durability,
+    # not compilation.
+    _, oracle_streams = run_oracle()
+    run_durable(oracle_streams)
+    restore_samples: list[float] = []
+    reload_samples: list[float] = []
+    restored_counts: list[float] = []
+    off_rates: list[float] = []
+
+    def durable_arm() -> float:
+        restore_ms, reload_ms, restored = run_durable(oracle_streams)
+        restore_samples.append(restore_ms)
+        reload_samples.append(reload_ms)
+        restored_counts.append(restored)
+        return restore_ms
+
+    def oracle_arm() -> float:
+        rate, streams = run_oracle()
+        if streams != oracle_streams:
+            raise RuntimeError(
+                "durability bench: durability-off streams drifted "
+                "between repeats — the greedy oracle must be stable"
+            )
+        off_rates.append(rate)
+        return rate
+
+    _interleaved_repeats(durable_arm, oracle_arm)
+    return {
+        "durable_replicas": n_rep,
+        "durable_requests": n_req,
+        "durable_restore_ms": round(
+            statistics.median(restore_samples), 2
+        ),
+        "durable_restore_ms_min": round(min(restore_samples), 2),
+        "durable_restore_ms_max": round(max(restore_samples), 2),
+        "durable_restore_ms_samples": [
+            round(s, 2) for s in restore_samples
+        ],
+        "kv_disk_reload_ms": round(
+            statistics.median(reload_samples), 3
+        ),
+        "kv_disk_reload_ms_samples": [
+            round(s, 3) for s in reload_samples
+        ],
+        "durable_sessions_per_hbm_page": round(
+            statistics.median(restored_counts) / fleet_hbm_pages, 4
+        ),
+        "durable_off_tokens_per_sec": round(
+            statistics.median(off_rates), 1
+        ),
+    }
+
+
 def measure_faststart(scale: BenchScale) -> dict:
     """Fast replica start economics (workloads/faststart.py;
     docs/SERVING.md "Fast replica start"), on a spec="auto" engine so
@@ -4142,6 +4359,12 @@ def run(scale_name: str = "full", pool_with: dict | None = None) -> dict:
             kvh["kv_offload_reload_ms_samples"], pool_with,
         )
     out.update(measure_kv_sched(scale))
+    dur = measure_durability(scale)
+    out.update(dur)
+    for key in ("durable_restore_ms", "kv_disk_reload_ms"):
+        _publish_ratio_spread(
+            out, key, dur[f"{key}_samples"], pool_with,
+        )
     out.update(measure_spec_serve(scale))
     out.update(measure_spec_economics(scale))
     phases = measure_spec_phases(scale)

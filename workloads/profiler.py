@@ -213,6 +213,11 @@ class DeviceTimeTable:
             raise ValueError(f"alpha must be in (0, 1], got {alpha}")
         self.alpha = alpha
         self._table: dict[str, dict] = {}
+        # Artifact refreshes that fail to parse (absent, truncated,
+        # corrupt, wrong schema) adopt nothing and count here —
+        # attribution degrades to live-measurement warmup (the cold
+        # path), never raises.
+        self.refresh_errors = 0
 
     @staticmethod
     def key(program: str, seq_tokens: int, batch: int) -> str:
@@ -268,22 +273,41 @@ class DeviceTimeTable:
         """Merge a persisted table (snapshot / bench artifact); existing
         live entries win — a snapshot must never overwrite fresher
         measurements.  Returns the number of entries adopted."""
+        if not isinstance(table, dict):
+            if table is not None:
+                self.refresh_errors += 1
+            return 0
         adopted = 0
-        for k, v in (table or {}).items():
+        for k, v in table.items():
             if k in self._table or not isinstance(v, dict):
                 continue
             ms = v.get("ms")
+            n = v.get("n", 1)
+            if not isinstance(n, (int, float)):
+                n = 1
             if isinstance(ms, (int, float)) and ms >= 0:
-                self._table[k] = {
-                    "ms": float(ms), "n": int(v.get("n", 1)) or 1
-                }
+                self._table[k] = {"ms": float(ms), "n": int(n) or 1}
                 adopted += 1
         return adopted
 
-    def refresh_from_artifact(self, artifact: dict) -> int:
+    def refresh_from_artifact(self, artifact) -> int:
         """Adopt the calibration the committed bench artifact carries
         (``profiler_device_time_table``, published by the
-        ``measure_profiler`` arm)."""
+        ``measure_profiler`` arm).  ``artifact`` is the parsed artifact
+        dict OR a path to the JSON file; an absent/truncated/corrupt
+        file or a malformed payload adopts nothing and bumps
+        ``refresh_errors`` — the table stays on live-measurement
+        warmup, the cold path."""
+        if isinstance(artifact, (str, os.PathLike)):
+            try:
+                with open(artifact, encoding="utf-8") as f:
+                    artifact = json.load(f)
+            except (OSError, ValueError):
+                self.refresh_errors += 1
+                return 0
+        if not isinstance(artifact, dict):
+            self.refresh_errors += 1
+            return 0
         return self.load(artifact.get("profiler_device_time_table"))
 
 

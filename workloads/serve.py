@@ -206,6 +206,8 @@ class ServeEngine:
         prefix_cache: bool | str = False,
         kv_offload: bool = False,
         kv_host_pages: int | None = None,
+        kv_disk_dir: str | None = None,
+        kv_disk_pages: int | None = None,
         adapters: dict[str, list] | None = None,
         lora_alpha: float = 1.0,
         batched_admission: bool = True,
@@ -469,22 +471,52 @@ class ServeEngine:
                 f"kv_host_pages must be >= 1 or None (unbounded), got "
                 f"{kv_host_pages}"
             )
+        # Durable sessions (docs/SERVING.md "Durable sessions"): the
+        # disk tier below host RAM.  Chain-key-named, checksummed
+        # per-page files under kv_disk_dir — a full host budget demotes
+        # its coldest page to a file instead of dropping state, and the
+        # files (shared across every engine/process pointing at the
+        # directory: that sharing IS the cross-replica dedup) survive a
+        # full process restart.
+        if kv_disk_dir is not None and not kv_offload:
+            raise ValueError(
+                "kv_disk_dir is the tier below the host-RAM offload "
+                "tier; it needs kv_offload=True"
+            )
+        if kv_disk_pages is not None and kv_disk_dir is None:
+            raise ValueError(
+                "kv_disk_pages bounds the kv_disk_dir tier; it has no "
+                "effect without kv_disk_dir"
+            )
         self._kv_offload = bool(kv_offload)
+        if kv_disk_dir is not None:
+            from .durable import KVDiskTier
+
+            self._kv_disk = KVDiskTier(
+                kv_disk_dir, budget_pages=kv_disk_pages,
+                injector=fault_injector,
+            )
+        else:
+            self._kv_disk = None
         if prefix_cache == "flat":
             self.prefix = PrefixCache(self.ctrl)
         elif prefix_cache:
             self.prefix = RadixKV(
                 self.ctrl,
                 host_pages=(kv_host_pages if kv_offload else 0),
+                disk=self._kv_disk,
             )
         else:
             self.prefix = None
         # Wall seconds spent moving KV pages across the HBM <-> host-RAM
         # boundary (spills pay one device_get each; reloads dispatch
         # async and ride the admission sweep) — the bench's
-        # kv_offload_reload_ms source.
-        self.kv_spill_s = 0.0
-        self.kv_reload_s = 0.0
+        # kv_offload_reload_ms source.  The public kv_spill_s /
+        # kv_reload_s properties fold the disk tier's file windows into
+        # these, so the chip-time ledger's kv_spill / kv_reload phases
+        # price every hop below HBM.
+        self._kv_spill_base_s = 0.0
+        self._kv_reload_base_s = 0.0
         # Speculative serving: the draft model gets its OWN physical
         # pools but SHARES the control plane — same page indices, same
         # tables — so one allocator serves both caches.
@@ -1007,6 +1039,54 @@ class ServeEngine:
 
     # ---- KV-cache hierarchy: host-RAM offload tier ----------------------
 
+    @property
+    def kv_spill_s(self) -> float:
+        """Wall seconds moving KV pages DOWN the hierarchy: HBM -> host
+        device_gets plus the disk tier's file writes — one number, so
+        the ledger's kv_spill phase prices every downward hop."""
+        disk = self._kv_disk.put_s if self._kv_disk is not None else 0.0
+        return self._kv_spill_base_s + disk
+
+    @property
+    def kv_reload_s(self) -> float:
+        """Wall seconds moving KV pages UP the hierarchy: write_page
+        dispatches plus the disk tier's verified file reads."""
+        disk = self._kv_disk.get_s if self._kv_disk is not None else 0.0
+        return self._kv_reload_base_s + disk
+
+    @property
+    def kv_disk_pages(self) -> int:
+        """Files currently in the disk tier (0 without one) — the
+        engine_kv_disk_pages gauge."""
+        return self._kv_disk.pages if self._kv_disk is not None else 0
+
+    def flush_kv_to_disk(
+        self, tokens: list[int], adapter: str | None = None,
+    ) -> int:
+        """Persist ``tokens``' prefix pages to the disk tier without
+        moving them (resident pages copy out through the gathered spill
+        path) — the fleet journal's parked-page-manifest half.  Returns
+        pages durable afterwards; 0 without a disk tier or radix
+        index."""
+        if self._kv_disk is None or not isinstance(self.prefix, RadixKV):
+            return 0
+        return self.prefix.flush_to_disk(
+            tokens, salt=self._handoff_salt(adapter),
+            copy_many=self._spill_pages,
+        )
+
+    def attach_kv_disk(
+        self, tokens: list[int], adapter: str | None = None,
+    ) -> int:
+        """Adopt ``tokens``' chain-key files from the disk tier as
+        reloadable nodes — restart rehydration (Fleet.restore calls
+        this per journaled session before re-dispatch)."""
+        if self._kv_disk is None or not isinstance(self.prefix, RadixKV):
+            return 0
+        return self.prefix.attach_disk(
+            tokens, salt=self._handoff_salt(adapter)
+        )
+
     def _spill_page(self, page: int):
         """Copy one cache-owned physical page (target pools, and draft
         pools when speculation is loaded — cached pages hold BOTH models'
@@ -1020,7 +1100,7 @@ class ServeEngine:
             if self.d_pools is not None else None
         )
         blob = jax.device_get((main, draft))
-        self.kv_spill_s += time.perf_counter() - t0
+        self._kv_spill_base_s += time.perf_counter() - t0
         return blob
 
     def _spill_pages(self, pages: list[int]) -> list:
@@ -1061,7 +1141,7 @@ class ServeEngine:
             )
             for i in range(n)
         ]
-        self.kv_spill_s += time.perf_counter() - t0
+        self._kv_spill_base_s += time.perf_counter() - t0
         return blobs
 
     def _reload_page(self, blob):
@@ -1089,7 +1169,7 @@ class ServeEngine:
                 self.d_pools, jnp.asarray(draft[0]), jnp.asarray(draft[1]),
                 page,
             )
-        self.kv_reload_s += time.perf_counter() - t0
+        self._kv_reload_base_s += time.perf_counter() - t0
         return page
 
     def _allocate_evicting(self, seq, n_tokens: int) -> list:
@@ -4004,7 +4084,9 @@ def _run_fleet_cli(
             superstep_k=args.superstep_k,
             prefill_budget=args.prefill_budget,
             prefix_cache=args.prefix_cache, kv_offload=args.kv_offload,
-            kv_host_pages=args.kv_host_pages, adapters=adapters,
+            kv_host_pages=args.kv_host_pages,
+            kv_disk_dir=args.kv_disk_dir,
+            kv_disk_pages=args.kv_disk_pages, adapters=adapters,
             observer=observers[i],
             ledger=(
                 ChipTimeLedger(name=str(i)) if args.ledger else None
@@ -4033,9 +4115,21 @@ def _run_fleet_cli(
         observer=fleet_obs,
         roles=roles, wfq_weights=wfq_weights,
         ledger=fleet_ledger,
+        journal_dir=args.journal_dir, journal_every=args.journal_every,
     )
     if recorder is not None:
         recorder.attach_fleet(fleet)
+    if args.journal_dir is not None:
+        # BEFORE any traffic (restore is a boot-time operation): a
+        # journal left by the previous process resurrects its sessions
+        # — interrupted streams continue exactly where they stopped.
+        restored = fleet.restore()
+        if restored:
+            print(
+                f"journal restored: {restored} session(s) from "
+                f"{args.journal_dir} ({len(fleet.queue)} continuing, "
+                f"{fleet.tokens_replayed} tokens replayed)"
+            )
     if roles is not None:
         print(f"disaggregated pools: roles={fleet.roles()}" + (
             f", wfq={wfq_weights}" if wfq_weights else ""
@@ -4095,7 +4189,9 @@ def _run_fleet_cli(
                 prefill_budget=args.prefill_budget,
                 prefix_cache=args.prefix_cache,
                 kv_offload=args.kv_offload,
-                kv_host_pages=args.kv_host_pages, adapters=adapters,
+                kv_host_pages=args.kv_host_pages,
+                kv_disk_dir=args.kv_disk_dir,
+                kv_disk_pages=args.kv_disk_pages, adapters=adapters,
                 max_retries=args.max_retries, observer=obs, ledger=led,
                 retry_backoff_s=args.retry_backoff_s, **spec_kw,
             )
@@ -4188,7 +4284,9 @@ def _run_fleet_cli(
                 prefill_budget=args.prefill_budget,
                 prefix_cache=args.prefix_cache,
                 kv_offload=args.kv_offload,
-                kv_host_pages=args.kv_host_pages, adapters=adapters,
+                kv_host_pages=args.kv_host_pages,
+                kv_disk_dir=args.kv_disk_dir,
+                kv_disk_pages=args.kv_disk_pages, adapters=adapters,
                 max_retries=args.max_retries,
                 retry_backoff_s=args.retry_backoff_s, **spec_kw,
             )
@@ -4552,6 +4650,35 @@ def main(argv=None) -> int:
                         metavar="N",
                         help="with --kv-offload: cap the host tier at N "
                         "offloaded pages (default: unbounded)")
+    parser.add_argument("--kv-disk-dir", default=None, metavar="DIR",
+                        help="durable disk tier below the host-RAM "
+                        "offload tier (requires --kv-offload): when "
+                        "host RAM is full, the coldest offloaded page "
+                        "demotes to a chain-key-named, checksummed "
+                        "file under DIR instead of dropping; files are "
+                        "deduplicated across replicas sharing DIR and "
+                        "survive a full process restart "
+                        "(docs/SERVING.md 'Durable sessions')")
+    parser.add_argument("--kv-disk-pages", type=int, default=None,
+                        metavar="N",
+                        help="with --kv-disk-dir: cap the disk tier at "
+                        "N page files, evicted coldest-first (default: "
+                        "unbounded)")
+    parser.add_argument("--journal-dir", default=None, metavar="DIR",
+                        help="with --fleet: checkpoint every session "
+                        "(prompt, emitted tokens, sampling identity, "
+                        "status) to an atomic epoch-stamped journal "
+                        "under DIR; on the next start a journal found "
+                        "there is restored BEFORE traffic — finished "
+                        "sessions re-register as history, interrupted "
+                        "ones continue exactly where they stopped, "
+                        "adopting parked --kv-disk-dir pages "
+                        "(docs/SERVING.md 'Durable sessions')")
+    parser.add_argument("--journal-every", type=int, default=None,
+                        metavar="STEPS",
+                        help="with --journal-dir: journal every STEPS "
+                        "fleet steps (default: only on close and on "
+                        "supervisor-observed replica deaths)")
     parser.add_argument("--spec-int8-draft", action="store_true",
                         help="speculative decoding with the int8-quantized "
                         "model drafting for its own bf16 self (quantized "
@@ -4783,6 +4910,21 @@ def main(argv=None) -> int:
         parser.error("--kv-host-pages bounds the --kv-offload host tier")
     if args.kv_host_pages is not None and args.kv_host_pages < 1:
         parser.error("--kv-host-pages must be >= 1 pages")
+    if args.kv_disk_dir is not None and not args.kv_offload:
+        parser.error("--kv-disk-dir is the tier below --kv-offload; "
+                     "pass --kv-offload too")
+    if args.kv_disk_pages is not None and args.kv_disk_dir is None:
+        parser.error("--kv-disk-pages bounds the --kv-disk-dir tier")
+    if args.kv_disk_pages is not None and args.kv_disk_pages < 1:
+        parser.error("--kv-disk-pages must be >= 1 page files")
+    if args.journal_dir is not None and args.fleet is None:
+        parser.error("--journal-dir checkpoints fleet sessions; it "
+                     "needs --fleet N")
+    if args.journal_every is not None and args.journal_dir is None:
+        parser.error("--journal-every paces the --journal-dir "
+                     "checkpoint cadence")
+    if args.journal_every is not None and args.journal_every < 1:
+        parser.error("--journal-every must be >= 1 fleet steps")
     if args.restart_backoff_s <= 0:
         parser.error("--restart-backoff-s must be > 0 seconds")
     if args.restart_backoff_max_s < args.restart_backoff_s:
@@ -4987,6 +5129,7 @@ def main(argv=None) -> int:
         prefill_budget=args.prefill_budget,
         prefix_cache=args.prefix_cache, kv_offload=args.kv_offload,
         kv_host_pages=args.kv_host_pages,
+        kv_disk_dir=args.kv_disk_dir, kv_disk_pages=args.kv_disk_pages,
         adapters=adapters, observer=observer, ledger=ledger,
         max_pending=args.max_pending, fault_injector=injector,
         max_retries=args.max_retries,
@@ -5077,6 +5220,12 @@ def main(argv=None) -> int:
                 f"kv_reloads={engine.prefix.reloads} "
                 f"kv_host_pages_now={engine.prefix.offloaded_pages} "
             )
+            if args.kv_disk_dir is not None:
+                kv += (
+                    f"kv_disk_demotions={engine.prefix.demotions} "
+                    f"kv_disk_reloads={engine.prefix.disk_reloads} "
+                    f"kv_disk_pages_now={engine.kv_disk_pages} "
+                )
         print(
             f"lifecycle: statuses={dict(statuses)} rejected={rejected} "
             f"quarantined_steps={engine.steps_quarantined} "

@@ -133,6 +133,7 @@ class FleetSupervisor:
         fault_injector=None,
         observer=None,
         snapshot=None,
+        journal_every_s: float | None = None,
         clock=time.perf_counter,
     ):
         if crash_loop_k < 1:
@@ -235,6 +236,19 @@ class FleetSupervisor:
         # resurrecting (the HealthEvent all-chips contract: "" marks /
         # clears every chip).
         self._unhealthy: set[str] = set()
+        # Durable sessions: with a cadence set (and the fleet built
+        # with journal_dir=), every poll past due checkpoints the
+        # session journal — and a freshly noted death checkpoints
+        # IMMEDIATELY, so a dead slot's sessions replay onto survivors
+        # (or a successor process) from durable state no older than
+        # the harvest.
+        if journal_every_s is not None and journal_every_s <= 0:
+            raise ValueError(
+                f"journal_every_s must be > 0 or None, got "
+                f"{journal_every_s}"
+            )
+        self.journal_every_s = journal_every_s
+        self._t_journal: float | None = None
         # Telemetry (mirrored to the registry by SupervisorObserver).
         self.restarts_total = 0
         self.restart_failures = 0
@@ -433,6 +447,7 @@ class FleetSupervisor:
         if self.fleet.closed:
             return
         now = self._clock() if now is None else now
+        deaths = 0
         for slot in self.slots:
             if slot.state == SERVING and (
                 slot.index is None
@@ -440,6 +455,22 @@ class FleetSupervisor:
                 or self.fleet.replicas[slot.index].state == "dead"
             ):
                 self._note_death(slot, now)
+                deaths += 1
+        if getattr(self.fleet, "_journal", None) is not None and (
+            deaths
+            or (
+                self.journal_every_s is not None
+                and (
+                    self._t_journal is None
+                    or now - self._t_journal >= self.journal_every_s
+                )
+            )
+        ):
+            try:
+                self.fleet.journal_now()
+            except Exception:  # noqa: BLE001 — supervision must not
+                pass  # die because a checkpoint did
+            self._t_journal = now
         for slot in self.slots:
             if (
                 slot.state == BACKOFF
