@@ -4,7 +4,7 @@ PYTHON ?= python
 
 include versions.mk
 
-.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check profile-check durable-check fmt-check
+.PHONY: all native test test-all coverage bench perf-bench busy-bench clean check check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check profile-check durable-check control-check fmt-check
 
 all: native
 
@@ -51,7 +51,7 @@ busy-bench: native
 	$(PYTHON) -m workloads.oversubscribe --chips 4 --replicas 2 --pods 8 \
 		--duration 8 --platform $(PLATFORM)
 
-check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check profile-check durable-check test
+check: check-compat obs-check faults-check prefill-check fleet-check selfheal-check autoscale-check superstep-check spec-superstep-check kvcache-check kvsched-check slo-check disagg-check ledger-check faststart-check profile-check durable-check control-check test
 
 # Chip-time-ledger tripwires (docs/OBSERVABILITY.md "Chip-time ledger,
 # goodput & postmortems"): one seeded fault run with the ledger and
@@ -67,6 +67,20 @@ check: check-compat obs-check faults-check prefill-check fleet-check selfheal-ch
 ledger-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_ledger.py::test_ledger_check_smoke" -q -o addopts=
 	JAX_PLATFORMS=cpu $(PYTHON) tools/postmortem.py --selfcheck
+
+# Goodput-control tripwires (docs/SERVING.md "Goodput-optimal
+# control", docs/OBSERVABILITY.md "Goodput control plane"): one seeded
+# waste spike — bad-draft replicas at always-speculate — that the
+# controller retunes away (spec_breakeven walks to 0, speculation
+# stops), with the measured goodput fraction RECOVERING batch over
+# batch, every stream bit-identical to the dense oracle, and no
+# slot/page leaks.  The full suite (every retune transition pinned,
+# WFQ re-weighting, scored preemption, jax-free hill-climb/hysteresis
+# units, the control-randomized chaos fuzz) rides the slow suite
+# (tests/test_control.py, tests/test_control_units.py,
+# tests/test_serve_fuzz.py).
+control-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest "tests/test_control.py::test_control_check_smoke" -q -o addopts=
 
 # Device-time-profiling tripwires (docs/OBSERVABILITY.md "Device-time
 # profiling & regression sentry"): one seeded serve loop captured

@@ -636,6 +636,13 @@ COMPACT_KEYS = [
     "faststart_cache_hit_spawn_ms", "faststart_calibration_skipped",
     "faststart_selfheal_restore_ms",
     "faststart_scaleup_cold_ms", "faststart_scaleup_hot_ms",
+    # Goodput-optimal control plane: controlled-vs-static throughput on
+    # the seeded waste stream (bit-identical tokens), each arm's
+    # ledger goodput verdict, the knob moves the hill-climb landed,
+    # and the dead-banded controller's poll tax.
+    "ctrl_vs_static_tokens_per_sec", "ctrl_goodput_fraction",
+    "ctrl_static_goodput_fraction", "ctrl_retunes_applied",
+    "ctrl_overhead_pct",
 ]
 
 

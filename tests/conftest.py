@@ -52,6 +52,12 @@ _FAST_DESPITE_JAX = {
     # jax-free) and drives fake engines; the real jax.profiler capture
     # smoke lives in test_profile_capture.py (slow / profile-check).
     "test_profiler",
+    # Goodput-controller hill-climb/hysteresis/WFQ units +
+    # FleetLedger.class_economics: imports workloads.control and
+    # workloads.ledger (both deliberately jax-free) and drives fake
+    # engines; the real-engine retune transitions live in
+    # test_control.py (slow / control-check).
+    "test_control_units",
 }
 _JAX_IMPORT_RE = re.compile(r"^\s*(?:import|from)\s+(?:jax|workloads)\b", re.MULTILINE)
 _slow_file_cache: dict[str, bool] = {}

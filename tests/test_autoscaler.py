@@ -684,3 +684,81 @@ def test_autoscaler_resize_chaos_fuzz():
                 )
         _assert_no_leaks(fleet)
         fleet.close()
+
+
+# ---- waste-budget SLO (the GoodputController's autoscaler seam) ----------
+
+
+def test_waste_budget_validates_its_range():
+    fleet = _fleet(1)
+    for bad in (0.0, 1.0, -0.2, 1.5):
+        with pytest.raises(ValueError):
+            _autoscaler(fleet, waste_budget=bad)
+    fleet.close()
+
+
+def test_waste_budget_holds_scale_up_until_waste_clears():
+    """Don't scale up into measured waste: while the (controller-fed)
+    waste fraction exceeds the budget, a breached signal buys a
+    waste_hold — counted once per open window — and the ladder
+    engages instead; the moment waste returns inside the budget the
+    ordinary probed scale-up proceeds."""
+    fleet = _fleet(1)
+    asc = _autoscaler(
+        fleet, waste_budget=0.3,
+        up_backoff=Backoff(base_s=10.0, max_s=10.0, jitter=0.0),
+        clock=lambda: 0.0,
+    )
+    assert asc.states()["waste_budget"] == 0.3
+    asc.calibrate_probe()
+    fleet.submit([1], 2)
+    fleet.run()  # warm the engine compiles
+    for p, n in _prompts(7, 3):  # breach depth_high without stepping
+        fleet.submit(p, n)
+    asc.waste_fraction_hint = 0.8  # the controller's smoothed view
+    asc.poll(now=100.0)
+    assert asc.scale_ups == 0 and len(fleet.alive) == 1
+    assert asc.waste_holds == 1
+    assert asc.decisions.get("waste_hold") == 1
+    assert asc.ladder_level >= 1  # the ladder attacks the spike instead
+    assert any(ev.kind == "waste_hold" for ev in asc.events)
+    # The same open window re-polled (past the up-gate): still held,
+    # still ONE hold counted.
+    asc.poll(now=111.0)
+    assert asc.scale_ups == 0 and asc.waste_holds == 1
+    assert asc.states()["waste_fraction"] == 0.8
+    # Waste back inside the budget: capacity may grow again.
+    asc.waste_fraction_hint = 0.1
+    asc.poll(now=122.0)
+    assert asc.scale_ups == 1 and len(fleet.alive) == 2
+    assert asc.waste_holds == 1
+    asc.run()
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_waste_headroom_relaxes_the_scale_down_streak():
+    """Eager scale-down: waste comfortably inside the budget collapses
+    the down_consecutive streak to a single clear poll — capacity
+    above the floor under goodput headroom is pure overprovision."""
+    fleet = _fleet(2)
+    asc = _autoscaler(
+        fleet, waste_budget=0.5, down_consecutive=3, max_replicas=2,
+    )
+    asc.calibrate_probe()
+    fleet.submit([1], 2)
+    fleet.run()
+    # Clear signal, but waste OUTSIDE the headroom band (> budget *
+    # clear_fraction = 0.25): the full 3-poll streak still applies.
+    asc.waste_fraction_hint = 0.4
+    asc.poll(now=100.0)
+    assert asc.scale_downs == 0
+    # Waste drops into the headroom band: the very next clear poll
+    # drains a replica without waiting out the streak.
+    asc.waste_fraction_hint = 0.05
+    asc.poll(now=101.0)
+    assert asc.scale_downs == 1
+    assert asc.wait_quiescent(20.0), asc.states()
+    assert len(fleet.alive) == 1
+    _assert_no_leaks(fleet)
+    fleet.close()

@@ -1,0 +1,495 @@
+"""Goodput-control contracts on REAL engines (workloads/control.py +
+ServeEngine.retune): every retune transition the controller can emit —
+breakeven shift, superstep_k step, spec_superstep_k step, WFQ
+re-weight, scored preempt — pinned for bit-identical greedy streams
+against the dense oracle, plus the closed loop itself: a seeded waste
+spike makes the controller walk the speculation knobs down and the
+measured goodput fraction recovers, with no slot/page leaks.  The
+jax-free hill-climb/hysteresis units live in test_control_units.py;
+``make control-check`` runs ``test_control_check_smoke`` alone."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from workloads.autoscaler import FleetAutoscaler
+from workloads.backoff import Backoff
+from workloads.control import GoodputController
+from workloads.errors import EngineClosed
+from workloads.fleet import DEAD, Fleet
+from workloads.generate import generate
+from workloads.ledger import ChipTimeLedger, FleetLedger
+from workloads.model import ModelConfig, init_params
+from workloads.serve import ServeEngine
+
+CONFIG = ModelConfig(max_seq_len=64, n_layers=2, dtype=jnp.float32)
+DRAFT_CONFIG = ModelConfig(
+    max_seq_len=64, n_layers=1, d_model=32, n_heads=2, d_ff=64,
+    dtype=jnp.float32,
+)
+PARAMS = init_params(CONFIG, jax.random.PRNGKey(0))
+# An UNCORRELATED draft: near-chance acceptance, so always-speculate
+# engines burn heavy spec_rejected waste — the seeded spike the
+# controller exists to retune away.  Greedy spec decoding stays exact
+# regardless of draft quality, so oracle parity still pins every
+# stream.
+BAD_DRAFT = init_params(DRAFT_CONFIG, jax.random.PRNGKey(99))
+ENGINE_KW = dict(slots=2, page_size=4, prompt_bucket=8)
+FAST = Backoff(base_s=1e-6, max_s=1e-6, jitter=0.0)
+
+
+def _spec_engine(**kw):
+    base = dict(ENGINE_KW)
+    base.update(kw)
+    return ServeEngine(
+        PARAMS, CONFIG, draft_params=BAD_DRAFT, draft_config=DRAFT_CONFIG,
+        gamma=3, spec="auto", **base,
+    )
+
+
+def _plain_engine(**kw):
+    base = dict(ENGINE_KW)
+    base.update(kw)
+    return ServeEngine(PARAMS, CONFIG, **base)
+
+
+def _oracle(prompt, new):
+    return [int(t) for t in np.asarray(generate(
+        PARAMS, jnp.asarray([prompt], jnp.int32), CONFIG,
+        max_new_tokens=new,
+    )[0])]
+
+
+def _controller(fleet, **kw):
+    kw.setdefault("min_sample_tokens", 16)
+    kw.setdefault("spec_reject_low", 0.02)
+    kw.setdefault("spec_reject_high", 0.2)
+    kw.setdefault("retune_backoff", FAST)
+    kw.setdefault("wfq_backoff", FAST)
+    return GoodputController(fleet, **kw)
+
+
+def _assert_no_leaks(fleet):
+    for rep in fleet.replicas:
+        if rep.state == DEAD:
+            continue
+        e = rep.engine
+        assert not e._occupied.any(), rep.index
+        assert e._committed_pages == 0, rep.index
+        assert not e._groups, rep.index
+        pinned = e.prefix.cached_pages if e.prefix is not None else 0
+        assert e.ctrl.used_pages == pinned, rep.index
+        assert not rep.rids, rep.index
+
+
+# ---- ServeEngine.retune: the actuation seam ------------------------------
+
+
+def test_retune_validates_and_counts_only_real_changes():
+    eng = _spec_engine(
+        spec_breakeven=2.0, superstep_k=2, spec_superstep_k=2,
+    )
+    # No-op retunes return {} and never count (no drain, no churn).
+    assert eng.retune(spec_breakeven=2.0) == {}
+    assert eng.retune(superstep_k=2, spec_superstep_k=2) == {}
+    assert eng.retunes == 0
+    # The k knobs are bounded by their construction-time ceilings.
+    with pytest.raises(ValueError, match="superstep_k"):
+        eng.retune(superstep_k=4)
+    with pytest.raises(ValueError, match="superstep_k"):
+        eng.retune(superstep_k=0)
+    with pytest.raises(ValueError, match="spec_superstep_k"):
+        eng.retune(spec_superstep_k=3)
+    with pytest.raises(ValueError, match="spec_breakeven"):
+        eng.retune(spec_breakeven=-1.0)
+    # A real change reports {knob: (old, new)} and counts once.
+    assert eng.retune(superstep_k=1, spec_breakeven=0.5) == {
+        "superstep_k": (2, 1), "spec_breakeven": (2.0, 0.5),
+    }
+    assert eng.retunes == 1
+    eng.close()
+    with pytest.raises(EngineClosed):
+        eng.retune(spec_breakeven=1.0)
+    # Breakeven shifts need spec="auto": other modes never consult the
+    # threshold, so accepting one would fake an actuation.
+    plain = _plain_engine()
+    with pytest.raises(ValueError, match="auto"):
+        plain.retune(spec_breakeven=1.0)
+    plain.close()
+
+
+def test_retune_breakeven_shift_mid_stream_bit_identical():
+    """The spec_down/spec_up transition: breakeven slots -> 0 flips the
+    engine from always-speculate to never mid-flight (draining the
+    in-flight rounds), and back up again — streams stay oracle-exact
+    across both switches."""
+    eng = _spec_engine(spec_breakeven=2.0)
+    reqs = [([5, 6, 7], 20), ([1, 2], 16), ([9], 12)]
+    rids = [eng.submit(p, n) for p, n in reqs]
+    out = {}
+    for _ in range(3):
+        for fr in eng.step():
+            out[fr.rid] = fr.tokens
+    assert eng.retune(spec_breakeven=0.0) == {
+        "spec_breakeven": (2.0, 0.0),
+    }
+    for _ in range(3):
+        for fr in eng.step():
+            out[fr.rid] = fr.tokens
+    assert eng.retune(spec_breakeven=2.0) == {
+        "spec_breakeven": (0.0, 2.0),
+    }
+    for rid, toks in eng.run().items():
+        out[rid] = toks
+    assert eng.retunes == 2
+    assert eng.spec_rounds > 0, "never speculated below the threshold"
+    assert eng.chunks_run > 0, "never decoded plainly at breakeven 0"
+    for rid, (prompt, new) in zip(rids, reqs):
+        assert list(out[rid]) == _oracle(prompt, new), rid
+    eng.close()
+
+
+def test_retune_superstep_k_step_mid_stream_bit_identical():
+    """The super_down/super_up transition on the plain fused path:
+    k 4 -> 2 -> 4 mid-flight, never above the construction ceiling,
+    streams oracle-exact throughout."""
+    eng = _plain_engine(superstep_k=4)
+    reqs = [([3, 4, 5, 6], 18), ([7, 8], 14)]
+    rids = [eng.submit(p, n) for p, n in reqs]
+    out = {}
+    for _ in range(2):
+        for fr in eng.step():
+            out[fr.rid] = fr.tokens
+    assert eng.retune(superstep_k=2) == {"superstep_k": (4, 2)}
+    for _ in range(2):
+        for fr in eng.step():
+            out[fr.rid] = fr.tokens
+    # Back UP to (never past) the constructed ceiling.
+    assert eng.retune(superstep_k=4) == {"superstep_k": (2, 4)}
+    with pytest.raises(ValueError):
+        eng.retune(superstep_k=8)
+    for rid, toks in eng.run().items():
+        out[rid] = toks
+    for rid, (prompt, new) in zip(rids, reqs):
+        assert list(out[rid]) == _oracle(prompt, new), rid
+    eng.close()
+
+
+def test_retune_spec_superstep_k_step_mid_stream_bit_identical():
+    """The fused-speculative-round transition: spec_superstep_k
+    2 -> 1 -> 2 mid-flight on an always-speculating engine, streams
+    oracle-exact."""
+    eng = _spec_engine(spec_breakeven=2.0, spec_superstep_k=2)
+    reqs = [([11, 12, 13], 16), ([14], 12)]
+    rids = [eng.submit(p, n) for p, n in reqs]
+    out = {}
+    for _ in range(2):
+        for fr in eng.step():
+            out[fr.rid] = fr.tokens
+    assert eng.retune(spec_superstep_k=1) == {
+        "spec_superstep_k": (2, 1),
+    }
+    for _ in range(2):
+        for fr in eng.step():
+            out[fr.rid] = fr.tokens
+    assert eng.retune(spec_superstep_k=2) == {
+        "spec_superstep_k": (1, 2),
+    }
+    for rid, toks in eng.run().items():
+        out[rid] = toks
+    for rid, (prompt, new) in zip(rids, reqs):
+        assert list(out[rid]) == _oracle(prompt, new), rid
+    eng.close()
+
+
+def test_retained_pages_fractional_for_fanout_shared_pages():
+    """Preemption-score input: a fork-shared page retains 1/refcount
+    per holder, so summing retained_pages over a fan-out group counts
+    every unique page exactly once; 0.0 before admission and after
+    retirement."""
+    eng = _plain_engine(slots=2)
+    assert eng.retained_pages("nope") == 0.0
+    r1, r2 = eng.submit_fanout([21, 22, 23, 24, 25, 26], 8, 2)
+    eng.step()  # admit + prefill: prompt pages now shared
+    a, b = eng.retained_pages(r1), eng.retained_pages(r2)
+    assert a > 0 and b > 0
+    union = set()
+    for seq, table in eng.ctrl.tables.items():
+        if (
+            isinstance(seq, tuple) and len(seq) == 3
+            and seq[0] == "slot" and seq[2] in (r1, r2)
+        ):
+            union.update(table)
+    assert a + b == pytest.approx(len(union))
+    # Shared prompt pages count HALF per child: each child retains
+    # strictly less than the pages its table lists.
+    tables = [
+        t for s, t in eng.ctrl.tables.items()
+        if isinstance(s, tuple) and len(s) == 3
+        and s[0] == "slot" and s[2] == r1
+    ]
+    assert a < len(tables[0])
+    eng.run()
+    assert eng.retained_pages(r1) == 0.0
+    assert eng.retained_pages(r2) == 0.0
+    eng.close()
+
+
+# ---- scored preemption ---------------------------------------------------
+
+
+def test_preempt_candidates_order_and_scored_preempt_exact_resume():
+    """The ladder's victim scoring: ascending goodput-per-retained-
+    page — a dispatched-but-unadmitted rid (0 pages, nothing lost)
+    parks first, then the stream delivering the fewest tokens per
+    retained page; the scored preempt itself resumes as an EXACT
+    continuation."""
+    fleet = Fleet(
+        [_plain_engine(slots=2)], chip_ids=["chip-0"],
+        hang_timeout_s=None,
+    )
+    # A: long prompt (many retained pages), B: short prompt (few) —
+    # comparable emissions, so A scores lower goodput-per-page than B.
+    reqs = {
+        "A": (list(range(30, 42)), 20),
+        "B": ([43, 44], 20),
+        "C": ([45, 46, 47], 6),  # third on 2 slots: queued, 0 pages
+    }
+    rids = {
+        k: fleet.submit(p, n, slo_class="bulk")
+        for k, (p, n) in reqs.items()
+    }
+    out = {}
+    for _ in range(2):  # prefill + one decode chunk: nothing finished
+        for fr in fleet.step():
+            out[fr.rid] = list(fr.tokens)
+    rep = fleet.replicas[0]
+    eng = rep.engine
+
+    def retained(k):
+        # Fleet rids map to engine-level requests; retained_pages is
+        # keyed by the ENGINE rid (what preempt_candidates passes).
+        ereq = rep.rids[rids[k]]
+        return eng.retained_pages(getattr(ereq, "rid", rids[k]))
+
+    pages = {k: retained(k) for k in ("A", "B")}
+    assert pages["A"] > pages["B"] > 0
+    assert retained("C") == 0.0
+    cands = fleet.preempt_candidates("bulk")
+    assert cands[0] == rids["C"], "the free victim must park first"
+    assert cands[1:] == [rids["A"], rids["B"]]
+    assert fleet.preempt_candidates("interactive") == []
+    # Park the scored head and drain: the preempted stream must come
+    # back bit-identical (uncharged continuation), like every other.
+    assert fleet.preempt(cands[0])
+    for rid, toks in fleet.run().items():
+        out[rid] = list(toks)
+    for k, (prompt, new) in reqs.items():
+        assert out[rids[k]] == _oracle(prompt, new), k
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_autoscaler_preempt_walks_the_scored_order():
+    """FleetAutoscaler._preempt_some consumes Fleet.preempt_candidates
+    head-first: with preempt_batch=1 exactly the lowest-scored victim
+    parks."""
+    fleet = Fleet(
+        [_plain_engine(slots=2)], chip_ids=["chip-0"],
+        hang_timeout_s=None,
+    )
+
+    def factory(slot):
+        return _plain_engine()
+
+    asc = FleetAutoscaler(
+        fleet, factory, min_replicas=1, max_replicas=1,
+        up_backoff=FAST, down_backoff=FAST, preempt_batch=1,
+        window_s=0.5,
+    )
+    reqs = {
+        "A": (list(range(50, 62)), 20),
+        "B": ([63, 64], 20),
+    }
+    rids = {
+        k: fleet.submit(p, n, slo_class="bulk")
+        for k, (p, n) in reqs.items()
+    }
+    out = {}
+    for _ in range(2):
+        for fr in fleet.step():
+            out[fr.rid] = list(fr.tokens)
+    expect = fleet.preempt_candidates("bulk")[0]
+    assert asc._preempt_some(0.0) == 1
+    assert asc.preemptions_total == 1
+    # The scored head was the one parked: it left its replica's rids.
+    assert expect not in fleet.replicas[0].rids
+    other = [r for r in rids.values() if r != expect][0]
+    assert other in fleet.replicas[0].rids
+    for rid, toks in fleet.run().items():
+        out[rid] = list(toks)
+    for k, (prompt, new) in reqs.items():
+        assert out[rids[k]] == _oracle(prompt, new), k
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+# ---- the controller on real fleets ---------------------------------------
+
+
+def _spike_fleet(n=1, **engine_kw):
+    engine_kw.setdefault("spec_breakeven", 2.0)  # slots: always spec
+    return Fleet(
+        [
+            _spec_engine(ledger=ChipTimeLedger(name=str(i)), **engine_kw)
+            for i in range(n)
+        ],
+        chip_ids=[f"chip-{i}" for i in range(n)],
+        hang_timeout_s=None,
+        ledger=FleetLedger(),
+    )
+
+
+def _spike_reqs(seed, n, new=14):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            [int(t) for t in rng.integers(0, CONFIG.vocab_size, 1 + i % 5)],
+            new,
+        )
+        for i in range(n)
+    ]
+
+
+def test_controller_retunes_away_spec_waste_streams_exact():
+    """The tentpole loop on a real fleet: a bad draft at
+    always-speculate burns spec_rejected waste, the controller walks
+    the breakeven down until speculation stops, and every stream is
+    still bit-identical to the dense oracle."""
+    fleet = _spike_fleet(1)
+    ctrl = _controller(fleet)
+    reqs = _spike_reqs(3, 6)
+    rids = [ctrl.submit(p, n, slo_class="bulk") for p, n in reqs]
+    out = ctrl.run()
+    assert ctrl.samples >= 1
+    assert ctrl.retunes_applied >= 1, ctrl.states()
+    eng = fleet.replicas[0].engine
+    assert eng.spec_breakeven < 2.0, "breakeven never walked down"
+    assert eng.retunes >= 1
+    assert ctrl.spec_rejected_fraction_ewma is not None
+    assert any(ev.kind == "retune" for ev in ctrl.events)
+    for rid, (prompt, new) in zip(rids, reqs):
+        assert list(out[rid]) == _oracle(prompt, new), rid
+    _assert_no_leaks(fleet)
+    fleet.close()
+
+
+def test_controller_off_and_inert_streams_identical_to_bare():
+    """Inert-by-default pin: the same workload on a bare fleet and on
+    a controller-attached fleet with dead-band-everything thresholds
+    yields identical streams and zero actuations — attaching the
+    controller is free until the signal demands otherwise."""
+    def run(controlled):
+        fleet = _spike_fleet(1)
+        reqs = _spike_reqs(7, 4)
+        if controlled:
+            ctrl = _controller(
+                fleet,
+                spec_reject_low=0.0, spec_reject_high=0.999,
+                overdecode_low=0.0, overdecode_high=0.999,
+                wfq_deadband=1e9,
+            )
+            rids = [ctrl.submit(p, n) for p, n in reqs]
+            out = ctrl.run()
+            assert ctrl.retunes_applied == 0
+            assert ctrl.wfq_reweights == 0
+            assert ctrl.polls > 0 and ctrl.samples > 0
+        else:
+            ctrl = None
+            rids = [fleet.submit(p, n) for p, n in reqs]
+            out = fleet.run()
+        eng = fleet.replicas[0].engine
+        assert eng.retunes == 0
+        assert eng.spec_breakeven == 2.0
+        streams = [list(out[r]) for r in rids]
+        fleet.close()
+        return streams
+
+    assert run(controlled=True) == run(controlled=False)
+
+
+def test_controller_wfq_reweight_boosts_measured_class_on_real_fleet():
+    """The WFQ seam end-to-end: interactive finishes clean while bulk
+    streams cancel mid-flight (their tokens classify as waste), so
+    measured goodput-per-chip-second diverges and the controller
+    boosts interactive ABOVE its operator floor without ever dropping
+    bulk below its own."""
+    fleet = Fleet(
+        [_plain_engine(slots=2, ledger=ChipTimeLedger(name="0"))],
+        chip_ids=["chip-0"], hang_timeout_s=None,
+        ledger=FleetLedger(),
+        wfq_weights={"interactive": 1.0, "bulk": 1.0},
+    )
+    ctrl = _controller(fleet, wfq_deadband=0.1)
+    good = [fleet.submit([70 + i], 20, slo_class="interactive")
+            for i in range(2)]
+    bad = [fleet.submit([80 + i], 20, slo_class="bulk")
+           for i in range(2)]
+    out = {}
+    for _ in range(2):
+        for fr in fleet.step():
+            out[fr.rid] = list(fr.tokens)
+    for rid in bad:
+        fleet.cancel(rid)
+    for rid, toks in fleet.run().items():
+        out[rid] = list(toks)
+    ctrl.poll()
+    assert ctrl.wfq_reweights >= 1, ctrl.states()
+    assert ctrl._wfq_floor == {"interactive": 1.0, "bulk": 1.0}
+    assert fleet.wfq_weights["interactive"] > 1.0
+    assert fleet.wfq_weights["bulk"] == 1.0
+    assert any(ev.kind == "wfq_reweight" for ev in ctrl.events)
+    for rid in good:
+        prompt = [70 + good.index(rid)]
+        assert list(out[rid]) == _oracle(prompt, 20), rid
+    fleet.close()
+
+
+def test_control_check_smoke():
+    """``make control-check``: the seeded waste spike — bad-draft
+    engines at always-speculate — is retuned away by the controller
+    (breakeven walks down, speculation stops) and the measured goodput
+    fraction RECOVERS: the post-retune batch's delta fraction beats
+    the spike batch's.  Streams stay oracle-exact and nothing leaks."""
+    fleet = _spike_fleet(2)
+    # spec_reject_low=0.0: converge to no-speculation and STAY — the
+    # smoke wants recovery, not the up-move's win-recapture probing.
+    ctrl = _controller(fleet, spec_reject_low=0.0)
+    led = fleet.ledger
+
+    def run_batch(seed):
+        before = (led.tokens_accounted, led.goodput_tokens)
+        reqs = _spike_reqs(seed, 8)
+        rids = [ctrl.submit(p, n, slo_class="bulk") for p, n in reqs]
+        out = ctrl.run()
+        for rid, (prompt, new) in zip(rids, reqs):
+            assert list(out[rid]) == _oracle(prompt, new), rid
+        d_acc = led.tokens_accounted - before[0]
+        d_good = led.goodput_tokens - before[1]
+        assert d_acc > 0
+        return d_good / d_acc
+
+    spike = run_batch(11)
+    assert ctrl.retunes_applied >= 1, ctrl.states()
+    for rep in fleet.replicas:
+        assert rep.engine.spec_breakeven < 2.0, rep.index
+    recovered = run_batch(12)
+    assert recovered > spike, (spike, recovered, ctrl.states())
+    assert recovered > 0.9, recovered  # speculation actually stopped
+    assert ctrl.poll_s >= 0.0
+    st = ctrl.states()
+    assert st["retunes_applied"] == ctrl.retunes_applied
+    assert st["decisions"].get("retune", 0) >= 1
+    _assert_no_leaks(fleet)
+    fleet.close()

@@ -1,0 +1,630 @@
+"""Fast-tier units for the goodput control plane (workloads/control.py)
+and the stable ``FleetLedger.class_economics()`` query it consumes.
+
+Everything here is jax-free: the controller's hill-climb, hysteresis,
+EWMA plumbing, WFQ floor/boost arithmetic and autoscaler hint feed are
+pure host-side control logic, exercised against fake engines/ledgers
+that honour the real ``ServeEngine.retune()`` contract (returns
+``{knob: (old, new)}``, validates ceilings, raises on closed / wrong
+mode).  The real-engine transitions — drains, stream bit-parity, the
+seeded waste-spike smoke — live in tests/test_control.py (slow tier).
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from workloads.backoff import Backoff
+from workloads.control import ControlSignals, GoodputController
+from workloads.errors import EngineClosed
+from workloads.ledger import ChipTimeLedger, FleetLedger
+
+# Deterministic hysteresis for clock-injected tests: delay(attempt) is
+# exactly base * 2**attempt, no jitter.
+FAST = Backoff(base_s=1.0, factor=2.0, max_s=64.0, jitter=0.0)
+
+
+# ---- fakes ---------------------------------------------------------------
+
+
+class FakeEngine:
+    """A ServeEngine stand-in honouring the retune() contract the
+    controller depends on: change-dict returns, construction-time k
+    ceilings, spec="auto" gating, EngineClosed on a closed engine."""
+
+    def __init__(
+        self,
+        *,
+        draft=True,
+        spec="auto",
+        spec_breakeven=4.0,
+        superstep_k=1,
+        superstep_k_max=None,
+        spec_superstep_k=1,
+        spec_superstep_k_max=None,
+        slots=8,
+    ):
+        self.spec = spec
+        self.draft_params = object() if draft else None
+        self.spec_breakeven = (
+            float(spec_breakeven) if spec_breakeven is not None else None
+        )
+        self.superstep_k = superstep_k
+        self._superstep_k_max = (
+            superstep_k_max if superstep_k_max is not None else superstep_k
+        )
+        self.spec_superstep_k = spec_superstep_k
+        self._spec_superstep_k_max = (
+            spec_superstep_k_max if spec_superstep_k_max is not None
+            else spec_superstep_k
+        )
+        self.slots = slots
+        self.closed = False
+        self.retune_log = []
+
+    def retune(self, **knobs):
+        if self.closed:
+            raise EngineClosed("engine is closed; no retune")
+        changes = {}
+        if "spec_breakeven" in knobs:
+            if self.spec != "auto" or self.draft_params is None:
+                raise ValueError("spec_breakeven retune needs auto+draft")
+            new = float(knobs["spec_breakeven"])
+            if new < 0:
+                raise ValueError("spec_breakeven must be >= 0")
+            if new != self.spec_breakeven:
+                changes["spec_breakeven"] = (self.spec_breakeven, new)
+        for knob, ceiling in (
+            ("superstep_k", self._superstep_k_max),
+            ("spec_superstep_k", self._spec_superstep_k_max),
+        ):
+            if knob in knobs:
+                new = int(knobs[knob])
+                if not 1 <= new <= ceiling:
+                    raise ValueError(f"{knob} out of [1, {ceiling}]")
+                if new != getattr(self, knob):
+                    changes[knob] = (getattr(self, knob), new)
+        for knob, (_, new) in changes.items():
+            setattr(self, knob, new)
+        if changes:
+            self.retune_log.append(dict(changes))
+        return changes
+
+
+class FakeFleetLedger:
+    """FleetLedger-shaped totals source: running counters the
+    controller's ``_totals`` fleet branch reads, plus an injectable
+    ``class_economics`` table for the WFQ seam."""
+
+    def __init__(self):
+        self.tokens_accounted = 0
+        self.goodput_tokens = 0
+        self._chip = ChipTimeLedger(name="fake")
+        self.econ = {}
+
+    @property
+    def engine_ledgers(self):
+        return [("0", self._chip)]
+
+    def feed(self, *, goodput=0, spec_rejected=0, overdecode=0):
+        """Account one delta: the controller only ever reads totals."""
+        self.tokens_accounted += goodput + spec_rejected + overdecode
+        self.goodput_tokens += goodput
+        self._chip.waste_tokens["spec_rejected"] += spec_rejected
+        self._chip.waste_tokens["overdecode"] += overdecode
+
+    def class_economics(self):
+        return {
+            cls: dict(row) for cls, row in self.econ.items()
+        }
+
+
+class FakeFleet:
+    """Just enough Fleet surface for the controller: replicas, a step
+    that finishes nothing, the armed ledger, live WFQ weights."""
+
+    def __init__(self, engines, *, wfq_weights=None):
+        self.replicas = [
+            SimpleNamespace(index=i, state="serving", engine=e)
+            for i, e in enumerate(engines)
+        ]
+        self.ledger = FakeFleetLedger()
+        self.wfq_weights = wfq_weights
+        self.closed = False
+        self.idle = True
+        self.steps = 0
+
+    def step(self):
+        self.steps += 1
+        return []
+
+    def submit(self, prompt, new):
+        return "rid-fake"
+
+    def cancel(self, rid):
+        return False
+
+
+def _ctrl(fleet, **kw):
+    kw.setdefault("retune_backoff", FAST)
+    kw.setdefault("wfq_backoff", FAST)
+    kw.setdefault("min_sample_tokens", 10)
+    clock = kw.pop("clock", None)
+    if clock is None:
+        t = [0.0]
+        kw["clock"] = lambda: t[0]
+        return GoodputController(fleet, **kw), t
+    kw["clock"] = clock
+    return GoodputController(fleet, **kw), None
+
+
+# ---- construction validation ---------------------------------------------
+
+
+def test_rejects_invalid_construction():
+    fleet = FakeFleet([FakeEngine()])
+    with pytest.raises(ValueError, match="step"):
+        GoodputController(object())
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        GoodputController(fleet, ewma_alpha=0.0)
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        GoodputController(fleet, ewma_alpha=1.5)
+    with pytest.raises(ValueError, match="min_sample_tokens"):
+        GoodputController(fleet, min_sample_tokens=0)
+    with pytest.raises(ValueError, match="spec_reject"):
+        GoodputController(fleet, spec_reject_low=0.5, spec_reject_high=0.3)
+    with pytest.raises(ValueError, match="overdecode"):
+        GoodputController(fleet, overdecode_low=-0.1)
+    with pytest.raises(ValueError, match="overdecode"):
+        GoodputController(fleet, overdecode_high=1.1)
+    with pytest.raises(ValueError, match="breakeven_step"):
+        GoodputController(fleet, breakeven_step=0.0)
+    with pytest.raises(ValueError, match="wfq_max_boost"):
+        GoodputController(fleet, wfq_max_boost=0.5)
+    with pytest.raises(ValueError, match="wfq_deadband"):
+        GoodputController(fleet, wfq_deadband=-1.0)
+
+
+def test_driver_defaults_autoscaler_then_target():
+    fleet = FakeFleet([FakeEngine()])
+    ctrl, _ = _ctrl(fleet)
+    assert ctrl.driver is fleet
+    asc = SimpleNamespace(waste_fraction_hint=None, closed=False)
+    ctrl2, _ = _ctrl(fleet, autoscaler=asc)
+    assert ctrl2.driver is asc
+    drv = SimpleNamespace(closed=False)
+    ctrl3, _ = _ctrl(fleet, autoscaler=asc, driver=drv)
+    assert ctrl3.driver is drv
+
+
+# ---- signal plumbing -----------------------------------------------------
+
+
+def test_poll_without_ledger_never_actuates():
+    eng = FakeEngine()
+    eng.step = lambda: []
+    eng.ledger = None
+    ctrl, _ = _ctrl(eng)
+    ctrl.poll()
+    assert ctrl.polls == 1
+    assert ctrl.samples == 0
+    assert ctrl.last_signals is None
+    assert ctrl.retunes_applied == 0
+
+
+def test_min_sample_gating_accumulates_small_deltas():
+    fleet = FakeFleet([FakeEngine()])
+    ctrl, _ = _ctrl(fleet, min_sample_tokens=10)
+    fleet.ledger.feed(goodput=4)
+    ctrl.poll()
+    # Below the floor: no sample, but the delta is NOT consumed — the
+    # baseline holds so small trickles accumulate into one sample.
+    assert ctrl.samples == 0
+    assert ctrl.last_signals.delta_tokens == 4
+    assert ctrl.goodput_fraction_ewma is None
+    fleet.ledger.feed(goodput=6)
+    ctrl.poll()
+    assert ctrl.samples == 1
+    assert ctrl.last_signals.delta_tokens == 10
+    assert ctrl.goodput_fraction_ewma == 1.0
+
+
+def test_ewma_seeds_then_blends():
+    fleet = FakeFleet([FakeEngine()])
+    ctrl, _ = _ctrl(fleet, ewma_alpha=0.5, min_sample_tokens=10)
+    fleet.ledger.feed(goodput=10)  # fraction 1.0 seeds
+    ctrl.poll()
+    assert ctrl.goodput_fraction_ewma == 1.0
+    fleet.ledger.feed(spec_rejected=10)  # fraction 0.0 blends
+    ctrl.poll()
+    assert ctrl.goodput_fraction_ewma == pytest.approx(0.5)
+    assert ctrl.spec_rejected_fraction_ewma == pytest.approx(0.5)
+    sig = ctrl.last_signals
+    assert isinstance(sig, ControlSignals)
+    assert sig.accounted_tokens == 20
+    assert sig.goodput_fraction == pytest.approx(0.5)
+
+
+def test_autoscaler_hint_is_clamped_smoothed_waste():
+    asc = SimpleNamespace(waste_fraction_hint=None, closed=False)
+    fleet = FakeFleet([FakeEngine()])
+    ctrl, _ = _ctrl(fleet, autoscaler=asc, driver=fleet, ewma_alpha=1.0)
+    ctrl.poll()
+    assert asc.waste_fraction_hint is None  # no evidence, no hint
+    fleet.ledger.feed(goodput=3, spec_rejected=7)
+    ctrl.poll()
+    assert asc.waste_fraction_hint == pytest.approx(0.7)
+
+
+# ---- hill-climb moves ----------------------------------------------------
+
+
+def _spike(fleet, ctrl, *, goodput=0, spec_rejected=0, overdecode=0):
+    fleet.ledger.feed(
+        goodput=goodput, spec_rejected=spec_rejected, overdecode=overdecode,
+    )
+    ctrl.poll()
+
+
+def test_spec_down_walks_breakeven_then_halves_spec_superstep():
+    eng = FakeEngine(
+        spec_breakeven=2.0, spec_superstep_k=4, spec_superstep_k_max=4,
+    )
+    fleet = FakeFleet([eng])
+    ctrl, t = _ctrl(fleet, ewma_alpha=1.0, breakeven_step=1.0)
+    # Sustained spec_rejected burn: each cooldown expiry lands exactly
+    # one knob move — breakeven walks 2 -> 1 -> 0 (clamped), then the
+    # fused spec rounds halve 4 -> 2 -> 1, then nothing is left.
+    expect = [
+        ("spec_breakeven", 1.0), ("spec_breakeven", 0.0),
+        ("spec_superstep_k", 2), ("spec_superstep_k", 1),
+    ]
+    for knob, value in expect:
+        t[0] += 1000.0  # past any escalated gate
+        _spike(fleet, ctrl, goodput=2, spec_rejected=18)
+        assert getattr(eng, knob) == value, (knob, eng.retune_log)
+    applied = ctrl.retunes_applied
+    t[0] += 1000.0
+    _spike(fleet, ctrl, goodput=2, spec_rejected=18)
+    assert ctrl.retunes_applied == applied  # floor reached: no-op
+    assert ctrl.decisions["retune"] == applied
+    kinds = [ev.kind for ev in ctrl.events]
+    assert kinds.count("retune") == applied
+
+
+def test_super_down_halves_superstep_then_spec_superstep():
+    eng = FakeEngine(
+        draft=False, spec="on", spec_breakeven=None,
+        superstep_k=4, superstep_k_max=4,
+        spec_superstep_k=2, spec_superstep_k_max=2,
+    )
+    fleet = FakeFleet([eng])
+    ctrl, t = _ctrl(fleet, ewma_alpha=1.0)
+    for k_sup, k_spec in ((2, 2), (1, 2), (1, 1)):
+        t[0] += 1000.0
+        _spike(fleet, ctrl, goodput=2, overdecode=18)
+        assert (eng.superstep_k, eng.spec_superstep_k) == (k_sup, k_spec)
+
+
+def test_spec_up_doubles_spec_superstep_then_raises_breakeven():
+    eng = FakeEngine(
+        spec_breakeven=1.0, slots=4,
+        spec_superstep_k=1, spec_superstep_k_max=4,
+    )
+    fleet = FakeFleet([eng])
+    ctrl, t = _ctrl(fleet, ewma_alpha=1.0, breakeven_step=2.0)
+    # Near-zero rejected waste: recapture the fused-round win first
+    # (1 -> 2 -> 4, the construction ceiling), then push breakeven
+    # toward slots, clamped at slots.
+    expect = [
+        ("spec_superstep_k", 2), ("spec_superstep_k", 4),
+        ("spec_breakeven", 3.0), ("spec_breakeven", 4.0),
+    ]
+    for knob, value in expect:
+        t[0] += 1000.0
+        _spike(fleet, ctrl, goodput=100)
+        assert getattr(eng, knob) == value, (knob, eng.retune_log)
+    t[0] += 1000.0
+    applied = ctrl.retunes_applied
+    _spike(fleet, ctrl, goodput=100)
+    assert ctrl.retunes_applied == applied  # at the ceilings
+
+
+def test_super_up_doubles_toward_construction_ceiling_only():
+    eng = FakeEngine(
+        draft=False, spec="off", spec_breakeven=None,
+        superstep_k=1, superstep_k_max=8,
+    )
+    fleet = FakeFleet([eng])
+    ctrl, t = _ctrl(fleet, ewma_alpha=1.0)
+    for k in (2, 4, 8):
+        t[0] += 1000.0
+        _spike(fleet, ctrl, goodput=100)
+        assert eng.superstep_k == k
+    t[0] += 1000.0
+    applied = ctrl.retunes_applied
+    _spike(fleet, ctrl, goodput=100)
+    assert eng.superstep_k == 8  # never above the ceiling
+    assert ctrl.retunes_applied == applied
+
+
+def test_dead_band_holds_and_resets_escalation():
+    eng = FakeEngine(spec_breakeven=8.0, slots=8)
+    fleet = FakeFleet([eng])
+    ctrl, t = _ctrl(
+        fleet, ewma_alpha=1.0,
+        spec_reject_low=0.05, spec_reject_high=0.3,
+    )
+    t[0] += 1000.0
+    _spike(fleet, ctrl, goodput=60, spec_rejected=40)  # 0.4 > high
+    assert ctrl.retunes_applied == 1
+    assert ctrl._retune_streak == 1
+    # Signal lands inside the dead band: hold, and the escalation
+    # streak resets so the next excursion acts at base cadence.
+    t[0] += 1000.0
+    _spike(fleet, ctrl, goodput=90, spec_rejected=10)  # 0.1 in band
+    assert ctrl.retunes_applied == 1
+    assert ctrl._retune_streak == 0
+
+
+def test_hysteresis_gate_blocks_until_cooldown_expires():
+    eng = FakeEngine(spec_breakeven=8.0, slots=8)
+    fleet = FakeFleet([eng])
+    ctrl, t = _ctrl(fleet, ewma_alpha=1.0)
+    t[0] = 10.0
+    _spike(fleet, ctrl, goodput=2, spec_rejected=18)
+    assert ctrl.retunes_applied == 1
+    gate = ctrl._retune_gate
+    assert gate == 10.0 + FAST.derive("retune").delay(1)
+    # Polls inside the cooldown never move a knob however hot the
+    # signal stays.
+    t[0] = gate - 1e-6
+    _spike(fleet, ctrl, goodput=2, spec_rejected=18)
+    assert ctrl.retunes_applied == 1
+    # Past the gate the next single move lands, and the escalated
+    # streak buys a LONGER cooldown (delay(2) > delay(1)).
+    t[0] = gate
+    _spike(fleet, ctrl, goodput=2, spec_rejected=18)
+    assert ctrl.retunes_applied == 2
+    assert ctrl._retune_gate == gate + FAST.derive("retune").delay(2)
+
+
+def test_incapable_engines_are_never_picked():
+    # No draft anywhere and every k ceiling at 1: there is nothing to
+    # retune, whatever the waste says.
+    eng = FakeEngine(draft=False, spec="off", spec_breakeven=None)
+    fleet = FakeFleet([eng])
+    ctrl, t = _ctrl(fleet, ewma_alpha=1.0)
+    t[0] += 1000.0
+    _spike(fleet, ctrl, goodput=1, spec_rejected=10, overdecode=9)
+    assert ctrl.retunes_applied == 0
+    assert ctrl._pick_move() is None
+
+
+def test_closed_engine_is_skipped_not_fatal():
+    dead = FakeEngine(spec_breakeven=4.0)
+    dead.closed = True
+    live = FakeEngine(spec_breakeven=4.0)
+    fleet = FakeFleet([dead, live])
+    ctrl, t = _ctrl(fleet, ewma_alpha=1.0)
+    t[0] += 1000.0
+    _spike(fleet, ctrl, goodput=2, spec_rejected=18)
+    assert dead.spec_breakeven == 4.0
+    assert live.spec_breakeven == 3.0
+    assert ctrl.retunes_applied == 1
+
+
+# ---- WFQ re-weighting ----------------------------------------------------
+
+
+def _wfq_fleet(econ, weights):
+    # Engines with nothing to retune, so only the WFQ seam actuates.
+    fleet = FakeFleet(
+        [FakeEngine(draft=False, spec="off", spec_breakeven=None)],
+        wfq_weights=weights,
+    )
+    fleet.ledger.econ = econ
+    return fleet
+
+
+def test_wfq_boosts_efficient_class_above_operator_floor():
+    econ = {
+        "interactive": {"goodput_per_chip_s": 30.0, "chip_s": 1.0},
+        "bulk": {"goodput_per_chip_s": 10.0, "chip_s": 1.0},
+    }
+    fleet = _wfq_fleet(econ, {"interactive": 2.0, "bulk": 1.0})
+    ctrl, t = _ctrl(fleet, ewma_alpha=1.0, wfq_deadband=0.25)
+    assert ctrl._wfq_floor == {"interactive": 2.0, "bulk": 1.0}
+    t[0] += 1000.0
+    _spike(fleet, ctrl, goodput=100)
+    # mean rate 20: interactive earns 1.5x its floor; bulk holds AT its
+    # floor (boost-above-floor only — never starved below the operator
+    # weight).
+    assert fleet.wfq_weights == {"interactive": 3.0, "bulk": 1.0}
+    assert ctrl.wfq_reweights == 1
+    assert ctrl.decisions["wfq_reweight"] == 1
+    assert any(ev.kind == "wfq_reweight" for ev in ctrl.events)
+
+
+def test_wfq_boost_caps_at_max_boost():
+    econ = {"interactive": {"goodput_per_chip_s": 1000.0, "chip_s": 1.0}}
+    for i in range(4):
+        econ[f"bulk{i}"] = {"goodput_per_chip_s": 1.0, "chip_s": 1.0}
+    weights = {cls: 1.0 for cls in econ}
+    fleet = _wfq_fleet(econ, weights)
+    ctrl, t = _ctrl(fleet, ewma_alpha=1.0, wfq_max_boost=4.0)
+    t[0] += 1000.0
+    _spike(fleet, ctrl, goodput=100)
+    # interactive's raw rate/mean multiplier is ~5x: capped at 4.
+    assert fleet.wfq_weights["interactive"] == 4.0
+    assert fleet.wfq_weights["bulk0"] == 1.0
+
+
+def test_wfq_deadband_suppresses_small_moves():
+    econ = {
+        "interactive": {"goodput_per_chip_s": 22.0, "chip_s": 1.0},
+        "bulk": {"goodput_per_chip_s": 18.0, "chip_s": 1.0},
+    }
+    fleet = _wfq_fleet(econ, {"interactive": 1.0, "bulk": 1.0})
+    ctrl, t = _ctrl(fleet, ewma_alpha=1.0, wfq_deadband=0.25)
+    t[0] += 1000.0
+    _spike(fleet, ctrl, goodput=100)
+    # interactive's earned mult is 1.1: an 10% move under the 25%
+    # deadband — weights hold, no reweight counted.
+    assert fleet.wfq_weights == {"interactive": 1.0, "bulk": 1.0}
+    assert ctrl.wfq_reweights == 0
+
+
+def test_wfq_needs_two_measured_classes():
+    econ = {"interactive": {"goodput_per_chip_s": 30.0, "chip_s": 1.0}}
+    fleet = _wfq_fleet(econ, {"interactive": 1.0, "bulk": 1.0})
+    ctrl, t = _ctrl(fleet, ewma_alpha=1.0)
+    t[0] += 1000.0
+    _spike(fleet, ctrl, goodput=100)
+    assert ctrl.wfq_reweights == 0
+    assert fleet.wfq_weights == {"interactive": 1.0, "bulk": 1.0}
+
+
+def test_wfq_noop_without_weights_or_economics():
+    fleet = FakeFleet(
+        [FakeEngine(draft=False, spec="off", spec_breakeven=None)],
+        wfq_weights=None,
+    )
+    ctrl, t = _ctrl(fleet, ewma_alpha=1.0)
+    t[0] += 1000.0
+    _spike(fleet, ctrl, goodput=100)
+    assert ctrl.wfq_reweights == 0
+
+
+# ---- telemetry, events, driving surface ----------------------------------
+
+
+def test_states_and_drain_events_and_overflow():
+    eng = FakeEngine(spec_breakeven=8.0, slots=8)
+    fleet = FakeFleet([eng])
+    ctrl, t = _ctrl(fleet, ewma_alpha=1.0)
+    t[0] += 1000.0
+    _spike(fleet, ctrl, goodput=2, spec_rejected=18)
+    st = ctrl.states()
+    assert st["polls"] == 1
+    assert st["samples"] == 1
+    assert st["retunes_applied"] == 1
+    assert st["goodput_fraction_ewma"] == pytest.approx(0.1)
+    assert st["decisions"] == {"retune": 1}
+    assert st["poll_s"] >= 0.0
+    drained = ctrl.drain_events()
+    assert [ev.kind for ev in drained] == ["retune"]
+    assert not ctrl.events
+    # Ring overflow counts drops instead of growing unbounded.
+    from collections import deque
+
+    ctrl.events = deque(maxlen=1)
+    ctrl._event("a")
+    ctrl._event("b")
+    assert ctrl.dropped_events == 1
+    assert [ev.kind for ev in ctrl.events] == ["b"]
+
+
+def test_step_polls_after_driving_and_run_collects():
+    eng = FakeEngine(spec_breakeven=8.0, slots=8)
+    fleet = FakeFleet([eng])
+    ctrl, _ = _ctrl(fleet)
+    assert ctrl.step() == []
+    assert fleet.steps == 1
+    assert ctrl.polls == 1
+    # run() drives the wrapped driver to idle, collecting finished
+    # streams fleet.run-style.
+    fr = SimpleNamespace(rid="r1", tokens=[1, 2, 3])
+    fleet.idle = False
+
+    def step_once():
+        fleet.steps += 1
+        fleet.idle = True
+        return [fr]
+
+    fleet.step = step_once
+    assert ctrl.run() == {"r1": [1, 2, 3]}
+    assert ctrl.submit([1], 2) == "rid-fake"
+    assert ctrl.cancel("r1") is False
+    assert ctrl.closed is False
+    assert ctrl.idle is True
+
+
+def test_engine_target_reads_chip_ledger_totals():
+    eng = FakeEngine(spec_breakeven=2.0)
+    eng.step = lambda: []
+    eng.ledger = ChipTimeLedger(name="solo")
+    ctrl, t = _ctrl(eng, ewma_alpha=1.0)
+    assert ctrl.fleet is None and ctrl.engine is eng
+    eng.ledger.tokens_accounted = 20
+    eng.ledger.goodput_tokens = 2
+    eng.ledger.waste_tokens["spec_rejected"] = 18
+    t[0] += 1000.0
+    ctrl.poll()
+    assert ctrl.samples == 1
+    assert ctrl.spec_rejected_fraction_ewma == pytest.approx(0.9)
+    assert eng.spec_breakeven == 1.0  # retune reached the bare engine
+
+
+# ---- FleetLedger.class_economics -----------------------------------------
+
+
+def _fleet_stub(generated=0, replayed=0):
+    return SimpleNamespace(
+        replicas=(), generated_tokens=generated, tokens_replayed=replayed,
+    )
+
+
+def _fin(n, cls, status="ok"):
+    return SimpleNamespace(tokens=[0] * n, slo_class=cls, status=status)
+
+
+def test_class_economics_empty_ledger_is_empty():
+    assert FleetLedger().class_economics() == {}
+
+
+def test_class_economics_apportions_busy_seconds_by_token_share():
+    led = FleetLedger()
+    chip = ChipTimeLedger(name="0")
+    chip.phase_s["decode"] = 6.0
+    chip.phase_s["idle"] = 4.0  # idle never charges a class
+    chip.wall_s = 10.0
+    led.attach("0", chip)
+    led.step_end(
+        _fleet_stub(generated=90),
+        [_fin(60, "interactive"), _fin(30, "bulk", status="cancelled")],
+    )
+    econ = led.class_economics()
+    assert set(econ) == {"interactive", "bulk"}
+    ia, bk = econ["interactive"], econ["bulk"]
+    assert ia["goodput_tokens"] == 60 and ia["waste_tokens"] == 0
+    assert bk["goodput_tokens"] == 0 and bk["waste_tokens"] == 30
+    # Shares partition the classified tokens; busy (non-idle) seconds
+    # are charged by share.
+    assert ia["token_share"] + bk["token_share"] == pytest.approx(1.0)
+    assert ia["chip_s"] == pytest.approx(4.0)
+    assert bk["chip_s"] == pytest.approx(2.0)
+    assert ia["chip_s_by_phase"]["decode"] == pytest.approx(4.0)
+    assert "idle" not in ia["chip_s_by_phase"]
+    # The WFQ ranking headline: goodput per attributed chip-second.
+    assert ia["goodput_per_chip_s"] == pytest.approx(15.0)
+    assert bk["goodput_per_chip_s"] == 0.0
+
+
+def test_class_economics_zero_seconds_is_zero_safe():
+    led = FleetLedger()
+    led.step_end(_fleet_stub(generated=10), [_fin(10, "interactive")])
+    econ = led.class_economics()
+    assert econ["interactive"]["chip_s"] == 0.0
+    assert econ["interactive"]["goodput_per_chip_s"] == 0.0
+    assert econ["interactive"]["token_share"] == pytest.approx(1.0)
+
+
+def test_class_economics_untagged_bucket_for_unclassed_traffic():
+    led = FleetLedger()
+    led.step_end(
+        _fleet_stub(generated=10),
+        [SimpleNamespace(tokens=[0] * 10, slo_class=None, status="ok")],
+    )
+    econ = led.class_economics()
+    assert econ["untagged"]["goodput_tokens"] == 10

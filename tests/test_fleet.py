@@ -975,3 +975,55 @@ def test_fleet_server_clear_lifts_quarantine_over_http():
     finally:
         server.stop()
         fleet.close()
+
+
+# ---- dispatch_score: the one routing seam --------------------------------
+
+
+def test_dispatch_score_pins_both_pre_unification_views():
+    """Replica.dispatch_score IS the router's scalar: the request-count
+    view must equal load() exactly, and the page-scheduled view
+    page_load() + goodput_penalty() — pinned so the unification can
+    never drift from the two pre-existing scoring paths."""
+    from workloads.ledger import ChipTimeLedger
+
+    fleet = _fleet(2)
+    for p, n in _prompts(5, 4, new_lo=6, new_hi=10):
+        fleet.submit(p, n)
+    fleet.step()  # dispatch + begin prefill: non-trivial loads
+    for rep in fleet.replicas:
+        assert rep.dispatch_score() == rep.load()
+        assert rep.goodput_penalty() == 0  # no ledger armed: no bias
+        assert rep.dispatch_score(page_scheduling=True) == rep.page_load()
+    # A ledger mid-burn adds its handicap to the PAGE view only.
+    led = ChipTimeLedger(name="x")
+    led.tokens_accounted = 100
+    led.goodput_tokens = 25
+    rep = fleet.replicas[0]
+    rep.engine.ledger = led
+    assert rep.goodput_penalty() == 3  # (1 - 0.25) * 4 penalty pages
+    assert rep.dispatch_score(page_scheduling=True) == (
+        rep.page_load() + 3
+    )
+    assert rep.dispatch_score() == rep.load()  # request view unbiased
+    fleet.run()
+    fleet.close()
+
+
+def test_goodput_penalty_steers_marginal_dispatch_to_clean_replica():
+    """Page-scheduled routing reads the ledger: with otherwise-equal
+    page loads, the replica burning its chip-time on waste carries the
+    handicap and LOSES the marginal dispatch it would have tie-won by
+    index — and the stream itself is unaffected."""
+    from workloads.ledger import ChipTimeLedger
+
+    fleet = _fleet(2, page_scheduling=True)
+    wasteful = fleet.replicas[0].engine
+    wasteful.ledger = ChipTimeLedger(name="w")
+    wasteful.ledger.tokens_accounted = 100  # zero goodput: full handicap
+    rid = fleet.submit([1, 2, 3], 24)
+    fleet.step()
+    assert fleet._reqs[rid].replica == 1, "handicap ignored by router"
+    out = fleet.run()
+    assert out[rid] == _oracle([1, 2, 3], 24)
+    fleet.close()

@@ -53,6 +53,7 @@ def _described_names() -> set[str]:
     from tpu_device_plugin import metrics
     from workloads.obs import (
         AUTOSCALER_METRICS,
+        CONTROL_METRICS,
         ENGINE_METRICS,
         FLEET_METRICS,
         LEDGER_METRICS,
@@ -65,6 +66,7 @@ def _described_names() -> set[str]:
         | {m.name for m in FLEET_METRICS}
         | {m.name for m in SUPERVISOR_METRICS}
         | {m.name for m in AUTOSCALER_METRICS}
+        | {m.name for m in CONTROL_METRICS}
         | {m.name for m in LEDGER_METRICS}
     )
 
@@ -203,6 +205,81 @@ def test_autoscaler_catalog_is_fully_described_on_bind():
     AutoscalerObserver().bind_registry(reg)
     missing = {m.name for m in AUTOSCALER_METRICS} - set(reg._help)
     assert not missing, missing
+
+
+def test_control_gauge_readers_match_the_catalog():
+    """Same drift pin for the goodput-controller bridge's gauge
+    families."""
+    from workloads.obs import CONTROL_METRICS, ControlObserver
+
+    catalog_gauges = {
+        m.name for m in CONTROL_METRICS if m.type == "gauge"
+    }
+    assert catalog_gauges == set(ControlObserver._CONTROL_GAUGE_READERS)
+
+
+def test_control_catalog_is_fully_described_on_bind():
+    from tpu_device_plugin.metrics import Registry
+    from workloads.obs import CONTROL_METRICS, ControlObserver
+
+    reg = Registry()
+    ControlObserver().bind_registry(reg)
+    missing = {m.name for m in CONTROL_METRICS} - set(reg._help)
+    assert not missing, missing
+
+
+def test_control_bridge_render_is_valid_exposition():
+    """Drive the control bridge against a fake controller (no jax):
+    actuation counters land as running-total deltas, the per-action
+    decisions counter carries the action label, the EWMA gauges emit
+    no sample until measured and scrape once they are — then unbind
+    releases the gauges."""
+    from tpu_device_plugin.metrics import PREFIX, Registry
+    from workloads.obs import ControlObserver
+
+    reg = Registry()
+    obs = ControlObserver(name="ctl0")
+    obs.bind_registry(reg)
+    ctrl = SimpleNamespace(
+        retunes_applied=0, wfq_reweights=0, dropped_events=0,
+        decisions={},
+        goodput_fraction_ewma=None, spec_rejected_fraction_ewma=None,
+        overdecode_fraction_ewma=None,
+    )
+    obs._bind(ctrl)
+    obs._control_poll_end(ctrl)
+    # Unmeasured EWMAs emit NO gauge sample (0.0 would read as
+    # "perfect waste" on a dashboard).
+    assert f"{PREFIX}_control_goodput_fraction{{" not in reg.render()
+    ctrl.retunes_applied = 3
+    ctrl.wfq_reweights = 1
+    ctrl.decisions = {"retune": 3, "wfq_reweight": 1}
+    ctrl.goodput_fraction_ewma = 0.75
+    ctrl.spec_rejected_fraction_ewma = 0.15
+    ctrl.overdecode_fraction_ewma = 0.05
+    obs._control_poll_end(ctrl)
+    obs._control_poll_end(ctrl)  # unchanged totals push no deltas
+    families = _parse_exposition(reg.render())
+    assert families[
+        f"{PREFIX}_control_retunes_total"
+    ]["samples"][0][2] == 3.0
+    assert families[
+        f"{PREFIX}_control_wfq_reweights_total"
+    ]["samples"][0][2] == 1.0
+    decisions = families[f"{PREFIX}_control_decisions_total"]["samples"]
+    assert {
+        (labels["action"], v) for _, labels, v in decisions
+    } == {("retune", 3.0), ("wfq_reweight", 1.0)}
+    assert families[
+        f"{PREFIX}_control_goodput_fraction"
+    ]["samples"][0][2] == 0.75
+    assert families[
+        f"{PREFIX}_control_overdecode_fraction"
+    ]["samples"][0][2] == 0.05
+    obs.unbind_registry()
+    assert f"{PREFIX}_control_goodput_fraction" not in _parse_exposition(
+        reg.render()
+    )
 
 
 # ---- exposition-format parsing -----------------------------------------

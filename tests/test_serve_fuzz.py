@@ -271,6 +271,22 @@ def _run_chaos(seed: int, params, draft, adapters) -> None:
         fault_injector=injector, max_retries=2,
         max_pending=int(rng.choice([3, 16])), **kw,
     )
+    # Goodput controller under chaos (workloads/control.py):
+    # randomized ON whenever the ledger is armed, with instant
+    # cooldowns so its online retunes (breakeven shifts, superstep
+    # steps) actually land between chaotic steps — every oracle pin
+    # below must STILL hold bit-identically (each retune drains
+    # in-flight state through the mode-boundary rules first).
+    controller = None
+    if kw.get("ledger") is not None and rng.integers(2):
+        from workloads.backoff import Backoff
+        from workloads.control import GoodputController
+
+        instant = Backoff(base_s=1e-6, max_s=1e-6, jitter=0.0)
+        controller = GoodputController(
+            engine, min_sample_tokens=16,
+            retune_backoff=instant, wfq_backoff=instant,
+        )
     names = [None] + (sorted(adapters) if use_adapters else [])
     expected = {}  # rid -> (prompt, max_new, adapter)
     for i in range(int(rng.integers(4, 8))):
@@ -301,7 +317,10 @@ def _run_chaos(seed: int, params, draft, adapters) -> None:
         live = [r for r in expected if r not in terminal]
         if live and rng.integers(8) == 0:
             engine.cancel(str(rng.choice(live)))
-        for req in engine.step():
+        for req in (
+            controller.step() if controller is not None
+            else engine.step()
+        ):
             assert req.rid not in terminal, (seed, req.rid, "double terminal")
             assert req.status in TERMINAL, (seed, req.rid, req.status)
             terminal[req.rid] = req.status
@@ -352,6 +371,12 @@ def _run_chaos(seed: int, params, draft, adapters) -> None:
             len(r.tokens) for r in engine.completed if r.status == "ok"
         )
         assert engine.ledger.goodput_tokens == ok_tokens, (seed, kw)
+    if controller is not None:
+        # The control loop ran every step; whatever it retuned, the
+        # oracle pins above already proved the streams unmoved.
+        assert controller.polls == steps, (seed, kw)
+        # Only the controller retunes in this arm: the counters agree.
+        assert controller.retunes_applied == engine.retunes, (seed, kw)
 
 
 def test_engine_fault_chaos_smoke():
@@ -539,6 +564,24 @@ def _run_fleet_chaos_impl(seed: int, params, adapters, root: str) -> None:
         journal_dir=journal_dir,
         journal_every=int(rng.choice([2, 5])) if durable else None,
     )
+    # Goodput controller riding the fleet chaos (workloads/control.py):
+    # randomized ON whenever the fleet ledger is armed (so never across
+    # the scheduled restart — the controller, like the ledger, is
+    # per-process state).  These draftless superstep-1 replicas give it
+    # nothing to retune, which is itself the pin: the control loop
+    # polls through failovers, health drains and live resizes without
+    # actuating, and every oracle below holds bit-identically —
+    # attach-but-inert is free under chaos.
+    controller = None
+    if fleet_ledger is not None and rng.integers(2):
+        from workloads.backoff import Backoff
+        from workloads.control import GoodputController
+
+        instant = Backoff(base_s=1e-6, max_s=1e-6, jitter=0.0)
+        controller = GoodputController(
+            fleet, min_sample_tokens=16,
+            retune_backoff=instant, wfq_backoff=instant,
+        )
     names = [None] + (sorted(adapters) if use_adapters else [])
     expected = {}
     terminal_frs: dict = {}  # rid -> FleetRequest (survives the restart)
@@ -605,7 +648,10 @@ def _run_fleet_chaos_impl(seed: int, params, adapters, root: str) -> None:
                 adapters=adapters if use_adapters else None,
             ), chip_id=f"chip-{n}")
             added = True
-        for fr in fleet.step():
+        for fr in (
+            controller.step() if controller is not None
+            else fleet.step()
+        ):
             assert fr.rid not in terminal, (seed, fr.rid, "double terminal")
             assert fr.status in TERMINAL, (seed, fr.rid, fr.status)
             terminal[fr.rid] = fr.status
@@ -675,6 +721,11 @@ def _run_fleet_chaos_impl(seed: int, params, adapters, root: str) -> None:
             len(r.tokens) for r in fleet.completed if r.status == "ok"
         )
         assert fleet_ledger.goodput_tokens == ok_tokens, (seed, verdict)
+    if controller is not None:
+        assert controller.polls == steps, (seed, controller.states())
+        # Nothing here is retunable (no drafts, superstep ceilings at
+        # 1): the control loop must have observed without actuating.
+        assert controller.retunes_applied == 0, (seed, controller.states())
     fleet.close()
 
 

@@ -89,6 +89,12 @@ TRACKED_UP = [
     "kvsched_vs_replica_tokens_per_sec",
     "kvsched_busy_fraction",
     "kvsched_goodput_fraction",
+    # Goodput-optimal control plane: the controlled/static throughput
+    # ratio on the seeded mis-calibrated spec stream (streams
+    # bit-identical by construction, so a drop is the control loop
+    # regressing), and the controlled arm's ledger goodput verdict.
+    "ctrl_vs_static_tokens_per_sec",
+    "ctrl_goodput_fraction",
     # Device-time profiling: the device-busy share of every charged
     # wall window under the profiled serve stream — a drop means host
     # stalls started eating the chip-seconds the ledger charges.
@@ -174,6 +180,11 @@ TRACKED_DOWN = [
     # verify + device put) hibernated sessions pay to come back.
     "durable_restore_ms",
     "kv_disk_reload_ms",
+    # Goodput-optimal control plane: the controller's metered poll tax
+    # as a share of controlled-run wall clock (streams bit-identical
+    # controller on/off by construction, so a rise is pure control-loop
+    # cost creeping between fleet steps).
+    "ctrl_overhead_pct",
 ]
 
 # The serving keys whose thresholds derive from the artifact's own
@@ -186,6 +197,7 @@ SPREAD_GUARDED = set(TRACKED_DOWN) | {
     "selfheal_capacity_recovered",
     "prefix_serve_speedup",
     "kv_multiturn_speedup",
+    "ctrl_vs_static_tokens_per_sec",
 }
 
 

@@ -124,6 +124,12 @@ class AutoscaleSignals:
     # start makes adding a replica cheap, so running low on pages is
     # itself scale-up territory (page_low_watermark=).
     free_page_fraction: float | None = None
+    # Fleet-wide wasted-chip-time fraction (1 - ledger goodput
+    # fraction; the GoodputController's EWMA when one is feeding
+    # ``waste_fraction_hint``, the instantaneous fleet-ledger read
+    # otherwise).  None while no ``waste_budget=`` is set or no ledger
+    # has accounted tokens — never an input on its own.
+    waste_fraction: float | None = None
 
 
 class FleetAutoscaler:
@@ -161,6 +167,7 @@ class FleetAutoscaler:
         preempt_class: str = "bulk",
         preempt_batch: int = 2,
         page_low_watermark: float | None = None,
+        waste_budget: float | None = None,
         probe: tuple[list[int], int] = ([1, 2, 3], 4),
         probe_oracle: list[int] | None = None,
         probe_max_steps: int = 400,
@@ -219,6 +226,12 @@ class FleetAutoscaler:
                 f"page_low_watermark must be in (0, 1) or None (off), "
                 f"got {page_low_watermark}"
             )
+        if waste_budget is not None and not 0.0 < waste_budget < 1.0:
+            raise ValueError(
+                f"waste_budget must be in (0, 1) or None (off) — the "
+                f"tolerated fraction of charged chip-time going to "
+                f"waste, got {waste_budget}"
+            )
         prompt, new = probe
         if not prompt or new < 1:
             raise ValueError(
@@ -244,6 +257,21 @@ class FleetAutoscaler:
             None if page_low_watermark is None
             else float(page_low_watermark)
         )
+        # Waste-budget SLO (the goodput control plane's seam 3): with a
+        # budget set and a fleet ledger armed, scale-up is HELD while
+        # the measured waste fraction exceeds it (more replicas
+        # multiply waste — the degradation ladder engages instead, and
+        # the GoodputController's retunes attack the waste itself),
+        # and the scale-down streak relaxes to one clear poll while
+        # waste sits comfortably inside the budget (goodput headroom
+        # means capacity above the floor is pure
+        # autoscale_overprovision_chip_s).  A GoodputController feeds
+        # its EWMA-smoothed view through ``waste_fraction_hint``;
+        # without one the instantaneous fleet-ledger read is used.
+        self.waste_budget = (
+            None if waste_budget is None else float(waste_budget)
+        )
+        self.waste_fraction_hint: float | None = None
         # Separate up/down hysteresis from the shared backoff policy:
         # derive() decorrelates the jitter per direction, consecutive
         # spawn failures escalate the up-gate, repeated downs space out.
@@ -303,6 +331,8 @@ class FleetAutoscaler:
         self.spawn_failures = 0
         self.brownouts = 0
         self.preemptions_total = 0
+        self.waste_holds = 0  # scale-up-held-by-waste-budget windows
+        self._waste_hold_open = False
         self.decisions: dict[str, int] = {}
         self.recover_s: list[float] = []  # breach -> clear windows
         self.overprovision_chip_s = 0.0
@@ -352,6 +382,12 @@ class FleetAutoscaler:
             "max_replicas": self.max_replicas,
             "admission_factor": self.fleet.admission_factor,
             "parked_classes": sorted(self.fleet.parked_classes),
+            "waste_budget": self.waste_budget,
+            "waste_fraction": (
+                None if self.last_signals is None
+                else self.last_signals.waste_fraction
+            ),
+            "waste_holds": self.waste_holds,
         }
 
     # ---- capacity accounting ---------------------------------------------
@@ -483,10 +519,55 @@ class FleetAutoscaler:
             or burn > sev * self.burn_high
             or (page_low and page_frac < wm / sev)
         )
+        # Wasted-chip-time fraction (waste_budget=): prefer the
+        # controller's EWMA hint (smoothed over its own poll windows),
+        # fall back to the instantaneous fleet-ledger read.  None
+        # until something has accounted tokens — an idle fleet must
+        # not hold or relax anything on zero evidence.
+        waste_frac = None
+        if self.waste_budget is not None:
+            if self.waste_fraction_hint is not None:
+                waste_frac = max(
+                    0.0, min(1.0, float(self.waste_fraction_hint))
+                )
+            else:
+                led = getattr(fleet, "ledger", None)
+                if led is not None and getattr(
+                    led, "tokens_accounted", 0
+                ):
+                    waste_frac = max(0.0, min(
+                        1.0, 1.0 - float(led.goodput_fraction)
+                    ))
         return AutoscaleSignals(
             qw_p99_s=qw_p99, depth_per_replica=depth_per, burn=burn,
             breach=breach, clear=clear, severe=severe,
             free_page_fraction=page_frac,
+            waste_fraction=waste_frac,
+        )
+
+    # ---- waste-budget SLO (goodput control plane seam 3) ---------------
+
+    def _waste_over(self, sig: AutoscaleSignals) -> bool:
+        """Scale-up-hold territory: measured waste exceeds the budget
+        — a new replica would burn its chip-time the same way, so
+        capacity must not grow into it (the ladder and the
+        controller's retunes attack the waste instead)."""
+        return (
+            self.waste_budget is not None
+            and sig.waste_fraction is not None
+            and sig.waste_fraction > self.waste_budget
+        )
+
+    def _waste_headroom(self, sig: AutoscaleSignals) -> bool:
+        """Eager-scale-down territory: waste comfortably inside the
+        budget (the same clear_fraction hysteresis band the other
+        signals use) — goodput headroom means replicas above the
+        floor are accumulating pure overprovision chip-seconds."""
+        return (
+            self.waste_budget is not None
+            and sig.waste_fraction is not None
+            and sig.waste_fraction
+            <= self.waste_budget * self.clear_fraction
         )
 
     # ---- actuation: scale up --------------------------------------------
@@ -729,23 +810,16 @@ class FleetAutoscaler:
 
     def _preempt_some(self, now: float) -> int:
         """Park up to ``preempt_batch`` running preempt-class streams
-        (deterministic order: replica index, then rid insertion
-        order) — their prefix pages push to the host tier and the
-        rids requeue uncharged for post-spike resumption."""
+        in VICTIM-SCORED order (``Fleet.preempt_candidates``:
+        ascending goodput-per-retained-page, so the stream that frees
+        the most KV pages per token thrown away parks first; without
+        page pools the scores all tie at 0 and the old deterministic
+        replica-index/insertion order applies) — their prefix pages
+        push to the host tier and the rids requeue uncharged for
+        post-spike resumption."""
         fleet = self.fleet
         preempted = 0
-        with fleet._lock:
-            targets = []
-            for rep in fleet.replicas:
-                if rep.state == "dead":
-                    continue
-                for rid in rep.rids:
-                    fr = fleet._reqs.get(rid)
-                    if (
-                        fr is not None and not fr.done
-                        and fr.slo_class == self.preempt_class
-                    ):
-                        targets.append(rid)
+        targets = fleet.preempt_candidates(self.preempt_class)
         for rid in targets:
             if preempted >= self.preempt_batch:
                 break
@@ -832,14 +906,33 @@ class FleetAutoscaler:
         if sig.breach:
             self._clear_streak = 0
             self._downs_in_row = 0
-            if not self._try_scale_up(now):
+            scaled = False
+            if self._waste_over(sig):
+                # Don't scale up into measured waste: a replica added
+                # now multiplies the burn.  Hold capacity, let the
+                # ladder shed/park while the retunes fix the waste.
+                if not self._waste_hold_open:
+                    self._waste_hold_open = True
+                    self.waste_holds += 1
+                    self._decide("waste_hold")
+                    self._event(
+                        "waste_hold", "",
+                        f"waste {sig.waste_fraction:.2f} > budget "
+                        f"{self.waste_budget:g}: scale-up held, "
+                        f"ladder engages", t=now,
+                    )
+            else:
+                scaled = self._try_scale_up(now)
+            if not scaled:
                 self._ladder_up(now, sig.severe)
         elif sig.clear:
+            self._waste_hold_open = False
             self._clear_streak += 1
-            if (
-                self._clear_streak >= self.down_consecutive
-                and now >= self._gate_down
-            ):
+            need = (
+                1 if self._waste_headroom(sig)
+                else self.down_consecutive
+            )
+            if self._clear_streak >= need and now >= self._gate_down:
                 self._try_scale_down(now)
         else:
             # The hysteresis band between clear and breach: hold.

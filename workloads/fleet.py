@@ -379,6 +379,41 @@ class Replica:
             demand += max(1, int(ctrl.pages_needed(remaining)))
         return int(ctrl.used_pages) + demand
 
+    # Pages of handicap a fully-wasteful replica carries in the
+    # page-granular load view — enough to steer marginal dispatches
+    # off a replica burning its chip-time, small enough that real
+    # free-page deltas still dominate.
+    _GOODPUT_PENALTY_PAGES = 4
+
+    def goodput_penalty(self) -> int:
+        """Ledger-informed handicap: (1 - goodput_fraction) scaled to
+        pages.  0 without an armed per-engine chip-time ledger, and 0
+        until the ledger has accounted any tokens — an idle fleet must
+        not dispatch differently just because a ledger is attached."""
+        led = getattr(self.engine, "ledger", None)
+        if led is None or not getattr(led, "tokens_accounted", 0):
+            return 0
+        try:
+            goodput = float(led.goodput_fraction)
+        except Exception:
+            return 0
+        return int(round(
+            (1.0 - max(0.0, min(1.0, goodput)))
+            * self._GOODPUT_PENALTY_PAGES
+        ))
+
+    def dispatch_score(self, *, page_scheduling: bool = False) -> int:
+        """THE routing scalar — the one seam the router and the
+        goodput controller share.  Request-count fleets score the
+        bucket-weighted ``load()``; page-scheduled fleets score pages
+        held + pages the queued work will claim (``page_load()``) plus
+        the ledger's goodput handicap, so a replica burning chip-time
+        on waste stops winning marginal dispatches.  Pinned unchanged
+        against the two pre-unification paths by tests/test_fleet.py."""
+        if page_scheduling:
+            return self.page_load() + self.goodput_penalty()
+        return self.load()
+
     @property
     def idle(self) -> bool:
         return self.engine.idle
@@ -895,28 +930,12 @@ class Fleet:
             total = (total or 0) + free + rep.host_free_pages()
         return total
 
-    # Pages of handicap a fully-wasteful replica carries in the
-    # page-granular load view — enough to steer marginal dispatches
-    # off a replica burning its chip-time, small enough that real
-    # free-page deltas still dominate.
-    _GOODPUT_PENALTY_PAGES = 4
+    # Back-compat alias: the penalty logic moved onto Replica (the
+    # dispatch_score unification); the fleet-side name stays callable.
+    _GOODPUT_PENALTY_PAGES = Replica._GOODPUT_PENALTY_PAGES
 
     def _goodput_penalty(self, rep: Replica) -> int:
-        """Ledger-informed handicap: (1 - goodput_fraction) scaled to
-        pages.  0 without an armed per-engine chip-time ledger, and 0
-        until the ledger has accounted any tokens — an idle fleet must
-        not dispatch differently just because a ledger is attached."""
-        led = getattr(rep.engine, "ledger", None)
-        if led is None or not getattr(led, "tokens_accounted", 0):
-            return 0
-        try:
-            goodput = float(led.goodput_fraction)
-        except Exception:
-            return 0
-        return int(round(
-            (1.0 - max(0.0, min(1.0, goodput)))
-            * self._GOODPUT_PENALTY_PAGES
-        ))
+        return rep.goodput_penalty()
 
     def publish_stats(self, path: str | None = None) -> str | None:
         """Publish each replica's live signals — free/total KV pages,
@@ -1313,6 +1332,46 @@ class Fleet:
                 self.generated_tokens += rep.engine.generated_tokens - g0
                 return got
             return False
+
+    def preempt_candidates(self, slo_class: str) -> list[str]:
+        """Running ``slo_class`` rids in preemption-VICTIM order:
+        ascending goodput-per-retained-page — tokens the stream has
+        delivered so far over the KV pages it uniquely retains
+        (``ServeEngine.retained_pages``: RadixKV/fork-shared pages
+        count 1/refcount).  The ladder's preempt step walks this order
+        so the request that frees the most pages per token thrown away
+        parks first; a rid retaining no pages (dispatched but never
+        admitted) scores 0 — the cheapest possible victim, nothing is
+        lost parking it.  Ties (and engines without page pools, which
+        all score 0) fall back to the old deterministic order: replica
+        index, then rid insertion order — so the scored ladder
+        degrades to exactly the unscored one."""
+        with self._lock:
+            scored: list[tuple[float, int, int, str]] = []
+            seq = 0
+            for rep in self.replicas:
+                if rep.state == DEAD:
+                    continue
+                for rid, ereq in rep.rids.items():
+                    fr = self._reqs.get(rid)
+                    if fr is None or fr.done or fr.slo_class != slo_class:
+                        continue
+                    emitted = len(fr.tokens) + len(
+                        getattr(ereq, "tokens", ()) or ()
+                    )
+                    pages = 0.0
+                    fn = getattr(rep.engine, "retained_pages", None)
+                    if fn is not None:
+                        try:
+                            pages = float(fn(getattr(ereq, "rid", rid)))
+                        except Exception:  # noqa: BLE001 — scoring must
+                            # never block a preemption the ladder needs.
+                            pages = 0.0
+                    score = emitted / pages if pages > 0 else 0.0
+                    scored.append((score, rep.index, seq, rid))
+                    seq += 1
+            scored.sort(key=lambda t: (t[0], t[1], t[2]))
+            return [t[3] for t in scored]
 
     def preempt(self, rid: str) -> bool:
         """Preemption-via-offload (degradation ladder step 2): pull one
@@ -1864,18 +1923,16 @@ class Fleet:
         t0 = time.perf_counter()
         now = t0
         dispatchable = [r for r in self.replicas if r.dispatchable]
-        if self.page_scheduling:
-            # Page-granular view: pages held + pages the queued work
-            # will claim, with a small penalty on replicas whose
-            # chip-time ledger shows wasted work — free pages, radix
-            # match depth (the Router's measured affinity) and goodput
-            # replace the request count as the dispatch currency.
-            loads = {
-                r.index: r.page_load() + self._goodput_penalty(r)
-                for r in dispatchable
-            }
-        else:
-            loads = {r.index: r.load() for r in dispatchable}
+        # One scoring seam for both dispatch currencies
+        # (Replica.dispatch_score): request-count least-loaded, or —
+        # page-scheduled — pages held + pages the queued work will
+        # claim plus the ledger's goodput handicap, so free pages,
+        # radix match depth (the Router's measured affinity) and
+        # goodput replace the request count as the dispatch currency.
+        loads = {
+            r.index: r.dispatch_score(page_scheduling=self.page_scheduling)
+            for r in dispatchable
+        }
         entries = [fr for fr in self.queue if not fr.done]
         self.queue.clear()
         order = (
@@ -2787,6 +2844,7 @@ class FleetServer:
     def __init__(
         self, fleet: Fleet, port: int = 0, poll_s: float = 0.002,
         supervisor=None, autoscaler=None, profiler=None,
+        controller=None,
     ):
         self.fleet = fleet
         self.port = port
@@ -2799,6 +2857,11 @@ class FleetServer:
         # fleet's) and /healthz reports the control-loop state too.
         self.supervisor = supervisor
         self.autoscaler = autoscaler
+        # Optional GoodputController (workloads/control.py): outranks
+        # both for the driver loop (its serve_forever wraps whatever
+        # driver it was built over — heal and scale before retune) and
+        # /healthz reports the control-loop state.
+        self.controller = controller
         # Optional ProfileSession (workloads/profiler.py): arms the
         # /profile endpoints for live device-trace capture.
         self.profiler = profiler
@@ -2812,6 +2875,7 @@ class FleetServer:
         fleet, poll_s, stop = self.fleet, self.poll_s, self._stop
         supervisor = self.supervisor
         autoscaler = self.autoscaler
+        controller = self.controller
         profiler = self.profiler
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -2890,6 +2954,8 @@ class FleetServer:
                     health["supervisor"] = supervisor.states()
                 if autoscaler is not None:
                     health["autoscaler"] = autoscaler.states()
+                if controller is not None:
+                    health["control"] = controller.states()
                 if getattr(fleet, "ledger", None) is not None:
                     # Chip-time accounting on the liveness endpoint:
                     # busy/goodput fractions + the per-waste-class
@@ -3042,7 +3108,9 @@ class FleetServer:
             ("", self.port), Handler
         )
         self.port = self._httpd.server_address[1]
-        if self.autoscaler is not None:
+        if self.controller is not None:
+            driver = self.controller.serve_forever
+        elif self.autoscaler is not None:
             driver = self.autoscaler.serve_forever
         elif self.supervisor is not None:
             driver = self.supervisor.serve_forever

@@ -533,6 +533,52 @@ class FleetLedger:
             "per_replica": per_replica,
         }
 
+    def class_economics(self) -> dict:
+        """The STABLE per-SLO-class economics query (the
+        GoodputController's input; callers used to re-derive this from
+        raw snapshot dicts): for every class that has terminal-
+        classified tokens — goodput and waste token counts, the
+        chip-seconds attributed to the class by phase, and the
+        headline goodput-per-chip-second the WFQ re-weighter ranks
+        classes by.
+
+        Attribution model (documented like ``waste_chip_s``'s): the
+        replica ledgers know phase seconds but not classes, and the
+        fleet knows classes but not seconds — so each class is charged
+        the fleet's busy (non-idle) phase seconds scaled by its share
+        of all terminal-classified tokens.  An estimate, not a
+        measurement: it assumes classes cost comparable chip-time per
+        token.  Zero-safe: no classified tokens or no charged seconds
+        yields zero shares and a 0.0 rate (never a division error)."""
+        snap = self.snapshot()
+        busy_phase_s = {
+            p: s for p, s in snap["phase_s"].items() if p != "idle"
+        }
+        busy_s = sum(busy_phase_s.values())
+        classified = {
+            cls: counts["goodput"] + counts["waste"]
+            for cls, counts in snap["per_class"].items()
+        }
+        total = sum(classified.values())
+        out: dict[str, dict] = {}
+        for cls, counts in snap["per_class"].items():
+            share = classified[cls] / total if total > 0 else 0.0
+            chip_s = busy_s * share
+            out[cls] = {
+                "goodput_tokens": counts["goodput"],
+                "waste_tokens": counts["waste"],
+                "token_share": round(share, 6),
+                "chip_s": round(chip_s, 6),
+                "chip_s_by_phase": {
+                    p: round(s * share, 6)
+                    for p, s in busy_phase_s.items()
+                },
+                "goodput_per_chip_s": round(
+                    counts["goodput"] / chip_s, 3
+                ) if chip_s > 0 else 0.0,
+            }
+        return out
+
     def healthz(self) -> dict:
         """The /healthz-sized summary: fractions + per-waste-class
         token and estimated chip-second totals."""

@@ -540,6 +540,57 @@ AUTOSCALER_METRICS: tuple[MetricSpec, ...] = (
     ),
 )
 
+# Goodput-controller families (workloads/control.py; ControlObserver
+# below).  Same three-consumer contract as the other catalogs:
+# bind_registry, the lint test, and the rendered docs/OBSERVABILITY.md
+# catalog all read this spec.
+CONTROL_METRICS: tuple[MetricSpec, ...] = (
+    MetricSpec(
+        "control_decisions_total", "counter", ("controller", "action"),
+        "goodput-control decisions by action (retune / wfq_reweight / "
+        "hold) — the audit trail of every ledger-driven actuation the "
+        "online retuning loop took",
+    ),
+    MetricSpec(
+        "control_retunes_total", "counter", ("controller",),
+        "ServeEngine.retune() transitions the controller applied "
+        "(spec_breakeven shifts, superstep_k / spec_superstep_k steps "
+        "— each drained in-flight state first, so greedy streams stay "
+        "bit-identical across the knob move)",
+    ),
+    MetricSpec(
+        "control_wfq_reweights_total", "counter", ("controller",),
+        "live Fleet.wfq_weights updates from measured per-class "
+        "goodput-per-chip-second (operator weights remain the floor; "
+        "wasteful classes stop buying dispatch credit)",
+    ),
+    MetricSpec(
+        "control_dropped_events_total", "counter", ("controller",),
+        "control-timeline events the bounded ring evicted unread — "
+        "the merged trace's supervisor lane is silently missing "
+        "exactly this many actuations",
+    ),
+    MetricSpec(
+        "control_goodput_fraction", "gauge", ("controller",),
+        "the controller's EWMA-smoothed view of the fleet's goodput "
+        "fraction — the signal the retune/reweight/waste-budget "
+        "decisions read (scrape-time; absent until the ledger has "
+        "accounted a measurable delta)",
+    ),
+    MetricSpec(
+        "control_spec_rejected_fraction", "gauge", ("controller",),
+        "EWMA share of newly-accounted device work going to rejected "
+        "speculative drafts — the speculation-retune input "
+        "(scrape-time; absent until measured)",
+    ),
+    MetricSpec(
+        "control_overdecode_fraction", "gauge", ("controller",),
+        "EWMA share of newly-accounted device work going to "
+        "overdecode (chained superstep chunks past retirement) — the "
+        "superstep-retune input (scrape-time; absent until measured)",
+    ),
+)
+
 # Chip-time ledger families (workloads/ledger.py; docs/OBSERVABILITY.md
 # "Chip-time ledger, goodput & postmortems").  Same three-consumer
 # contract as the other catalogs: the engine/fleet bridges push them
@@ -1776,6 +1827,112 @@ class AutoscalerObserver:
             if delta:
                 reg.inc(
                     "autoscaler_decisions_total",
+                    {**labels, "action": action}, delta,
+                )
+                self._pushed[key] = float(total)
+
+
+class ControlObserver:
+    """Goodput-controller Prometheus bridge (workloads/control.py):
+    actuation counters and the EWMA signal gauges, NEXT TO the fleet,
+    supervisor, autoscaler and per-replica engine series on one shared
+    registry.
+
+    Same discipline as the other bridges: inert (host counters only,
+    never control state), jax-free, counters pushed as deltas against
+    the controller's running totals at each ``poll()``."""
+
+    def __init__(self, *, name: str = "0"):
+        self.name = name
+        self._registry = None
+        self._labels: dict = {}
+        self._controller = None
+        self._pushed: dict[str, float] = {}
+
+    # Scrape-time readers; ``e`` is the bound GoodputController (the
+    # lint's reader-regex contract shared with the other bridges).
+    # EWMA gauges emit NO sample until the signal has been measured —
+    # a 0.0 placeholder would read as "perfect waste" on dashboards.
+    _CONTROL_GAUGE_READERS = {
+        "control_goodput_fraction": lambda e: (
+            [] if e.goodput_fraction_ewma is None
+            else [({}, float(e.goodput_fraction_ewma))]
+        ),
+        "control_spec_rejected_fraction": lambda e: (
+            [] if e.spec_rejected_fraction_ewma is None
+            else [({}, float(e.spec_rejected_fraction_ewma))]
+        ),
+        "control_overdecode_fraction": lambda e: (
+            [] if e.overdecode_fraction_ewma is None
+            else [({}, float(e.overdecode_fraction_ewma))]
+        ),
+    }
+
+    # Counter family -> GoodputController attribute with the running
+    # total.
+    _CONTROL_COUNTERS = {
+        "control_retunes_total": "retunes_applied",
+        "control_wfq_reweights_total": "wfq_reweights",
+        "control_dropped_events_total": "dropped_events",
+    }
+
+    def bind_registry(self, reg, labels: dict | None = None) -> None:
+        self._registry = reg
+        self._labels = dict(labels or {})
+        self._labels.setdefault("controller", self.name)
+        for m in CONTROL_METRICS:
+            if m.type == "histogram":
+                reg.describe(m.name, m.help, buckets=SERVE_SECONDS_BUCKETS)
+            else:
+                reg.describe(m.name, m.help)
+        for name, reader in self._CONTROL_GAUGE_READERS.items():
+            reg.register_gauge(
+                name, lambda reader=reader: self._gauge(reader),
+                key=f"controller:{self.name}",
+            )
+
+    def unbind_registry(self) -> None:
+        reg, self._registry = self._registry, None
+        if reg is None:
+            return
+        for name in self._CONTROL_GAUGE_READERS:
+            reg.unregister_gauge(name, key=f"controller:{self.name}")
+        self._controller = None
+
+    def _gauge(self, value_fn) -> list[tuple[dict, float]]:
+        ctrl = self._controller
+        if ctrl is None:
+            return []
+        try:
+            return [
+                ({**self._labels, **labels}, float(v))
+                for labels, v in value_fn(ctrl)
+            ]
+        except Exception:
+            return []  # a gauge must never fail a scrape mid-teardown
+
+    # ---- controller-facing hooks ----------------------------------------
+
+    def _bind(self, controller) -> None:
+        self._controller = controller
+
+    def _control_poll_end(self, controller) -> None:
+        reg = self._registry
+        if reg is None:
+            return
+        labels = self._labels
+        for metric, attr in self._CONTROL_COUNTERS.items():
+            total = float(getattr(controller, attr, 0))
+            delta = total - self._pushed.get(metric, 0.0)
+            if delta:
+                reg.inc(metric, labels, delta)
+                self._pushed[metric] = total
+        for action, total in controller.decisions.items():
+            key = f"control_decisions_total:{action}"
+            delta = float(total) - self._pushed.get(key, 0.0)
+            if delta:
+                reg.inc(
+                    "control_decisions_total",
                     {**labels, "action": action}, delta,
                 )
                 self._pushed[key] = float(total)

@@ -3843,6 +3843,273 @@ def measure_kv_sched(scale: BenchScale) -> dict:
 measure_kvsched = measure_kv_sched
 
 
+def measure_goodput_ctrl(scale: BenchScale) -> dict:
+    """Goodput-optimal control plane (docs/SERVING.md "Goodput-optimal
+    control"): the SAME seeded oversubscribed mixed-class stream run
+    CONTROLLED (``GoodputController`` polling the fleet ledger between
+    steps, retuning speculation as measured waste burn demands and
+    re-weighting WFQ from per-class economics) vs STATIC (the same
+    fleet, knobs frozen at their construction values), as interleaved
+    repeats.  The fleet is built mis-calibrated on purpose: auto-spec
+    engines whose draft weights share nothing with the target
+    (acceptance ~ chance) and whose ``spec_breakeven`` starts at the
+    slot count, so every dispatch speculates and the ledger charges
+    heavy ``spec_rejected`` waste — drafted-and-verified device work
+    that delivers almost nothing.  The controller's hill-climb walks
+    ``spec_breakeven`` down until the engines stop paying for
+    speculation; the static arm burns the waste forever.
+
+    Every pair's greedy streams are ASSERTED bit-identical — greedy
+    speculative decoding is exact by construction and a retune drains
+    all pipelined/fused state through the mode-boundary rules before a
+    knob moves — so the published ratio prices pure control:
+
+      * ``ctrl_vs_static_tokens_per_sec`` — the headline ratio
+        (controlled / static delivered-token rate), median with
+        cross-run pooled min/max.
+      * ``ctrl_goodput_fraction`` vs ``ctrl_static_goodput_fraction``
+        — the fleet ledger's verdict on each arm (the controller's
+        whole job is the gap).
+      * ``ctrl_retunes_applied`` — knob moves the hill-climb landed
+        (median per controlled run).
+      * ``ctrl_overhead_pct`` — the poll tax: a DEAD-BANDED controller
+        (thresholds it can never cross, so it reads the ledger every
+        step and actuates nothing) runs the tripled stream with its
+        streams asserted bit-identical to the bare fleet's, and the
+        published number is its metered poll seconds as a share of the
+        run's wall clock (polls are strictly additive to the fleet
+        step, and the meter resolves a tax an A/B wall-clock delta
+        would drown in noise).  The bar is <= 2%.
+    """
+    import statistics
+
+    from .backoff import Backoff
+    from .control import GoodputController
+    from .fleet import Fleet
+    from .ledger import ChipTimeLedger, FleetLedger
+    from .serve import ServeEngine
+
+    batch, ps = scale.batch, scale.page_size
+    chunk = ps
+    gamma = 4
+    max_new_hi = 1 + 3 * chunk
+    prompt_max = 2 * ps
+    longest = prompt_max + max_new_hi + (gamma + 1) * 2
+    config = ModelConfig(
+        vocab_size=scale.vocab, d_model=scale.d_model,
+        n_heads=scale.n_heads, n_layers=scale.n_layers, d_ff=scale.d_ff,
+        max_seq_len=longest + 2 * chunk,
+    )
+    params = jax.tree.map(
+        lambda w: w.astype(config.dtype),
+        init_params(config, jax.random.PRNGKey(0)),
+    )
+    # The mis-calibration: a draft that never trained with the target
+    # (independent init) drafts tokens the verifier rejects at ~chance,
+    # so speculation is almost pure spec_rejected burn.
+    bad_draft = jax.tree.map(
+        lambda w: w.astype(config.dtype),
+        init_params(config, jax.random.PRNGKey(99)),
+    )
+    n_rep = 2
+    n_req = 4 * batch  # beyond n_rep * batch slots: oversubscribed
+    key = jax.random.PRNGKey(23)
+    reqs = []
+    for i in range(n_req):
+        plen = 1 + ps + (i * 7) % prompt_max
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.fold_in(key, i), (min(plen, prompt_max),), 0,
+            config.vocab_size, jnp.int32,
+        )]
+        new = 1 + chunk + (i % 3) * chunk
+        cls = "interactive" if i % 3 else "bulk"
+        reqs.append((prompt, new, cls))
+    pages_req = -(-(longest + 2 * chunk) // ps)
+    n_pages = pages_req * batch
+
+    def build_fleet() -> Fleet:
+        engines = [
+            ServeEngine(
+                params, config, slots=batch, page_size=ps, chunk=chunk,
+                prompt_bucket=ps, n_pages=n_pages,
+                draft_params=bad_draft, draft_config=config,
+                gamma=gamma, spec="auto",
+                spec_breakeven=float(batch),  # always speculate
+                ledger=ChipTimeLedger(),
+            )
+            for _ in range(n_rep)
+        ]
+        fleet = Fleet(
+            engines, chip_ids=[f"chip-{i}" for i in range(n_rep)],
+            hang_timeout_s=60.0, ledger=FleetLedger(),
+            wfq_weights={"interactive": 2.0, "bulk": 1.0},
+        )
+        for i in range(n_rep):  # warm each replica's compiles off-clock
+            fleet.submit([1 + i], 1 + chunk)
+        fleet.run()
+        fleet.drain_completed()
+        return fleet
+
+    def build_controller(fleet: Fleet, inert: bool) -> GoodputController:
+        fast = Backoff(base_s=1e-6, max_s=1e-6, jitter=0.0)
+        if inert:
+            # Dead-banded: thresholds no measured signal can cross, and
+            # a WFQ dead band no re-weight can clear — every poll reads
+            # the ledger and holds.  Default backoff cadences (the
+            # controller a production fleet would run): this arm prices
+            # the steady-state poll tax.
+            return GoodputController(
+                fleet, min_sample_tokens=16,
+                spec_reject_low=0.0, spec_reject_high=0.999,
+                overdecode_low=0.0, overdecode_high=0.999,
+                wfq_deadband=1e9,
+            )
+        return GoodputController(
+            fleet, min_sample_tokens=16,
+            spec_reject_low=0.01, spec_reject_high=0.2,
+            retune_backoff=fast, wfq_backoff=fast,
+        )
+
+    streams_by_mode: dict[str, list] = {}
+    goodput_by_mode: dict[str, list] = {}
+    retunes: list[int] = []
+    overhead_fracs: list[float] = []
+    wfq_reweights = 0
+
+    def run_arm(mode: str) -> float:
+        nonlocal wfq_reweights
+        fleet = build_fleet()
+        ctrl = (
+            None if mode in ("static", "bare")
+            else build_controller(fleet, inert=(mode == "inert"))
+        )
+        # The overhead pair ("inert" vs "bare") runs the stream three
+        # times over: the poll tax it prices sits near the run-to-run
+        # noise floor, and longer runs push that floor down.
+        arm_reqs = reqs * (3 if mode in ("inert", "bare") else 1)
+        rids = [
+            fleet.submit(p, n, slo_class=cls) for p, n, cls in arm_reqs
+        ]
+        tokens0 = fleet.generated_tokens
+        t0 = time.perf_counter()
+        if ctrl is None:
+            fleet.run()
+        else:
+            ctrl.run()
+        secs = time.perf_counter() - t0
+        rate = (fleet.generated_tokens - tokens0) / secs
+        done = {fr.rid: fr for fr in fleet.drain_completed()}
+        statuses = {fr.status for fr in done.values()}
+        if len(done) != len(arm_reqs) or statuses != {"ok"}:
+            raise RuntimeError(
+                f"goodput_ctrl bench: {len(done)} of {len(arm_reqs)} "
+                f"finished with statuses {statuses}, expected all ok"
+            )
+        streams_by_mode.setdefault(mode, []).append(
+            [list(done[rid].tokens) for rid in rids]
+        )
+        goodput_by_mode.setdefault(mode, []).append(
+            fleet.ledger.snapshot()["goodput_fraction"]
+        )
+        if mode == "controlled":
+            if ctrl.retunes_applied == 0:
+                raise RuntimeError(
+                    "goodput_ctrl bench: the controlled arm applied no "
+                    "retunes — the mis-calibrated spec stream is "
+                    "supposed to trip the spec_rejected threshold"
+                )
+            retunes.append(ctrl.retunes_applied)
+            wfq_reweights += ctrl.wfq_reweights
+        if mode == "inert":
+            if ctrl.retunes_applied:
+                raise RuntimeError(
+                    "goodput_ctrl bench: the dead-banded controller "
+                    "actuated — the overhead arm must price polling "
+                    "only"
+                )
+            # Polls are strictly additive to fleet.step(), so their
+            # metered share of the run's wall clock IS the controller
+            # tax — stable where an A/B wall-clock delta drowns in
+            # run-to-run noise at this tax's magnitude.
+            overhead_fracs.append(ctrl.poll_s / secs * 100.0)
+        fleet.close()
+        return rate
+
+    # Throwaway passes: one run per arm shape lands every program each
+    # arm dispatches (the static arm speculates at every occupancy all
+    # run; the controlled arm also reaches the plain-chunk fallback the
+    # breakeven walk lands on) in the process compile cache, so the
+    # first interleaved pair prices control, not compilation.
+    run_arm("controlled")
+    run_arm("static")
+    for mode in ("controlled", "static"):
+        streams_by_mode[mode].clear()
+        goodput_by_mode[mode].clear()
+    retunes.clear()
+    wfq_reweights = 0
+    ctrl_rates, static_rates = _interleaved_repeats(
+        lambda: run_arm("controlled"), lambda: run_arm("static")
+    )
+    for ctrl_streams, static_streams in zip(
+        streams_by_mode["controlled"], streams_by_mode["static"]
+    ):
+        if ctrl_streams != static_streams:
+            raise RuntimeError(
+                "goodput_ctrl bench: controlled streams diverged from "
+                "the no-controller oracle — a retune is supposed to "
+                "drain first and move throughput, never a token"
+            )
+    # Overhead pair: dead-banded controller vs bare fleet on the
+    # tripled stream — the interleave pins the controller-off streams
+    # bit-identical to the no-controller oracle; the tax itself comes
+    # from the controller's own poll_s meter (see run_arm).
+    _interleaved_repeats(
+        lambda: run_arm("inert"), lambda: run_arm("bare"), repeats=2,
+    )
+    for inert_streams, bare_streams in zip(
+        streams_by_mode["inert"], streams_by_mode["bare"]
+    ):
+        if inert_streams != bare_streams:
+            raise RuntimeError(
+                "goodput_ctrl bench: controller-off streams diverged "
+                "from the no-controller oracle"
+            )
+    ratios = [c / s for c, s in zip(ctrl_rates, static_rates)]
+    return {
+        "ctrl_replicas": n_rep,
+        "ctrl_requests": n_req,
+        "ctrl_tokens_per_sec": round(statistics.median(ctrl_rates), 1),
+        "ctrl_static_tokens_per_sec": round(
+            statistics.median(static_rates), 1
+        ),
+        "ctrl_vs_static_tokens_per_sec": round(
+            statistics.median(ratios), 3
+        ),
+        "ctrl_vs_static_tokens_per_sec_samples": [
+            round(r, 3) for r in ratios
+        ],
+        "ctrl_goodput_fraction": round(
+            statistics.median(goodput_by_mode["controlled"]), 3
+        ),
+        "ctrl_static_goodput_fraction": round(
+            statistics.median(goodput_by_mode["static"]), 3
+        ),
+        "ctrl_retunes_applied": int(statistics.median(retunes)),
+        "ctrl_wfq_reweights": wfq_reweights,
+        "ctrl_overhead_pct": round(statistics.median(overhead_fracs), 2),
+        "ctrl_overhead_pct_min": round(min(overhead_fracs), 2),
+        "ctrl_overhead_pct_max": round(max(overhead_fracs), 2),
+        "ctrl_overhead_pct_samples": [
+            round(o, 2) for o in overhead_fracs
+        ],
+    }
+
+
+# tools/refresh_bench_baseline.py --only control resolves the arm by
+# attribute name.
+measure_control = measure_goodput_ctrl
+
+
 def measure_durability(scale: BenchScale) -> dict:
     """Durable sessions (docs/SERVING.md "Durable sessions"): the SAME
     seeded greedy stream run two ways as interleaved repeats — an
@@ -4382,6 +4649,12 @@ def run(scale_name: str = "full", pool_with: dict | None = None) -> dict:
         sps["spec_superstep_tokens_per_sec_samples"], pool_with,
     )
     out.update(measure_multi_lora(scale))
+    ctrl = measure_goodput_ctrl(scale)
+    out.update(ctrl)
+    _publish_ratio_spread(
+        out, "ctrl_vs_static_tokens_per_sec",
+        ctrl["ctrl_vs_static_tokens_per_sec_samples"], pool_with,
+    )
     out.update(measure_profiler(scale))
     # LAST: measure_faststart enables the process-global persistent
     # compile cache — every arm before it measures the un-cached

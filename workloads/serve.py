@@ -413,6 +413,15 @@ class ServeEngine:
         # rng keys preserve the k=1 key schedule; pinned by
         # tests/test_spec_superstep.py).
         self.spec_superstep_k = spec_superstep_k
+        # Online retune ceilings (workloads/control.py GoodputController):
+        # retune() may step the k knobs DOWN from their construction-time
+        # values and back up, never above — _overshoot, max_pages and
+        # every admission-time page commitment below are sized from the
+        # constructed k, so raising past them could fault the allocator
+        # mid-scan.  `retunes` counts applied transitions.
+        self._superstep_k_max = superstep_k
+        self._spec_superstep_k_max = spec_superstep_k
+        self.retunes = 0
         self._overshoot = max(
             self.chunk * superstep_k * (2 if pipelined else 1),
             ((gamma + 1) * max(spec_lookahead, spec_superstep_k)
@@ -3250,6 +3259,108 @@ class ServeEngine:
         else:
             self.plain_mode_steps += 1
 
+    def retune(
+        self,
+        *,
+        spec_breakeven: float | None = None,
+        superstep_k: int | None = None,
+        spec_superstep_k: int | None = None,
+    ) -> dict:
+        """Online knob transition between dispatches (the
+        GoodputController's actuation seam, workloads/control.py): move
+        ``spec_breakeven`` and/or step ``superstep_k`` /
+        ``spec_superstep_k`` on a LIVE engine.  Before any knob mutates,
+        every in-flight pipelined chunk, speculative round and superstep
+        drains through the existing mode-boundary rules
+        (``_drain_all_pending``) — the host mirrors then hold exactly
+        what the device computed, so the next dispatch under the new
+        knobs proceeds from identical state and greedy streams stay
+        bit-identical across every transition (pinned by
+        tests/test_control.py).  Requests the drain retires surface
+        through the next ``step()``'s return, like cancel's.
+
+        Constraints: the k knobs may step down and back UP TO their
+        construction-time values, never above — ``_overshoot``,
+        ``max_pages`` and every admission-time page commitment were
+        sized from the constructed k, so exceeding them could fault the
+        allocator mid-scan.  ``spec_breakeven`` shifts need
+        ``spec="auto"`` (with "on"/a missing draft the threshold is
+        never consulted and a silent accept would fake an actuation).
+
+        Returns ``{knob: (old, new)}`` for the knobs that actually
+        changed (empty dict = no-op: no drain, nothing counted)."""
+        if self._closed:
+            raise EngineClosed("engine is closed; no retune")
+        changes: dict[str, tuple] = {}
+        if spec_breakeven is not None:
+            if self.spec != "auto" or self.draft_params is None:
+                raise ValueError(
+                    'spec_breakeven retune needs spec="auto" with a '
+                    "draft loaded — other modes never consult the "
+                    "threshold"
+                )
+            if spec_breakeven < 0:
+                raise ValueError(
+                    f"spec_breakeven must be >= 0, got {spec_breakeven}"
+                )
+            if float(spec_breakeven) != (
+                float(self.spec_breakeven)
+                if self.spec_breakeven is not None else None
+            ):
+                changes["spec_breakeven"] = (
+                    self.spec_breakeven, float(spec_breakeven)
+                )
+        if superstep_k is not None:
+            if not 1 <= int(superstep_k) <= self._superstep_k_max:
+                raise ValueError(
+                    f"superstep_k must be in [1, {self._superstep_k_max}] "
+                    f"(the construction-time ceiling), got {superstep_k}"
+                )
+            if int(superstep_k) != self.superstep_k:
+                changes["superstep_k"] = (
+                    self.superstep_k, int(superstep_k)
+                )
+        if spec_superstep_k is not None:
+            if not 1 <= int(spec_superstep_k) <= self._spec_superstep_k_max:
+                raise ValueError(
+                    f"spec_superstep_k must be in "
+                    f"[1, {self._spec_superstep_k_max}] (the "
+                    f"construction-time ceiling), got {spec_superstep_k}"
+                )
+            if int(spec_superstep_k) != self.spec_superstep_k:
+                changes["spec_superstep_k"] = (
+                    self.spec_superstep_k, int(spec_superstep_k)
+                )
+        if not changes:
+            return changes
+        # Drain FIRST: the k knobs route _step_impl and size dispatches,
+        # and the breakeven flips the mode decision — all of them assume
+        # no in-flight state dispatched under the old knobs.
+        self._finished_buffer.extend(self._drain_all_pending())
+        for knob, (_, new) in changes.items():
+            setattr(self, knob, new)
+        self.retunes += 1
+        return changes
+
+    def retained_pages(self, rid) -> float:
+        """Preemption-victim scoring input (the ladder's
+        goodput-per-retained-page, workloads/control.py): the KV pages
+        this request's sequences hold, each weighted by 1/refcount so a
+        page shared with live forks or RadixKV retains counts
+        fractionally — preempting the rid frees ~this many pages.  0.0
+        for rids holding no pages (queued, never admitted, or already
+        retired)."""
+        total = 0.0
+        refcounts = self.ctrl.refcounts
+        for seq, table in self.ctrl.tables.items():
+            if (
+                isinstance(seq, tuple) and len(seq) == 3
+                and seq[0] == "slot" and seq[2] == rid
+            ):
+                for page in table:
+                    total += 1.0 / max(1, refcounts.get(page, 1))
+        return total
+
     def _drain_pending_plain(self) -> list[Request]:
         """Mode-boundary handoff, plain -> spec: consume the pipelined
         plain path's in-flight chunk (syncing the host position/token
@@ -4317,6 +4428,32 @@ def _run_fleet_cli(
             f"{autoscaler.brownout_factor:g}, preempt class "
             f"{autoscaler.preempt_class!r}"
         )
+    controller = None
+    ctrl_obs = None
+    if args.control:
+        from .control import GoodputController
+
+        if args.metrics_port is not None or args.trace_out:
+            from .obs import ControlObserver
+
+            ctrl_obs = ControlObserver()
+            if args.metrics_port is not None:
+                from tpu_device_plugin.metrics import registry
+
+                ctrl_obs.bind_registry(registry)
+        # The controller wraps whatever driver is already stacked
+        # (autoscaler > supervisor > fleet): heal and scale land
+        # before each control pass reads the ledger.
+        controller = GoodputController(
+            fleet, autoscaler=autoscaler,
+            driver=(autoscaler or supervisor or fleet),
+            observer=ctrl_obs,
+        )
+        print(
+            "controller armed: ledger-driven retune/WFQ/waste-budget/"
+            "preempt scoring (inert until the ledger accounts "
+            f"{controller.min_sample_tokens}+ tokens per poll)"
+        )
     # SLO-classed traffic: --slo-mix tags every arrival with a class
     # drawn from the weighted mix; attainment is scored by the fleet's
     # default interactive/bulk targets and summarized at exit.
@@ -4363,6 +4500,7 @@ def _run_fleet_cli(
         server = FleetServer(
             fleet, args.http_port, supervisor=supervisor,
             autoscaler=autoscaler, profiler=profiler,
+            controller=controller,
         )
         port = server.start()
         print(f"fleet SSE front end: http://127.0.0.1:{port}/v1/generate")
@@ -4417,6 +4555,8 @@ def _run_fleet_cli(
             driver = autoscaler
         elif supervisor is not None:
             driver = supervisor
+        if controller is not None:
+            driver = controller  # built over the same stacked driver
         if recorder is not None:
             driver = _RecorderDriver(driver, recorder, sentry_feed)
         if profiler is not None:
@@ -4501,6 +4641,16 @@ def _run_fleet_cli(
             f"overprovision_chip_s="
             f"{round(autoscaler.overprovision_chip_s, 3)}"
         )
+    if controller is not None:
+        gp = controller.goodput_fraction_ewma
+        print(
+            f"control: retunes={controller.retunes_applied} "
+            f"wfq_reweights={controller.wfq_reweights} "
+            f"decisions={dict(sorted(controller.decisions.items()))} "
+            f"goodput_ewma="
+            f"{'n/a' if gp is None else format(gp, '.3f')} "
+            f"poll_s={controller.poll_s:.3f}"
+        )
     if fleet_ledger is not None:
         if recorder is not None:
             recorder.poll()  # final trigger sweep before the summary
@@ -4569,6 +4719,13 @@ def _run_fleet_cli(
             # reads in wall order.
             control_events = sorted(
                 control_events + list(autoscaler.events),
+                key=lambda ev: ev.t,
+            )
+        if controller is not None:
+            # Controller actuations (retunes, WFQ re-weights) join the
+            # same control-plane lane.
+            control_events = sorted(
+                control_events + list(controller.events),
                 key=lambda ev: ev.t,
             )
         n_events, n_replicas = export_fleet_trace(
@@ -4864,6 +5021,21 @@ def main(argv=None) -> int:
                         "resumption (docs/SERVING.md 'Elastic fleet & "
                         "overload protection'); --fleet N is the "
                         "starting size and must sit in [MIN, MAX]")
+    parser.add_argument("--control", action="store_true",
+                        help="with --fleet and --ledger: arm the "
+                        "goodput-optimal GoodputController "
+                        "(workloads/control.py) — a cooperative "
+                        "control loop that reads the fleet ledger's "
+                        "goodput/waste burn between steps and retunes "
+                        "speculation knobs (ServeEngine.retune), "
+                        "re-weights WFQ from measured per-class "
+                        "goodput-per-chip-second, feeds the "
+                        "autoscaler's waste budget, and scores "
+                        "preemption victims by goodput-per-retained-"
+                        "page; inert until the ledger accounts a "
+                        "measurable delta, and greedy streams are "
+                        "bit-identical controller on/off "
+                        "(docs/SERVING.md 'Goodput-optimal control')")
     parser.add_argument("--supervise", action="store_true",
                         help="with --fleet: arm the self-healing "
                         "FleetSupervisor (workloads/supervisor.py) — "
@@ -4951,6 +5123,12 @@ def main(argv=None) -> int:
             parser.error(f"--fleet {args.fleet} must sit inside "
                          f"--autoscale [{args.autoscale[0]}, "
                          f"{args.autoscale[1]}]")
+    if args.control:
+        if args.fleet is None:
+            parser.error("--control retunes a fleet; it needs --fleet N")
+        if not args.ledger:
+            parser.error("--control reads the chip-time ledger's "
+                         "goodput/waste burn; it needs --ledger")
 
     from . import lease
 
